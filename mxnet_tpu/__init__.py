@@ -1,0 +1,25 @@
+"""mxnet_tpu: a TPU-native deep learning framework.
+
+A from-scratch reimplementation of the capability surface of pre-Gluon MXNet
+(reference: KaiyuanWu/mxnet) designed TPU-first: NDArray on XLA buffers,
+Symbol -> one jitted XLA computation per executor (instead of a threaded
+per-op dependency engine), KVStore -> XLA collectives over ICI/DCN, and a
+Module/FeedForward training API that scales over a jax.sharding.Mesh.
+
+Import-compatible with ``import mxnet as mx`` usage patterns:
+    import mxnet_tpu as mx
+    data = mx.sym.Variable('data')
+    net  = mx.sym.FullyConnected(data, num_hidden=10)
+    mod  = mx.mod.Module(net, context=mx.tpu())
+"""
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
+from .attribute import AttrScope
+from .name import NameManager, Prefix
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import random
+
+__version__ = "0.1.0"

@@ -1,0 +1,74 @@
+"""Base types, errors, and dtype plumbing for the TPU-native framework.
+
+Plays the role of the reference's ``python/mxnet/base.py`` plus the small
+type-system pieces of ``include/mxnet/base.h`` (Context lives in context.py,
+TShape is plain python tuples).  There is no ctypes/C-ABI boundary here: the
+"C API" of the reference collapses into plain Python calls on top of JAX, and
+the native pieces of this framework (data pipeline) expose their own small
+ABI instead of one monolithic ``c_api.h``.
+
+Reference parity notes:
+- dtype codes follow ``include/mxnet/base.h`` / mshadow type_flag numbering so
+  saved NDArray files interoperate (0=float32, 1=float64, 2=float16,
+  3=uint8, 4=int32).  We extend with bfloat16=5 and int64=6 for TPU use.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError", "NotSupportedForTPU", "mx_real_t", "mx_uint",
+    "dtype_np_to_mx", "dtype_mx_to_np", "string_types", "numeric_types",
+]
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (parity with mxnet.base.MXNetError)."""
+
+
+class NotSupportedForTPU(MXNetError):
+    """Raised for reference features with no TPU analog (e.g. dist_async)."""
+
+
+# mx_real_t: the reference's default real type (real_t = float, fp32).
+mx_real_t = _np.float32
+mx_uint = int
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+
+try:  # bfloat16 comes from ml_dtypes via jax/numpy ecosystem
+    import ml_dtypes as _ml_dtypes
+    bfloat16 = _np.dtype(_ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    bfloat16 = None
+
+# type_flag numbering compatible with mshadow (include/mxnet/base.h) for 0..4.
+_DTYPE_NP_TO_MX = {
+    _np.dtype(_np.float32): 0,
+    _np.dtype(_np.float64): 1,
+    _np.dtype(_np.float16): 2,
+    _np.dtype(_np.uint8): 3,
+    _np.dtype(_np.int32): 4,
+}
+if bfloat16 is not None:
+    _DTYPE_NP_TO_MX[bfloat16] = 5
+_DTYPE_NP_TO_MX[_np.dtype(_np.int64)] = 6
+_DTYPE_NP_TO_MX[_np.dtype(_np.bool_)] = 7
+
+_DTYPE_MX_TO_NP = {v: k for k, v in _DTYPE_NP_TO_MX.items()}
+
+
+def dtype_np_to_mx(dtype) -> int:
+    """numpy dtype -> mshadow-compatible type flag."""
+    dtype = _np.dtype(dtype)
+    if dtype not in _DTYPE_NP_TO_MX:
+        raise MXNetError("unsupported dtype %s" % dtype)
+    return _DTYPE_NP_TO_MX[dtype]
+
+
+def dtype_mx_to_np(flag: int):
+    """mshadow-compatible type flag -> numpy dtype."""
+    if flag not in _DTYPE_MX_TO_NP:
+        raise MXNetError("unsupported type flag %d" % flag)
+    return _DTYPE_MX_TO_NP[flag]

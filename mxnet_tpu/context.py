@@ -1,0 +1,137 @@
+"""Device contexts mapped onto JAX devices.
+
+Mirrors ``include/mxnet/base.h:85-170`` (Context) and
+``python/mxnet/context.py`` of the reference, extended with the ``tpu``
+device type that is this framework's reason to exist.
+
+Mapping rules:
+- ``cpu(i)``        -> i-th JAX cpu device (XLA host platform). With
+  ``--xla_force_host_platform_device_count=N`` multiple cpu ids exist, which is
+  the analog of the reference's multi-``mx.cpu(i)`` test trick
+  (tests/python/unittest/test_multi_device_exec.py:19-32).
+- ``tpu(i)``        -> i-th accelerator device.
+- ``gpu(i)``        -> alias for accelerator too: reference scripts that say
+  ``mx.gpu(0)`` run unchanged on a TPU chip (north-star "context-string
+  change only").
+- ``cpu_pinned(i)`` -> cpu (pinned memory is meaningless under XLA host).
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
+           "num_gpus", "num_tpus"]
+
+
+class Context:
+    """Device context. Constructed as Context('tpu', 0) or via cpu()/gpu()/tpu().
+
+    Parity: Context at include/mxnet/base.h:85; python/mxnet/context.py:10.
+    """
+
+    # numbering matches the reference for 1..3; tpu is new.
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
+
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            if device_type not in Context.devstr2type:
+                raise MXNetError("unknown device type %s" % device_type)
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_type(self) -> str:
+        return Context.devtype2str[self.device_typeid]
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_typeid == other.device_typeid
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __str__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __repr__ = __str__
+
+    # -- jax integration ---------------------------------------------------
+    @property
+    def jax_device(self):
+        """Resolve to a concrete jax.Device (lazy; raises if absent)."""
+        import jax
+
+        if self.device_type in ("cpu", "cpu_pinned"):
+            devs = jax.devices("cpu")
+        else:
+            # gpu and tpu both mean "the accelerator platform".
+            devs = _accelerator_devices()
+            if not devs:  # CPU-only test environment: fall back gracefully
+                devs = jax.devices("cpu")
+        if self.device_id >= len(devs):
+            raise MXNetError(
+                "%s: device_id %d out of range (%d %s devices visible)"
+                % (self, self.device_id, len(devs), self.device_type))
+        return devs[self.device_id]
+
+    # -- `with` scoping (python/mxnet/context.py:40-58) --------------------
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        Context._default_ctx.value = self._old_ctx
+
+
+def _accelerator_devices():
+    import jax
+
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        return []
+    return [d for d in devs if d.platform != "cpu"]
+
+
+def cpu(device_id=0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id=0) -> Context:
+    """Reference-compat alias: targets the accelerator (TPU) platform."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0) -> Context:
+    return Context("tpu", device_id)
+
+
+def num_gpus() -> int:
+    return len(_accelerator_devices())
+
+
+def num_tpus() -> int:
+    return len(_accelerator_devices())
+
+
+def current_context() -> Context:
+    if not hasattr(Context._default_ctx, "value"):
+        Context._default_ctx.value = Context("cpu", 0)
+    return Context._default_ctx.value

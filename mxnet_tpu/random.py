@@ -1,0 +1,64 @@
+"""Random sampling (parity: python/mxnet/random.py, ndarray.cc:446 samplers).
+
+The reference seeds a per-device mshadow::Random resource; here a process-wide
+splittable PRNG key (jax.random) is kept, split per call.  ``seed()`` resets
+it — same contract as mx.random.seed.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .base import mx_real_t
+from .ndarray import NDArray
+
+__all__ = ["seed", "uniform", "normal", "randint", "next_key"]
+
+_state = threading.local()
+
+
+def _get_key():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+    return _state.key
+
+
+def next_key():
+    """Split and return a fresh subkey (used by Dropout/executors too)."""
+    key = _get_key()
+    _state.key, sub = jax.random.split(key)
+    return sub
+
+
+def seed(seed_state: int):
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def uniform(low=0.0, high=1.0, shape=None, ctx=None, out=None, dtype=mx_real_t):
+    shape = shape if shape is not None else (out.shape if out is not None else (1,))
+    res = jax.random.uniform(next_key(), shape, minval=low, maxval=high,
+                             dtype=jnp.dtype(dtype))
+    if out is not None:
+        out._set_data(res)
+        return out
+    return NDArray(res, ctx=ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, ctx=None, out=None, dtype=mx_real_t):
+    shape = shape if shape is not None else (out.shape if out is not None else (1,))
+    res = loc + scale * jax.random.normal(next_key(), shape, dtype=jnp.dtype(dtype))
+    if out is not None:
+        out._set_data(res)
+        return out
+    return NDArray(res, ctx=ctx)
+
+
+# reference aliases (mx.random.gaussian etc.)
+gaussian = normal
+
+
+def randint(low, high, shape=(1,), ctx=None, dtype="int32"):
+    res = jax.random.randint(next_key(), shape, low, high, dtype=jnp.dtype(dtype))
+    return NDArray(res, ctx=ctx)

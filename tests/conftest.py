@@ -1,0 +1,19 @@
+"""Test harness config: fake an 8-device TPU-like mesh on CPU.
+
+This is the analog of the reference's multi-`mx.cpu(i)` trick
+(tests/python/unittest/test_multi_device_exec.py): XLA's host platform is
+forced to expose 8 devices so sharding/collective paths run without real
+chips (SURVEY §4 "Implication for the TPU build").
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# fp64 for numeric-gradient checks (reference CPU tests run fp64 numpy refs)
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,104 @@
+"""Tests for base/context/registry/param-struct foundations."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import dtype_np_to_mx, dtype_mx_to_np, MXNetError
+from mxnet_tpu.dparam import Field, ParamStruct, parse_tuple
+from mxnet_tpu.registry import Registry
+
+
+def test_dtype_flags():
+    # reference type_flag numbering (include/mxnet/base.h)
+    assert dtype_np_to_mx(np.float32) == 0
+    assert dtype_np_to_mx(np.float64) == 1
+    assert dtype_np_to_mx(np.float16) == 2
+    assert dtype_np_to_mx(np.uint8) == 3
+    assert dtype_np_to_mx(np.int32) == 4
+    for f in range(5):
+        assert dtype_np_to_mx(dtype_mx_to_np(f)) == f
+
+
+def test_context():
+    assert mx.cpu(0) == mx.Context("cpu", 0)
+    assert mx.cpu(0) != mx.cpu(1)
+    assert mx.tpu(0).device_type == "tpu"
+    assert str(mx.gpu(2)) == "gpu(2)"
+    with mx.Context("cpu", 1):
+        assert mx.current_context() == mx.cpu(1)
+    assert mx.current_context() == mx.cpu(0)
+
+
+def test_registry():
+    reg = Registry("thing")
+
+    @reg.register("Foo")
+    class Foo:
+        pass
+
+    @reg.register
+    class Bar:
+        pass
+
+    assert reg.get("foo") is Foo
+    assert reg.get("Bar") is Bar
+    reg.alias("Foo", "F2")
+    assert reg.get("f2") is Foo
+    with pytest.raises(MXNetError):
+        reg.get("nope")
+    assert "Bar" in reg.list_names()
+
+
+def test_param_struct():
+    class ConvParam(ParamStruct):
+        kernel = Field(tuple, required=True, doc="conv kernel")
+        stride = Field(tuple, default=(1, 1), length=2)
+        num_filter = Field(int, required=True, lower=1)
+        no_bias = Field(bool, default=False)
+        layout = Field(str, default="NCHW", enum=("NCHW", "NHWC"))
+
+    p = ConvParam(kernel="(3, 3)", num_filter="64", no_bias="True")
+    assert p.kernel == (3, 3)
+    assert p.num_filter == 64
+    assert p.no_bias is True
+    assert p.stride == (1, 1)
+    with pytest.raises(MXNetError):
+        ConvParam(num_filter=1)  # kernel missing
+    with pytest.raises(MXNetError):
+        ConvParam(kernel="(3,3)", num_filter=0)  # below lower bound
+    with pytest.raises(MXNetError):
+        ConvParam(kernel="(3,3)", num_filter=1, layout="NCWH")
+    with pytest.raises(MXNetError):
+        ConvParam(kernel="(3,3)", num_filter=1, bogus=1)
+    # round-trip through string attrs (graph serialization path)
+    attrs = p.to_attrs()
+    p2 = ConvParam.from_attrs(attrs)
+    assert p2.kernel == p.kernel and p2.num_filter == p.num_filter
+
+
+def test_parse_tuple():
+    assert parse_tuple("(2, 2)") == (2, 2)
+    assert parse_tuple("[1,2,3]") == (1, 2, 3)
+    assert parse_tuple(3, length=2) == (3, 3)
+
+
+def test_attr_scope():
+    from mxnet_tpu.attribute import AttrScope
+    with AttrScope(ctx_group="stage1"):
+        attrs = AttrScope.current().get({"lr_mult": "2"})
+        assert attrs == {"ctx_group": "stage1", "lr_mult": "2"}
+        with AttrScope(mirror_stage="0"):
+            attrs = AttrScope.current().get(None)
+            assert attrs["ctx_group"] == "stage1"
+            assert attrs["mirror_stage"] == "0"
+    assert AttrScope.current().get(None) == {}
+
+
+def test_name_manager():
+    from mxnet_tpu.name import NameManager, Prefix
+    with NameManager() as nm:
+        assert nm.get(None, "fc") == "fc0"
+        assert nm.get(None, "fc") == "fc1"
+        assert nm.get("explicit", "fc") == "explicit"
+    with Prefix("net_") as nm:
+        assert nm.get(None, "fc") == "net_fc0"

@@ -1,0 +1,34 @@
+"""Random sampling tests (modeled on tests/python/unittest/test_random.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_seed_determinism():
+    mx.random.seed(7)
+    a = mx.random.uniform(0, 1, shape=(100,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.random.uniform(0, 1, shape=(100,)).asnumpy()
+    assert np.allclose(a, b)
+    c = mx.random.uniform(0, 1, shape=(100,)).asnumpy()
+    assert not np.allclose(b, c)
+
+
+def test_uniform_range():
+    mx.random.seed(0)
+    a = mx.random.uniform(-2, 3, shape=(10000,)).asnumpy()
+    assert a.min() >= -2 and a.max() < 3
+    assert abs(a.mean() - 0.5) < 0.1
+
+
+def test_normal_moments():
+    mx.random.seed(0)
+    a = mx.random.normal(1.0, 2.0, shape=(50000,)).asnumpy()
+    assert abs(a.mean() - 1.0) < 0.1
+    assert abs(a.std() - 2.0) < 0.1
+
+
+def test_out_param():
+    out = mx.nd.zeros((50,))
+    mx.random.uniform(0, 1, out=out)
+    assert out.asnumpy().max() > 0
