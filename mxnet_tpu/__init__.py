@@ -21,5 +21,10 @@ from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import random
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol, Variable, Group
+from . import executor
+from .executor import Executor
 
 __version__ = "0.1.0"

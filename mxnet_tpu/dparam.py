@@ -60,6 +60,12 @@ class Field:
         self.name = None  # filled by ParamStructMeta
 
     def coerce(self, value):
+        if value is None or (isinstance(value, str) and value.strip() == "None"):
+            # only genuinely-optional fields may hold None; required/enum
+            # fields must fail validation rather than defer to a runtime crash
+            if not self.required and self.enum is None:
+                return None
+            raise MXNetError("field %s: value None is not allowed" % self.name)
         try:
             if self.typ is bool:
                 value = parse_bool(value)

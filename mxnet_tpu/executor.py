@@ -1,0 +1,380 @@
+"""Executor: bind a Symbol to devices and run it as ONE XLA computation.
+
+This is the TPU-native replacement of the reference's GraphExecutor
+(``src/symbol/graph_executor.cc``, ``Executor::Bind`` at :1151) — SURVEY §3.2:
+the whole Init pipeline (backward pass construction, context assignment,
+memory planning, op instantiation, bulk segments) collapses into tracing the
+graph into a jax function and letting XLA compile/fuse/plan it:
+
+- ``MakeBackwardPass`` (static_graph.cc:395)  -> jax.vjp over the traced fwd
+- grad_req write/add/null (OpReqType)         -> post-vjp combine
+- memory plan + GraphStoragePool              -> XLA buffer planning/donation
+- bulk segments / cached engine ops           -> a single jitted computation
+- per-shape rebinding (Executor.reshape)      -> jit's shape-keyed compile cache
+
+Monitor callbacks (graph_executor.cc:937) run via an eager interpret mode.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context
+from .ndarray import NDArray, zeros
+from . import random as _random
+
+_ZERO_KEY = None
+
+
+def _zero_key():
+    global _ZERO_KEY
+    if _ZERO_KEY is None:
+        _ZERO_KEY = jax.random.PRNGKey(0)
+    return _ZERO_KEY
+
+
+__all__ = ["Executor", "simple_bind"]
+
+
+def _as_list(obj, names, what):
+    """Normalize list-or-dict user input to a list aligned with ``names``."""
+    if obj is None:
+        return [None] * len(names)
+    if isinstance(obj, dict):
+        return [obj.get(n) for n in names]
+    obj = list(obj)
+    if len(obj) != len(names):
+        raise MXNetError("%s: expected %d entries (%s), got %d"
+                         % (what, len(names), names, len(obj)))
+    return obj
+
+
+
+
+class _Program:
+    """Compiled form of a symbol graph: pure trace + jitted entries."""
+
+    __slots__ = ("trace", "jit_forward", "jit_fwd_bwd", "needs_rng")
+
+    def __init__(self, trace, jit_forward, jit_fwd_bwd, needs_rng):
+        self.trace = trace
+        self.jit_forward = jit_forward
+        self.jit_fwd_bwd = jit_fwd_bwd
+        self.needs_rng = needs_rng
+
+
+def _build_program(symbol, group2ctx):
+    """Flatten the symbol into an executable schedule and jit it.
+
+    Parity: the GraphExecutor Init pipeline (graph_executor.h:40-72); device
+    placement for ctx_group nodes is resolved here (AssignContext analog,
+    graph_executor.cc:391) with XLA inserting the transfers.
+    """
+    topo = symbol._topo()
+    heads = list(symbol._heads)
+    n_rng = sum(1 for n in topo if not n.is_variable and n.op.need_rng)
+    needs_rng = n_rng > 0
+    n_rng = max(n_rng, 1)
+
+    node_device = {}
+    for node in topo:
+        group = node.attrs.get("ctx_group")
+        if group and group in group2ctx:
+            try:
+                node_device[id(node)] = group2ctx[group].jax_device
+            except Exception:
+                pass
+
+    def trace(arg_values, aux_values, rng, is_train, monitor=None):
+        """Evaluate the graph; pure & jax-traceable (the 'StaticGraph run')."""
+        values = {}
+        aux_out = dict(aux_values)
+        rngs = jax.random.split(rng, n_rng) if needs_rng else None
+        rng_i = 0
+        for node in topo:
+            if node.is_variable:
+                values[(id(node), 0)] = arg_values[node.name]
+                continue
+            op = node.op
+            ins = [values[(id(c), ci)] for c, ci in node.inputs]
+            aux_names = ["%s_%s" % (node.name, a)
+                         for a in op.list_auxiliary_states()]
+            aux_in = [aux_values[a] for a in aux_names]
+            key = None
+            if op.need_rng:
+                key = rngs[rng_i]
+                rng_i += 1
+            outs, aux_updates = op.forward(ins, aux_in, is_train, key)
+            dev = node_device.get(id(node))
+            if dev is not None:
+                outs = [jax.device_put(o, dev) for o in outs]
+            for i, o in enumerate(outs):
+                values[(id(node), i)] = o
+            if aux_updates is not None:
+                for a, u in zip(aux_names, aux_updates):
+                    aux_out[a] = u
+            if monitor is not None:
+                for oname, o in zip(op.list_outputs(), outs):
+                    monitor("%s_%s" % (node.name, oname), o)
+        outputs = [values[(id(n), i)] for n, i in heads]
+        return outputs, aux_out
+
+    def fwd_bwd(arg_values, aux_values, rng, out_grads, wrt):
+        """Forward + vjp in ONE XLA computation (replaces the reference's
+        explicit Backward nodes, static_graph.cc:395)."""
+        def f(wrt_values):
+            merged = dict(arg_values)
+            merged.update(wrt_values)
+            return trace(merged, aux_values, rng, True)
+
+        (outs, aux_out), vjp_fn = jax.vjp(f, wrt)
+        if out_grads is None:  # implicit loss-layer heads: cotangent of ones
+            out_grads = [jnp.ones_like(o) for o in outs]
+        grads = vjp_fn((out_grads,
+                        jax.tree_util.tree_map(jnp.zeros_like, aux_out)))[0]
+        return outs, aux_out, grads
+
+    return _Program(trace, jax.jit(trace, static_argnames=("is_train",)),
+                    jax.jit(fwd_bwd), needs_rng)
+
+class Executor:
+    """Parity: include/mxnet/symbolic.h:323 + python/mxnet/executor.py."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self._group2ctx = group2ctx or {}
+        self._monitor_callback = None
+
+        self._arg_names = symbol.list_arguments()
+        self._out_names = symbol.list_outputs()
+        self._aux_names = symbol.list_auxiliary_states()
+
+        arg_list = _as_list(args, self._arg_names, "args")
+        if any(a is None for a in arg_list):
+            missing = [n for n, a in zip(self._arg_names, arg_list) if a is None]
+            raise MXNetError("bind: missing arguments %s" % missing)
+        self.arg_arrays = arg_list
+        self.arg_dict = dict(zip(self._arg_names, arg_list))
+
+        self.grad_arrays = _as_list(args_grad, self._arg_names, "args_grad")
+        self.grad_dict = {n: g for n, g in zip(self._arg_names, self.grad_arrays)
+                          if g is not None}
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self._arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null") for n in self._arg_names}
+        for n in self._arg_names:
+            if self._grad_req.get(n, "null") not in ("null", "write", "add"):
+                raise MXNetError("invalid grad_req %r" % self._grad_req[n])
+            if self._grad_req[n] != "null" and self.grad_dict.get(n) is None:
+                self._grad_req[n] = "null"
+
+        aux_list = _as_list(aux_states, self._aux_names, "aux_states")
+        if any(a is None for a in aux_list):
+            # allocate missing aux from inferred shapes
+            shapes = {n: a.shape for n, a in self.arg_dict.items()}
+            _, _, aux_shapes = symbol.infer_shape(**shapes)
+            if aux_shapes is None:
+                raise MXNetError("bind: cannot infer aux shapes")
+            aux_list = [a if a is not None else zeros(s, ctx=self._ctx)
+                        for a, s in zip(aux_list, aux_shapes)]
+        self.aux_arrays = aux_list
+        self.aux_dict = dict(zip(self._aux_names, aux_list))
+
+        self.outputs = [None] * len(self._out_names)
+
+        # The traced program is a pure function of (symbol, group2ctx) — NOT
+        # of this executor — and is cached on the symbol so every executor
+        # bound to the same graph shares one compile cache (the analog of
+        # GraphStoragePool sharing; also what makes repeated bind cheap).
+        # Caching bound methods here would pin the first executor's buffers.
+        cache_key = tuple(sorted((k, str(v)) for k, v in self._group2ctx.items()))
+        cache = getattr(symbol, "_jit_cache", None)
+        if cache is None:
+            cache = symbol._jit_cache = {}
+        if cache_key not in cache:
+            cache[cache_key] = _build_program(symbol, self._group2ctx)
+        self._program = cache[cache_key]
+        self._needs_rng = self._program.needs_rng
+        self._jit_forward = self._program.jit_forward
+        self._jit_fwd_bwd = self._program.jit_fwd_bwd
+
+    @property
+    def _trace(self):
+        return self._program.trace
+
+    # ------------------------------------------------------------------
+    # public API (python/mxnet/executor.py parity)
+    # ------------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        for name, arr in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError("forward: unknown argument %r" % name)
+            if isinstance(arr, NDArray):
+                self.arg_dict[name]._set_data(arr.data)
+            else:
+                self.arg_dict[name]._set_data(jnp.asarray(arr))
+        arg_values = {n: a.data for n, a in self.arg_dict.items()}
+        aux_values = {n: a.data for n, a in self.aux_dict.items()}
+        rng = _random.next_key() if self._needs_rng else _zero_key()
+        if self._monitor_callback is not None:
+            outs, aux_out = self._trace(arg_values, aux_values, rng,
+                                        is_train, monitor=self._run_monitor)
+        else:
+            outs, aux_out = self._jit_forward(arg_values, aux_values, rng,
+                                              is_train=bool(is_train))
+        for i, o in enumerate(outs):
+            self.outputs[i] = NDArray(o, ctx=self._ctx)
+        if is_train:
+            for n, a in self.aux_dict.items():
+                if aux_out[n] is not aux_values[n]:
+                    a._set_data(aux_out[n])
+        self._last_inputs = (arg_values, aux_values, rng)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if not hasattr(self, "_last_inputs"):
+            raise MXNetError("backward called before forward(is_train=True)")
+        arg_values, aux_values, rng = self._last_inputs
+        wrt_names = tuple(n for n in self._arg_names
+                          if self._grad_req.get(n, "null") != "null")
+        if not wrt_names:
+            return
+        if out_grads is None:
+            ograds = None
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ograds = [g.data if isinstance(g, NDArray) else jnp.asarray(g)
+                      for g in out_grads]
+        wrt = {n: arg_values[n] for n in wrt_names}
+        _outs, _aux, grads = self._jit_fwd_bwd(arg_values, aux_values, rng,
+                                               ograds, wrt)
+        for n in wrt_names:
+            g = grads[n]
+            tgt = self.grad_dict[n]
+            if self._grad_req[n] == "add":
+                tgt._set_data(tgt.data + g)
+            else:
+                tgt._set_data(g)
+
+    def forward_backward(self, out_grads=None, **kwargs):
+        """Fused train step building block: one XLA computation for fwd+bwd."""
+        for name, arr in kwargs.items():
+            self.arg_dict[name]._set_data(
+                arr.data if isinstance(arr, NDArray) else jnp.asarray(arr))
+        arg_values = {n: a.data for n, a in self.arg_dict.items()}
+        aux_values = {n: a.data for n, a in self.aux_dict.items()}
+        rng = _random.next_key() if self._needs_rng else _zero_key()
+        wrt_names = tuple(n for n in self._arg_names
+                          if self._grad_req.get(n, "null") != "null")
+        if out_grads is None:
+            ograds = None
+        else:
+            ograds = [g.data if isinstance(g, NDArray) else jnp.asarray(g)
+                      for g in out_grads]
+        wrt = {n: arg_values[n] for n in wrt_names}
+        outs, aux_out, grads = self._jit_fwd_bwd(arg_values, aux_values, rng,
+                                                 ograds, wrt)
+        for i, o in enumerate(outs):
+            self.outputs[i] = NDArray(o, ctx=self._ctx)
+        for n, a in self.aux_dict.items():
+            a._set_data(aux_out[n])
+        for n in wrt_names:
+            tgt = self.grad_dict[n]
+            if self._grad_req[n] == "add":
+                tgt._set_data(tgt.data + grads[n])
+            else:
+                tgt._set_data(grads[n])
+        return self.outputs
+
+    # -- monitor (MXExecutorSetMonitorCallback parity) ------------------
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def _run_monitor(self, name, value):
+        self._monitor_callback(name, NDArray(value, ctx=self._ctx))
+
+    # -- param management ----------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                arr.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("unknown argument %r" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    arr.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux state %r" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **new_shapes):
+        """Re-bind to new input shapes (executor.py:270). Param arrays are
+        shared; data/label arrays reallocated; jit recompiles per shape."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**new_shapes)
+        if arg_shapes is None:
+            raise MXNetError("reshape: cannot infer shapes from %s" % new_shapes)
+        new_args = {}
+        new_grads = {}
+        for name, shape in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[name]
+            if tuple(cur.shape) == tuple(shape):
+                new_args[name] = cur
+                if name in self.grad_dict:
+                    new_grads[name] = self.grad_dict[name]
+            else:
+                if not partial_shaping and name not in new_shapes:
+                    raise MXNetError(
+                        "reshape changed shape of %s; pass partial_shaping=True"
+                        % name)
+                new_args[name] = zeros(shape, ctx=self._ctx, dtype=cur.dtype)
+                if name in self.grad_dict:
+                    new_grads[name] = zeros(shape, ctx=self._ctx, dtype=cur.dtype)
+        aux = {n: a for n, a in self.aux_dict.items()}
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self._grad_req, aux, group2ctx=self._group2ctx,
+                        shared_exec=self)
+
+    def debug_str(self):
+        """Execution plan dump (GraphExecutor::Print parity); under jit the
+        real plan is XLA's — expose both our schedule and cost analysis."""
+        lines = [self._symbol.debug_str(), ""]
+        total = sum(_np.prod(a.shape) * a.dtype.itemsize
+                    for a in self.arg_arrays + self.aux_arrays
+                    + [g for g in self.grad_arrays if g is not None])
+        lines.append("Total %d MB allocated (args+grads+aux)" % (total // (1 << 20)))
+        return "\n".join(lines)
+
+
+def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
+                shared_exec=None, **kwargs):
+    """Allocate arg/grad/aux arrays from inferred shapes and bind
+    (parity: symbol.py:630-710)."""
+    arg_shapes, _, aux_shapes = symbol.infer_shape(**kwargs)
+    if arg_shapes is None:
+        raise MXNetError("simple_bind: cannot infer shapes from %s" % kwargs)
+    arg_names = symbol.list_arguments()
+    type_dict = type_dict or {}
+    args = {}
+    grads = {}
+    for name, shape in zip(arg_names, arg_shapes):
+        dtype = type_dict.get(name, _np.float32)
+        args[name] = zeros(shape, ctx=ctx, dtype=dtype)
+        req = grad_req if isinstance(grad_req, str) else \
+            (grad_req.get(name, "null") if isinstance(grad_req, dict)
+             else dict(zip(arg_names, grad_req)).get(name, "null"))
+        if req != "null":
+            grads[name] = zeros(shape, ctx=ctx, dtype=dtype)
+    aux = [zeros(s, ctx=ctx) for s in aux_shapes]
+    return Executor(symbol, ctx, args, grads, grad_req, aux,
+                    group2ctx=group2ctx, shared_exec=shared_exec)

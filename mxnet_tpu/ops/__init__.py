@@ -1,0 +1,7 @@
+"""Operator package: importing this module registers all operators."""
+from .registry import (OperatorProperty, register_op, create_operator,
+                       OP_REGISTRY, IncompleteShape)
+from . import tensor  # noqa: F401
+from . import nn      # noqa: F401
+from . import loss    # noqa: F401
+from . import sequence  # noqa: F401

@@ -1,0 +1,269 @@
+"""Output/loss layers with reference backward semantics via jax.custom_vjp.
+
+The reference's loss layers define Backward as "the gradient of an implicit
+loss", ignoring head gradients (e.g. SoftmaxOutput backward = p - onehot,
+softmax_output-inl.h; DeclareBackwardDependency omits out_grad).  jax AD
+would instead differentiate the forward (softmax), so each op here pins the
+reference contract with custom_vjp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..dparam import Field, ParamStruct
+from .registry import OperatorProperty, register_op, require_known
+
+
+class _SoftmaxOutputParam(ParamStruct):
+    grad_scale = Field(float, default=1.0)
+    ignore_label = Field(float, default=-1.0)
+    multi_output = Field(bool, default=False)
+    use_ignore = Field(bool, default=False)
+    preserve_shape = Field(bool, default=False)
+    normalization = Field(str, default="null", enum=("null", "batch", "valid"))
+    out_grad = Field(bool, default=False)
+
+
+@register_op("SoftmaxOutput", aliases=("Softmax",))
+class SoftmaxOutput(OperatorProperty):
+    """softmax_output-inl.h: fwd=softmax(data); bwd=(p - onehot(label))·scale."""
+    param_cls = _SoftmaxOutputParam
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known("SoftmaxOutput", in_shapes[:1], ["data"])
+        if self.param.multi_output:
+            label = (data[0],) + tuple(data[2:])
+        else:
+            label = (data[0],)
+        return [data, label], [data], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        use_out_grad = self.param.out_grad
+
+        @jax.custom_vjp
+        def _softmax_out(data, label):
+            return self._softmax(data)
+
+        def _fwd(data, label):
+            out = self._softmax(data)
+            return out, (out, label)
+
+        def _bwd(res, g):
+            out, label = res
+            grad = self._grad(out, label)
+            if use_out_grad:  # softmax_output-inl.h: scale by head gradient
+                grad = grad * g
+            return grad, jnp.zeros_like(label)
+
+        _softmax_out.defvjp(_fwd, _bwd)
+        return [_softmax_out(inputs[0], inputs[1])], None
+
+    def _softmax(self, data):
+        if self.param.multi_output:
+            return jax.nn.softmax(data, axis=1)
+        return jax.nn.softmax(data, axis=-1)
+
+    def _grad(self, out, label):
+        p = self.param
+        lab = label.astype(jnp.int32)
+        if p.multi_output:
+            onehot = jax.nn.one_hot(lab, out.shape[1], dtype=out.dtype, axis=1)
+        else:
+            onehot = jax.nn.one_hot(lab, out.shape[-1], dtype=out.dtype)
+        grad = out - onehot
+        valid = jnp.ones_like(label, dtype=out.dtype)
+        if p.use_ignore:
+            valid = (label != p.ignore_label).astype(out.dtype)
+            if p.multi_output:
+                grad = grad * valid[:, None]
+            else:
+                grad = grad * valid.reshape(valid.shape + (1,) * (grad.ndim - valid.ndim))
+        scale = p.grad_scale
+        if p.normalization == "batch":
+            grad = grad / out.shape[0]
+        elif p.normalization == "valid":
+            grad = grad / jnp.maximum(jnp.sum(valid), 1.0)
+        return grad * scale
+
+
+def _make_regression(op_name, fwd_fn, grad_fn):
+    class _RegParam(ParamStruct):
+        grad_scale = Field(float, default=1.0)
+
+    @register_op(op_name)
+    class _Regression(OperatorProperty):
+        """regression_output-inl.h family."""
+        param_cls = _RegParam
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def infer_shape(self, in_shapes):
+            data = in_shapes[0]
+            if data is None:
+                require_known(op_name, in_shapes[:1], ["data"])
+            return [data, data], [data], []
+
+        def forward(self, inputs, aux, is_train, rng):
+            scale = self.param.grad_scale
+
+            @jax.custom_vjp
+            def _reg(data, label):
+                return fwd_fn(data)
+
+            def _f(data, label):
+                out = fwd_fn(data)
+                return out, (out, label)
+
+            def _b(res, g):
+                out, label = res
+                return (grad_fn(out, label) * scale, jnp.zeros_like(label))
+
+            _reg.defvjp(_f, _b)
+            data, label = inputs
+            label = label.reshape(data.shape)
+            return [_reg(data, label)], None
+
+    _Regression.__name__ = "Op" + op_name
+    return _Regression
+
+
+_make_regression("LinearRegressionOutput",
+                 lambda x: x, lambda out, label: out - label)
+_make_regression("LogisticRegressionOutput",
+                 jax.nn.sigmoid, lambda out, label: out - label)
+_make_regression("MAERegressionOutput",
+                 lambda x: x, lambda out, label: jnp.sign(out - label))
+
+
+class _MakeLossParam(ParamStruct):
+    grad_scale = Field(float, default=1.0)
+    valid_thresh = Field(float, default=0.0)
+    normalization = Field(str, default="null", enum=("null", "batch", "valid"))
+
+
+@register_op("MakeLoss")
+class MakeLoss(OperatorProperty):
+    """make_loss-inl.h: fwd=data; bwd=grad_scale (constant ones)."""
+    param_cls = _MakeLossParam
+
+    def forward(self, inputs, aux, is_train, rng):
+        p = self.param
+
+        @jax.custom_vjp
+        def _make_loss(data):
+            return data
+
+        def _f(data):
+            return data, data
+
+        def _b(data, g):
+            grad = jnp.full_like(data, p.grad_scale)
+            if p.normalization == "batch":
+                grad = grad / data.shape[0]
+            elif p.normalization == "valid":
+                valid = (data > p.valid_thresh).astype(data.dtype)
+                grad = grad / jnp.maximum(jnp.sum(valid), 1.0)
+            return (grad,)
+
+        _make_loss.defvjp(_f, _b)
+        return [_make_loss(inputs[0])], None
+
+
+class _SVMOutputParam(ParamStruct):
+    margin = Field(float, default=1.0)
+    regularization_coefficient = Field(float, default=1.0)
+    use_linear = Field(bool, default=False)
+
+
+@register_op("SVMOutput")
+class SVMOutput(OperatorProperty):
+    """svm_output-inl.h: fwd=identity; bwd=hinge (L2 default, L1 opt)."""
+    param_cls = _SVMOutputParam
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known("SVMOutput", in_shapes[:1], ["data"])
+        return [data, (data[0],)], [data], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        p = self.param
+
+        @jax.custom_vjp
+        def _svm(data, label):
+            return data
+
+        def _f(data, label):
+            return data, (data, label)
+
+        def _b(res, g):
+            scores, label = res
+            lab = label.astype(jnp.int32)
+            s_l = jnp.take_along_axis(scores, lab[:, None], axis=1)
+            viol = scores - s_l + p.margin  # >0 where margin violated (k != l)
+            onehot = jax.nn.one_hot(lab, scores.shape[1], dtype=scores.dtype)
+            mask = (viol > 0).astype(scores.dtype) * (1.0 - onehot)
+            if p.use_linear:
+                gk = mask
+            else:
+                gk = 2.0 * viol * mask
+            gl = -jnp.sum(gk, axis=1, keepdims=True) * onehot
+            grad = (gk + gl) * p.regularization_coefficient
+            return grad, jnp.zeros_like(label)
+
+        _svm.defvjp(_f, _b)
+        return [_svm(inputs[0], inputs[1])], None
+
+
+class _KLSparseParam(ParamStruct):
+    sparseness_target = Field(float, default=0.1)
+    penalty = Field(float, default=0.001)
+    momentum = Field(float, default=0.9)
+
+
+@register_op("IdentityAttachKLSparseReg")
+class IdentityAttachKLSparseReg(OperatorProperty):
+    """identity_attach_KL_sparse_reg-inl.h: identity fwd; adds KL sparsity
+    penalty gradient against the batch mean activation (aux moving avg)."""
+    param_cls = _KLSparseParam
+
+    def list_auxiliary_states(self):
+        return ["moving_avg"]
+
+    def infer_shape(self, in_shapes):
+        require_known("IdentityAttachKLSparseReg", in_shapes, ["data"])
+        d = in_shapes[0]
+        return in_shapes, [d], [(d[1],)]
+
+    def forward(self, inputs, aux, is_train, rng):
+        p = self.param
+        x = inputs[0]
+        avg = jnp.mean(x, axis=tuple(i for i in range(x.ndim) if i != 1))
+        new_avg = p.momentum * aux[0] + (1 - p.momentum) * avg
+
+        @jax.custom_vjp
+        def _kl(data):
+            return data
+
+        def _f(data):
+            return data, None
+
+        def _b(res, g):
+            a = lax.stop_gradient(new_avg).reshape((1, -1) + (1,) * (x.ndim - 2))
+            pen = p.penalty * (-p.sparseness_target / (a + 1e-8)
+                               + (1.0 - p.sparseness_target) / (1.0 - a + 1e-8))
+            return (g + pen,)
+
+        _kl.defvjp(_f, _b)
+        return [_kl(x)], ([new_avg] if is_train else None)

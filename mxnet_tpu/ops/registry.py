@@ -1,0 +1,126 @@
+"""Operator registry: metadata + jax-traceable compute bodies.
+
+TPU-native replacement for the reference's OperatorProperty system
+(``include/mxnet/operator.h:165-480``, ``MXNET_REGISTER_OP_PROPERTY``
+``operator.h:537``) and the simple-op registry
+(``src/operator/operator_util.cc:22``).
+
+Key translation (SURVEY §7 stage 3): an operator here is *metadata* (argument
+/output/aux names, shape+type inference) plus a pure jax-traceable
+``forward``.  There is no per-op Backward: gradients come from jax AD tracing
+through ``forward``; ops whose reference Backward is *not* the true gradient
+(loss layers like SoftmaxOutput, MakeLoss, BlockGrad) implement that contract
+with ``jax.custom_vjp`` so the semantics match the reference exactly.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..registry import Registry
+
+__all__ = ["OperatorProperty", "register_op", "create_operator", "OP_REGISTRY",
+           "require_known"]
+
+OP_REGISTRY = Registry("operator")
+
+
+def register_op(name, aliases=()):
+    """Class decorator: register an OperatorProperty subclass under ``name``."""
+    def _wrap(cls):
+        cls.op_name = name
+        OP_REGISTRY.register(name, cls)
+        for a in aliases:
+            OP_REGISTRY.register(a, cls)
+        return cls
+    return _wrap
+
+
+def create_operator(op_name, **attrs):
+    cls = OP_REGISTRY.get(op_name)
+    return cls(**attrs)
+
+
+def require_known(op_name, in_shapes, arg_names):
+    for shape, aname in zip(in_shapes, arg_names):
+        if shape is None:
+            raise IncompleteShape("%s: shape of input '%s' unknown" % (op_name, aname))
+    return in_shapes
+
+
+class IncompleteShape(MXNetError):
+    """Raised when infer_shape lacks information (caught by Symbol.infer_shape)."""
+
+
+class OperatorProperty:
+    """Base operator: subclass, set ``param_cls``, implement metadata+forward.
+
+    Parity: include/mxnet/operator.h:165 (OperatorProperty).  ``forward`` must
+    be pure and jax-traceable:
+
+        forward(params_of_op_already_on_self, inputs, aux, is_train, rng)
+            -> (outputs: list[jax.Array], aux_updates: list[jax.Array] | None)
+
+    ``aux_updates``, when not None, aligns with ``list_auxiliary_states()``
+    and carries new values for auxiliary states (BatchNorm moving stats —
+    batch_norm-inl.h:49,89).  ``rng`` is a jax PRNG key or None (only passed
+    when ``need_rng`` is True — Dropout & friends).
+    """
+
+    op_name = None          # filled by register_op
+    param_cls = None        # optional ParamStruct subclass
+    need_rng = False        # request a PRNG key slice in forward
+    hint = None             # name hint for auto naming (defaults to lowercased op)
+
+    # graph-level attrs that ride on nodes but are not op params
+    _SYSTEM_ATTRS = frozenset(
+        {"ctx_group", "lr_mult", "wd_mult", "mirror_stage", "force_mirroring"})
+
+    def __init__(self, **attrs):
+        self.attrs = {k: str(v) for k, v in attrs.items()}
+        fields = self.param_cls._fields if self.param_cls is not None else {}
+        unknown = [k for k in attrs
+                   if k not in fields and k not in self._SYSTEM_ATTRS
+                   and not (k.startswith("__") and k.endswith("__"))]
+        if unknown:
+            raise MXNetError("%s: unknown arguments %s (valid: %s)"
+                             % (type(self).op_name or type(self).__name__,
+                                sorted(unknown), sorted(fields)))
+        if self.param_cls is not None:
+            self.param = self.param_cls.from_attrs(attrs)
+        else:
+            self.param = None
+
+    # -- metadata ----------------------------------------------------------
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    @property
+    def num_outputs(self):
+        return len(self.list_outputs())
+
+    # -- inference ---------------------------------------------------------
+    def infer_shape(self, in_shapes):
+        """in_shapes: list aligned with list_arguments, entries tuple|None.
+
+        Returns (in_shapes, out_shapes, aux_shapes) with everything known, or
+        raises IncompleteShape.  Default: unary-ish same-shape op.
+        """
+        in_shapes = require_known(self.op_name, in_shapes, self.list_arguments())
+        return in_shapes, [in_shapes[0]] * self.num_outputs, []
+
+    def infer_type(self, in_types):
+        """Default: all inputs and outputs share the first known dtype."""
+        known = [t for t in in_types if t is not None]
+        base = known[0] if known else None
+        n_in = len(self.list_arguments())
+        return ([base] * n_in, [base] * self.num_outputs,
+                [base] * len(self.list_auxiliary_states()))
+
+    # -- compute -----------------------------------------------------------
+    def forward(self, inputs, aux, is_train, rng):
+        raise NotImplementedError(self.op_name)

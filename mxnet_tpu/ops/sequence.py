@@ -1,0 +1,86 @@
+"""Sequence operators (src/operator/sequence_last/mask/reverse-inl.h).
+
+Layout follows the reference: data is (seq_len, batch, ...) and the optional
+``sequence_length`` input is (batch,).  All bodies are gather/where formulations
+that XLA vectorizes — no scalar loops (TPU-friendly control flow).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..dparam import Field, ParamStruct
+from .registry import OperatorProperty, register_op, require_known
+
+
+class _SeqParam(ParamStruct):
+    use_sequence_length = Field(bool, default=False)
+
+
+class _SeqBase(OperatorProperty):
+    param_cls = _SeqParam
+
+    def list_arguments(self):
+        if self.param.use_sequence_length:
+            return ["data", "sequence_length"]
+        return ["data"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known(self.op_name, in_shapes[:1], ["data"])
+        ins = [data]
+        if self.param.use_sequence_length:
+            ins.append((data[1],))
+        return ins, [self._out_shape(data)], []
+
+    def _out_shape(self, data):
+        return data
+
+    def _lengths(self, inputs):
+        data = inputs[0]
+        if self.param.use_sequence_length:
+            return inputs[1].astype(jnp.int32)
+        return jnp.full((data.shape[1],), data.shape[0], dtype=jnp.int32)
+
+
+@register_op("SequenceLast")
+class SequenceLast(_SeqBase):
+    def _out_shape(self, data):
+        return data[1:]
+
+    def forward(self, inputs, aux, is_train, rng):
+        data = inputs[0]
+        lengths = self._lengths(inputs)
+        idx = jnp.maximum(lengths - 1, 0)  # (batch,)
+        batch = jnp.arange(data.shape[1])
+        return [data[idx, batch]], None
+
+
+class _SeqMaskParam(_SeqParam):
+    value = Field(float, default=0.0)
+
+
+@register_op("SequenceMask")
+class SequenceMask(_SeqBase):
+    param_cls = _SeqMaskParam
+
+    def forward(self, inputs, aux, is_train, rng):
+        data = inputs[0]
+        lengths = self._lengths(inputs)
+        steps = jnp.arange(data.shape[0])[:, None]  # (seq, 1)
+        mask = steps < lengths[None, :]             # (seq, batch)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+        return [jnp.where(mask, data, jnp.asarray(self.param.value, data.dtype))], None
+
+
+@register_op("SequenceReverse")
+class SequenceReverse(_SeqBase):
+    def forward(self, inputs, aux, is_train, rng):
+        data = inputs[0]
+        lengths = self._lengths(inputs)
+        seq = data.shape[0]
+        steps = jnp.arange(seq)[:, None]                   # (seq, 1)
+        src = jnp.where(steps < lengths[None, :],
+                        lengths[None, :] - 1 - steps, steps)  # (seq, batch)
+        batch = jnp.arange(data.shape[1])[None, :]
+        return [data[src, batch]], None

@@ -1,0 +1,477 @@
+"""Simple tensor operators (elementwise / scalar / reduce / matrix).
+
+Parity: the ~55 "simple ops" of the reference registered via
+``MXNET_REGISTER_SIMPLE_OP`` (src/operator/elementwise_*op*, matrix_op,
+broadcast_reduce_op, src/ndarray/unary_function) — SURVEY §2 operator row.
+Gradients come from jax AD, which matches the hand-written kernel+grad pairs
+of the reference (e.g. ``sqrt``'s grad 0.5/sqrt(x)).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..dparam import Field, ParamStruct, parse_tuple
+from .registry import OperatorProperty, register_op, require_known
+
+
+def _broadcast_shape(a, b):
+    try:
+        return tuple(_np.broadcast_shapes(a, b))
+    except ValueError:
+        raise MXNetError("incompatible shapes %s and %s" % (a, b))
+
+
+# ----------------------------------------------------------------------
+# elementwise binary ops (elementwise_binary_op-inl.h)
+# ----------------------------------------------------------------------
+def _make_binary(op_name, fn, aliases=()):
+    @register_op(op_name, aliases=aliases)
+    class _Binary(OperatorProperty):
+        hint = op_name.strip("_").lower()
+
+        def list_arguments(self):
+            return ["lhs", "rhs"]
+
+        def infer_shape(self, in_shapes):
+            lhs, rhs = in_shapes
+            if lhs is None and rhs is None:
+                require_known(self.op_name, in_shapes, self.list_arguments())
+            if lhs is None:
+                lhs = rhs
+            if rhs is None:
+                rhs = lhs
+            return [lhs, rhs], [_broadcast_shape(lhs, rhs)], []
+
+        def forward(self, inputs, aux, is_train, rng):
+            return [fn(inputs[0], inputs[1])], None
+
+    _Binary.__name__ = "Op" + op_name
+    return _Binary
+
+
+_make_binary("_Plus", jnp.add, aliases=("elemwise_add", "broadcast_plus", "broadcast_add"))
+_make_binary("_Minus", jnp.subtract, aliases=("elemwise_sub", "broadcast_minus", "broadcast_sub"))
+_make_binary("_Mul", jnp.multiply, aliases=("elemwise_mul", "broadcast_mul"))
+_make_binary("_Div", jnp.divide, aliases=("elemwise_div", "broadcast_div"))
+_make_binary("_Power", jnp.power, aliases=("broadcast_power",))
+_make_binary("_Maximum", jnp.maximum, aliases=("broadcast_maximum",))
+_make_binary("_Minimum", jnp.minimum, aliases=("broadcast_minimum",))
+
+
+# ----------------------------------------------------------------------
+# scalar variants (elementwise_scalar_op; reference keeps scalar in attrs)
+# ----------------------------------------------------------------------
+class _ScalarParam(ParamStruct):
+    scalar = Field(float, required=True, doc="scalar operand")
+
+
+def _make_scalar(op_name, fn):
+    @register_op(op_name)
+    class _Scalar(OperatorProperty):
+        param_cls = _ScalarParam
+        hint = op_name.strip("_").lower()
+
+        def infer_shape(self, in_shapes):
+            require_known(self.op_name, in_shapes, self.list_arguments())
+            return in_shapes, [in_shapes[0]], []
+
+        def forward(self, inputs, aux, is_train, rng):
+            return [fn(inputs[0], jnp.asarray(self.param.scalar, inputs[0].dtype))], None
+
+    _Scalar.__name__ = "Op" + op_name
+    return _Scalar
+
+
+_make_scalar("_PlusScalar", jnp.add)
+_make_scalar("_MinusScalar", jnp.subtract)
+_make_scalar("_RMinusScalar", lambda x, s: s - x)
+_make_scalar("_MulScalar", jnp.multiply)
+_make_scalar("_DivScalar", jnp.divide)
+_make_scalar("_RDivScalar", lambda x, s: s / x)
+_make_scalar("_PowerScalar", jnp.power)
+_make_scalar("_RPowerScalar", lambda x, s: s ** x)
+_make_scalar("_MaximumScalar", jnp.maximum)
+_make_scalar("_MinimumScalar", jnp.minimum)
+
+
+# ----------------------------------------------------------------------
+# unary math (src/ndarray/unary_function-inl.h)
+# ----------------------------------------------------------------------
+def _make_unary(op_name, fn, aliases=()):
+    @register_op(op_name, aliases=aliases)
+    class _Unary(OperatorProperty):
+        hint = op_name.strip("_").lower()
+
+        def forward(self, inputs, aux, is_train, rng):
+            return [fn(inputs[0])], None
+
+    _Unary.__name__ = "Op" + op_name
+    return _Unary
+
+
+_make_unary("sqrt", jnp.sqrt)
+_make_unary("rsqrt", lambda x: 1.0 / jnp.sqrt(x))
+_make_unary("exp", jnp.exp)
+_make_unary("log", jnp.log)
+_make_unary("cos", jnp.cos)
+_make_unary("sin", jnp.sin)
+_make_unary("abs", jnp.abs)
+_make_unary("sign", jnp.sign)
+_make_unary("round", jnp.round)
+_make_unary("ceil", jnp.ceil)
+_make_unary("floor", jnp.floor)
+_make_unary("square", jnp.square)
+_make_unary("negative", jnp.negative, aliases=("_Negative",))
+_make_unary("_copy", lambda x: x, aliases=("identity",))
+
+
+class _SmoothL1Param(ParamStruct):
+    scalar = Field(float, default=1.0, doc="sigma of the smooth-l1 transition")
+
+
+@register_op("smooth_l1")
+class SmoothL1(OperatorProperty):
+    """smooth_l1_unary-inl.h (Faster R-CNN bbox loss)."""
+    param_cls = _SmoothL1Param
+
+    def forward(self, inputs, aux, is_train, rng):
+        sigma2 = self.param.scalar ** 2
+        x = inputs[0]
+        out = jnp.where(jnp.abs(x) < 1.0 / sigma2,
+                        0.5 * sigma2 * jnp.square(x),
+                        jnp.abs(x) - 0.5 / sigma2)
+        return [out], None
+
+
+# ----------------------------------------------------------------------
+# reductions (broadcast_reduce_op-inl.h)
+# ----------------------------------------------------------------------
+class _ReduceParam(ParamStruct):
+    axis = Field(tuple, default=None, doc="axes to reduce; None = all")
+    keepdims = Field(bool, default=False)
+
+
+def _reduced_shape(shape, axis, keepdims):
+    if axis is None:
+        return (1,) if not keepdims else (1,) * len(shape)
+    axes = set(a % len(shape) for a in axis)
+    out = []
+    for i, s in enumerate(shape):
+        if i in axes:
+            if keepdims:
+                out.append(1)
+        else:
+            out.append(s)
+    return tuple(out) if out else (1,)
+
+
+def _make_reduce(op_name, fn, aliases=()):
+    @register_op(op_name, aliases=aliases)
+    class _Reduce(OperatorProperty):
+        param_cls = _ReduceParam
+        hint = op_name.lower()
+
+        def infer_shape(self, in_shapes):
+            require_known(self.op_name, in_shapes, self.list_arguments())
+            p = self.param
+            return in_shapes, [_reduced_shape(in_shapes[0], p.axis, p.keepdims)], []
+
+        def forward(self, inputs, aux, is_train, rng):
+            p = self.param
+            axis = tuple(p.axis) if p.axis is not None else None
+            out = fn(inputs[0], axis=axis, keepdims=p.keepdims)
+            if axis is None and not p.keepdims:
+                out = out.reshape((1,))
+            return [out], None
+
+    _Reduce.__name__ = "Op" + op_name
+    return _Reduce
+
+
+_make_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_make_reduce("max", jnp.max, aliases=("max_axis",))
+_make_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+@register_op("norm")
+class Norm(OperatorProperty):
+    def infer_shape(self, in_shapes):
+        require_known("norm", in_shapes, self.list_arguments())
+        return in_shapes, [(1,)], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        return [jnp.sqrt(jnp.sum(jnp.square(inputs[0]))).reshape((1,))], None
+
+
+@register_op("argmax_channel")
+class ArgmaxChannel(OperatorProperty):
+    def infer_shape(self, in_shapes):
+        require_known("argmax_channel", in_shapes, self.list_arguments())
+        return in_shapes, [(in_shapes[0][0],)], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        return [jnp.argmax(inputs[0], axis=1).astype(inputs[0].dtype)], None
+
+
+# ----------------------------------------------------------------------
+# matrix ops (matrix_op-inl.h): dot / batch_dot / transpose / ...
+# ----------------------------------------------------------------------
+class _DotParam(ParamStruct):
+    transpose_a = Field(bool, default=False)
+    transpose_b = Field(bool, default=False)
+
+
+@register_op("dot")
+class Dot(OperatorProperty):
+    """Matrix product; hits the MXU — keep operands large & bf16-friendly."""
+    param_cls = _DotParam
+
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, in_shapes):
+        require_known("dot", in_shapes, self.list_arguments())
+        (a, b) = in_shapes
+        m = a[1] if self.param.transpose_a else a[0]
+        ka = a[0] if self.param.transpose_a else a[1]
+        kb = b[1] if self.param.transpose_b else b[0]
+        n = b[0] if self.param.transpose_b else b[1]
+        if ka != kb:
+            raise MXNetError("dot shape mismatch %s x %s" % (a, b))
+        return in_shapes, [(m, n)], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        a, b = inputs
+        if self.param.transpose_a:
+            a = a.T
+        if self.param.transpose_b:
+            b = b.T
+        return [jnp.dot(a, b, preferred_element_type=a.dtype)], None
+
+
+@register_op("batch_dot")
+class BatchDot(OperatorProperty):
+    param_cls = _DotParam
+
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, in_shapes):
+        require_known("batch_dot", in_shapes, self.list_arguments())
+        a, b = in_shapes
+        at = (a[0], a[2], a[1]) if self.param.transpose_a else a
+        bt = (b[0], b[2], b[1]) if self.param.transpose_b else b
+        return in_shapes, [(at[0], at[1], bt[2])], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        a, b = inputs
+        if self.param.transpose_a:
+            a = jnp.swapaxes(a, 1, 2)
+        if self.param.transpose_b:
+            b = jnp.swapaxes(b, 1, 2)
+        return [jnp.matmul(a, b)], None
+
+
+class _TransposeParam(ParamStruct):
+    axes = Field(tuple, default=None)
+
+
+@register_op("transpose")
+class Transpose(OperatorProperty):
+    param_cls = _TransposeParam
+
+    def infer_shape(self, in_shapes):
+        require_known("transpose", in_shapes, self.list_arguments())
+        s = in_shapes[0]
+        axes = self.param.axes or tuple(reversed(range(len(s))))
+        return in_shapes, [tuple(s[a] for a in axes)], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        return [jnp.transpose(inputs[0], axes=self.param.axes)], None
+
+
+class _ExpandDimsParam(ParamStruct):
+    axis = Field(int, required=True)
+
+
+@register_op("expand_dims")
+class ExpandDims(OperatorProperty):
+    param_cls = _ExpandDimsParam
+
+    def infer_shape(self, in_shapes):
+        require_known("expand_dims", in_shapes, self.list_arguments())
+        s = list(in_shapes[0])
+        ax = self.param.axis
+        if ax < 0:
+            ax += len(s) + 1
+        s.insert(ax, 1)
+        return in_shapes, [tuple(s)], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        return [jnp.expand_dims(inputs[0], self.param.axis)], None
+
+
+class _FlipParam(ParamStruct):
+    axis = Field(int, required=True)
+
+
+@register_op("flip")
+class Flip(OperatorProperty):
+    param_cls = _FlipParam
+
+    def forward(self, inputs, aux, is_train, rng):
+        return [jnp.flip(inputs[0], self.param.axis)], None
+
+
+class _SliceAxisParam(ParamStruct):
+    axis = Field(int, required=True)
+    begin = Field(int, required=True)
+    end = Field(int, default=None, doc="None/0 means to the end")
+
+
+@register_op("slice_axis")
+class SliceAxis(OperatorProperty):
+    param_cls = _SliceAxisParam
+
+    def _resolve(self, dim):
+        p = self.param
+        begin = p.begin if p.begin >= 0 else p.begin + dim
+        end = p.end
+        if end is None or end == 0:
+            end = dim
+        elif end < 0:
+            end += dim
+        return begin, end
+
+    def infer_shape(self, in_shapes):
+        require_known("slice_axis", in_shapes, self.list_arguments())
+        s = list(in_shapes[0])
+        begin, end = self._resolve(s[self.param.axis])
+        s[self.param.axis] = end - begin
+        return in_shapes, [tuple(s)], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        x = inputs[0]
+        begin, end = self._resolve(x.shape[self.param.axis])
+        idx = [slice(None)] * x.ndim
+        idx[self.param.axis] = slice(begin, end)
+        return [x[tuple(idx)]], None
+
+
+class _BroadcastAxisParam(ParamStruct):
+    axis = Field(tuple, default=())
+    size = Field(tuple, default=())
+
+
+@register_op("broadcast_axis")
+class BroadcastAxis(OperatorProperty):
+    param_cls = _BroadcastAxisParam
+
+    def _target(self, shape):
+        s = list(shape)
+        for ax, sz in zip(self.param.axis, self.param.size):
+            s[ax] = sz
+        return tuple(s)
+
+    def infer_shape(self, in_shapes):
+        require_known("broadcast_axis", in_shapes, self.list_arguments())
+        return in_shapes, [self._target(in_shapes[0])], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        return [jnp.broadcast_to(inputs[0], self._target(inputs[0].shape))], None
+
+
+class _BroadcastToParam(ParamStruct):
+    shape = Field(tuple, required=True)
+
+
+@register_op("broadcast_to")
+class BroadcastTo(OperatorProperty):
+    param_cls = _BroadcastToParam
+
+    def infer_shape(self, in_shapes):
+        require_known("broadcast_to", in_shapes, self.list_arguments())
+        # 0 entries mean "keep input dim" (reference convention)
+        tgt = tuple(d if t == 0 else t
+                    for d, t in zip(in_shapes[0], self.param.shape))
+        return in_shapes, [tgt], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        tgt = tuple(d if t == 0 else t
+                    for d, t in zip(inputs[0].shape, self.param.shape))
+        return [jnp.broadcast_to(inputs[0], tgt)], None
+
+
+# ----------------------------------------------------------------------
+# softmax_cross_entropy (loss simple op)
+# ----------------------------------------------------------------------
+@register_op("softmax_cross_entropy")
+class SoftmaxCrossEntropy(OperatorProperty):
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shapes):
+        data, label = in_shapes
+        if data is None:
+            require_known("softmax_cross_entropy", in_shapes, self.list_arguments())
+        if label is None:
+            label = (data[0],)
+        return [data, label], [(1,)], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        logits, label = inputs
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        lab = label.astype(jnp.int32)
+        nll = -jnp.take_along_axis(logp, lab[:, None], axis=-1)
+        return [jnp.sum(nll).reshape((1,))], None
+
+
+# ----------------------------------------------------------------------
+# samplers (need_rng): _sample_uniform / _sample_normal
+# ----------------------------------------------------------------------
+class _SampleUniformParam(ParamStruct):
+    low = Field(float, default=0.0)
+    high = Field(float, default=1.0)
+    shape = Field(tuple, required=True)
+
+
+@register_op("_sample_uniform", aliases=("uniform",))
+class SampleUniform(OperatorProperty):
+    param_cls = _SampleUniformParam
+    need_rng = True
+
+    def list_arguments(self):
+        return []
+
+    def infer_shape(self, in_shapes):
+        return [], [tuple(self.param.shape)], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        p = self.param
+        return [jax.random.uniform(rng, tuple(p.shape), minval=p.low, maxval=p.high)], None
+
+
+class _SampleNormalParam(ParamStruct):
+    loc = Field(float, default=0.0)
+    scale = Field(float, default=1.0)
+    shape = Field(tuple, required=True)
+
+
+@register_op("_sample_normal", aliases=("normal",))
+class SampleNormal(OperatorProperty):
+    param_cls = _SampleNormalParam
+    need_rng = True
+
+    def list_arguments(self):
+        return []
+
+    def infer_shape(self, in_shapes):
+        return [], [tuple(self.param.shape)], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        p = self.param
+        return [p.loc + p.scale * jax.random.normal(rng, tuple(p.shape))], None

@@ -1,0 +1,502 @@
+"""Symbol: the symbolic graph API.
+
+TPU-native reimplementation of the reference's Symbol/StaticGraph
+(``src/symbol/symbol.cc``, ``include/mxnet/symbolic.h:40-317``).  The DAG is
+plain Python nodes; *execution* happens by tracing the whole graph into one
+jax function that XLA compiles (executor.py) — the reference's
+Symbol→StaticGraph→GraphExecutor pipeline collapses into Symbol→trace→jit
+(SURVEY §3.2: "This function is what becomes jax.jit tracing + XLA compile").
+
+Kept reference semantics:
+- composition with auto-created variables (``fc1_weight``) and NameManager
+  auto-naming (symbol.cc:335,403),
+- DFS-order ``list_arguments``/``list_outputs``/``list_auxiliary_states``,
+- partial shape inference that *fills parameter shapes from data shapes*
+  (static_graph.cc:59 InferNodeShapes) — what makes ``simple_bind`` work,
+- attrs (``ctx_group``, ``lr_mult``, ``__shape__`` hints), AttrScope scoping,
+- JSON save/load in the reference's nodes/arg_nodes/heads layout
+  (static_graph.cc JSON ~:60-270) for checkpoint compatibility.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+from .base import MXNetError
+from .attribute import AttrScope
+from .name import NameManager
+from .ops.registry import (OP_REGISTRY, IncompleteShape, create_operator)
+
+__all__ = ["Symbol", "Variable", "Group", "load", "load_json"]
+
+
+class _Node:
+    __slots__ = ("op", "name", "inputs", "attrs")
+
+    def __init__(self, op, name, inputs, attrs):
+        self.op = op            # OperatorProperty | None (=> variable)
+        self.name = name
+        self.inputs = inputs    # list[(node, out_index)]
+        self.attrs = dict(attrs or {})
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    @property
+    def num_outputs(self):
+        return 1 if self.op is None else self.op.num_outputs
+
+
+def _topo_order(head_nodes):
+    """Post-DFS order (parity: static_graph.cc:17 PostDFSOrder)."""
+    order, visited = [], set()
+    for head in head_nodes:
+        stack = [(head, 0)]
+        while stack:
+            node, child_idx = stack.pop()
+            if id(node) in visited and child_idx == 0:
+                continue
+            if child_idx < len(node.inputs):
+                stack.append((node, child_idx + 1))
+                child = node.inputs[child_idx][0]
+                if id(child) not in visited:
+                    stack.append((child, 0))
+            else:
+                if id(node) not in visited:
+                    visited.add(id(node))
+                    order.append(node)
+    return order
+
+
+class Symbol:
+    """Handle to one or more output entries of the DAG."""
+
+    def __init__(self, heads):
+        self._heads = list(heads)  # list[(node, out_index)]
+
+    # -- naming / attrs ----------------------------------------------------
+    @property
+    def name(self):
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return None
+
+    def attr(self, key):
+        return self._heads[0][0].attrs.get(key)
+
+    def _set_attr(self, **kwargs):
+        for k, v in kwargs.items():
+            self._heads[0][0].attrs[k] = str(v)
+
+    def list_attr(self):
+        return dict(self._heads[0][0].attrs)
+
+    def attr_dict(self):
+        out = {}
+        for node in self._topo():
+            if node.attrs:
+                out[node.name] = dict(node.attrs)
+        return out
+
+    # -- traversal ---------------------------------------------------------
+    def _topo(self):
+        return _topo_order([n for n, _ in self._heads])
+
+    def list_arguments(self):
+        return [n.name for n in self._topo() if n.is_variable]
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._heads:
+            if node.is_variable:
+                out.append(node.name)
+            else:
+                names = node.op.list_outputs()
+                out.append("%s_%s" % (node.name, names[idx]))
+        return out
+
+    def list_auxiliary_states(self):
+        out = []
+        for node in self._topo():
+            if not node.is_variable:
+                for aux in node.op.list_auxiliary_states():
+                    out.append("%s_%s" % (node.name, aux))
+        return out
+
+    def get_internals(self):
+        heads = []
+        for node in self._topo():
+            for i in range(node.num_outputs):
+                heads.append((node, i))
+        return Symbol(heads)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("cannot find output %r in %s" % (index, names))
+            index = names.index(index)
+        return Symbol([self._heads[index]])
+
+    def __len__(self):
+        return len(self._heads)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._heads)))
+
+    def __repr__(self):
+        name = self.name
+        return "<Symbol %s>" % (name if name else "Grouped")
+
+    # -- composition sugar -------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        raise MXNetError("Symbol composition via __call__ is not supported; "
+                         "pass symbols as op arguments instead")
+
+    def _binop(self, other, op_name, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            lhs, rhs = (other, self) if reverse else (self, other)
+            return _create(op_name, lhs, rhs)
+        attrs = {"scalar": float(other)}
+        return _create(scalar_op, self, **attrs)
+
+    def __add__(self, other):
+        return self._binop(other, "_Plus", "_PlusScalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, "_Minus", "_MinusScalar")
+
+    def __rsub__(self, other):
+        if isinstance(other, Symbol):
+            return other.__sub__(self)
+        return _create("_RMinusScalar", self, scalar=float(other))
+
+    def __mul__(self, other):
+        return self._binop(other, "_Mul", "_MulScalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, "_Div", "_DivScalar")
+
+    def __rtruediv__(self, other):
+        if isinstance(other, Symbol):
+            return other.__truediv__(self)
+        return _create("_RDivScalar", self, scalar=float(other))
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, other):
+        return self._binop(other, "_Power", "_PowerScalar")
+
+    def __neg__(self):
+        return _create("_MulScalar", self, scalar=-1.0)
+
+    # -- inference ---------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """Returns (arg_shapes, out_shapes, aux_shapes); (None,)*3 if incomplete."""
+        arg_shapes, out_shapes, aux_shapes, complete = \
+            self._infer_shape_impl(args, kwargs)
+        if not complete:
+            return None, None, None
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        a, o, x, _ = self._infer_shape_impl(args, kwargs)
+        return a, o, x
+
+    def _infer_shape_impl(self, args, kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            if len(args) > len(arg_names):
+                raise MXNetError("too many positional shapes")
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        for name, shape in kwargs.items():
+            if name not in arg_names:
+                raise MXNetError("infer_shape: unknown argument %r; arguments "
+                                 "are %s" % (name, arg_names))
+            known[name] = tuple(shape)
+
+        topo = self._topo()
+        shapes = {}  # (id(node), idx) -> tuple
+        for node in topo:
+            if node.is_variable:
+                if node.name in known:
+                    shapes[(id(node), 0)] = known[node.name]
+                elif "__shape__" in node.attrs:
+                    from .dparam import parse_tuple
+                    shapes[(id(node), 0)] = parse_tuple(node.attrs["__shape__"])
+
+        while True:  # fixpoint: forward fill + param backfill until no progress
+            progress = False
+            for node in topo:
+                if node.is_variable:
+                    continue
+                in_shapes = [shapes.get((id(c), ci)) for c, ci in node.inputs]
+                try:
+                    full_in, outs, _aux = node.op.infer_shape(in_shapes)
+                except IncompleteShape:
+                    continue
+                for (c, ci), s in zip(node.inputs, full_in):
+                    key = (id(c), ci)
+                    if s is not None:
+                        prev = shapes.get(key)
+                        if prev is not None and tuple(prev) != tuple(s):
+                            raise MXNetError(
+                                "shape mismatch for input of %s: %s vs %s"
+                                % (node.name, prev, s))
+                        if prev is None:
+                            shapes[key] = tuple(s)
+                            progress = True
+                for i, s in enumerate(outs):
+                    key = (id(node), i)
+                    if shapes.get(key) is None:
+                        shapes[key] = tuple(s)
+                        progress = True
+            if not progress:
+                break
+
+        node_by_name = {n.name: n for n in topo if n.is_variable}
+        arg_shapes = [shapes.get((id(node_by_name[n]), 0)) for n in arg_names]
+        out_shapes = [shapes.get((id(n), i)) for n, i in self._heads]
+        aux_shapes = []
+        for node in topo:
+            if not node.is_variable:
+                in_shapes = [shapes.get((id(c), ci)) for c, ci in node.inputs]
+                try:
+                    _, _, aux = node.op.infer_shape(in_shapes)
+                except IncompleteShape:
+                    aux = [None] * len(node.op.list_auxiliary_states())
+                aux_shapes.extend(aux)
+        complete = (all(s is not None for s in arg_shapes)
+                    and all(s is not None for s in out_shapes)
+                    and all(s is not None for s in aux_shapes))
+        return arg_shapes, out_shapes, aux_shapes, complete
+
+    def infer_type(self, *args, **kwargs):
+        """Forward type propagation consulting per-op infer_type (Cast etc)."""
+        arg_names = self.list_arguments()
+        known = {}
+        for name, t in zip(arg_names, args):
+            if t is not None:
+                known[name] = _np.dtype(t)
+        for name, t in kwargs.items():
+            if name not in arg_names:
+                raise MXNetError("infer_type: unknown argument %r; arguments "
+                                 "are %s" % (name, arg_names))
+            known[name] = _np.dtype(t)
+        base = next(iter(known.values()), _np.dtype(_np.float32))
+
+        topo = self._topo()
+        types = {}
+        for node in topo:
+            if node.is_variable:
+                types[(id(node), 0)] = known.get(node.name, base)
+        aux_types = []
+        for node in topo:
+            if node.is_variable:
+                continue
+            in_types = [types.get((id(c), ci)) for c, ci in node.inputs]
+            full_in, outs, aux = node.op.infer_type(in_types)
+            for (c, ci), t in zip(node.inputs, full_in):
+                if types.get((id(c), ci)) is None and t is not None:
+                    types[(id(c), ci)] = _np.dtype(t)
+            for i, t in enumerate(outs):
+                types[(id(node), i)] = _np.dtype(t) if t is not None else base
+            aux_types.extend(_np.dtype(t) if t is not None else base for t in aux)
+        node_by_name = {n.name: n for n in topo if n.is_variable}
+        arg_types = [types.get((id(node_by_name[n]), 0), base) for n in arg_names]
+        out_types = [types.get((id(n), i), base) for n, i in self._heads]
+        return arg_types, out_types, aux_types
+
+    # -- binding (implemented in executor.py) ------------------------------
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        from .executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None, group2ctx=None,
+                    shared_exec=None, **kwargs):
+        from .executor import simple_bind
+        return simple_bind(self, ctx, grad_req=grad_req, type_dict=type_dict,
+                           group2ctx=group2ctx, shared_exec=shared_exec, **kwargs)
+
+    # -- grad (Symbol::Grad symbol.cc:569) ---------------------------------
+    def grad(self, wrt):
+        raise MXNetError("Symbol.grad is not supported; bind with args_grad "
+                         "and call backward (autograd runs inside the jitted "
+                         "executor)")
+
+    # -- serialization (reference JSON layout) -----------------------------
+    def tojson(self):
+        topo = self._topo()
+        node_index = {id(n): i for i, n in enumerate(topo)}
+        nodes = []
+        for n in topo:
+            nodes.append({
+                "op": "null" if n.is_variable else n.op.op_name,
+                "name": n.name,
+                "attr": {k: str(v) for k, v in n.attrs.items()},
+                "inputs": [[node_index[id(c)], ci] for c, ci in n.inputs],
+            })
+        arg_nodes = [i for i, n in enumerate(topo) if n.is_variable]
+        heads = [[node_index[id(n)], i] for n, i in self._heads]
+        return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
+                           "heads": heads}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as fo:
+            fo.write(self.tojson())
+
+    def debug_str(self):
+        lines = []
+        for n in self._topo():
+            if n.is_variable:
+                lines.append("Variable:%s" % n.name)
+            else:
+                ins = ", ".join("%s[%d]" % (c.name, ci) for c, ci in n.inputs)
+                lines.append("%s(%s) -> %s" % (n.op.op_name, ins, n.name))
+        return "\n".join(lines)
+
+
+def Variable(name, attr=None, shape=None, **kwargs):
+    """Create a symbolic variable (parity symbol.cc CreateVariable)."""
+    if not isinstance(name, str):
+        raise TypeError("Variable name must be a string")
+    attr = AttrScope.current().get(attr)
+    if shape is not None:
+        attr = dict(attr)
+        attr["__shape__"] = str(tuple(shape))
+    for k, v in kwargs.items():
+        attr = dict(attr)
+        attr[k] = str(v)
+    return Symbol([(_Node(None, name, [], attr), 0)])
+
+
+def Group(symbols):
+    heads = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for spec in data["nodes"]:
+        attrs = spec.get("attr", spec.get("param", {})) or {}
+        inputs = [(nodes[i], ci) for i, ci, *_ in spec["inputs"]]
+        if spec["op"] in ("null", "None"):
+            node = _Node(None, spec["name"], [], attrs)
+        else:
+            cls = OP_REGISTRY.get(spec["op"])
+            fields = cls.param_cls._fields if cls.param_cls is not None else {}
+            # nodes may carry arbitrary user/graph attrs (ctx_group, lr_mult,
+            # custom tags); only declared param fields configure the op
+            op_kwargs = {k: v for k, v in attrs.items() if k in fields}
+            op = create_operator(spec["op"], **op_kwargs)
+            node = _Node(op, spec["name"], inputs, attrs)
+        nodes.append(node)
+    heads = [(nodes[i], ci) for i, ci, *_ in data["heads"]]
+    return Symbol(heads)
+
+
+def load(fname):
+    with open(fname) as fi:
+        return load_json(fi.read())
+
+
+# ----------------------------------------------------------------------
+# op creator functions (parity: symbol.py:1090-1104 _init_symbol_module)
+# ----------------------------------------------------------------------
+def _create(op_name, *args, **kwargs):
+    explicit_name = kwargs.pop("name", None)
+    attr = kwargs.pop("attr", None)
+
+    sym_kwargs = {}
+    attr_kwargs = {}
+    for k, v in kwargs.items():
+        if isinstance(v, Symbol):
+            sym_kwargs[k] = v
+        else:
+            attr_kwargs[k] = v
+
+    pos_syms = []
+    for a in args:
+        if isinstance(a, Symbol):
+            pos_syms.append(a)
+        else:
+            raise MXNetError("%s: positional arguments must be Symbols, got %r"
+                             % (op_name, type(a)))
+
+    cls = OP_REGISTRY.get(op_name)
+    if getattr(cls, "param_cls", None) is not None and \
+            "num_args" in cls.param_cls._fields and "num_args" not in attr_kwargs:
+        attr_kwargs["num_args"] = len(pos_syms) + len(sym_kwargs)
+
+    op = create_operator(op_name, **attr_kwargs)
+    hint = op.hint or op_name.lower().strip("_")
+    name = NameManager.current().get(explicit_name, hint)
+    attrs = AttrScope.current().get(attr)
+    attrs = dict(attrs)
+    attrs.update(op.attrs)
+
+    arg_names = op.list_arguments()
+    inputs = {}
+    for aname, s in zip(arg_names, pos_syms):
+        inputs[aname] = s
+    for aname, s in sym_kwargs.items():
+        if aname not in arg_names:
+            raise MXNetError("%s: unknown input %r; inputs are %s"
+                             % (op_name, aname, arg_names))
+        if aname in inputs:
+            raise MXNetError("%s: input %r given twice" % (op_name, aname))
+        inputs[aname] = s
+    # auto-create missing inputs as variables named {name}_{arg}
+    entries = []
+    for aname in arg_names:
+        if aname in inputs:
+            s = inputs[aname]
+            if len(s._heads) != 1:
+                raise MXNetError("%s: input %r must have a single output"
+                                 % (op_name, aname))
+            entries.append(s._heads[0])
+        else:
+            var = Variable("%s_%s" % (name, aname))
+            entries.append(var._heads[0])
+
+    node = _Node(op, name, entries, attrs)
+    return Symbol([(node, i) for i in range(op.num_outputs)])
+
+
+def _make_creator(op_name):
+    def creator(*args, **kwargs):
+        return _create(op_name, *args, **kwargs)
+    creator.__name__ = op_name
+    cls = OP_REGISTRY.get(op_name)
+    doc = cls.__doc__ or ""
+    if getattr(cls, "param_cls", None) is not None:
+        doc += "\n\nParameters\n----------\n" + cls.param_cls.describe()
+    creator.__doc__ = doc
+    return creator
+
+
+def _init_symbol_module():
+    """Inject one creator per registered op into this module's namespace."""
+    g = globals()
+    for name, _cls in OP_REGISTRY.items():
+        if name not in g:
+            g[name] = _make_creator(name)
+
+
+from . import ops as _ops  # noqa: E402  (triggers op registration)
+_init_symbol_module()

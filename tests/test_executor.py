@@ -1,0 +1,187 @@
+"""Executor binding/running tests (modeled on tests/python/unittest/test_executor.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import assert_almost_equal
+
+rng = np.random.RandomState(7)
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _init(exe, seed=0):
+    r = np.random.RandomState(seed)
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = r.uniform(-0.1, 0.1, arr.shape).astype(np.float32)
+
+
+def test_bind_forward_backward():
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(0), data=(8, 20))
+    _init(exe)
+    exe.arg_dict["data"][:] = rng.rand(8, 20).astype(np.float32)
+    exe.arg_dict["softmax_label"][:] = np.arange(8) % 4
+    out = exe.forward(is_train=True)[0]
+    assert out.shape == (8, 4)
+    assert np.allclose(out.asnumpy().sum(1), 1, atol=1e-5)
+    exe.backward()
+    assert np.abs(exe.grad_dict["fc1_weight"].asnumpy()).sum() > 0
+
+
+def test_bind_explicit_arrays():
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    z = x * y
+    a = mx.nd.array(rng.rand(3, 3).astype(np.float32))
+    b = mx.nd.array(rng.rand(3, 3).astype(np.float32))
+    ga = mx.nd.zeros((3, 3))
+    gb = mx.nd.zeros((3, 3))
+    exe = z.bind(mx.cpu(0), args=[a, b], args_grad=[ga, gb])
+    out = exe.forward()[0]
+    assert_almost_equal(out, a.asnumpy() * b.asnumpy())
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones((3, 3))])
+    assert_almost_equal(ga, b.asnumpy())
+    assert_almost_equal(gb, a.asnumpy())
+
+
+def test_grad_req_variants():
+    x = sym.Variable("x")
+    y = sym.sqrt(x) * 2.0
+    data = np.abs(rng.rand(4, 4)).astype(np.float32) + 0.5
+    # write
+    exe = y.simple_bind(mx.cpu(0), grad_req="write", x=(4, 4))
+    exe.arg_dict["x"][:] = data
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones((4, 4))])
+    g1 = exe.grad_dict["x"].asnumpy().copy()
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones((4, 4))])
+    assert_almost_equal(exe.grad_dict["x"].asnumpy(), g1)
+    # add
+    exe2 = y.simple_bind(mx.cpu(0), grad_req="add", x=(4, 4))
+    exe2.arg_dict["x"][:] = data
+    exe2.forward(is_train=True)
+    exe2.backward([mx.nd.ones((4, 4))])
+    exe2.forward(is_train=True)
+    exe2.backward([mx.nd.ones((4, 4))])
+    assert_almost_equal(exe2.grad_dict["x"].asnumpy(), 2 * g1, rtol=1e-4)
+    # null
+    exe3 = y.simple_bind(mx.cpu(0), grad_req="null", x=(4, 4))
+    exe3.arg_dict["x"][:] = data
+    exe3.forward(is_train=True)
+    exe3.backward([mx.nd.ones((4, 4))])
+    assert "x" not in exe3.grad_dict
+
+
+def test_executor_outputs_multi():
+    x = sym.Variable("x")
+    sc = sym.SliceChannel(x, num_outputs=2, name="sc")
+    data = rng.rand(2, 4).astype(np.float32)
+    exe = sc.bind(mx.cpu(0), {"x": mx.nd.array(data)})
+    outs = exe.forward()
+    assert len(outs) == 2
+    assert_almost_equal(outs[0], data[:, :2])
+    assert_almost_equal(outs[1], data[:, 2:])
+
+
+def test_reshape_shares_params():
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(0), data=(8, 20))
+    _init(exe)
+    exe2 = exe.reshape(partial_shaping=True, data=(4, 20))
+    assert exe2.arg_dict["fc1_weight"] is exe.arg_dict["fc1_weight"]
+    out = exe2.forward(is_train=False,
+                       data=rng.rand(4, 20).astype(np.float32))[0]
+    assert out.shape == (4, 4)
+    with pytest.raises(MXNetError):
+        exe.reshape(data=(4, 20))  # label shape changes -> needs partial
+
+
+def test_monitor_callback():
+    seen = []
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(0), data=(2, 20))
+    _init(exe)
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    exe.forward(is_train=False)
+    assert "fc1_output" in seen
+    assert any(n.startswith("softmax") for n in seen)
+
+
+def test_copy_params_from():
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(0), data=(2, 20))
+    w = mx.nd.array(rng.rand(16, 20).astype(np.float32))
+    exe.copy_params_from({"fc1_weight": w})
+    assert_almost_equal(exe.arg_dict["fc1_weight"], w.asnumpy())
+    with pytest.raises(MXNetError):
+        exe.copy_params_from({"nope": w})
+    exe.copy_params_from({"nope": w}, allow_extra_params=True)
+
+
+def test_forward_backward_fused():
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(0), data=(8, 20))
+    _init(exe)
+    exe.arg_dict["data"][:] = rng.rand(8, 20).astype(np.float32)
+    exe.arg_dict["softmax_label"][:] = np.arange(8) % 4
+    # fused result equals separate forward+backward
+    exe.forward(is_train=True)
+    exe.backward()
+    g_sep = exe.grad_dict["fc2_weight"].asnumpy().copy()
+    out_fused = exe.forward_backward()[0]
+    assert np.allclose(out_fused.asnumpy().sum(1), 1, atol=1e-5)
+    assert_almost_equal(exe.grad_dict["fc2_weight"].asnumpy(), g_sep, rtol=1e-4)
+
+
+def test_shared_exec_compile_cache():
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(0), data=(8, 20))
+    exe2 = net.simple_bind(mx.cpu(0), data=(16, 20), shared_exec=exe)
+    assert exe2._jit_forward is exe._jit_forward
+    _init(exe2)
+    out = exe2.forward(is_train=False,
+                       data=rng.rand(16, 20).astype(np.float32))[0]
+    assert out.shape == (16, 4)
+
+
+def test_ctx_group_model_parallel():
+    """group2ctx placement (test_model_parallel.py:28-40 pattern): same
+    result with and without placement."""
+    with mx.AttrScope(ctx_group="stage1"):
+        data = sym.Variable("data")
+        fc1 = sym.FullyConnected(data, num_hidden=8, name="fc1")
+        act = sym.Activation(fc1, act_type="relu")
+    with mx.AttrScope(ctx_group="stage2"):
+        fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+        net = sym.LinearRegressionOutput(fc2, name="lro")
+
+    shapes = {"data": (4, 6)}
+    exe_plain = net.simple_bind(mx.cpu(0), **shapes)
+    exe_mp = net.simple_bind(
+        mx.cpu(0), group2ctx={"stage1": mx.cpu(1), "stage2": mx.cpu(2)},
+        **shapes)
+    r = np.random.RandomState(3)
+    for name, arr in exe_plain.arg_dict.items():
+        v = r.uniform(-1, 1, arr.shape).astype(np.float32)
+        arr[:] = v
+        exe_mp.arg_dict[name][:] = v
+    o1 = exe_plain.forward(is_train=True)[0].asnumpy()
+    o2 = exe_mp.forward(is_train=True)[0].asnumpy()
+    assert_almost_equal(o1, o2)
+    exe_plain.backward()
+    exe_mp.backward()
+    for name in exe_plain.grad_dict:
+        assert_almost_equal(exe_plain.grad_dict[name].asnumpy(),
+                            exe_mp.grad_dict[name].asnumpy(), rtol=1e-4)

@@ -1,0 +1,93 @@
+"""Shape inference tests (modeled on tests/python/unittest/test_infer_shape.py)."""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+
+
+def _assert_shapes(symbol, arg_shapes_expect, out_shapes_expect=None, **kwargs):
+    arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+    assert arg_shapes is not None
+    assert dict(zip(symbol.list_arguments(), arg_shapes)) == arg_shapes_expect
+    if out_shapes_expect is not None:
+        assert out_shapes == out_shapes_expect
+
+
+def test_mlp_infer():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, name="fc1", num_hidden=30)
+    net = sym.SoftmaxOutput(fc1, name="sm")
+    _assert_shapes(net,
+                   {"data": (100, 50), "fc1_weight": (30, 50),
+                    "fc1_bias": (30,), "sm_label": (100,)},
+                   [(100, 30)],
+                   data=(100, 50))
+
+
+def test_incomplete_returns_none():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=10)
+    a, o, x = net.infer_shape()
+    assert a is None and o is None and x is None
+    # partial still reports what it can
+    a, o, x = net.infer_shape_partial()
+    assert a[0] is None
+
+
+def test_conv_chain():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1), name="c1")
+    pool = sym.Pooling(conv, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    flat = sym.Flatten(pool)
+    fc = sym.FullyConnected(flat, num_hidden=10, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape(data=(2, 3, 28, 28))
+    shapes = dict(zip(fc.list_arguments(), arg_shapes))
+    assert shapes["c1_weight"] == (8, 3, 3, 3)
+    assert shapes["fc_weight"] == (10, 8 * 14 * 14)
+    assert out_shapes[0] == (2, 10)
+
+
+def test_backfill_from_weight():
+    """Weight shape determines nothing upstream, but label backfills from data."""
+    data = sym.Variable("data")
+    out = sym.SoftmaxOutput(sym.FullyConnected(data, num_hidden=4, name="fc"),
+                            name="sm")
+    arg_shapes, _, _ = out.infer_shape(data=(10, 6))
+    shapes = dict(zip(out.list_arguments(), arg_shapes))
+    assert shapes["sm_label"] == (10,)
+
+
+def test_mismatch_raises():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    with pytest.raises(MXNetError):
+        c.infer_shape(a=(2, 3), b=(4, 5))
+
+
+def test_batchnorm_aux_shapes():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn")
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(8, 5, 4, 4))
+    assert aux_shapes == [(5,), (5,)]
+    assert dict(zip(bn.list_arguments(), arg_shapes))["bn_gamma"] == (5,)
+
+
+def test_reshape_infer():
+    data = sym.Variable("data")
+    r = sym.Reshape(data, shape=(0, -1))
+    _, out_shapes, _ = r.infer_shape(data=(4, 3, 2))
+    assert out_shapes[0] == (4, 6)
+    r2 = sym.Reshape(data, target_shape=(0, 6))
+    _, out_shapes, _ = r2.infer_shape(data=(4, 3, 2))
+    assert out_shapes[0] == (4, 6)
+
+
+def test_deconv_infer():
+    data = sym.Variable("data")
+    d = sym.Deconvolution(data, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                          num_filter=8, name="dc")
+    arg_shapes, out_shapes, _ = d.infer_shape(data=(1, 16, 8, 8))
+    assert out_shapes[0] == (1, 8, 16, 16)
+    assert dict(zip(d.list_arguments(), arg_shapes))["dc_weight"] == (16, 8, 4, 4)

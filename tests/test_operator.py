@@ -1,0 +1,495 @@
+"""Per-operator forward/backward checks vs numpy
+(modeled on tests/python/unittest/test_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward,
+                                  check_symbolic_backward)
+
+rng = np.random.RandomState(12345)
+
+
+def _f32(*shape):
+    return rng.uniform(-1, 1, size=shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------- elementwise
+def test_elementwise_binary():
+    a, b = _f32(3, 4), _f32(3, 4)
+    x, y = sym.Variable("x"), sym.Variable("y")
+    check_symbolic_forward(x + y, [a, b], [a + b])
+    check_symbolic_forward(x - y, [a, b], [a - b])
+    check_symbolic_forward(x * y, [a, b], [a * b])
+    check_symbolic_forward(x / y, [a, b], [a / b], rtol=1e-3, atol=1e-4)
+    check_symbolic_forward(sym._Maximum(x, y), [a, b], [np.maximum(a, b)])
+    check_symbolic_forward(sym._Minimum(x, y), [a, b], [np.minimum(a, b)])
+
+
+def test_elementwise_backward():
+    a, b = _f32(3, 4), _f32(3, 4)
+    x, y = sym.Variable("x"), sym.Variable("y")
+    og = _f32(3, 4)
+    check_symbolic_backward(x * y, [a, b], [og], [og * b, og * a])
+    check_symbolic_backward(x + y, [a, b], [og], [og, og])
+
+
+def test_scalar_ops():
+    a = _f32(3, 4)
+    x = sym.Variable("x")
+    check_symbolic_forward(x + 2, [a], [a + 2])
+    check_symbolic_forward(2 - x, [a], [2 - a])
+    check_symbolic_forward(x * 3, [a], [a * 3])
+    check_symbolic_forward(6.0 / (x + 3), [a], [6 / (a + 3)], rtol=1e-3)
+    check_symbolic_forward(x ** 2, [a], [a ** 2], rtol=1e-3)
+
+
+def test_unary_math():
+    a = rng.uniform(0.5, 2, size=(3, 4)).astype(np.float32)
+    x = sym.Variable("x")
+    for s, f in [(sym.sqrt(x), np.sqrt), (sym.exp(x), np.exp),
+                 (sym.log(x), np.log), (sym.square(x), np.square),
+                 (sym.cos(x), np.cos), (sym.sin(x), np.sin),
+                 (sym.abs(x), np.abs), (sym.sign(x), np.sign),
+                 (sym.ceil(x), np.ceil), (sym.floor(x), np.floor),
+                 (sym.rsqrt(x), lambda v: 1 / np.sqrt(v))]:
+        check_symbolic_forward(s, [a], [f(a)], rtol=1e-3, atol=1e-5)
+    check_numeric_gradient(sym.sqrt(x) * sym.exp(x), {"x": a.astype(np.float64)})
+
+
+def test_reductions():
+    a = _f32(2, 3, 4)
+    x = sym.Variable("x")
+    check_symbolic_forward(sym.sum(x), [a], [a.sum().reshape(1)], rtol=1e-3)
+    check_symbolic_forward(sym.sum(x, axis=(1,)), [a], [a.sum(1)], rtol=1e-3)
+    check_symbolic_forward(sym.sum(x, axis=(0, 2), keepdims=True), [a],
+                           [a.sum((0, 2), keepdims=True)], rtol=1e-3)
+    check_symbolic_forward(sym.max(x, axis=(1,)), [a], [a.max(1)])
+    check_symbolic_forward(sym.min(x), [a], [a.min().reshape(1)])
+    check_symbolic_forward(sym.norm(x), [a],
+                           [np.sqrt((a ** 2).sum()).reshape(1)], rtol=1e-3)
+
+
+def test_dot():
+    a, b = _f32(4, 5), _f32(5, 6)
+    x, y = sym.Variable("x"), sym.Variable("y")
+    check_symbolic_forward(sym.dot(x, y), [a, b], [a.dot(b)], rtol=1e-3)
+    check_symbolic_forward(sym.dot(x, y, transpose_a=True),
+                           [a.T.copy(), b], [a.dot(b)], rtol=1e-3)
+    og = _f32(4, 6)
+    check_symbolic_backward(sym.dot(x, y), [a, b], [og],
+                            [og.dot(b.T), a.T.dot(og)], rtol=1e-3)
+    # batched
+    ba, bb = _f32(2, 4, 5), _f32(2, 5, 6)
+    check_symbolic_forward(sym.batch_dot(x, y), [ba, bb],
+                           [np.matmul(ba, bb)], rtol=1e-3)
+
+
+def test_transpose_reshape_ops():
+    a = _f32(2, 3, 4)
+    x = sym.Variable("x")
+    check_symbolic_forward(sym.transpose(x), [a], [a.T])
+    check_symbolic_forward(sym.transpose(x, axes=(1, 0, 2)), [a],
+                           [a.transpose(1, 0, 2)])
+    check_symbolic_forward(sym.expand_dims(x, axis=1), [a], [a[:, None]])
+    check_symbolic_forward(sym.flip(x, axis=1), [a], [a[:, ::-1]])
+    check_symbolic_forward(sym.slice_axis(x, axis=2, begin=1, end=3), [a],
+                           [a[:, :, 1:3]])
+    check_symbolic_forward(sym.SwapAxis(x, dim1=0, dim2=2), [a],
+                           [np.swapaxes(a, 0, 2)])
+
+
+def test_broadcast_ops():
+    a = _f32(1, 3, 1)
+    x = sym.Variable("x")
+    check_symbolic_forward(sym.broadcast_axis(x, axis=(0, 2), size=(2, 4)), [a],
+                           [np.broadcast_to(a, (2, 3, 4))])
+    check_symbolic_forward(sym.broadcast_to(x, shape=(2, 0, 4)), [a],
+                           [np.broadcast_to(a, (2, 3, 4))])
+    # broadcast backward sums over broadcast axes
+    og = np.ones((2, 3, 4), dtype=np.float32)
+    check_symbolic_backward(sym.broadcast_axis(x, axis=(0, 2), size=(2, 4)),
+                            [a], [og], [np.full((1, 3, 1), 8, np.float32)])
+
+
+def test_activation():
+    a = _f32(3, 4)
+    x = sym.Variable("x")
+    check_symbolic_forward(sym.Activation(x, act_type="relu"), [a],
+                           [np.maximum(a, 0)])
+    check_symbolic_forward(sym.Activation(x, act_type="sigmoid"), [a],
+                           [1 / (1 + np.exp(-a))], rtol=1e-3)
+    check_symbolic_forward(sym.Activation(x, act_type="tanh"), [a],
+                           [np.tanh(a)], rtol=1e-3)
+    check_symbolic_forward(sym.Activation(x, act_type="softrelu"), [a],
+                           [np.log1p(np.exp(a))], rtol=1e-3)
+    check_numeric_gradient(sym.Activation(x, act_type="tanh"),
+                           {"x": a.astype(np.float64)})
+
+
+def test_leaky_relu():
+    a = _f32(3, 4)
+    x = sym.Variable("x")
+    check_symbolic_forward(sym.LeakyReLU(x, act_type="leaky", slope=0.1), [a],
+                           [np.where(a > 0, a, 0.1 * a)])
+    check_symbolic_forward(sym.LeakyReLU(x, act_type="elu", slope=0.5), [a],
+                           [np.where(a > 0, a, 0.5 * (np.exp(a) - 1))], rtol=1e-3)
+    # prelu with learnable gamma
+    g = np.array([0.1, 0.2, 0.3, 0.4], dtype=np.float32)
+    pr = sym.LeakyReLU(x, act_type="prelu", name="pr")
+    assert pr.list_arguments() == ["x", "pr_gamma"]
+    check_symbolic_forward(pr, [a, g], [np.where(a > 0, a, g[None, :] * a)])
+
+
+def test_fully_connected():
+    a, w, b = _f32(5, 8), _f32(3, 8), _f32(3)
+    x = sym.Variable("x")
+    fc = sym.FullyConnected(x, num_hidden=3, name="fc")
+    check_symbolic_forward(fc, [a, w, b], [a.dot(w.T) + b], rtol=1e-3)
+    og = _f32(5, 3)
+    check_symbolic_backward(fc, [a, w, b], [og],
+                            [og.dot(w), og.T.dot(a), og.sum(0)], rtol=1e-3)
+    fc_nb = sym.FullyConnected(x, num_hidden=3, no_bias=True, name="fcnb")
+    check_symbolic_forward(fc_nb, [a, w], [a.dot(w.T)], rtol=1e-3)
+
+
+def test_convolution():
+    # compare against explicit im2col-style numpy conv
+    data = _f32(2, 3, 7, 7)
+    weight = _f32(4, 3, 3, 3)
+    bias = _f32(4)
+    x = sym.Variable("x")
+    conv = sym.Convolution(x, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                           stride=(2, 2), name="conv")
+
+    def np_conv(d, w, b, pad, stride):
+        n, c, h, ww = d.shape
+        f, _, kh, kw = w.shape
+        dp = np.pad(d, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        oh = (h + 2 * pad - kh) // stride + 1
+        ow = (ww + 2 * pad - kw) // stride + 1
+        out = np.zeros((n, f, oh, ow), np.float32)
+        for i in range(oh):
+            for j in range(ow):
+                patch = dp[:, :, i * stride:i * stride + kh,
+                           j * stride:j * stride + kw]
+                out[:, :, i, j] = np.einsum("nchw,fchw->nf", patch, w)
+        return out + b[None, :, None, None]
+
+    expect = np_conv(data, weight, bias, 1, 2)
+    check_symbolic_forward(conv, [data, weight, bias], [expect], rtol=1e-3,
+                           atol=1e-4)
+    # numeric check on a small instance (keeps eval count manageable)
+    sconv = sym.Convolution(x, kernel=(3, 3), num_filter=2, pad=(1, 1),
+                            name="sc")
+    check_numeric_gradient(sconv, {"x": _f32(1, 2, 4, 4).astype(np.float64),
+                                   "sc_weight": _f32(2, 2, 3, 3).astype(np.float64),
+                                   "sc_bias": _f32(2).astype(np.float64)},
+                           rtol=5e-2, atol=5e-2)
+
+
+def test_grouped_convolution():
+    data = _f32(1, 4, 5, 5)
+    weight = _f32(4, 2, 3, 3)
+    x = sym.Variable("x")
+    conv = sym.Convolution(x, kernel=(3, 3), num_filter=4, num_group=2,
+                           no_bias=True, name="gconv")
+    arg_shapes, out_shapes, _ = conv.infer_shape(x=(1, 4, 5, 5))
+    assert dict(zip(conv.list_arguments(), arg_shapes))["gconv_weight"] == (4, 2, 3, 3)
+    exe = conv.bind(mx.cpu(0), {"x": mx.nd.array(data),
+                                "gconv_weight": mx.nd.array(weight)})
+    out = exe.forward()[0].asnumpy()
+    # group 0 uses channels 0:2, group 1 uses channels 2:4
+    half0 = out[:, :2]
+    dp = data[:, :2]
+    ref = np.zeros_like(half0)
+    for i in range(3):
+        for j in range(3):
+            ref += np.einsum("nchw,fc->nfhw",
+                             dp[:, :, i:i + 3, j:j + 3], weight[:2, :, i, j])
+    assert_almost_equal(half0, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_pooling():
+    data = _f32(2, 3, 6, 6)
+    x = sym.Variable("x")
+    mp = sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    expect = data.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+    check_symbolic_forward(mp, [data], [expect])
+    ap = sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    expect = data.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+    check_symbolic_forward(ap, [data], [expect], rtol=1e-3)
+    gp = sym.Pooling(x, kernel=(1, 1), global_pool=True, pool_type="max")
+    check_symbolic_forward(gp, [data], [data.max(axis=(2, 3), keepdims=True)])
+    # 'full' convention rounds up
+    fp = sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max",
+                     pooling_convention="full")
+    _, out_shapes, _ = fp.infer_shape(x=(2, 3, 6, 6))
+    assert out_shapes[0] == (2, 3, 3, 3)
+
+
+def test_batchnorm_forward():
+    data = _f32(4, 3, 2, 2)
+    gamma = np.abs(_f32(3)) + 0.5
+    beta = _f32(3)
+    x = sym.Variable("x")
+    bn = sym.BatchNorm(x, fix_gamma=False, name="bn")
+    mean = data.mean(axis=(0, 2, 3))
+    var = data.var(axis=(0, 2, 3))
+    expect = ((data - mean[None, :, None, None])
+              / np.sqrt(var[None, :, None, None] + 1e-3)
+              * gamma[None, :, None, None] + beta[None, :, None, None])
+    check_symbolic_forward(bn, [data, gamma, beta], [expect], rtol=1e-2,
+                           atol=1e-3,
+                           aux_states=[np.zeros(3, np.float32),
+                                       np.ones(3, np.float32)],
+                           is_train=True)
+
+
+def test_dropout():
+    data = np.ones((200, 200), dtype=np.float32)
+    x = sym.Variable("x")
+    do = sym.Dropout(x, p=0.5)
+    exe = do.bind(mx.cpu(0), {"x": mx.nd.array(data)})
+    out = exe.forward(is_train=True)[0].asnumpy()
+    frac_kept = (out > 0).mean()
+    assert abs(frac_kept - 0.5) < 0.05
+    assert_almost_equal(out[out > 0], np.full((out > 0).sum(), 2.0, np.float32))
+    out_eval = exe.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(out_eval, data)
+
+
+def test_concat_slice():
+    a, b = _f32(2, 3, 4), _f32(2, 5, 4)
+    x, y = sym.Variable("x"), sym.Variable("y")
+    check_symbolic_forward(sym.Concat(x, y, dim=1, name="cat"), [a, b],
+                           [np.concatenate([a, b], 1)])
+    og = _f32(2, 8, 4)
+    check_symbolic_backward(sym.Concat(x, y, dim=1, name="cat2"), [a, b], [og],
+                            [og[:, :3], og[:, 3:]])
+    data = _f32(2, 6, 4)
+    sc = sym.SliceChannel(sym.Variable("d"), num_outputs=3, name="sc")
+    check_symbolic_forward(sc, [data], [data[:, :2], data[:, 2:4], data[:, 4:]])
+
+
+def test_reshape_flatten():
+    a = _f32(2, 3, 4)
+    x = sym.Variable("x")
+    check_symbolic_forward(sym.Reshape(x, shape=(2, 12)), [a], [a.reshape(2, 12)])
+    check_symbolic_forward(sym.Reshape(x, shape=(0, -1)), [a], [a.reshape(2, 12)])
+    check_symbolic_forward(sym.Flatten(x), [a], [a.reshape(2, 12)])
+
+
+def test_embedding():
+    ids = np.array([1, 0, 3, 2], dtype=np.float32)
+    weight = _f32(4, 5)
+    e = sym.Embedding(sym.Variable("ids"), input_dim=4, output_dim=5, name="em")
+    check_symbolic_forward(e, [ids, weight], [weight[ids.astype(int)]])
+    og = _f32(4, 5)
+    expect_w = np.zeros_like(weight)
+    for i, ix in enumerate(ids.astype(int)):
+        expect_w[ix] += og[i]
+    check_symbolic_backward(e, [ids, weight], [og], {"em_weight": expect_w})
+
+
+def test_blockgrad_makeloss():
+    a = _f32(3, 4)
+    x = sym.Variable("x")
+    bg = sym.BlockGrad(x)
+    check_symbolic_forward(bg, [a], [a])
+    check_symbolic_backward(bg, [a], [np.ones_like(a)], [np.zeros_like(a)])
+    ml = sym.MakeLoss(x, grad_scale=2.0)
+    check_symbolic_forward(ml, [a], [a])
+    check_symbolic_backward(ml, [a], [np.ones_like(a)],
+                            [np.full_like(a, 2.0)])
+
+
+def test_softmax_output():
+    data = _f32(4, 5)
+    label = np.array([0, 2, 4, 1], dtype=np.float32)
+    x = sym.Variable("x")
+    sm = sym.SoftmaxOutput(x, name="sm", grad_scale=1.0)
+    e = np.exp(data - data.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    check_symbolic_forward(sm, [data, label], [p], rtol=1e-3)
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    check_symbolic_backward(sm, [data, label], [np.ones_like(data)],
+                            {"x": p - onehot}, rtol=1e-3)
+
+
+def test_softmax_output_ignore():
+    data = _f32(4, 5)
+    label = np.array([0, -1, 4, -1], dtype=np.float32)
+    x = sym.Variable("x")
+    sm = sym.SoftmaxOutput(x, name="sm", use_ignore=True, ignore_label=-1)
+    e = np.exp(data - data.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    grad = p.copy()
+    for i, l in enumerate(label.astype(int)):
+        if l == -1:
+            grad[i] = 0
+        else:
+            grad[i, l] -= 1
+    check_symbolic_backward(sm, [data, label], [np.ones_like(data)],
+                            {"x": grad}, rtol=1e-3)
+
+
+def test_regression_outputs():
+    data = _f32(4, 3)
+    label = _f32(4, 3)
+    x = sym.Variable("x")
+    lin = sym.LinearRegressionOutput(x, name="lin")
+    check_symbolic_forward(lin, [data, label], [data])
+    check_symbolic_backward(lin, [data, label], [np.ones_like(data)],
+                            {"x": data - label}, rtol=1e-3)
+    logi = sym.LogisticRegressionOutput(x, name="lo")
+    s = 1 / (1 + np.exp(-data))
+    check_symbolic_forward(logi, [data, label], [s], rtol=1e-3)
+    check_symbolic_backward(logi, [data, label], [np.ones_like(data)],
+                            {"x": s - label}, rtol=1e-3)
+    mae = sym.MAERegressionOutput(x, name="mae")
+    check_symbolic_backward(mae, [data, label], [np.ones_like(data)],
+                            {"x": np.sign(data - label)})
+
+
+def test_smooth_l1():
+    a = np.array([-2.0, -0.5, 0.0, 0.3, 1.5], dtype=np.float32)
+    x = sym.Variable("x")
+    s = sym.smooth_l1(x, scalar=1.0)
+    expect = np.where(np.abs(a) < 1, 0.5 * a ** 2, np.abs(a) - 0.5)
+    check_symbolic_forward(s, [a], [expect.astype(np.float32)])
+
+
+def test_softmax_cross_entropy():
+    data = _f32(4, 5)
+    label = np.array([0, 2, 4, 1], dtype=np.float32)
+    out = sym.softmax_cross_entropy(sym.Variable("x"), sym.Variable("l"))
+    e = np.exp(data - data.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    expect = -np.log(p[np.arange(4), label.astype(int)]).sum()
+    check_symbolic_forward(out, [data, label], [expect.reshape(1)], rtol=1e-3)
+
+
+def test_lrn():
+    data = np.abs(_f32(2, 8, 3, 3))
+    x = sym.Variable("x")
+    l = sym.LRN(x, nsize=3, alpha=1e-3, beta=0.75, knorm=2.0)
+    sq = data ** 2
+    pad = np.pad(sq, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    ssum = pad[:, 0:8] + pad[:, 1:9] + pad[:, 2:10]
+    expect = data * (2.0 + (1e-3 / 3) * ssum) ** -0.75
+    check_symbolic_forward(l, [data], [expect.astype(np.float32)], rtol=1e-3)
+
+
+def test_l2_normalization():
+    data = _f32(3, 4, 2)
+    x = sym.Variable("x")
+    out = sym.L2Normalization(x, mode="instance")
+    norm = np.sqrt((data ** 2).sum(axis=(1, 2), keepdims=True) + 1e-10)
+    check_symbolic_forward(out, [data], [data / norm], rtol=1e-3)
+    out_c = sym.L2Normalization(x, mode="channel")
+    norm = np.sqrt((data ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    check_symbolic_forward(out_c, [data], [data / norm], rtol=1e-3)
+
+
+def test_upsampling():
+    data = _f32(1, 2, 3, 3)
+    x = sym.Variable("x")
+    up = sym.UpSampling(x, scale=2, sample_type="nearest")
+    expect = data.repeat(2, axis=2).repeat(2, axis=3)
+    check_symbolic_forward(up, [data], [expect])
+
+
+def test_crop():
+    data = _f32(1, 2, 6, 6)
+    like = _f32(1, 2, 4, 4)
+    x, y = sym.Variable("x"), sym.Variable("y")
+    c = sym.Crop(x, y, num_args=2, offset=(1, 1), name="crop")
+    check_symbolic_forward(c, [data, like], [data[:, :, 1:5, 1:5]])
+    c2 = sym.Crop(x, num_args=1, h_w=(3, 3), center_crop=True, name="crop2")
+    # center crop of 6x6 to 3x3 starts at (1,1)
+    check_symbolic_forward(c2, [data], [data[:, :, 1:4, 1:4]])
+
+
+def test_cast():
+    data = _f32(3, 4)
+    x = sym.Variable("x")
+    c = sym.Cast(x, dtype="int32")
+    exe = c.bind(mx.cpu(0), {"x": mx.nd.array(data)})
+    out = exe.forward()[0]
+    assert out.dtype == np.int32
+
+
+def test_sequence_ops():
+    # (seq, batch, feat)
+    data = _f32(5, 3, 2)
+    lengths = np.array([2, 5, 3], dtype=np.float32)
+    d, l = sym.Variable("d"), sym.Variable("l")
+    last = sym.SequenceLast(d, l, use_sequence_length=True)
+    expect = np.stack([data[1, 0], data[4, 1], data[2, 2]])
+    check_symbolic_forward(last, [data, lengths], [expect])
+    mask = sym.SequenceMask(d, l, use_sequence_length=True, value=-1.0)
+    expect = data.copy()
+    expect[2:, 0] = -1
+    expect[3:, 2] = -1
+    check_symbolic_forward(mask, [data, lengths], [expect])
+    rev = sym.SequenceReverse(d, l, use_sequence_length=True)
+    expect = data.copy()
+    expect[:2, 0] = data[:2, 0][::-1]
+    expect[:5, 1] = data[:5, 1][::-1]
+    expect[:3, 2] = data[:3, 2][::-1]
+    check_symbolic_forward(rev, [data, lengths], [expect])
+
+
+def test_svm_output():
+    data = _f32(4, 3)
+    label = np.array([0, 1, 2, 1], dtype=np.float32)
+    x = sym.Variable("x")
+    svm = sym.SVMOutput(x, name="svm", margin=1.0, use_linear=True,
+                        regularization_coefficient=1.0)
+    check_symbolic_forward(svm, [data, label], [data])
+    # grads: for k != l with margin violation: +1; label gets -count
+    scores = data
+    grad = np.zeros_like(scores)
+    for i, l in enumerate(label.astype(int)):
+        for k in range(3):
+            if k != l and scores[i, k] - scores[i, l] + 1.0 > 0:
+                grad[i, k] += 1
+                grad[i, l] -= 1
+    check_symbolic_backward(svm, [data, label], [np.ones_like(data)],
+                            {"x": grad})
+
+
+def test_upsampling_multi_input_nonsquare():
+    """Non-square multi-input upsampling (review regression)."""
+    a, b = _f32(1, 1, 4, 6), _f32(1, 1, 2, 3)
+    x, y = sym.Variable("x"), sym.Variable("y")
+    up = sym.UpSampling(x, y, scale=2, sample_type="nearest", num_args=2)
+    exe = up.bind(mx.cpu(0), {"x": mx.nd.array(a), "y": mx.nd.array(b)})
+    out = exe.forward()[0]
+    assert out.shape == (1, 2, 8, 12)
+
+
+def test_softmax_output_out_grad():
+    """out_grad=True must scale by the head gradient (review regression)."""
+    data = _f32(4, 5)
+    label = np.array([0, 2, 4, 1], dtype=np.float32)
+    x = sym.Variable("x")
+    sm = sym.SoftmaxOutput(x, name="sm", out_grad=True)
+    e = np.exp(data - data.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    og = np.full_like(data, 2.0)
+    check_symbolic_backward(sm, [data, label], [og],
+                            {"x": (p - onehot) * 2.0}, rtol=1e-3)
+
+
+def test_param_none_validation():
+    from mxnet_tpu.base import MXNetError as MXE
+    x = sym.Variable("x")
+    with pytest.raises(MXE):
+        sym.Activation(x, act_type="None")
+    with pytest.raises(MXE):
+        sym.Convolution(x, kernel="None", num_filter=8)

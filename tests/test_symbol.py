@@ -1,0 +1,160 @@
+"""Symbol composition tests (modeled on tests/python/unittest/test_symbol.py)."""
+import os
+import tempfile
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+
+
+def mlp2():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data=data, name="fc1", num_hidden=1000)
+    out = sym.Activation(data=out, act_type="relu")
+    out = sym.FullyConnected(data=out, name="fc2", num_hidden=10)
+    return out
+
+
+def test_symbol_basic():
+    m = mlp2()
+    assert m.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                  "fc2_weight", "fc2_bias"]
+    assert m.list_outputs() == ["fc2_output"]
+
+
+def test_symbol_compose():
+    data = sym.Variable("data")
+    net1 = sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net1 = sym.FullyConnected(data=net1, name="fc2", num_hidden=100)
+    assert net1.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                     "fc2_weight", "fc2_bias"]
+    # explicit weight supply suppresses auto-creation
+    w = sym.Variable("myweight")
+    net2 = sym.FullyConnected(data=data, weight=w, name="fc3", num_hidden=10)
+    assert net2.list_arguments() == ["data", "myweight", "fc3_bias"]
+
+
+def test_symbol_internals():
+    m = mlp2()
+    internals = m.get_internals()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+    assert "fc1_output" in internals.list_outputs()
+
+
+def test_symbol_group():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=10)
+    fc2 = sym.FullyConnected(data, name="fc2", num_hidden=10)
+    g = sym.Group([fc1, fc2])
+    assert g.list_outputs() == ["fc1_output", "fc2_output"]
+    assert len(g) == 2
+    assert g[1].list_outputs() == ["fc2_output"]
+
+
+def test_symbol_arith():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    assert set(c.list_arguments()) == {"a", "b"}
+    d = (a * 2 - b / 3) ** 2.0
+    _, out_shapes, _ = d.infer_shape(a=(3, 4), b=(3, 4))
+    assert out_shapes[0] == (3, 4)
+    e = 1.0 - a
+    _, o, _ = e.infer_shape(a=(2, 2))
+    assert o[0] == (2, 2)
+
+
+def test_symbol_json_roundtrip():
+    m = mlp2()
+    js = m.tojson()
+    m2 = sym.load_json(js)
+    assert m2.list_arguments() == m.list_arguments()
+    assert m2.list_outputs() == m.list_outputs()
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "sym.json")
+        m.save(fname)
+        m3 = sym.load(fname)
+        assert m3.tojson() == js
+
+
+def test_symbol_attr():
+    data = sym.Variable("data", attr={"mood": "angry"})
+    assert data.attr("mood") == "angry"
+    with mx.AttrScope(ctx_group="stage1"):
+        fc = sym.FullyConnected(data, num_hidden=10, name="fc")
+    assert fc.attr("ctx_group") == "stage1"
+    ad = fc.attr_dict()
+    assert ad["data"]["mood"] == "angry"
+    assert ad["fc"]["ctx_group"] == "stage1"
+
+
+def test_symbol_errors():
+    data = sym.Variable("data")
+    with pytest.raises(MXNetError):
+        sym.FullyConnected(data, num_hidden=10, bogus_attr_xyz=3)
+    with pytest.raises(MXNetError):
+        sym.Activation(data, act_type="bogus")
+    with pytest.raises(MXNetError):
+        mlp2()["nonexistent_output"]
+
+
+def test_variable_shape_hint():
+    x = sym.Variable("x", shape=(4, 5))
+    y = sym.sqrt(x)
+    _, out, _ = y.infer_shape()
+    assert out[0] == (4, 5)
+
+
+def test_vararg_ops():
+    a, b, c = sym.Variable("a"), sym.Variable("b"), sym.Variable("c")
+    cat = sym.Concat(a, b, c, dim=1, name="cat")
+    arg_shapes, out_shapes, _ = cat.infer_shape(a=(2, 3), b=(2, 4), c=(2, 5))
+    assert out_shapes[0] == (2, 12)
+    s = sym.ElementWiseSum(a, b, c, name="esum")
+    _, out_shapes, _ = s.infer_shape(a=(2, 3), b=(2, 3), c=(2, 3))
+    assert out_shapes[0] == (2, 3)
+
+
+def test_slice_channel_outputs():
+    data = sym.Variable("data")
+    sc = sym.SliceChannel(data, num_outputs=3, name="sc")
+    assert sc.list_outputs() == ["sc_output0", "sc_output1", "sc_output2"]
+    _, out_shapes, _ = sc.infer_shape(data=(2, 6, 4))
+    assert out_shapes == [(2, 2, 4)] * 3
+
+
+def test_deep_chain_infer_fixpoint():
+    """Fixpoint inference must not cap iteration depth (review regression)."""
+    x = sym.Variable("x")
+    zs = [sym.Variable("z%d" % i) for i in range(6)]
+    ys = [x + zs[0]]
+    for i in range(5):
+        ys.append(zs[i] + zs[i + 1])
+    g = sym.Group(list(reversed(ys)))
+    arg_shapes, out_shapes, _ = g.infer_shape(x=(2, 3))
+    assert arg_shapes is not None
+    assert all(s == (2, 3) for s in out_shapes)
+
+
+def test_load_json_custom_attrs():
+    """Nodes carrying user attrs must reload (review regression)."""
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=3, name="fc",
+                            attr={"mood": "happy", "ctx_group": "g1"})
+    s2 = sym.load_json(fc.tojson())
+    assert s2.attr("mood") == "happy"
+    assert s2.list_arguments() == fc.list_arguments()
+
+
+def test_infer_type_cast():
+    import numpy as np
+    data = sym.Variable("data")
+    c = sym.Cast(data, dtype="float16")
+    arg_types, out_types, _ = c.infer_type(data=np.float32)
+    assert out_types[0] == np.float16
+    assert arg_types[0] == np.float32
+    with pytest.raises(MXNetError):
+        c.infer_type(bogus=np.float32)
