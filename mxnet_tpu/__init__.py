@@ -26,5 +26,18 @@ from . import symbol as sym
 from .symbol import Symbol, Variable, Group
 from . import executor
 from .executor import Executor
+from . import lr_scheduler
+from . import optimizer
+from . import optimizer as opt
+from . import initializer
+from . import initializer as init
+from . import metric
+from . import callback
+from . import io
+from . import recordio
+from . import image
+from . import kvstore
+from . import kvstore as kv
+from . import parallel
 
 __version__ = "0.1.0"

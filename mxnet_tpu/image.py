@@ -1,0 +1,114 @@
+"""Host-side image decode/encode/augmentation.
+
+TPU-native counterpart of the reference's OpenCV-backed image path
+(``src/io/image_augmenter.h``/``image_aug_default.cc``, ``imdecode`` NDArray
+function ``src/ndarray/ndarray.cc:919-944``).  Decode runs on host CPU (the
+reference's OMP decode threads, iter_image_recordio.cc:184-234); augmented
+uint8/float arrays are shipped to device once per batch.  Uses OpenCV when
+importable, else PIL — both are decode-only dependencies, never on the
+compute path.
+"""
+from __future__ import annotations
+
+import io as _io
+
+import numpy as _np
+
+__all__ = ["imdecode_bytes", "imencode", "augment", "imresize"]
+
+try:
+    import cv2 as _cv2
+except Exception:  # pragma: no cover
+    _cv2 = None
+try:
+    from PIL import Image as _PILImage
+except Exception:  # pragma: no cover
+    _PILImage = None
+
+
+def imdecode_bytes(buf, iscolor=1):
+    """Decode an encoded image buffer to an HWC uint8 RGB array."""
+    buf = bytes(buf)
+    if _cv2 is not None:
+        flag = _cv2.IMREAD_COLOR if iscolor != 0 else _cv2.IMREAD_GRAYSCALE
+        img = _cv2.imdecode(_np.frombuffer(buf, dtype=_np.uint8), flag)
+        if img is None:
+            raise ValueError("cannot decode image")
+        if img.ndim == 2:
+            img = img[:, :, None]
+        else:
+            img = _cv2.cvtColor(img, _cv2.COLOR_BGR2RGB)
+        return img
+    if _PILImage is not None:
+        img = _PILImage.open(_io.BytesIO(buf))
+        img = img.convert("L" if iscolor == 0 else "RGB")
+        arr = _np.asarray(img, dtype=_np.uint8)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr
+    raise ImportError("image decoding requires cv2 or PIL")
+
+
+def imencode(img, quality=95, img_fmt=".jpg"):
+    """Encode an HWC uint8 array to JPEG/PNG bytes."""
+    img = _np.asarray(img, dtype=_np.uint8)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    if _cv2 is not None:
+        enc = img if img.shape[2] == 1 else _cv2.cvtColor(img, _cv2.COLOR_RGB2BGR)
+        params = [_cv2.IMWRITE_JPEG_QUALITY, quality] \
+            if img_fmt.lower() in (".jpg", ".jpeg") else []
+        ok, buf = _cv2.imencode(img_fmt, enc, params)
+        if not ok:
+            raise ValueError("cannot encode image")
+        return buf.tobytes()
+    if _PILImage is not None:
+        mode = "L" if img.shape[2] == 1 else "RGB"
+        pimg = _PILImage.fromarray(img.squeeze() if mode == "L" else img, mode)
+        bio = _io.BytesIO()
+        fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+        pimg.save(bio, format=fmt, quality=quality)
+        return bio.getvalue()
+    raise ImportError("image encoding requires cv2 or PIL")
+
+
+def imresize(img, w, h):
+    if _cv2 is not None:
+        out = _cv2.resize(img, (w, h), interpolation=_cv2.INTER_LINEAR)
+        if out.ndim == 2:
+            out = out[:, :, None]
+        return out
+    pimg = _PILImage.fromarray(img.squeeze() if img.shape[2] == 1 else img)
+    out = _np.asarray(pimg.resize((w, h), _PILImage.BILINEAR), dtype=img.dtype)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return out
+
+
+def augment(img, data_shape, rand_crop=False, rand_mirror=False, rng=None):
+    """Default augmenter (parity: image_aug_default.cc DefaultImageAugmenter):
+    resize-to-fit + (random|center) crop to data_shape (C,H,W) + mirror."""
+    rng = rng or _np.random
+    c, th, tw = data_shape
+    h, w = img.shape[:2]
+    # upscale if needed so a crop fits
+    if h < th or w < tw:
+        scale = max(th / h, tw / w)
+        img = imresize(img, max(tw, int(w * scale + 0.5)),
+                       max(th, int(h * scale + 0.5)))
+        h, w = img.shape[:2]
+    if rand_crop:
+        y = rng.randint(0, h - th + 1)
+        x = rng.randint(0, w - tw + 1)
+    else:
+        y = (h - th) // 2
+        x = (w - tw) // 2
+    img = img[y:y + th, x:x + tw]
+    if rand_mirror and rng.randint(0, 2):
+        img = img[:, ::-1]
+    if img.shape[2] != c:
+        if c == 1:
+            img = img.mean(axis=2, keepdims=True).astype(img.dtype)
+        else:
+            img = _np.repeat(img[:, :, :1], c, axis=2)
+    return img

@@ -1,0 +1,258 @@
+"""Weight initializers.
+
+TPU-native counterpart of the reference's ``python/mxnet/initializer.py``
+(286 lines): name-pattern dispatch (``_weight``/``_bias``/``_gamma``/...),
+Uniform/Normal/Orthogonal/Xavier/MSRAPrelu, Load/Mixed wrappers.  Random
+draws use jax.random with a per-call split of the global framework key
+(mxnet_tpu.random) so runs are reproducible under mx.random.seed().
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+from . import random as _random
+
+__all__ = ["Initializer", "Uniform", "Normal", "Orthogonal", "Xavier",
+           "MSRAPrelu", "Constant", "One", "Zero", "Bilinear", "Load", "Mixed"]
+
+
+class Initializer(object):
+    """Base: dispatch on parameter name (parity: initializer.py:15 __call__)."""
+
+    def __call__(self, name, arr):
+        if not isinstance(name, str):
+            raise TypeError("name must be a string")
+        if not isinstance(arr, NDArray):
+            raise TypeError("arr must be NDArray")
+        if name.startswith("upsampling"):
+            self._init_bilinear(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("moving_mean"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_var"):
+            self._init_one(name, arr)
+        elif name.endswith("moving_inv_var"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    def _init_bilinear(self, _, arr):
+        shape = arr.shape
+        weight = _np.zeros(int(_np.prod(shape)), dtype="float32")
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data(jnp.asarray(weight.reshape(shape)))
+
+    def _init_zero(self, _, arr):
+        arr._set_data(jnp.zeros(arr.shape, dtype=arr.dtype))
+
+    def _init_one(self, _, arr):
+        arr._set_data(jnp.ones(arr.shape, dtype=arr.dtype))
+
+    def _init_bias(self, _, arr):
+        self._init_zero(_, arr)
+
+    def _init_gamma(self, _, arr):
+        self._init_one(_, arr)
+
+    def _init_beta(self, _, arr):
+        self._init_zero(_, arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("Must override _init_weight")
+
+    def _init_default(self, name, _):
+        raise ValueError(
+            "Unknown initialization pattern for %s. Default initialization "
+            "is now limited to \"weight\", \"bias\", \"gamma\" (1.0), and "
+            "\"beta\" (0.0). Please use mx.sym.Variable(init=mx.init.*) to "
+            "set the initialization pattern" % name)
+
+
+class Load(object):
+    """Init from existing param dict, fall back to ``default_init``
+    (parity: initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray import load as nd_load
+            param = nd_load(param)
+        self.param = {}
+        for name, arr in param.items():
+            if name.startswith("arg:") or name.startswith("aux:"):
+                self.param[name[4:]] = arr
+            else:
+                self.param[name] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            if arr.shape != self.param[name].shape:
+                raise MXNetError(
+                    "Parameter %s cannot be initialized from loading. "
+                    "Shape mismatch, target %s vs loaded %s"
+                    % (name, arr.shape, self.param[name].shape))
+            arr._set_data(self.param[name].data)
+            if self.verbose:
+                logging.info("Initialized %s by loading", name)
+        else:
+            if self.default_init is None:
+                raise MXNetError(
+                    "Cannot Initialize parameter %s. Not found in loaded "
+                    "param and no default initializer provided" % name)
+            self.default_init(name, arr)
+            if self.verbose:
+                logging.info("Initialized %s by default", name)
+
+
+class Mixed(object):
+    """Regex-pattern-dispatched initializer list (parity: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        assert len(patterns) == len(initializers)
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError(
+            "Parameter name %s did not match any pattern. Consider adding a "
+            "\".*\" pattern at the end with default Initializer." % name)
+
+
+class Constant(Initializer):
+    """Fill with a constant regardless of the name pattern."""
+
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, name, arr):
+        arr._set_data(jnp.full(arr.shape, self.value, dtype=arr.dtype))
+
+
+class Zero(Constant):
+    def __init__(self):
+        super().__init__(0.0)
+
+
+class One(Constant):
+    def __init__(self):
+        super().__init__(1.0)
+
+
+class Uniform(Initializer):
+    """U(-scale, scale) (parity: initializer.py Uniform)."""
+
+    def __init__(self, scale=0.07):
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        key = _random.next_key()
+        arr._set_data(jax.random.uniform(
+            key, arr.shape, dtype=jnp.float32,
+            minval=-self.scale, maxval=self.scale).astype(arr.dtype))
+
+
+class Normal(Initializer):
+    """N(0, sigma) (parity: initializer.py Normal)."""
+
+    def __init__(self, sigma=0.01):
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        key = _random.next_key()
+        arr._set_data((jax.random.normal(key, arr.shape, dtype=jnp.float32)
+                       * self.sigma).astype(arr.dtype))
+
+
+class Orthogonal(Initializer):
+    """(Scaled) orthogonal matrix via QR/SVD (parity: initializer.py Orthogonal)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        key = _random.next_key()
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (nout, nin), minval=-1.0, maxval=1.0)
+        else:
+            tmp = jax.random.normal(key, (nout, nin))
+        u, _s, v = _np.linalg.svd(_np.asarray(tmp), full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr._set_data(jnp.asarray(self.scale * q.reshape(arr.shape),
+                                  dtype=arr.dtype))
+
+
+class Xavier(Initializer):
+    """Xavier/Glorot (parity: initializer.py Xavier): factor from fan_in/out,
+    rnd_type uniform or gaussian."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) > 2:
+            hw_scale = _np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = _np.sqrt(self.magnitude / factor)
+        key = _random.next_key()
+        if self.rnd_type == "uniform":
+            val = jax.random.uniform(key, shape, minval=-scale, maxval=scale)
+        elif self.rnd_type == "gaussian":
+            val = jax.random.normal(key, shape) * scale
+        else:
+            raise ValueError("Unknown random type")
+        arr._set_data(val.astype(arr.dtype))
+
+
+class MSRAPrelu(Xavier):
+    """He init adjusted for PReLU slope (parity: initializer.py MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel for deconvolution weights."""
+
+    def _init_weight(self, name, arr):
+        self._init_bilinear(name, arr)

@@ -1,0 +1,493 @@
+"""Data iterators.
+
+TPU-native counterpart of the reference's ``python/mxnet/io.py`` (602 lines)
+plus the C++ registered iterators in ``src/io/`` (MNISTIter, CSVIter,
+ImageRecordIter — io.cc).  The layering mirrors the reference's
+parser → batcher → normalizer → prefetcher stack; host-side work stays in
+numpy (cheap, overlappable) and device transfer happens once per batch when
+the training step consumes the arrays.
+
+Distributed sharding follows the reference's ``num_parts``/``part_index``
+protocol (iter_image_recordio.cc:108-133): each worker constructs its iter
+with its shard so a pod host only touches 1/num_parts of the data.
+"""
+from __future__ import annotations
+
+import threading
+from collections import namedtuple
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray, array as nd_array
+
+__all__ = ["DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "DataDesc"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Name+shape of one input stream (later mxnet DataDesc; dtype f32)."""
+
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+
+class DataBatch(object):
+    """One mini-batch (parity: io.py DataBatch): data/label lists of NDArray,
+    pad = #fake samples at the tail, index = sample indices."""
+
+    def __init__(self, data, label, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter(object):
+    """Iterator protocol (parity: io.py:87 DataIter): reset/iter_next/
+    getdata/getlabel/getpad/getindex + provide_data/provide_label."""
+
+    def __init__(self):
+        self.batch_size = 0
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def iter_next(self):
+        raise NotImplementedError()
+
+    def getdata(self):
+        raise NotImplementedError()
+
+    def getlabel(self):
+        raise NotImplementedError()
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError()
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize {list|dict|array} -> list[(name, numpy)] (parity io.py:250)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of them "
+                        "or dict with them as values")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, _np.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (parity: io.py:320 NDArrayIter):
+    shuffle, last_batch_handle pad/discard/roll_over, pad accounting."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__()
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+
+        self.idx = _np.arange(self.data[0][1].shape[0])
+        if shuffle:
+            _np.random.shuffle(self.idx)
+            self.data = [(k, v[self.idx]) for k, v in self.data]
+            self.label = [(k, v[self.idx]) for k, v in self.label]
+
+        if last_batch_handle == "discard":
+            new_n = self.data[0][1].shape[0] - self.data[0][1].shape[0] % batch_size
+            self.data = [(k, v[:new_n]) for k, v in self.data]
+            self.label = [(k, v[:new_n]) for k, v in self.label]
+            self.idx = self.idx[:new_n]
+
+        self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
+        self.num_source = len(self.data_list)
+        self.num_data = self.idx.shape[0]
+        assert self.num_data >= batch_size, \
+            "batch_size need to be smaller than data size."
+        self.cursor = -batch_size
+        self.batch_size = batch_size
+        self.last_batch_handle = last_batch_handle
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype)
+                for k, v in self.label]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=None)
+        raise StopIteration
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter need reset."
+        if self.cursor + self.batch_size <= self.num_data:
+            return [nd_array(v[self.cursor:self.cursor + self.batch_size])
+                    for _, v in data_source]
+        pad = self.batch_size - self.num_data + self.cursor
+        return [nd_array(_np.concatenate([v[self.cursor:], v[:pad]], axis=0))
+                for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iter to ``size`` batches per epoch (parity: io.py:118)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Thread + event double-buffering prefetcher (parity: io.py:172;
+    the analog of the C++ PrefetcherIter, iter_prefetcher.h:45).  Overlaps
+    host-side batch assembly with device compute."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0][1][0]
+        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
+        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
+        for e in self.data_taken:
+            e.set()
+        self.started = True
+        self.current_batch = [None] * self.n_iter
+        self.next_batch = [None] * self.n_iter
+
+        def prefetch_func(self, i):
+            while True:
+                self.data_taken[i].wait()
+                if not self.started:
+                    break
+                try:
+                    self.next_batch[i] = self.iters[i].next()
+                except StopIteration:
+                    self.next_batch[i] = None
+                self.data_taken[i].clear()
+                self.data_ready[i].set()
+        self.prefetch_threads = [
+            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
+            for i in range(self.n_iter)]
+        for thread in self.prefetch_threads:
+            thread.start()
+
+    def __del__(self):
+        try:
+            self.started = False
+            for e in self.data_taken:
+                e.set()
+            for thread in self.prefetch_threads:
+                thread.join(timeout=1.0)
+        except Exception:
+            pass
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[n], s) if isinstance(r, dict) else r
+                     for n, s in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[n], s) if isinstance(r, dict) else r
+                     for n, s in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        for e in self.data_ready:
+            e.wait()
+        for i in self.iters:
+            i.reset()
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    def iter_next(self):
+        for e in self.data_ready:
+            e.wait()
+        if self.next_batch[0] is None:
+            for i in self.next_batch:
+                assert i is None, "Number of entry mismatches between iterators"
+            return False
+        for batch in self.next_batch:
+            assert batch.pad == self.next_batch[0].pad, \
+                "Number of entry mismatches between iterators"
+        self.current_batch = DataBatch(
+            sum([batch.data for batch in self.next_batch], []),
+            sum([batch.label for batch in self.next_batch], []),
+            self.next_batch[0].pad, self.next_batch[0].index)
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+def _shard(arrays, num_parts, part_index):
+    """num_parts/part_index sharding (parity: iter_image_recordio.cc:108-133)."""
+    if num_parts <= 1:
+        return arrays
+    n = arrays[0].shape[0]
+    per = n // num_parts
+    lo, hi = part_index * per, (part_index + 1) * per if part_index < num_parts - 1 else n
+    return [a[lo:hi] for a in arrays]
+
+
+class CSVIter(NDArrayIter):
+    """CSV file iterator (parity: src/io/iter_csv.cc registered CSVIter)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, num_parts=1, part_index=0,
+                 data_name="data", label_name="label", **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32,
+                                ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[:-1])
+        else:
+            label = _np.zeros((data.shape[0],), dtype=_np.float32)
+        data, label = _shard([data, label], num_parts, part_index)
+        super().__init__(data, label, batch_size=batch_size,
+                         last_batch_handle="pad" if round_batch else "discard",
+                         data_name=data_name, label_name=label_name)
+
+
+def _load_mnist_idx(image_path, label_path):
+    """Parse IDX-format MNIST files (the format MNISTIter reads,
+    src/io/iter_mnist.cc)."""
+    import gzip
+    import struct
+
+    def _open(p):
+        return gzip.open(p, "rb") if str(p).endswith(".gz") else open(p, "rb")
+
+    with _open(label_path) as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        assert magic == 2049, "bad MNIST label magic"
+        labels = _np.frombuffer(f.read(num), dtype=_np.uint8)
+    with _open(image_path) as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, "bad MNIST image magic"
+        images = _np.frombuffer(f.read(num * rows * cols), dtype=_np.uint8)
+        images = images.reshape(num, rows, cols)
+    return images, labels
+
+
+def MNISTIter(image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+              batch_size=128, shuffle=True, flat=False, silent=False,
+              seed=0, input_shape=None, num_parts=1, part_index=0, **kwargs):
+    """MNIST iterator (parity: src/io/iter_mnist.cc MNISTIter params).
+
+    Reads IDX files (optionally .gz).  Returns an NDArrayIter — batching,
+    shuffling, and padding semantics are shared with the in-memory path.
+    """
+    images, labels = _load_mnist_idx(image, label)
+    images = images.astype(_np.float32) / 255.0
+    if flat or (input_shape is not None and len(input_shape) == 1):
+        data = images.reshape(images.shape[0], -1)
+    else:
+        data = images.reshape(images.shape[0], 1,
+                              images.shape[1], images.shape[2])
+    data, labels = _shard([data, labels.astype(_np.float32)],
+                          num_parts, part_index)
+    if shuffle:
+        rng = _np.random.RandomState(seed)
+        perm = rng.permutation(data.shape[0])
+        data, labels = data[perm], labels[perm]
+    return NDArrayIter(data, labels, batch_size=batch_size,
+                       shuffle=False, last_batch_handle="discard")
+
+
+def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
+                    shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                    scale=1.0, rand_crop=False, rand_mirror=False,
+                    num_parts=1, part_index=0, preprocess_threads=4,
+                    seed=0, **kwargs):
+    """Image RecordIO iterator (parity: iter_image_recordio.cc ImageRecordIter).
+
+    Reads packed image records (recordio.py IRHeader format), decodes JPEG
+    via the native pipeline when available (mxnet_tpu.libmxnet_tpu) else
+    PIL/numpy fallback, applies mean/scale + crop/mirror augmentation, and
+    yields NCHW float32 batches.  num_parts/part_index shard the record file
+    across workers exactly like the reference.
+    """
+    from . import recordio as rio
+    from .image import imdecode_bytes, augment
+
+    reader = rio.MXRecordIO(path_imgrec, "r")
+    records = []
+    while True:
+        item = reader.read()
+        if item is None:
+            break
+        records.append(item)
+    reader.close()
+    if num_parts > 1:
+        per = len(records) // num_parts
+        lo = part_index * per
+        hi = (part_index + 1) * per if part_index < num_parts - 1 else len(records)
+        records = records[lo:hi]
+
+    datas, labels = [], []
+    rng = _np.random.RandomState(seed)
+    for rec in records:
+        header, img_bytes = rio.unpack(rec)
+        img = imdecode_bytes(img_bytes)          # HWC uint8
+        img = augment(img, data_shape, rand_crop=rand_crop,
+                      rand_mirror=rand_mirror, rng=rng)
+        img = img.astype(_np.float32)
+        img[:, :, 0] -= mean_r
+        if img.shape[2] > 1:
+            img[:, :, 1] -= mean_g
+            img[:, :, 2] -= mean_b
+        img *= scale
+        datas.append(img.transpose(2, 0, 1))     # HWC -> CHW
+        lbl = header.label
+        labels.append(lbl if label_width > 1 else float(_np.asarray(lbl).ravel()[0]))
+    data = _np.stack(datas) if datas else _np.zeros((0,) + tuple(data_shape))
+    label = _np.asarray(labels, dtype=_np.float32)
+    if 0 < data.shape[0] < batch_size:
+        # fewer records than one batch: pad by wrapping so one full batch
+        # exists (the reference's C++ batcher pads the tail the same way)
+        reps = -(-batch_size // data.shape[0])
+        data = _np.tile(data, (reps,) + (1,) * (data.ndim - 1))[:batch_size]
+        label = _np.tile(label, reps)[:batch_size]
+    return NDArrayIter(data, label, batch_size=batch_size, shuffle=shuffle,
+                       last_batch_handle="discard")

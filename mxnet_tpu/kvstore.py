@@ -1,0 +1,230 @@
+"""KVStore: parameter synchronization.
+
+TPU-native counterpart of the reference's kvstore stack (``src/kvstore/``,
+``python/mxnet/kvstore.py``; SURVEY §2 KVStore rows).  Same string factory
+(`kvstore.cc:17-45`) and Python API (init/push/pull/set_updater/rank/
+num_workers/barrier/set_optimizer) so user scripts are unchanged, but the
+communication design is inverted for TPU:
+
+- The reference moves gradients through an explicit CPU/GPU reduction tree
+  (comm.h) or a parameter-server (ps-lite RPC).  On TPU the *fast path* is an
+  ``lax.psum`` over the device mesh **inside the compiled training step**
+  (``parallel/``); this module is (a) the API-compatible host-side store used
+  by Module/FeedForward when ``update_on_kvstore`` and by the kvstore unit
+  tests, and (b) the factory that tells the trainer which collective scope
+  ('device' = chips in this process, 'dist*' = whole pod) to psum over.
+- ``dist_sync`` worker identity comes from ``jax.distributed`` /
+  ``jax.process_index()`` (the ps-lite scheduler/rendezvous equivalent,
+  SURVEY §2.10) instead of DMLC_ROLE env + ps-lite.  ``dist_async`` has no
+  ICI analog (SURVEY §5 "Distributed communication backend"): we accept the
+  type and run it with dist_sync semantics, documented divergence.
+
+Aggregation math runs as one jitted XLA computation per shape (tree-sum +
+assign), not per-pair engine ops.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+@jax.jit
+def _tree_sum(values):
+    out = values[0]
+    for v in values[1:]:
+        out = out + v
+    return out
+
+
+def _key_list(key):
+    if isinstance(key, (int, str)):
+        return [key], True
+    return list(key), False
+
+
+def _group_values(keys, values, single):
+    """Normalize values to one list-of-NDArray per key (kvstore_local.h
+    GroupKVPairs analog)."""
+    if single:
+        if isinstance(values, NDArray):
+            return [[values]]
+        return [list(values)]
+    if len(values) == len(keys) and all(
+            isinstance(v, NDArray) for v in values):
+        return [[v] for v in values]
+    if len(values) % len(keys) == 0 and all(
+            isinstance(v, NDArray) for v in values):
+        # flat list, len = num_keys * num_devices, reference grouping
+        per = len(values) // len(keys)
+        return [values[i * per:(i + 1) * per] for i in range(len(keys))]
+    out = []
+    for v in values:
+        out.append([v] if isinstance(v, NDArray) else list(v))
+    assert len(out) == len(keys)
+    return out
+
+
+class KVStore(object):
+    """Host-side key-value store (parity: python/mxnet/kvstore.py KVStore).
+
+    Semantics matched to the reference's local store:
+    - ``init`` sets the initial weight once per key (rank 0 broadcast in dist).
+    - ``push`` sums the pushed copies (the multi-device gradient reduce),
+      then either runs the updater on (merged_grad, stored_weight) or
+      *assigns* the merged value to the store (default updater is assign,
+      kvstore_local.h).
+    - ``pull`` broadcasts the stored weight into every out array.
+    """
+
+    def __init__(self, kvtype="local"):
+        self.type = kvtype
+        self._store = {}
+        self._updater = None
+        self._barrier_before_exit = True
+
+    # -- identity (include/mxnet/kvstore.h:222-241) -----------------------
+    @property
+    def rank(self):
+        if self.type.startswith("dist"):
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self):
+        if self.type.startswith("dist"):
+            return jax.process_count()
+        return 1
+
+    # -- core ops ----------------------------------------------------------
+    def init(self, key, value):
+        keys, single = _key_list(key)
+        groups = _group_values(keys, value, single)
+        for k, vals in zip(keys, groups):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % (k,))
+            self._store[k] = NDArray(vals[0].data)
+
+    def push(self, key, value, priority=0):
+        keys, single = _key_list(key)
+        groups = _group_values(keys, value, single)
+        for k, vals in zip(keys, groups):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            merged = vals[0].data if len(vals) == 1 else \
+                _tree_sum([v.data for v in vals])
+            merged = self._allreduce(merged)
+            if self._updater is not None:
+                self._updater(k, NDArray(merged), self._store[k])
+            else:
+                self._store[k]._set_data(merged)
+
+    def pull(self, key, out=None, priority=0):
+        assert out is not None
+        keys, single = _key_list(key)
+        groups = _group_values(keys, out, single)
+        for k, outs in zip(keys, groups):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            src = self._store[k].data
+            for o in outs:
+                o._set_data(src)
+
+    def _allreduce(self, merged):
+        """Cross-worker gradient sum for dist types.
+
+        With one process this is the identity; in a multi-host pod the sum
+        rides DCN via jax.make_array / process_allgather.  The *performant*
+        pod path never calls this: Module folds the psum into the compiled
+        step (update_on_kvstore=False ≡ in-step update, SURVEY §5 mapping).
+        """
+        if self.type.startswith("dist") and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(merged)
+            return jnp.sum(gathered, axis=0)
+        return merged
+
+    # -- updater / optimizer ----------------------------------------------
+    def set_updater(self, updater):
+        """Parity: kvstore.py _set_updater."""
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        """Parity: kvstore.py:231 set_optimizer — in the reference this
+        pickles the optimizer to PS servers (command 0); on TPU there are no
+        servers, so the updater runs in-process (≡ server-side update)."""
+        from .optimizer import get_updater
+        # round-trip through pickle to preserve the reference's contract that
+        # the optimizer must be serializable for the server
+        optimizer = pickle.loads(pickle.dumps(optimizer))
+        self.set_updater(get_updater(optimizer))
+
+    # -- misc --------------------------------------------------------------
+    def barrier(self):
+        """Global worker barrier (parity kvstore.h:249; ps Postoffice barrier)."""
+        if self.type.startswith("dist") and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+
+    def _barrier(self):
+        self.barrier()
+
+    def _send_command_to_servers(self, head, body):
+        """No servers on TPU; commands are accepted and ignored (kSyncMode
+        etc. are implicit in the collective design)."""
+
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as fout:
+            opt = getattr(self._updater, "optimizer", None)
+            states = getattr(self._updater, "states", None)
+            fout.write(pickle.dumps((opt, _states_to_host(states))))
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as fin:
+            opt, states = pickle.loads(fin.read())
+        from .optimizer import get_updater
+        updater = get_updater(opt)
+        if states:
+            updater.states.update(_states_from_host(states))
+        self.set_updater(updater)
+
+
+def _states_to_host(states):
+    if states is None:
+        return None
+    return {k: jax.tree_util.tree_map(
+        lambda a: a.asnumpy() if isinstance(a, NDArray) else a, v)
+        for k, v in states.items()}
+
+
+def _states_from_host(states):
+    return {k: jax.tree_util.tree_map(
+        lambda a: NDArray(a) if a is not None else None, v)
+        for k, v in states.items()}
+
+
+_VALID_TYPES = ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device",
+                "dist_sync", "dist_async", "dist_sync_device",
+                "dist_async_device")
+
+
+def create(name="local"):
+    """String factory (parity: kvstore.cc:17-45 + kvstore.py:360 create)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    base = name.lower()
+    if base not in _VALID_TYPES and not any(
+            t in base for t in ("local", "device", "dist")):
+        raise MXNetError("unknown KVStore type %r" % name)
+    return KVStore(base)

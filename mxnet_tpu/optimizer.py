@@ -1,0 +1,453 @@
+"""Optimizers: weight-update rules compiled to single XLA computations.
+
+TPU-native counterpart of the reference's ``python/mxnet/optimizer.py`` (821
+lines) + the C++ engine-scheduled SGD (``src/optimizer/sgd-inl.h:102``).  The
+reference runs each update as an engine op over (weight, grad, state) NDArray
+vars; here each optimizer exposes a *pure* ``update_fn(weight, grad, state,
+lr, wd) -> (weight, state)`` that is jitted once and reused across all
+parameters (shape-keyed XLA compile cache), with lr/wd/rescale passed as
+traced scalars so schedule changes never recompile.
+
+The same pure functions are reused by the fused data-parallel training step
+(``parallel/``): there the update runs *inside* the sharded jitted step after
+the gradient psum — the analog of the reference's ``update_on_kvstore``
+server-side update (kvstore_dist_server.h:164).
+
+Registry parity: ``Optimizer.register`` / ``create_optimizer`` mirror
+``MXNET_REGISTER_OPTIMIZER`` (src/optimizer/optimizer.cc) and
+``optimizer.py:59-88``.
+"""
+from __future__ import annotations
+
+import logging
+import math
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from .lr_scheduler import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "Adam", "AdamW",
+           "AdaGrad", "RMSProp", "AdaDelta", "LAMB", "Test", "create",
+           "get_updater", "register"]
+
+
+def _as_jax(x):
+    return x.data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+class Optimizer(object):
+    """Base optimizer (parity: optimizer.py:22 class Optimizer).
+
+    Subclasses implement ``create_state_arrays(shape, dtype) -> pytree of
+    jax arrays`` and ``update_fn`` (a pure function; jitted lazily on first
+    use).  ``update(index, weight, grad, state)`` keeps the reference's
+    imperative signature for kvstore updaters and Module.update.
+    """
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        """Parity: optimizer.py Optimizer.register decorator."""
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            logging.warning("Optimizer %s is overridden", name)
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, rescale_grad=1.0, **kwargs):
+        """Parity: optimizer.py:69 create_optimizer."""
+        if name.lower() not in Optimizer.opt_registry:
+            raise ValueError("Cannot find optimizer %s" % name)
+        return Optimizer.opt_registry[name.lower()](
+            rescale_grad=rescale_grad, **kwargs)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        if not isinstance(param_idx2name, dict):
+            raise MXNetError("param_idx2name should be a dict of param indexes to names")
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self._jit_cache = {}
+
+    # -- per-weight lr/wd multipliers (optimizer.py:118-176) --------------
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            # biases / norm params are exempt from weight decay by default
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- state + update ----------------------------------------------------
+    def create_state_arrays(self, shape, dtype):
+        """Pure-jax state pytree for one weight; None if stateless."""
+        return None
+
+    def create_state(self, index, weight):
+        """NDArray-wrapped state (reference create_state signature)."""
+        state = self.create_state_arrays(weight.shape, weight.dtype)
+        if state is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda a: NDArray(a, ctx=getattr(weight, "context", None)), state)
+
+    def update_fn(self, weight, grad, state, lr, wd, t):
+        """Pure update: (new_weight, new_state). Subclasses override."""
+        raise NotImplementedError()
+
+    def _preprocess_grad(self, grad):
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = jnp.clip(grad, -self.clip_gradient, self.clip_gradient)
+        return grad
+
+    def __getstate__(self):
+        """Optimizers must pickle (kvstore set_optimizer sends them to the
+        'server', kvstore.py:231); the jit cache is rebuilt lazily."""
+        d = self.__dict__.copy()
+        d["_jit_cache"] = {}
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._jit_cache = {}
+
+    def _jitted(self):
+        key = "update"
+        if key not in self._jit_cache:
+            def step(weight, grad, state, lr, wd, t):
+                grad = self._preprocess_grad(grad)
+                return self.update_fn(weight, grad, state, lr, wd, t)
+            self._jit_cache[key] = jax.jit(step)
+        return self._jit_cache[key]
+
+    def update(self, index, weight, grad, state):
+        """Imperative update used by kvstore updaters / Module.update."""
+        assert isinstance(weight, NDArray) and isinstance(grad, NDArray)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        jstate = jax.tree_util.tree_map(lambda a: a.data, state) \
+            if state is not None else None
+        new_w, new_state = self._jitted()(
+            weight.data, grad.data, jstate,
+            jnp.float32(lr), jnp.float32(wd), jnp.int32(t))
+        weight._set_data(new_w)
+        if state is not None:
+            jax.tree_util.tree_map(
+                lambda nd, a: nd._set_data(a), state, new_state)
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum/wd/clip (parity: optimizer.py:234 + sgd-inl.h:102).
+
+    state = momentum buffer (None when momentum==0);
+    update: m = mu*m - lr*(grad + wd*w);  w += m
+    """
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state_arrays(self, shape, dtype):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros(shape, dtype=dtype)
+
+    def update_fn(self, weight, grad, state, lr, wd, t):
+        g = grad + wd * weight
+        if state is None:
+            return weight - lr * g, None
+        m = self.momentum * state - lr * g
+        return weight + m, m
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (parity: optimizer.py:313)."""
+
+    def update_fn(self, weight, grad, state, lr, wd, t):
+        g = grad + wd * weight
+        if state is None:
+            return weight - lr * g, None
+        m = self.momentum * state + g
+        lookahead = g + self.momentum * m
+        return weight - lr * lookahead, m
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (parity: optimizer.py:361):
+    w -= lr/2 * (grad + wd*w) + N(0, lr)."""
+
+    def __init__(self, seed=0, **kwargs):
+        super().__init__(**kwargs)
+        self._key = jax.random.PRNGKey(seed)
+
+    def update(self, index, weight, grad, state):
+        self._key, sub = jax.random.split(self._key)
+        self._noise_key = sub
+        super().update(index, weight, grad, state)
+
+    def _jitted(self):
+        if "update" not in self._jit_cache:
+            def step(weight, grad, state, lr, wd, t, key):
+                grad = self._preprocess_grad(grad)
+                g = grad + wd * weight
+                noise = jax.random.normal(key, weight.shape, weight.dtype) \
+                    * jnp.sqrt(lr)
+                return weight - lr / 2.0 * g + noise, None
+            inner = jax.jit(step)
+            self._jit_cache["update"] = \
+                lambda w, g, s, lr, wd, t: inner(w, g, s, lr, wd, t,
+                                                 self._noise_key)
+        return self._jit_cache["update"]
+
+
+@register
+class ccSGD(SGD):
+    """Reference ccSGD (optimizer.py:426) holds a C++ optimizer handle purely
+    to run the update inside the engine; here *every* optimizer already runs
+    as one compiled XLA computation, so ccSGD is SGD.  Kept for API parity
+    and for pickling to kvstore servers (optimizer.py:453-498)."""
+
+
+@register
+class Adam(Optimizer):
+    """Adam (parity: optimizer.py:504). state = (mean, var); bias-corrected."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state_arrays(self, shape, dtype):
+        return (jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype))
+
+    def update_fn(self, weight, grad, state, lr, wd, t):
+        mean, var = state
+        g = grad + wd * weight
+        mean = self.beta1 * mean + (1.0 - self.beta1) * g
+        var = self.beta2 * var + (1.0 - self.beta2) * g * g
+        tf = t.astype(jnp.float32)
+        mhat = mean / (1.0 - self.beta1 ** tf)
+        vhat = var / (1.0 - self.beta2 ** tf)
+        w = weight - lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        return w, (mean, var)
+
+
+@register
+class AdamW(Optimizer):
+    """Adam with decoupled weight decay (modern LLM default; beyond-reference)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state_arrays(self, shape, dtype):
+        return (jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype))
+
+    def update_fn(self, weight, grad, state, lr, wd, t):
+        mean, var = state
+        mean = self.beta1 * mean + (1.0 - self.beta1) * grad
+        var = self.beta2 * var + (1.0 - self.beta2) * grad * grad
+        tf = t.astype(jnp.float32)
+        mhat = mean / (1.0 - self.beta1 ** tf)
+        vhat = var / (1.0 - self.beta2 ** tf)
+        w = weight - lr * (mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * weight)
+        return w, (mean, var)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (parity: optimizer.py:605). state = sum of squared grads."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state_arrays(self, shape, dtype):
+        return jnp.zeros(shape, dtype=dtype)
+
+    def update_fn(self, weight, grad, state, lr, wd, t):
+        g = grad + wd * weight
+        hist = state + g * g
+        w = weight - lr * g / jnp.sqrt(hist + self.float_stable_eps)
+        return w, hist
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, Graves-style with momentum-of-update (parity: optimizer.py:654).
+
+    state = (n, g, delta): n = ema(grad^2), g = ema(grad),
+    delta = gamma2*delta - lr*grad/sqrt(n - g^2 + eps); w += delta.
+    """
+
+    def __init__(self, learning_rate=0.002, gamma1=0.95, gamma2=0.9,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+
+    def create_state_arrays(self, shape, dtype):
+        z = jnp.zeros(shape, dtype=dtype)
+        return (z, z, z)
+
+    def update_fn(self, weight, grad, state, lr, wd, t):
+        n, g, delta = state
+        grad = grad + wd * weight
+        n = (1.0 - self.gamma1) * grad * grad + self.gamma1 * n
+        g = (1.0 - self.gamma1) * grad + self.gamma1 * g
+        delta = self.gamma2 * delta - lr * grad / jnp.sqrt(n - g * g + 1e-4)
+        return weight + delta, (n, g, delta)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (parity: optimizer.py:728). state = (acc_g, acc_delta)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state_arrays(self, shape, dtype):
+        return (jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype))
+
+    def update_fn(self, weight, grad, state, lr, wd, t):
+        acc_g, acc_delta = state
+        g = grad + wd * weight
+        acc_g = self.rho * acc_g + (1.0 - self.rho) * g * g
+        delta = jnp.sqrt(acc_delta + self.epsilon) / \
+            jnp.sqrt(acc_g + self.epsilon) * g
+        acc_delta = self.rho * acc_delta + (1.0 - self.rho) * delta * delta
+        return weight - delta, (acc_g, acc_delta)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive large-batch optimizer (beyond-reference; the
+    standard recipe for pod-scale batch sizes on TPU)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state_arrays(self, shape, dtype):
+        return (jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype))
+
+    def update_fn(self, weight, grad, state, lr, wd, t):
+        mean, var = state
+        mean = self.beta1 * mean + (1.0 - self.beta1) * grad
+        var = self.beta2 * var + (1.0 - self.beta2) * grad * grad
+        tf = t.astype(jnp.float32)
+        mhat = mean / (1.0 - self.beta1 ** tf)
+        vhat = var / (1.0 - self.beta2 ** tf)
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * weight
+        w_norm = jnp.linalg.norm(weight)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return weight - lr * trust * r, (mean, var)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: w -= grad (parity: optimizer.py:782; used by
+    dist_sync_kvstore.py to verify server-side updates)."""
+
+    def create_state_arrays(self, shape, dtype):
+        return jnp.zeros(shape, dtype=dtype)
+
+    def update_fn(self, weight, grad, state, lr, wd, t):
+        return weight + grad * 1.0 - 0.0 * lr, state
+
+    def update(self, index, weight, grad, state):
+        weight._set_data(weight.data + grad.data * self.rescale_grad)
+
+
+def get_updater(optimizer):
+    """Closure used as kvstore updater (parity: optimizer.py:801)."""
+    states = {}
+
+    def updater(index, grad, weight):
+        if index not in states:
+            states[index] = optimizer.create_state(index, weight)
+        optimizer.update(index, weight, grad, states[index])
+    updater.optimizer = optimizer
+    updater.states = states
+    return updater

@@ -1,0 +1,21 @@
+"""parallel: device-mesh distribution for mxnet_tpu.
+
+This package is the TPU-native replacement for the reference's *entire*
+distributed stack — multi-device executor groups (executor_manager.py),
+device-side gradient reduction (src/kvstore/comm.h), and the parameter
+server (src/kvstore/kvstore_dist*.h + ps-lite): instead of shipping
+gradients through reduction trees/RPC, the training step is compiled once
+over a ``jax.sharding.Mesh`` and XLA inserts the collectives (psum over ICI
+for data-parallel grads, all-gather/reduce-scatter for tensor-parallel
+matmuls) — the scaling-book recipe: pick a mesh, annotate shardings, let
+XLA place collectives.
+
+Axes (by convention): ``dp`` data, ``tp`` tensor, ``pp`` pipeline,
+``sp`` sequence (ring attention), ``ep`` expert.
+"""
+from .mesh import make_mesh, auto_mesh, local_device_count
+from .sharding import ShardingRules, param_pspec, batch_pspec
+from .trainer import ShardedTrainer
+
+__all__ = ["make_mesh", "auto_mesh", "local_device_count",
+           "ShardingRules", "param_pspec", "batch_pspec", "ShardedTrainer"]

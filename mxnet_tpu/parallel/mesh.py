@@ -1,0 +1,79 @@
+"""Device-mesh construction.
+
+Replaces the reference's device bookkeeping (ctx lists in
+executor_manager.py, P2P enable in comm.h:186, ps-lite node ranks): on TPU
+the set of devices is a named ``jax.sharding.Mesh`` and every placement
+decision is a PartitionSpec over its axes.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "auto_mesh", "local_device_count"]
+
+AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")  # outer→inner; tp innermost so
+# its collectives ride the fastest ICI links (scaling-book layout rule)
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+def make_mesh(devices=None, **axis_sizes):
+    """Build a Mesh with named axes, e.g. ``make_mesh(dp=4, tp=2)``.
+
+    Axis sizes must multiply to the device count; an axis given as -1 is
+    inferred.  Axes are laid out in AXIS_ORDER so the innermost (tp/sp)
+    axes map to physically adjacent devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = _np.asarray(devices)
+    n = devices.size
+
+    names = [a for a in AXIS_ORDER if a in axis_sizes]
+    extra = [a for a in axis_sizes if a not in AXIS_ORDER]
+    names += extra
+    sizes = [axis_sizes[a] for a in names]
+    n_infer = sizes.count(-1)
+    if n_infer > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = 1
+    for s in sizes:
+        if s != -1:
+            known *= s
+    if n_infer:
+        if n % known:
+            raise ValueError("cannot infer axis: %d devices not divisible by %d"
+                             % (n, known))
+        sizes[sizes.index(-1)] = n // known
+        known = n
+    if known != n:
+        raise ValueError("mesh axes %s multiply to %d but %d devices present"
+                         % (dict(zip(names, sizes)), known, n))
+    return Mesh(devices.reshape(sizes), axis_names=tuple(names))
+
+
+def auto_mesh(n_devices=None, tp=1, sp=1, pp=1, ep=1):
+    """Data-parallel-first mesh: everything not claimed by tp/sp/pp/ep goes
+    to dp (the reference's default: pure DP across all ctxs)."""
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    denom = tp * sp * pp * ep
+    if n_devices % denom:
+        raise ValueError("%d devices not divisible by tp*sp*pp*ep=%d"
+                         % (n_devices, denom))
+    kwargs = {"dp": n_devices // denom}
+    if pp > 1:
+        kwargs["pp"] = pp
+    if ep > 1:
+        kwargs["ep"] = ep
+    if sp > 1:
+        kwargs["sp"] = sp
+    if tp > 1:
+        kwargs["tp"] = tp
+    return make_mesh(jax.devices()[:n_devices], **kwargs)

@@ -1,0 +1,84 @@
+"""Sharding rules: map parameter/batch names+shapes to PartitionSpecs.
+
+The reference's analog is implicit: weights are replicated per device
+(executor_manager.py copies) and only the kvstore shards big arrays across
+PS servers (kvstore_dist.h:281-295 EncodeKey striping).  On TPU sharding is
+explicit and first-class: these rules drive pjit's in/out shardings for the
+compiled training step.
+
+Default policy (matches megatron-style TP for the op set):
+- FullyConnected ``*_weight`` (num_hidden, input_dim): column-parallel on
+  axis 0 over ``tp`` when divisible; biases likewise.
+- Convolution ``*_weight`` (O, I, kH, kW): shard output channels over tp.
+- Embedding ``*_weight`` (vocab, dim): shard vocab over tp.
+- BatchNorm/aux scalars: replicated.
+- Batch tensors: shard axis 0 over dp (and sequence axis over sp when the
+  rule-set is built with an sp axis).
+"""
+from __future__ import annotations
+
+import re
+
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ShardingRules", "param_pspec", "batch_pspec"]
+
+
+def _divisible(dim, mesh, axis):
+    return axis in mesh.shape and dim % mesh.shape[axis] == 0 and \
+        mesh.shape[axis] > 1
+
+
+def param_pspec(name, shape, mesh, rules=None):
+    """PartitionSpec for one parameter."""
+    if rules is not None:
+        spec = rules.match(name, shape)
+        if spec is not None:
+            return spec
+    if "tp" in mesh.shape and mesh.shape["tp"] > 1 and shape:
+        # shard the widest shardable axis over tp: prefer axis 0 (out-features
+        # / vocab) — column parallel; fall back to axis 1 (row parallel)
+        if _divisible(shape[0], mesh, "tp") and len(shape) >= 2:
+            return P("tp", *([None] * (len(shape) - 1)))
+        if len(shape) >= 2 and _divisible(shape[1], mesh, "tp"):
+            return P(None, "tp", *([None] * (len(shape) - 2)))
+        if len(shape) == 1 and _divisible(shape[0], mesh, "tp"):
+            return P("tp")
+    return P(*([None] * len(shape)))
+
+
+def batch_pspec(shape, mesh, seq_axis=None):
+    """PartitionSpec for a batch tensor: axis0 over dp, seq axis over sp."""
+    spec = [None] * len(shape)
+    if "dp" in mesh.shape and mesh.shape["dp"] > 1:
+        spec[0] = "dp"
+    if seq_axis is not None and "sp" in mesh.shape and mesh.shape["sp"] > 1 \
+            and len(shape) > seq_axis:
+        spec[seq_axis] = "sp"
+    return P(*spec)
+
+
+class ShardingRules(object):
+    """Ordered (regex, fn(shape, mesh) -> PartitionSpec|None) rule list.
+
+    Example::
+
+        rules = ShardingRules([
+            (r".*embed.*_weight", lambda s, m: P("tp", None)),
+            (r".*_bias",          lambda s, m: P(None)),
+        ])
+    """
+
+    def __init__(self, rules=(), mesh=None):
+        self._rules = [(re.compile(pat), fn) for pat, fn in rules]
+        self._mesh = mesh
+
+    def add(self, pattern, fn):
+        self._rules.append((re.compile(pattern), fn))
+        return self
+
+    def match(self, name, shape):
+        for prog, fn in self._rules:
+            if prog.match(name):
+                return fn(shape, self._mesh)
+        return None

@@ -1,0 +1,197 @@
+"""ShardedTrainer: the whole training step as ONE pjit'd XLA computation.
+
+This is the TPU-native form of the reference's data-parallel SGD loop
+(`model.py:115-305 _train_multi_device` + executor_manager batch slicing +
+kvstore push/pull): forward, backward, gradient all-reduce, and optimizer
+update fuse into a single compiled program over a device mesh.  The
+collectives are *implicit*: batch inputs are sharded over ``dp`` (and the
+sequence axis over ``sp``), parameters are sharded per rule (tp) or
+replicated; because the out-sharding of parameters is the same as their
+in-sharding, XLA inserts the gradient psum over ICI exactly where the
+reference did a kvstore push/pull — this ≡ ``update_on_kvstore`` with the
+update running server-side (kvstore_dist_server.h:164), except the "server"
+is the compiled step itself.
+
+Buffer donation on (params, opt_state, aux) gives in-place parameter
+updates — the analog of the reference's shared memory pool + kWriteInplace.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from .sharding import param_pspec, batch_pspec
+
+__all__ = ["ShardedTrainer"]
+
+
+class ShardedTrainer(object):
+    """Compile a Symbol's train step over a Mesh.
+
+    Parameters
+    ----------
+    symbol : Symbol with loss head(s) (e.g. SoftmaxOutput).
+    optimizer : mxnet_tpu.optimizer.Optimizer (its pure update_fn is traced
+        into the step; its host-side schedule drives the lr scalar).
+    mesh : jax.sharding.Mesh from parallel.make_mesh.
+    data_names / label_names : input argument names.
+    rules : optional ShardingRules for parameter placement.
+    seq_axis : batch axis to shard over 'sp' for sequence parallelism.
+    """
+
+    def __init__(self, symbol, optimizer, mesh, data_names=("data",),
+                 label_names=("softmax_label",), rules=None, seq_axis=None,
+                 donate=True):
+        self.symbol = symbol
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.data_names = tuple(data_names)
+        self.label_names = tuple(label_names)
+        self.rules = rules
+        self.seq_axis = seq_axis
+
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self.param_names = [n for n in self._arg_names
+                            if n not in self.data_names
+                            and n not in self.label_names]
+        from ..executor import _build_program
+        program = _build_program(symbol, {})
+        self._trace = program.trace
+        self._needs_rng = program.needs_rng
+        self.num_update = 0
+
+        opt_update = optimizer.update_fn
+        preprocess = optimizer._preprocess_grad
+        trace = self._trace
+        data_keys = self.data_names + self.label_names
+
+        def train_step(params, opt_state, aux, batch, rng, lr, wd, t):
+            """One fused step: fwd + bwd + psum(grad) + update."""
+            def run(p):
+                args = dict(p)
+                args.update(batch)
+                outs, aux_out = trace(args, aux, rng, True)
+                return outs, aux_out
+
+            (outs, aux_out), vjp_fn = jax.vjp(run, params)
+            ones = [jnp.ones_like(o) for o in outs]
+            zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_out)
+            grads = vjp_fn((ones, zero_aux))[0]
+
+            new_params = {}
+            new_opt_state = {}
+            for name in params:
+                g = preprocess(grads[name])
+                w, s = opt_update(params[name], g, opt_state.get(name),
+                                  lr, wd, t)
+                new_params[name] = w
+                if s is not None:
+                    new_opt_state[name] = s
+            return new_params, new_opt_state, aux_out, outs
+
+        donate_argnums = (0, 1, 2) if donate else ()
+        self._jit_step = jax.jit(train_step, donate_argnums=donate_argnums)
+
+        def eval_step(params, aux, batch, rng):
+            args = dict(params)
+            args.update(batch)
+            outs, _ = trace(args, aux, rng, False)
+            return outs
+
+        self._jit_eval = jax.jit(eval_step)
+
+    # ------------------------------------------------------------------
+    # shardings
+    # ------------------------------------------------------------------
+    def param_sharding(self, name, shape):
+        return NamedSharding(self.mesh,
+                             param_pspec(name, shape, self.mesh, self.rules))
+
+    def batch_sharding(self, shape):
+        return NamedSharding(self.mesh,
+                             batch_pspec(shape, self.mesh, self.seq_axis))
+
+    def _replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    # ------------------------------------------------------------------
+    # state init
+    # ------------------------------------------------------------------
+    def init_params(self, data_shapes, initializer=None, label_shapes=None,
+                    dtype=_np.float32):
+        """Infer shapes, allocate sharded params/opt_state/aux.
+
+        Returns (params, opt_state, aux) dicts of jax.Arrays placed with
+        their pjit shardings (so the first step doesn't reshard).
+        """
+        shapes = dict(data_shapes)
+        if label_shapes:
+            shapes.update(label_shapes)
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
+        if arg_shapes is None:
+            raise MXNetError("init_params: cannot infer shapes from %s"
+                             % (shapes,))
+        shape_map = dict(zip(self._arg_names, arg_shapes))
+        aux_map = dict(zip(self._aux_names, aux_shapes))
+
+        from ..ndarray import NDArray
+        from ..initializer import Uniform
+        initializer = initializer or Uniform(0.07)
+        params = {}
+        for name in self.param_names:
+            host = NDArray(jnp.zeros(shape_map[name], dtype=dtype))
+            initializer(name, host)
+            params[name] = jax.device_put(host.data,
+                                          self.param_sharding(name, host.shape))
+        opt_state = {}
+        for name in self.param_names:
+            s = self.optimizer.create_state_arrays(shape_map[name], dtype)
+            if s is not None:
+                sharding = self.param_sharding(name, shape_map[name])
+                opt_state[name] = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, sharding), s)
+        aux = {}
+        for name in self._aux_names:
+            init_val = jnp.ones(aux_map[name], dtype=dtype) \
+                if name.endswith("moving_var") else \
+                jnp.zeros(aux_map[name], dtype=dtype)
+            aux[name] = jax.device_put(init_val, self._replicated())
+        return params, opt_state, aux
+
+    def shard_batch(self, batch):
+        """Place host batch arrays onto the mesh with dp/sp sharding —
+        the analog of executor_manager.load_data_batch slicing."""
+        out = {}
+        for name, arr in batch.items():
+            arr = jnp.asarray(getattr(arr, "data", arr))
+            out[name] = jax.device_put(arr, self.batch_sharding(arr.shape))
+        return out
+
+    # ------------------------------------------------------------------
+    # steps
+    # ------------------------------------------------------------------
+    def step(self, params, opt_state, aux, batch, rng=None):
+        """Run one fused train step; returns (params, opt_state, aux, outputs)."""
+        self.num_update += 1
+        opt = self.optimizer
+        if opt.lr_scheduler is not None:
+            lr = opt.lr_scheduler(self.num_update)
+        else:
+            lr = opt.lr
+        if rng is None:
+            from .. import random as _random
+            rng = _random.next_key() if self._needs_rng \
+                else jax.random.PRNGKey(0)
+        return self._jit_step(params, opt_state, aux, batch, rng,
+                              jnp.float32(lr), jnp.float32(opt.wd),
+                              jnp.int32(self.num_update))
+
+    def eval(self, params, aux, batch, rng=None):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return self._jit_eval(params, aux, batch, rng)

@@ -1,0 +1,233 @@
+"""RecordIO: packed binary record files.
+
+TPU-native counterpart of the reference's ``python/mxnet/recordio.py`` (275
+lines) + the dmlc-core RecordIO writer/reader it wraps (SURVEY §2.11) + the
+C API surface (``src/c_api/c_api.cc:1377-1454``).  The on-disk format is the
+dmlc format so record files are interchangeable with the reference:
+
+    [kMagic: uint32][lrec: uint32][data][pad to 4-byte boundary]
+    lrec = (cflag << 29) | length; cflag 0=whole, 1=start, 2=middle, 3=end
+    (continuation records let data contain the magic; assembled on read)
+
+``pack``/``unpack`` implement the image-record header (``IRHeader``:
+flag/label/id/id2, ``src/io/image_recordio.h``), with flag>0 meaning a
+float-array label of that many entries.  A native C++ reader
+(src/cc, loaded via ctypes) accelerates scans when built; this pure-python
+implementation is the always-available fallback and the format oracle.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "unpack_img", "pack_img"]
+
+_kMagic = 0xced7230a
+_FMT_MAGIC_LREC = "<II"
+
+
+def _encode_record(data):
+    """Encode one logical record into the dmlc multi-part wire format."""
+    out = []
+    magic_bytes = struct.pack("<I", _kMagic)
+    # split wherever the payload contains the magic sequence
+    parts = data.split(magic_bytes)
+    n = len(parts)
+    for i, part in enumerate(parts):
+        if n == 1:
+            cflag = 0
+        elif i == 0:
+            cflag = 1
+        elif i == n - 1:
+            cflag = 3
+        else:
+            cflag = 2
+        lrec = (cflag << 29) | len(part)
+        out.append(struct.pack(_FMT_MAGIC_LREC, _kMagic, lrec))
+        out.append(part)
+        pad = (4 - (len(part) & 3)) & 3
+        if pad:
+            out.append(b"\x00" * pad)
+    return b"".join(out)
+
+
+class MXRecordIO(object):
+    """Sequential reader/writer (parity: recordio.py:14 MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def write(self, buf):
+        assert self.writable
+        self.handle.write(_encode_record(buf))
+
+    def read(self):
+        """Read one logical record; None at EOF."""
+        assert not self.writable
+        parts = []
+        while True:
+            head = self.handle.read(8)
+            if len(head) < 8:
+                if parts:
+                    raise IOError("Truncated RecordIO file: EOF inside a "
+                                  "multi-part record")
+                return None
+            magic, lrec = struct.unpack(_FMT_MAGIC_LREC, head)
+            if magic != _kMagic:
+                raise IOError("Invalid RecordIO magic at offset %d"
+                              % (self.handle.tell() - 8))
+            cflag = lrec >> 29
+            length = lrec & ((1 << 29) - 1)
+            data = self.handle.read(length)
+            if len(data) < length:
+                raise IOError("Truncated RecordIO record")
+            pad = (4 - (length & 3)) & 3
+            if pad:
+                self.handle.read(pad)
+            parts.append(data)
+            if cflag == 0:
+                return data
+            if cflag == 3:
+                return b"".join(_interleave_magic(parts))
+            # cflag 1/2: continue reading
+
+
+def _interleave_magic(parts):
+    """Reassemble continuation parts: the split token was the magic bytes."""
+    magic_bytes = struct.pack("<I", _kMagic)
+    out = []
+    for i, p in enumerate(parts):
+        if i:
+            out.append(magic_bytes)
+        out.append(p)
+    return out
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Keyed random-access record file via a ``.idx`` sidecar
+    (parity: recordio.py:85 MXIndexedRecordIO; key \\t byte-offset lines)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (str(key), self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        assert self.writable
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + payload into one record payload (parity: recordio.py pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        header = header._replace(flag=0)
+        payload = struct.pack(_IR_FORMAT, header.flag, float(header.label),
+                              header.id, header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0.0)
+        payload = struct.pack(_IR_FORMAT, header.flag, 0.0,
+                              header.id, header.id2) + label.tobytes()
+    return payload + s
+
+
+def unpack(s):
+    """Unpack a record payload into (IRHeader, bytes) (parity: recordio.py unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a packed image record to (header, HWC uint8 array)."""
+    from .image import imdecode_bytes
+    header, s = unpack(s)
+    img = imdecode_bytes(s, iscolor=iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array into a record (parity: recordio.py pack_img)."""
+    from .image import imencode
+    buf = imencode(img, quality=quality, img_fmt=img_fmt)
+    return pack(header, buf)

@@ -15,5 +15,11 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# sitecustomize may have imported jax at interpreter startup (capturing
+# JAX_PLATFORMS from the outer env, e.g. a tpu plugin); the runtime config
+# update wins over that capture, the env vars above cover the
+# not-yet-imported case.
+jax.config.update("jax_platforms", "cpu")
+
 # fp64 for numeric-gradient checks (reference CPU tests run fp64 numpy refs)
 jax.config.update("jax_enable_x64", True)

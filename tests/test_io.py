@@ -1,0 +1,214 @@
+"""Data IO tests (mirrors reference tests/python/unittest/test_io.py +
+test_recordio.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu import recordio as rio
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(100).reshape(25, 4).astype(np.float32)
+    label = np.arange(25).astype(np.float32)
+    it = mio.NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 5
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5])
+    np.testing.assert_allclose(batches[2].label[0].asnumpy(), label[10:15])
+    assert batches[-1].pad == 0
+    # second epoch after reset
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_ndarray_iter_pad_discard():
+    data = np.arange(23 * 3).reshape(23, 3).astype(np.float32)
+    it = mio.NDArrayIter(data, None, batch_size=5, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[-1].pad == 2
+    # padded tail wraps to the head
+    np.testing.assert_allclose(batches[-1].data[0].asnumpy()[-2:], data[:2])
+    it = mio.NDArrayIter(data, None, batch_size=5, last_batch_handle="discard")
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_dict_multi_input():
+    it = mio.NDArrayIter({"a": np.zeros((10, 2)), "b": np.ones((10, 3))},
+                         np.arange(10), batch_size=2)
+    names = sorted(d.name for d in it.provide_data)
+    assert names == ["a", "b"]
+    b = next(it)
+    assert len(b.data) == 2
+
+
+def test_ndarray_iter_shuffle():
+    data = np.arange(50).astype(np.float32).reshape(50, 1)
+    it = mio.NDArrayIter(data, data[:, 0], batch_size=10, shuffle=True)
+    got = np.concatenate([b.data[0].asnumpy() for b in it])
+    assert not np.array_equal(got[:, 0], data[:, 0])
+    assert sorted(got[:, 0].tolist()) == data[:, 0].tolist()
+    # data/label stay aligned under shuffle
+    it.reset()
+    for b in it:
+        np.testing.assert_allclose(b.data[0].asnumpy()[:, 0],
+                                   b.label[0].asnumpy())
+
+
+def test_resize_iter():
+    data = np.zeros((10, 2))
+    it = mio.ResizeIter(mio.NDArrayIter(data, batch_size=2), size=8)
+    assert len(list(it)) == 8
+    it.reset()
+    assert len(list(it)) == 8
+
+
+def test_prefetching_iter():
+    data = np.arange(40).reshape(20, 2).astype(np.float32)
+    base = mio.NDArrayIter(data, np.arange(20), batch_size=4)
+    it = mio.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 5
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4])
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(12, 3).astype(np.float32)
+    label = np.arange(12, dtype=np.float32)
+    dpath, lpath = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, label, delimiter=",")
+    it = mio.CSVIter(data_csv=dpath, data_shape=(3,), label_csv=lpath,
+                     batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4],
+                               rtol=1e-5)
+
+
+def test_num_parts_sharding(tmp_path):
+    data = np.arange(20, dtype=np.float32).reshape(20, 1)
+    dpath = str(tmp_path / "d.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    parts = []
+    for part in range(2):
+        it = mio.CSVIter(data_csv=dpath, data_shape=(1,), batch_size=5,
+                         num_parts=2, part_index=part)
+        parts.append(np.concatenate([b.data[0].asnumpy() for b in it]))
+    got = np.concatenate(parts)[:, 0]
+    assert sorted(got.tolist()) == data[:, 0].tolist()
+
+
+# ------------------------------ recordio -----------------------------------
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = rio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"x" * 1000, b"", b"tail"]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = rio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+
+def test_recordio_magic_in_payload(tmp_path):
+    """Payload containing the magic sequence must survive (continuation recs)."""
+    import struct
+    path = str(tmp_path / "m.rec")
+    magic = struct.pack("<I", 0xced7230a)
+    payload = b"abc" + magic + b"def" + magic + magic + b"ghi"
+    w = rio.MXRecordIO(path, "w")
+    w.write(payload)
+    w.write(b"next")
+    w.close()
+    r = rio.MXRecordIO(path, "r")
+    assert r.read() == payload
+    assert r.read() == b"next"
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t.rec")
+    idx_path = str(tmp_path / "t.idx")
+    w = rio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(5):
+        w.write_idx(i, b"rec%d" % i)
+    w.close()
+    r = rio.MXIndexedRecordIO(idx_path, path, "r")
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+    assert r.keys == list(range(5))
+
+
+def test_pack_unpack_scalar_and_vector_label():
+    hdr = rio.IRHeader(0, 3.0, 7, 0)
+    rec = rio.pack(hdr, b"payload")
+    h2, s = rio.unpack(rec)
+    assert h2.label == 3.0 and h2.id == 7 and s == b"payload"
+
+    hdr = rio.IRHeader(0, np.array([1.0, 2.0, 3.0], dtype=np.float32), 9, 0)
+    rec = rio.pack(hdr, b"xy")
+    h2, s = rio.unpack(rec)
+    assert h2.flag == 3
+    np.testing.assert_allclose(h2.label, [1, 2, 3])
+    assert s == b"xy"
+
+
+def test_pack_img_roundtrip(tmp_path):
+    img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+    rec = rio.pack_img(rio.IRHeader(0, 1.0, 0, 0), img, quality=95,
+                       img_fmt=".png")
+    hdr, out = rio.unpack_img(rec)
+    assert hdr.label == 1.0
+    assert out.shape == (32, 32, 3)
+    np.testing.assert_allclose(out, img)  # png is lossless
+
+
+def test_image_record_iter(tmp_path):
+    path = str(tmp_path / "imgs.rec")
+    w = rio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = (rng.rand(40, 40, 3) * 255).astype(np.uint8)
+        w.write(rio.pack_img(rio.IRHeader(0, float(i % 2), i, 0), img,
+                             img_fmt=".png"))
+    w.close()
+    it = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                             batch_size=4, rand_crop=True, rand_mirror=True)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (4, 3, 32, 32)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert set(labels.tolist()) == {0.0, 1.0}
+
+
+def test_mnist_iter(tmp_path):
+    """Synthesize IDX files and read them back through MNISTIter."""
+    import gzip
+    import struct
+    n = 30
+    images = (np.random.rand(n, 28, 28) * 255).astype(np.uint8)
+    labels = (np.arange(n) % 10).astype(np.uint8)
+    ipath = str(tmp_path / "img-idx3-ubyte.gz")
+    lpath = str(tmp_path / "lbl-idx1-ubyte.gz")
+    with gzip.open(ipath, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(images.tobytes())
+    with gzip.open(lpath, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    it = mio.MNISTIter(image=ipath, label=lpath, batch_size=10, shuffle=False)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (10, 1, 28, 28)
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), labels[:10])
+    flat = mio.MNISTIter(image=ipath, label=lpath, batch_size=10, flat=True,
+                         shuffle=False)
+    assert next(flat).data[0].shape == (10, 784)

@@ -1,0 +1,117 @@
+"""KVStore tests (mirrors reference tests/python/unittest/test_kvstore.py:
+multiple NDArrays stand in for devices)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+shape = (4, 4)
+keys = [5, 7, 11]
+
+
+def init_kv():
+    kv = mx.kv.create()
+    kv.init(3, mx.nd.zeros(shape))
+    kv.init(keys, [mx.nd.zeros(shape)] * len(keys))
+    return kv
+
+
+def check_diff_to_scalar(A, x):
+    assert (A.asnumpy() == x).all(), A.asnumpy()
+
+
+def test_single_kv_pair():
+    kv = init_kv()
+    kv.push(3, mx.nd.ones(shape))
+    val = mx.nd.empty(shape)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 1)
+
+
+def test_list_kv_pair():
+    kv = init_kv()
+    kv.push(keys, [mx.nd.ones(shape) * 4] * len(keys))
+    val = [mx.nd.empty(shape)] * len(keys)
+    kv.pull(keys, out=val)
+    for v in val:
+        check_diff_to_scalar(v, 4)
+
+
+def test_aggregator():
+    """Multiple NDArrays per key = multiple 'devices'; push sums them."""
+    kv = init_kv()
+    num_devs = 4
+    vals = [mx.nd.ones(shape)] * num_devs
+    kv.push(3, vals)
+    outs = [mx.nd.empty(shape) for _ in range(num_devs)]
+    kv.pull(3, out=outs)
+    for out in outs:
+        check_diff_to_scalar(out, num_devs)
+    # list of keys, flat list of values (num_keys * num_devs)
+    kv2 = init_kv()
+    flat = [mx.nd.ones(shape) * 2.0 for _ in range(num_devs * len(keys))]
+    kv2.push(keys, flat)
+    kv2.pull(keys, out=flat)
+    for v in flat:
+        check_diff_to_scalar(v, 2.0 * num_devs)
+
+
+def test_updater():
+    kv = init_kv()
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+
+    kv.set_updater(updater)
+    kv.push(3, mx.nd.ones(shape))
+    val = mx.nd.empty(shape)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 2)
+    kv.push(3, [mx.nd.ones(shape)] * 3)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 8)
+
+
+def test_get_type_and_ranks():
+    kvtype = "local_allreduce_cpu"
+    kv = mx.kv.create(kvtype)
+    assert kv.type == kvtype
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+
+
+def test_set_optimizer_pickles():
+    kv = init_kv()
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+    kv.push(3, mx.nd.ones(shape))
+    val = mx.nd.empty(shape)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, -0.1)
+
+
+def test_dist_sync_single_process():
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == 1 and kv.rank == 0
+    kv.init(9, mx.nd.ones(shape))
+    kv.push(9, mx.nd.ones(shape) * 3)
+    out = mx.nd.empty(shape)
+    kv.pull(9, out=out)
+    check_diff_to_scalar(out, 3)
+    kv.barrier()
+
+
+def test_optimizer_states_roundtrip(tmp_path):
+    kv = init_kv()
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                         momentum=0.9))
+    kv.push(3, mx.nd.ones(shape))
+    fname = str(tmp_path / "states.bin")
+    kv.save_optimizer_states(fname)
+    kv2 = init_kv()
+    kv2.load_optimizer_states(fname)
+    assert 3 in kv2._updater.states
+
+
+def test_invalid_type():
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("nosuchstore")

@@ -1,0 +1,151 @@
+"""Tests for metrics, initializers, callbacks (reference test style)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric, initializer
+
+
+def test_accuracy():
+    m = metric.create("acc")
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, value = m.get()
+    assert name == "accuracy"
+    assert abs(value - 2.0 / 3.0) < 1e-6
+
+
+def test_topk():
+    m = metric.create("top_k_accuracy", top_k=2)
+    pred = mx.nd.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]])
+    label = mx.nd.array([1, 0])
+    m.update([label], [pred])
+    _, value = m.get()
+    assert abs(value - 0.5) < 1e-6
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([0.0, 4.0])
+    for name, expect in [("mse", (1.0 + 4.0) / 2), ("mae", (1 + 2) / 2.0)]:
+        m = metric.create(name)
+        m.update([label], [pred])
+        assert abs(m.get()[1] - expect) < 1e-6, name
+
+
+def test_cross_entropy():
+    m = metric.create("ce")
+    pred = mx.nd.array([[0.2, 0.8], [0.9, 0.1]])
+    label = mx.nd.array([1, 0])
+    m.update([label], [pred])
+    expect = (-np.log(0.8 + 1e-8) - np.log(0.9 + 1e-8)) / 2
+    assert abs(m.get()[1] - expect) < 1e-6
+
+
+def test_perplexity_ignore():
+    m = metric.Perplexity(ignore_label=0)
+    pred = mx.nd.array([[0.2, 0.8], [0.5, 0.5]])
+    label = mx.nd.array([1, 0])
+    m.update([label], [pred])
+    _, val = m.get()
+    assert abs(val - np.exp(-np.log(0.8))) < 1e-5
+
+
+def test_composite_and_custom():
+    def feval(label, pred):
+        return float(np.sum(label))
+    comp = metric.create(["acc", metric.np(feval)])
+    pred = mx.nd.array([[0.3, 0.7]])
+    label = mx.nd.array([1])
+    comp.update([label], [pred])
+    names, values = comp.get()
+    assert len(names) == 2 and len(values) == 2
+
+
+def test_f1():
+    m = metric.create("f1")
+    pred = mx.nd.array([[0.3, 0.7], [0.8, 0.2], [0.1, 0.9]])
+    label = mx.nd.array([1, 0, 1])
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 1.0) < 1e-6
+
+
+# --------------------------- initializers ----------------------------------
+def test_uniform_normal_ranges():
+    mx.random.seed(42)
+    arr = mx.nd.zeros((100, 100))
+    initializer.Uniform(0.1)("fc_weight", arr)
+    a = arr.asnumpy()
+    assert a.min() >= -0.1 and a.max() <= 0.1 and abs(a.mean()) < 0.01
+    initializer.Normal(2.0)("fc_weight", arr)
+    a = arr.asnumpy()
+    assert abs(a.std() - 2.0) < 0.1
+
+
+def test_init_name_dispatch():
+    ini = initializer.Uniform(0.5)
+    bias = mx.nd.ones((4,))
+    ini("fc_bias", bias)
+    np.testing.assert_allclose(bias.asnumpy(), 0.0)
+    gamma = mx.nd.zeros((4,))
+    ini("bn_gamma", gamma)
+    np.testing.assert_allclose(gamma.asnumpy(), 1.0)
+    mmean = mx.nd.ones((4,))
+    ini("bn_moving_mean", mmean)
+    np.testing.assert_allclose(mmean.asnumpy(), 0.0)
+    mvar = mx.nd.zeros((4,))
+    ini("bn_moving_var", mvar)
+    np.testing.assert_allclose(mvar.asnumpy(), 1.0)
+
+
+def test_xavier_scale():
+    mx.random.seed(0)
+    arr = mx.nd.zeros((64, 64))
+    initializer.Xavier(factor_type="avg", magnitude=3)("fc_weight", arr)
+    a = arr.asnumpy()
+    bound = np.sqrt(3.0 / 64)
+    assert a.min() >= -bound - 1e-6 and a.max() <= bound + 1e-6
+
+
+def test_orthogonal():
+    mx.random.seed(0)
+    arr = mx.nd.zeros((16, 16))
+    initializer.Orthogonal(scale=1.0)("fc_weight", arr)
+    a = arr.asnumpy()
+    np.testing.assert_allclose(a @ a.T, np.eye(16), atol=1e-4)
+
+
+def test_msra_prelu():
+    mx.random.seed(0)
+    arr = mx.nd.zeros((128, 128))
+    initializer.MSRAPrelu()("fc_weight", arr)
+    a = arr.asnumpy()
+    expect_std = np.sqrt(2.0 / (1 + 0.25 ** 2) / 128)
+    assert abs(a.std() - expect_std) / expect_std < 0.15
+
+
+def test_load_and_mixed():
+    src = {"arg:fc_weight": mx.nd.ones((2, 2))}
+    ini = initializer.Load(src, default_init=initializer.Zero())
+    w = mx.nd.zeros((2, 2))
+    ini("fc_weight", w)
+    np.testing.assert_allclose(w.asnumpy(), 1.0)
+    other = mx.nd.ones((3,))
+    ini("other_weight", other)
+    np.testing.assert_allclose(other.asnumpy(), 0.0)
+
+    mixed = initializer.Mixed([".*bias", ".*"],
+                              [initializer.One(), initializer.Zero()])
+    b = mx.nd.zeros((3,))
+    mixed("fc_bias", b)
+    np.testing.assert_allclose(b.asnumpy(), 1.0)
+
+
+def test_speedometer_and_batch_end():
+    from mxnet_tpu.callback import Speedometer, BatchEndParam
+    s = Speedometer(batch_size=32, frequent=1)
+    m = metric.create("acc")
+    m.update([mx.nd.array([1])], [mx.nd.array([[0.2, 0.8]])])
+    for i in range(3):
+        s(BatchEndParam(epoch=0, nbatch=i, eval_metric=m, locals=None))

@@ -1,0 +1,193 @@
+"""Optimizer tests: each rule vs a hand-written numpy reference.
+
+Mirrors the reference's tests/python/unittest style (plain asserts, numpy
+refs) for the optimizer zoo (python/mxnet/optimizer.py).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _setup(shape=(4, 7), seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.uniform(-1, 1, size=shape).astype(np.float32)
+    g = rng.uniform(-1, 1, size=shape).astype(np.float32)
+    return w, g
+
+
+def _run(optimizer, w, g, steps=3):
+    weight = mx.nd.array(w.copy())
+    state = optimizer.create_state(0, weight)
+    for _ in range(steps):
+        grad = mx.nd.array(g)
+        optimizer.update(0, weight, grad, state)
+    return weight.asnumpy()
+
+
+def test_sgd_no_momentum():
+    w, g = _setup()
+    out = _run(opt.create("sgd", learning_rate=0.1, wd=0.01), w, g, steps=1)
+    expect = w - 0.1 * (g + 0.01 * w)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_sgd_momentum():
+    w, g = _setup()
+    lr, mu, wd = 0.1, 0.9, 0.001
+    out = _run(opt.create("sgd", learning_rate=lr, momentum=mu, wd=wd), w, g, steps=3)
+    ww, m = w.copy(), np.zeros_like(w)
+    for _ in range(3):
+        gg = g + wd * ww
+        m = mu * m - lr * gg
+        ww = ww + m
+    np.testing.assert_allclose(out, ww, rtol=1e-5)
+
+
+def test_sgd_rescale_clip():
+    w, g = _setup()
+    o = opt.create("sgd", learning_rate=1.0, rescale_grad=0.5, clip_gradient=0.1)
+    out = _run(o, w, g, steps=1)
+    expect = w - 1.0 * np.clip(g * 0.5, -0.1, 0.1)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_nag():
+    w, g = _setup()
+    lr, mu = 0.05, 0.9
+    out = _run(opt.create("nag", learning_rate=lr, momentum=mu), w, g, steps=2)
+    ww, m = w.copy(), np.zeros_like(w)
+    for _ in range(2):
+        gg = g.copy()
+        m = mu * m + gg
+        ww = ww - lr * (gg + mu * m)
+    np.testing.assert_allclose(out, ww, rtol=1e-5)
+
+
+def test_adam():
+    w, g = _setup()
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    out = _run(opt.create("adam", learning_rate=lr), w, g, steps=4)
+    ww = w.copy()
+    mean = np.zeros_like(w)
+    var = np.zeros_like(w)
+    for t in range(1, 5):
+        mean = b1 * mean + (1 - b1) * g
+        var = b2 * var + (1 - b2) * g * g
+        mhat = mean / (1 - b1 ** t)
+        vhat = var / (1 - b2 ** t)
+        ww = ww - lr * mhat / (np.sqrt(vhat) + eps)
+    np.testing.assert_allclose(out, ww, rtol=1e-4)
+
+
+def test_adagrad():
+    w, g = _setup()
+    lr, eps = 0.1, 1e-7
+    out = _run(opt.create("adagrad", learning_rate=lr), w, g, steps=3)
+    ww, hist = w.copy(), np.zeros_like(w)
+    for _ in range(3):
+        hist += g * g
+        ww = ww - lr * g / np.sqrt(hist + eps)
+    np.testing.assert_allclose(out, ww, rtol=1e-4)
+
+
+def test_rmsprop():
+    w, g = _setup()
+    o = opt.create("rmsprop", learning_rate=0.002)
+    out = _run(o, w, g, steps=3)
+    ww = w.copy()
+    n = np.zeros_like(w); gg = np.zeros_like(w); d = np.zeros_like(w)
+    for _ in range(3):
+        n = 0.05 * g * g + 0.95 * n
+        gg = 0.05 * g + 0.95 * gg
+        d = 0.9 * d - 0.002 * g / np.sqrt(n - gg * gg + 1e-4)
+        ww = ww + d
+    np.testing.assert_allclose(out, ww, rtol=1e-4)
+
+
+def test_adadelta():
+    w, g = _setup()
+    out = _run(opt.create("adadelta"), w, g, steps=3)
+    ww = w.copy()
+    ag = np.zeros_like(w); ad = np.zeros_like(w)
+    rho, eps = 0.90, 1e-5
+    for _ in range(3):
+        ag = rho * ag + (1 - rho) * g * g
+        delta = np.sqrt(ad + eps) / np.sqrt(ag + eps) * g
+        ad = rho * ad + (1 - rho) * delta * delta
+        ww = ww - delta
+    np.testing.assert_allclose(out, ww, rtol=1e-4)
+
+
+def test_test_optimizer():
+    w, g = _setup()
+    out = _run(opt.create("test", rescale_grad=1.0), w, g, steps=1)
+    np.testing.assert_allclose(out, w + g, rtol=1e-6)
+
+
+def test_lamb_trust_ratio_runs():
+    w, g = _setup()
+    out = _run(opt.create("lamb", learning_rate=0.01), w, g, steps=2)
+    assert out.shape == w.shape
+    assert np.isfinite(out).all()
+
+
+def test_get_updater_state_per_index():
+    w1, g1 = _setup(seed=1)
+    w2, g2 = _setup(seed=2)
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    updater = opt.get_updater(o)
+    a1, a2 = mx.nd.array(w1), mx.nd.array(w2)
+    updater(0, mx.nd.array(g1), a1)
+    updater(3, mx.nd.array(g2), a2)
+    assert 0 in updater.states and 3 in updater.states
+    np.testing.assert_allclose(a1.asnumpy(), w1 - 0.1 * g1, rtol=1e-5)
+
+
+def test_lr_scheduler_factor():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    sched = FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+
+
+def test_lr_scheduler_multifactor():
+    from mxnet_tpu.lr_scheduler import MultiFactorScheduler
+    sched = MultiFactorScheduler(step=[5, 8], factor=0.1)
+    sched.base_lr = 1.0
+    assert abs(sched(4) - 1.0) < 1e-12
+    assert abs(sched(6) - 0.1) < 1e-12
+    assert abs(sched(9) - 0.01) < 1e-12
+
+
+def test_optimizer_with_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    w, g = _setup()
+    o = opt.create("sgd", learning_rate=0.1,
+                   lr_scheduler=FactorScheduler(step=1, factor=0.1))
+    weight = mx.nd.array(w.copy())
+    o.update(0, weight, mx.nd.array(g), None)
+    after1 = weight.asnumpy()
+    np.testing.assert_allclose(after1, w - 0.1 * g, rtol=1e-5)
+
+
+def test_lr_wd_mult():
+    w, g = _setup()
+    o = opt.create("sgd", learning_rate=0.1, wd=0.1,
+                   param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    o.set_wd_mult({})
+    o.set_lr_mult({"fc_bias": 2.0})
+    wt = mx.nd.array(w.copy()); bs = mx.nd.array(w.copy())
+    o.update(0, wt, mx.nd.array(g), None)
+    o.update(1, bs, mx.nd.array(g), None)
+    np.testing.assert_allclose(wt.asnumpy(), w - 0.1 * (g + 0.1 * w), rtol=1e-5)
+    # bias: wd_mult defaults to 0 for non-weight/gamma, lr_mult 2x
+    np.testing.assert_allclose(bs.asnumpy(), w - 0.2 * g, rtol=1e-5)
+
+
+def test_create_unknown_raises():
+    with pytest.raises(ValueError):
+        opt.create("nosuchopt")

@@ -1,0 +1,141 @@
+"""Mesh / sharded-trainer tests on the 8-device CPU mesh.
+
+The reference fakes multi-device with multiple cpu(i) contexts
+(tests/python/unittest/test_multi_device_exec.py); conftest.py's
+xla_force_host_platform_device_count=8 is our analog (SURVEY §4).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_make_mesh_shapes():
+    assert len(jax.devices()) == 8, "conftest must force 8 cpu devices"
+    mesh = parallel.make_mesh(dp=4, tp=2)
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    mesh = parallel.make_mesh(dp=-1, tp=2)
+    assert mesh.shape["dp"] == 4
+    with pytest.raises(ValueError):
+        parallel.make_mesh(dp=3, tp=2)
+    mesh = parallel.auto_mesh()
+    assert mesh.shape == {"dp": 8}
+
+
+def test_param_pspec_rules():
+    mesh = parallel.make_mesh(dp=4, tp=2)
+    assert parallel.param_pspec("fc1_weight", (16, 8), mesh) == P("tp", None)
+    assert parallel.param_pspec("fc1_bias", (16,), mesh) == P("tp")
+    # non-divisible: replicate
+    assert parallel.param_pspec("w", (5, 3), mesh) == P(None, None)
+    assert parallel.batch_pspec((32, 8), mesh) == P("dp", None)
+
+
+def test_dp_trainer_step_runs_and_learns():
+    mesh = parallel.auto_mesh()  # dp=8
+    net = _mlp()
+    opt = mx.optimizer.create("sgd", learning_rate=0.5,
+                              rescale_grad=1.0 / 64)
+    tr = parallel.ShardedTrainer(net, opt, mesh)
+    assert set(tr.param_names) == {"fc1_weight", "fc1_bias",
+                                   "fc2_weight", "fc2_bias"}
+    mx.random.seed(0)
+    params, opt_state, aux, = tr.init_params({"data": (64, 8)},
+                                             label_shapes={"softmax_label": (64,)})
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32) * 3  # labels in {0,3}
+    batch = tr.shard_batch({"data": x, "softmax_label": y})
+
+    first_acc = None
+    for i in range(30):
+        params, opt_state, aux, outs = tr.step(params, opt_state, aux, batch)
+        pred = np.asarray(outs[0]).argmax(axis=1)
+        acc = (pred == y).mean()
+        if first_acc is None:
+            first_acc = acc
+    assert acc > 0.9, "did not learn: acc=%s (first=%s)" % (acc, first_acc)
+
+
+def test_dp_matches_single_device():
+    """DP-sharded step == unsharded step (the reference's
+    test_model_parallel.py equivalence pattern)."""
+    net = _mlp()
+
+    def run(mesh):
+        opt = mx.optimizer.create("sgd", learning_rate=0.1)
+        tr = parallel.ShardedTrainer(net, opt, mesh)
+        mx.random.seed(7)
+        params, opt_state, aux = tr.init_params(
+            {"data": (16, 8)}, label_shapes={"softmax_label": (16,)})
+        rng = np.random.RandomState(1)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = (rng.rand(16) * 4).astype(np.float32)
+        batch = tr.shard_batch({"data": x, "softmax_label": y})
+        for _ in range(3):
+            params, opt_state, aux, outs = tr.step(params, opt_state, aux, batch)
+        return {k: np.asarray(v) for k, v in params.items()}
+
+    p_multi = run(parallel.auto_mesh())          # dp=8
+    p_single = run(parallel.make_mesh(jax.devices()[:1], dp=1))
+    for k in p_multi:
+        np.testing.assert_allclose(p_multi[k], p_single[k], rtol=2e-4,
+                                   atol=2e-5)
+
+
+def test_tp_trainer_matches_replicated():
+    """Tensor-parallel sharded params produce the same math."""
+    net = _mlp()
+
+    def run(mesh):
+        opt = mx.optimizer.create("sgd", learning_rate=0.1)
+        tr = parallel.ShardedTrainer(net, opt, mesh)
+        mx.random.seed(3)
+        params, opt_state, aux = tr.init_params(
+            {"data": (8, 8)}, label_shapes={"softmax_label": (8,)})
+        rng = np.random.RandomState(2)
+        x = rng.randn(8, 8).astype(np.float32)
+        y = (rng.rand(8) * 4).astype(np.float32)
+        batch = tr.shard_batch({"data": x, "softmax_label": y})
+        for _ in range(2):
+            params, opt_state, aux, outs = tr.step(params, opt_state, aux, batch)
+        return {k: np.asarray(v) for k, v in params.items()}, np.asarray(outs[0])
+
+    p_tp, out_tp = run(parallel.make_mesh(dp=2, tp=4))
+    p_rep, out_rep = run(parallel.make_mesh(jax.devices()[:1], dp=1))
+    np.testing.assert_allclose(out_tp, out_rep, rtol=2e-4, atol=2e-5)
+    for k in p_tp:
+        np.testing.assert_allclose(p_tp[k], p_rep[k], rtol=2e-4, atol=2e-5)
+
+
+def test_batchnorm_aux_updates_in_sharded_step():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(bn, num_hidden=2),
+                               name="softmax")
+    mesh = parallel.auto_mesh()
+    opt = mx.optimizer.create("sgd", learning_rate=0.01)
+    tr = parallel.ShardedTrainer(net, opt, mesh)
+    params, opt_state, aux = tr.init_params(
+        {"data": (16, 4)}, label_shapes={"softmax_label": (16,)})
+    assert "bn_moving_mean" in aux and "bn_moving_var" in aux
+    x = np.random.RandomState(0).randn(16, 4).astype(np.float32) * 3 + 1
+    batch = tr.shard_batch({"data": x,
+                            "softmax_label": np.zeros(16, np.float32)})
+    before = np.asarray(aux["bn_moving_mean"]).copy()
+    params, opt_state, aux, _ = tr.step(params, opt_state, aux, batch)
+    after = np.asarray(aux["bn_moving_mean"])
+    assert not np.allclose(before, after)
