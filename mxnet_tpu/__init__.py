@@ -39,5 +39,15 @@ from . import image
 from . import kvstore
 from . import kvstore as kv
 from . import parallel
+from . import model
+from .model import FeedForward, save_checkpoint, load_checkpoint
+from . import module
+from . import module as mod
+from .module import Module
+from . import monitor
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
+from . import models
 
 __version__ = "0.1.0"

@@ -1,0 +1,16 @@
+"""Module API: the intermediate/high-level training interface.
+
+TPU-native counterpart of the reference's ``python/mxnet/module/`` (2626
+lines): BaseModule.fit (base_module.py:273), Module.bind (module.py:201),
+DataParallelExecutorGroup (executor_group.py:21), BucketingModule
+(bucketing_module.py:16), SequentialModule, PythonModule.
+"""
+from .base_module import BaseModule
+from .executor_group import DataParallelExecutorGroup
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .python_module import PythonModule, PythonLossModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule", "SequentialModule",
+           "PythonModule", "PythonLossModule", "DataParallelExecutorGroup"]

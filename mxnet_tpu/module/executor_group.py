@@ -1,0 +1,188 @@
+"""DataParallelExecutorGroup for the Module API.
+
+TPU-native counterpart of ``python/mxnet/module/executor_group.py:21``: a
+group of bound executors, one per context, each holding a batch slice.  On a
+single TPU context this degenerates to one Executor — i.e. one fused XLA
+computation per forward/backward — which is the common case; multi-ctx
+slicing is kept for API parity and CPU-mesh tests.  (The genuinely parallel
+multi-chip path is parallel.ShardedTrainer, where slicing is replaced by
+``jax.sharding`` over the batch axis.)
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, zeros, concatenate
+from ..executor_manager import (_split_input_slice, _check_arguments,
+                                _bind_exec, _load_data, _load_label)
+from ..io import DataDesc
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+def _as_data_desc(pairs):
+    out = []
+    for item in pairs or []:
+        if isinstance(item, DataDesc):
+            out.append(item)
+        else:
+            out.append(DataDesc(item[0], tuple(item[1])))
+    return out
+
+
+class DataParallelExecutorGroup(object):
+    """Parity: module/executor_group.py:21 (richer than the legacy
+    executor_manager group: label-less bind, inputs_need_grad, merged
+    outputs/input-grads, shared-group rebinding for bucketing)."""
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=None, fixed_param_names=None,
+                 grad_req="write"):
+        _check_arguments(symbol)
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.param_names = [n for n in param_names
+                            if n not in self.fixed_param_names]
+
+        self.data_shapes = _as_data_desc(data_shapes)
+        self.label_shapes = _as_data_desc(label_shapes)
+        self.data_names = [d.name for d in self.data_shapes]
+        self.label_names = [l.name for l in self.label_shapes]
+
+        self.batch_size = self.data_shapes[0].shape[0]
+        self.slices = _split_input_slice(self.batch_size, self.workload)
+
+        if shared_group is None:
+            self.shared_data_arrays = [{} for _ in contexts]
+        else:
+            self.shared_data_arrays = shared_group.shared_data_arrays
+
+        input_names = set(self.data_names) | set(self.label_names)
+        if isinstance(grad_req, str):
+            grad_req_dict = {}
+            for name in self.arg_names:
+                if name in self.param_names:
+                    grad_req_dict[name] = grad_req if for_training else "null"
+                elif name in input_names:
+                    grad_req_dict[name] = "write" if (
+                        for_training and inputs_need_grad and
+                        name in self.data_names) else "null"
+                else:
+                    grad_req_dict[name] = "null"
+        else:
+            grad_req_dict = dict(grad_req)
+
+        self.execs = []
+        for i, ctx in enumerate(contexts):
+            islice = self.slices[i]
+            shard = islice.stop - islice.start
+            input_shapes = {}
+            for d in self.data_shapes + self.label_shapes:
+                input_shapes[d.name] = (shard,) + tuple(d.shape[1:])
+            shared_exec = None if shared_group is None else \
+                shared_group.execs[i]
+            need_grad = {n for n, r in grad_req_dict.items() if r != "null"}
+            exec_ = _bind_exec(self.symbol, ctx, input_shapes,
+                               self.param_names,
+                               need_grad=need_grad if for_training else False,
+                               base_exec=shared_exec,
+                               shared_data_arrays=self.shared_data_arrays[i],
+                               grad_req=grad_req_dict)
+            self.execs.append(exec_)
+
+        self.data_arrays = [[(self.slices[i], e.arg_dict[name])
+                             for i, e in enumerate(self.execs)]
+                            for name in self.data_names]
+        self.label_arrays = [[(self.slices[i], e.arg_dict[name])
+                              for i, e in enumerate(self.execs)]
+                             for name in self.label_names]
+        self.param_arrays = [[e.arg_dict[name] for e in self.execs]
+                             for name in self.param_names]
+        if for_training:
+            self.grad_arrays = [[e.grad_dict[name] for e in self.execs]
+                                for name in self.param_names
+                                if grad_req_dict.get(name, "null") != "null"]
+        else:
+            self.grad_arrays = []
+        self.aux_arrays = [[e.aux_dict[name] for e in self.execs]
+                           for name in self.aux_names]
+
+    # ------------------------------------------------------------------
+    def load_data_batch(self, data_batch):
+        _load_data(data_batch, self.data_arrays)
+        if self.label_arrays and data_batch.label:
+            _load_label(data_batch, self.label_arrays)
+
+    def forward(self, data_batch=None, is_train=None):
+        if data_batch is not None:
+            self.load_data_batch(data_batch)
+        if is_train is None:
+            is_train = self.for_training
+        for exec_ in self.execs:
+            exec_.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        if not self.for_training:
+            raise MXNetError("re-bind with for_training=True to run backward")
+        for i, exec_ in enumerate(self.execs):
+            if out_grads is not None:
+                islice = self.slices[i]
+                sliced = [g[islice] if g.shape[0] == self.batch_size else g
+                          for g in out_grads]
+                exec_.backward(sliced)
+            else:
+                exec_.backward()
+
+    # ------------------------------------------------------------------
+    def get_outputs(self, merge_multi_context=True):
+        outputs = [[e.outputs[i] for e in self.execs]
+                   for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            return [_merge(parts) for parts in outputs]
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True first")
+        grads = [[e.grad_dict[name] for e in self.execs]
+                 for name in self.data_names]
+        if merge_multi_context:
+            return [_merge(parts) for parts in grads]
+        return grads
+
+    def get_params(self, arg_params, aux_params):
+        """Average device copies out into host dicts (executor_group.py:470)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            full = sum(w.asnumpy() for w in block) / len(block)
+            arg_params[name] = NDArray(full)
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            full = sum(w.asnumpy() for w in block) / len(block)
+            aux_params[name] = NDArray(full)
+
+    def set_params(self, arg_params, aux_params):
+        for exec_ in self.execs:
+            exec_.copy_params_from(arg_params, aux_params)
+
+    def update_metric(self, eval_metric, labels):
+        for texec, islice in zip(self.execs, self.slices):
+            labels_slice = [label[islice] for label in labels]
+            eval_metric.update(labels_slice, texec.outputs)
+
+    def install_monitor(self, mon):
+        for exec_ in self.execs:
+            mon.install(exec_)
+
+
+def _merge(parts):
+    if len(parts) == 1:
+        return parts[0]
+    return concatenate(parts, axis=0)

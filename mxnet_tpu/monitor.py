@@ -1,0 +1,94 @@
+"""Monitor: regex-filtered per-output statistics during training.
+
+TPU-native counterpart of ``python/mxnet/monitor.py:16``.  The reference
+installs a C callback fired per-op by the graph executor
+(graph_executor.cc:937-951).  Here the Executor's monitor path re-runs the
+trace in interpret mode capturing intermediate outputs (the analog of
+PartialForward debugging), so stats are exact without perturbing the
+compiled fast path.
+"""
+from __future__ import annotations
+
+import logging
+import re
+from math import sqrt
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor(object):
+    """Parity: monitor.py:16."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                """returns |x|/size(x), async execution."""
+                a = x.asnumpy()
+                return abs(a).sum() / a.size
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(array)))
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """Install the monitor callback on an executor (monitor.py:51)."""
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting stats for this batch (monitor.py:59)."""
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for array in exe.arg_arrays:
+                    if isinstance(array, NDArray):
+                        array.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """End collection; return list of (step, name, stat) (monitor.py:70)."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for array in exe.arg_arrays:
+                if isinstance(array, NDArray):
+                    array.wait_to_read()
+        for exe in self.exes:
+            for name, array in zip(exe._arg_names, exe.arg_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(array)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, (list, tuple)):
+                v = v_list
+            else:
+                v = [v_list]
+            s = ""
+            for vv in v:
+                s += str(vv) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """End collection and log results (monitor.py:97)."""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

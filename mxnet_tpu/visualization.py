@@ -1,0 +1,185 @@
+"""Network visualization: print_summary + graphviz plotting.
+
+TPU-native counterpart of ``python/mxnet/visualization.py`` (288 lines).
+``plot_network`` emits graphviz if the package is importable and raises a
+clear error otherwise (no hard dependency); ``print_summary`` is pure text.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .symbol import Symbol
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Print a table of layers, output shapes and param counts
+    (parity: visualization.py:27)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+
+    positions = [int(line_length * p) for p in positions]
+    # header names for the different log elements
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node.op
+        name = node.name
+        pre_nodes = [inp[0].name for inp in node.inputs]
+        pre_filter = 0
+        cur_param = 0
+        if op is None:  # variable
+            cls_name = "Variable"
+        else:
+            cls_name = type(op).op_name or type(op).__name__
+            # count params from bound variable inputs
+            for inp, _ in node.inputs:
+                if inp.is_variable and inp.name.startswith(name) is False:
+                    pass
+        if show_shape and op is not None:
+            for inp, idx in node.inputs:
+                if inp.is_variable:
+                    key = inp.name
+                    if key in _arg_shapes:
+                        import numpy as _np
+                        cur_param += int(_np.prod(_arg_shapes[key]))
+        first_connection = ", ".join(pre_nodes)
+        fields = ["%s (%s)" % (name, cls_name),
+                  str(out_shape) if out_shape else "",
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        total_params[0] += cur_param
+
+    _arg_shapes = {}
+    if show_shape:
+        arg_names = symbol.list_arguments()
+        arg_shapes, _, _ = symbol.infer_shape(**shape)
+        _arg_shapes = dict(zip(arg_names, arg_shapes))
+        input_names = set(shape.keys())
+        _arg_shapes = {k: v for k, v in _arg_shapes.items()
+                       if k not in input_names}
+
+    nodes = symbol._topo()
+    counted = set()
+    for node in nodes:
+        if node.is_variable:
+            continue
+        out_name = node.name + "_output"
+        out_shape = shape_dict.get(out_name) if show_shape else None
+        # only count each param var once
+        print_layer_summary(node, out_shape)
+        print("_" * line_length)
+    print("Total params: %s" % total_params[0])
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz rendering of a Symbol DAG (parity: visualization.py:126)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires the graphviz python package")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+
+    shape_dict = {}
+    draw_shape = False
+    if shape is not None:
+        draw_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs or {})
+    dot = Digraph(name=title, format=save_format)
+
+    # color palette (same scheme family as the reference)
+    cm = ("#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3",
+          "#fdb462", "#b3de69", "#fccde5")
+
+    nodes = symbol._topo()
+    hidden = set()
+    for node in nodes:
+        name = node.name
+        if node.is_variable:
+            if hide_weights and not name.endswith("data") and \
+                    not name.endswith("label"):
+                hidden.add(id(node))
+                continue
+            dot.node(name=name, label=name, shape="oval", style="filled",
+                     fillcolor=cm[0])
+            continue
+        op_name = type(node.op).op_name or type(node.op).__name__
+        label = op_name
+        fillcolor = cm[1]
+        if op_name == "Convolution":
+            p = node.op.param
+            label = "Convolution\n%s/%s, %d" % (
+                "x".join(str(x) for x in p.kernel),
+                "x".join(str(x) for x in (p.stride or (1, 1))), p.num_filter)
+            fillcolor = cm[1]
+        elif op_name == "FullyConnected":
+            label = "FullyConnected\n%d" % node.op.param.num_hidden
+            fillcolor = cm[1]
+        elif op_name == "BatchNorm":
+            fillcolor = cm[3]
+        elif op_name == "Activation" or op_name == "LeakyReLU":
+            label = "%s\n%s" % (op_name, node.op.param.act_type)
+            fillcolor = cm[2]
+        elif op_name == "Pooling":
+            p = node.op.param
+            label = "Pooling\n%s, %s/%s" % (
+                p.pool_type, "x".join(str(x) for x in p.kernel),
+                "x".join(str(x) for x in (p.stride or (1, 1))))
+            fillcolor = cm[4]
+        elif op_name in ("Concat", "Flatten", "Reshape"):
+            fillcolor = cm[5]
+        elif op_name == "SoftmaxOutput":
+            fillcolor = cm[6]
+        dot.node(name=name, label=label, fillcolor=fillcolor, **{
+            k: v for k, v in node_attr.items() if k not in ("style",)},
+            style="filled")
+
+    for node in nodes:
+        if node.is_variable:
+            continue
+        name = node.name
+        for inp, idx in node.inputs:
+            if id(inp) in hidden:
+                continue
+            attrs = {"dir": "back", "arrowtail": "open"}
+            if draw_shape:
+                key = inp.name if inp.is_variable else inp.name + "_output"
+                if key in shape_dict:
+                    attrs["label"] = "x".join(
+                        str(x) for x in shape_dict[key][1:])
+            dot.edge(tail_name=name, head_name=inp.name, **attrs)
+    return dot

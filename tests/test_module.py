@@ -1,0 +1,256 @@
+"""Module API + FeedForward + model zoo tests.
+
+Mirrors the reference's tests/python/unittest/test_module.py and
+tests/python/train/test_mlp.py (small end-to-end runs asserting an accuracy
+threshold, SURVEY §4).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _toy_problem(n=200, d=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype("float32")
+    w = rng.randn(d)
+    y = (X @ w > 0).astype("float32")
+    return X, y
+
+
+def test_module_bind_forward():
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    batch = mx.io.DataBatch(data=[mx.nd.ones((4, 10))],
+                            label=[mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 2)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1),
+                               np.ones(4), rtol=1e-5)
+
+
+def test_module_fit_accuracy():
+    X, y = _toy_problem()
+    train = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=True)
+    val = mx.io.NDArrayIter(X, y, batch_size=20)
+    net = mx.models.get_mlp(num_classes=2, hidden=(16,))
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=5)
+    score = dict(mod.score(val, "acc"))
+    assert score["accuracy"] > 0.9, score
+
+
+def test_module_get_set_params_roundtrip():
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    arg_params, aux_params = mod.get_params()
+    assert "fc1_weight" in arg_params
+
+    mod2 = mx.mod.Module(net, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (4, 10))],
+              label_shapes=[("softmax_label", (4,))])
+    mod2.set_params(arg_params, aux_params)
+    a1, _ = mod2.get_params()
+    np.testing.assert_allclose(a1["fc1_weight"].asnumpy(),
+                               arg_params["fc1_weight"].asnumpy())
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    prefix = str(tmp_path / "mod_test")
+    mod.save_checkpoint(prefix, 3)
+
+    mod2 = mx.mod.Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (4, 10))],
+              label_shapes=[("softmax_label", (4,))])
+    a0, _ = mod.get_params()
+    a1, _ = mod2.get_params()
+    for k in a0:
+        np.testing.assert_allclose(a0[k].asnumpy(), a1[k].asnumpy())
+
+
+def test_module_input_grads():
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))],
+             for_training=True, inputs_need_grad=True)
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    batch = mx.io.DataBatch(data=[mx.nd.ones((4, 10))],
+                            label=[mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    igrads = mod.get_input_grads()
+    assert igrads[0].shape == (4, 10)
+    assert np.abs(igrads[0].asnumpy()).sum() > 0
+
+
+def test_module_multi_context_slicing():
+    """Batch slicing across two CPU contexts (reference fakes multi-device
+    with cpu dev_ids, test_multi_device_exec.py)."""
+    X, y = _toy_problem()
+    train = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=True)
+    net = mx.models.get_mlp(num_classes=2, hidden=(16,))
+    mod = mx.mod.Module(net, context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=3)
+    val = mx.io.NDArrayIter(X, y, batch_size=20)
+    score = dict(mod.score(val, "acc"))
+    assert score["accuracy"] > 0.85, score
+
+
+def test_feedforward_fit_score_predict(tmp_path):
+    X, y = _toy_problem()
+    train = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=True)
+    val = mx.io.NDArrayIter(X, y, batch_size=20)
+    model = mx.FeedForward(mx.models.get_mlp(2, (16,)), ctx=mx.cpu(),
+                           num_epoch=5, optimizer="sgd", learning_rate=0.5)
+    model.fit(train, eval_data=val)
+    assert model.score(val) > 0.9
+    pred = model.predict(val)
+    assert pred.shape == (200, 2)
+
+    prefix = str(tmp_path / "ff_test")
+    model.save(prefix)
+    m2 = mx.FeedForward.load(prefix, 5, ctx=mx.cpu())
+    assert m2.score(val) > 0.9
+
+
+def test_feedforward_numpy_input():
+    X, y = _toy_problem()
+    model = mx.FeedForward(mx.models.get_mlp(2, (16,)), ctx=mx.cpu(),
+                           num_epoch=4, optimizer="sgd", learning_rate=0.5,
+                           numpy_batch_size=20)
+    model.fit(X, y)
+    pred = model.predict(X)
+    acc = ((pred.argmax(axis=1) == y).mean())
+    assert acc > 0.85
+
+
+def test_bucketing_module():
+    """Per-bucket executors sharing params (bucketing_module.py:189)."""
+    batch_size = 8
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        fc = mx.sym.FullyConnected(data, name="fc", num_hidden=4)
+        net = mx.sym.SoftmaxOutput(fc, label=label, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=12,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (batch_size, 12))],
+             label_shapes=[("softmax_label", (batch_size,))])
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+
+    # feed batches from two different buckets: 12 cols and 12 cols; fc
+    # weight is shared so switching buckets must not lose updates
+    for seq_len in (12, 12):
+        data = mx.nd.ones((batch_size, seq_len))
+        label = mx.nd.zeros((batch_size,))
+        batch = mx.io.DataBatch(data=[data], label=[label],
+                                provide_data=[("data", (batch_size, seq_len))],
+                                provide_label=[("softmax_label", (batch_size,))],
+                                bucket_key=seq_len)
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    out = mod.get_outputs()[0]
+    assert out.shape == (batch_size, 4)
+
+
+def test_sequential_module():
+    net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc1",
+                                 num_hidden=8)
+    net1 = mx.sym.Activation(net1, act_type="relu")
+    net2 = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc2",
+                                 num_hidden=2)
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+
+    mod1 = mx.mod.Module(net1, label_names=None, context=mx.cpu())
+    mod2 = mx.mod.Module(net2, context=mx.cpu())
+    seq = mx.mod.SequentialModule()
+    seq.add(mod1).add(mod2, take_labels=True, auto_wiring=True)
+
+    X, y = _toy_problem()
+    train = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=True)
+    seq.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    seq.init_params(initializer=mx.init.Uniform(0.1))
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.create("acc")
+    for epoch in range(3):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            seq.forward_backward(batch)
+            seq.update()
+            seq.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.8
+
+
+@pytest.mark.parametrize("name,builder,shape", [
+    ("lenet", lambda: mx.models.get_lenet(10), (2, 1, 28, 28)),
+    ("resnet18", lambda: mx.models.get_resnet(10, 18, (3, 32, 32)),
+     (2, 3, 32, 32)),
+])
+def test_model_zoo_forward(name, builder, shape):
+    net = builder()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", shape)],
+             label_shapes=[("softmax_label", (shape[0],))])
+    mod.init_params(initializer=mx.init.Xavier())
+    batch = mx.io.DataBatch(data=[mx.nd.ones(shape)],
+                            label=[mx.nd.zeros((shape[0],))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (shape[0], 10)
+    assert np.all(np.isfinite(out.asnumpy()))
+
+
+def test_model_zoo_shapes():
+    """All zoo symbols infer shapes (parity: test_symbol/infer_shape)."""
+    cases = [
+        (mx.models.get_alexnet(100), (2, 3, 224, 224), 100),
+        (mx.models.get_vgg(10, 11), (2, 3, 224, 224), 10),
+        (mx.models.get_googlenet(10), (2, 3, 224, 224), 10),
+        (mx.models.get_inception_bn(10), (2, 3, 224, 224), 10),
+        (mx.models.get_resnet(10, 50), (2, 3, 224, 224), 10),
+    ]
+    for net, dshape, ncls in cases:
+        _, out_shapes, _ = net.infer_shape(data=dshape)
+        assert out_shapes[0] == (dshape[0], ncls)
+
+
+def test_monitor():
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mon = mx.Monitor(interval=1, pattern=".*weight")
+    mod.install_monitor(mon)
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    batch = mx.io.DataBatch(data=[mx.nd.ones((4, 10))],
+                            label=[mx.nd.zeros((4,))])
+    mon.tic()
+    mod.forward(batch, is_train=False)
+    res = mon.toc()
+    assert len(res) > 0
+    names = [k for _, k, _ in res]
+    assert any("weight" in n for n in names)
