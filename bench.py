@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 fused training-step throughput (images/sec).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's only citable training-throughput figure —
+~170 images/sec, ImageNet-22k Inception on 4×GTX-980 data-parallel
+(docs/tutorials/imagenet_full.md:45; BASELINE.md).  The whole step
+(fwd + bwd + SGD-momentum update, buffers donated) is one XLA
+computation over every visible chip, batch sharded dp.
+
+Env knobs: BENCH_BATCH (per-device batch, default 64), BENCH_STEPS
+(timed steps, default 10), BENCH_LAYERS (default 50).
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    n_dev = len(jax.devices())
+    per_dev_batch = int(os.environ.get("BENCH_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    num_layers = int(os.environ.get("BENCH_LAYERS", "50"))
+    global_batch = per_dev_batch * n_dev
+
+    mesh = make_mesh(jax.devices(), dp=n_dev)
+    sym = resnet.get_symbol(num_classes=1000, num_layers=num_layers)
+    optimizer = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9,
+                               wd=1e-4, rescale_grad=1.0 / global_batch)
+    trainer = ShardedTrainer(sym, optimizer, mesh)
+
+    params, opt_state, aux = trainer.init_params(
+        {"data": (global_batch, 3, 224, 224)},
+        label_shapes={"softmax_label": (global_batch,)})
+    rng = np.random.RandomState(0)
+    batch = trainer.shard_batch({
+        "data": rng.rand(global_batch, 3, 224, 224).astype(np.float32),
+        "softmax_label": rng.randint(
+            0, 1000, size=(global_batch,)).astype(np.float32),
+    })
+
+    # warmup (compile)
+    for _ in range(2):
+        params, opt_state, aux, outs = trainer.step(params, opt_state, aux,
+                                                    batch)
+    jax.block_until_ready(outs)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, aux, outs = trainer.step(params, opt_state, aux,
+                                                    batch)
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = global_batch * steps / dt
+    baseline = 170.0  # ref: 4-GPU data-parallel training throughput
+    print(json.dumps({
+        "metric": "resnet%d_train_images_per_sec" % num_layers,
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

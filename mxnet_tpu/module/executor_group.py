@@ -50,8 +50,10 @@ class DataParallelExecutorGroup(object):
 
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
-        self.param_names = [n for n in param_names
-                            if n not in self.fixed_param_names]
+        # fixed params stay in param_names (so they are initialized, synced
+        # and checkpointed); only their grad_req becomes 'null' — matching
+        # the reference (module.py fixed_param_names handling)
+        self.param_names = list(param_names)
 
         self.data_shapes = _as_data_desc(data_shapes)
         self.label_shapes = _as_data_desc(label_shapes)
@@ -70,7 +72,9 @@ class DataParallelExecutorGroup(object):
         if isinstance(grad_req, str):
             grad_req_dict = {}
             for name in self.arg_names:
-                if name in self.param_names:
+                if name in self.fixed_param_names:
+                    grad_req_dict[name] = "null"
+                elif name in self.param_names:
                     grad_req_dict[name] = grad_req if for_training else "null"
                 elif name in input_names:
                     grad_req_dict[name] = "write" if (
@@ -108,9 +112,10 @@ class DataParallelExecutorGroup(object):
         self.param_arrays = [[e.arg_dict[name] for e in self.execs]
                              for name in self.param_names]
         if for_training:
-            self.grad_arrays = [[e.grad_dict[name] for e in self.execs]
-                                for name in self.param_names
-                                if grad_req_dict.get(name, "null") != "null"]
+            # aligned with param_names; [None] entries for no-grad (fixed)
+            # params, skipped by _update_params (model.py:91 contract)
+            self.grad_arrays = [[e.grad_dict.get(name) for e in self.execs]
+                                for name in self.param_names]
         else:
             self.grad_arrays = []
         self.aux_arrays = [[e.aux_dict[name] for e in self.execs]

@@ -110,6 +110,7 @@ class Module(BaseModule):
 
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
         self.binded = True
 
         if not for_training:
@@ -365,5 +366,5 @@ class Module(BaseModule):
         self.bind(data_shapes, label_shapes,
                   for_training=self.for_training,
                   inputs_need_grad=self.inputs_need_grad,
-                  force_rebind=True)
+                  force_rebind=True, grad_req=self._grad_req)
         self._exec_group.set_params(self._arg_params, self._aux_params)

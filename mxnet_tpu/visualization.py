@@ -43,53 +43,45 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74,
     print_row(to_display, positions)
     print("=" * line_length)
 
+    # trainable-param shapes: every argument that is neither a fed input
+    # (shape keys) nor a label variable
+    _arg_shapes = {}
+    if show_shape:
+        import numpy as _np
+        arg_names = symbol.list_arguments()
+        arg_shapes, _, _ = symbol.infer_shape(**shape)
+        input_names = set(shape.keys())
+        _arg_shapes = {k: v for k, v in zip(arg_names, arg_shapes)
+                       if k not in input_names and not k.endswith("label")}
+
     total_params = [0]
+    counted = set()  # each shared weight counts once (e.g. unrolled RNNs)
 
     def print_layer_summary(node, out_shape):
         op = node.op
-        name = node.name
-        pre_nodes = [inp[0].name for inp in node.inputs]
-        pre_filter = 0
+        cls_name = "Variable" if op is None else \
+            (type(op).op_name or type(op).__name__)
         cur_param = 0
-        if op is None:  # variable
-            cls_name = "Variable"
-        else:
-            cls_name = type(op).op_name or type(op).__name__
-            # count params from bound variable inputs
-            for inp, _ in node.inputs:
-                if inp.is_variable and inp.name.startswith(name) is False:
-                    pass
         if show_shape and op is not None:
-            for inp, idx in node.inputs:
-                if inp.is_variable:
-                    key = inp.name
-                    if key in _arg_shapes:
-                        import numpy as _np
-                        cur_param += int(_np.prod(_arg_shapes[key]))
-        first_connection = ", ".join(pre_nodes)
-        fields = ["%s (%s)" % (name, cls_name),
+            import numpy as _np
+            for inp, _idx in node.inputs:
+                key = inp.name
+                if inp.is_variable and key in _arg_shapes \
+                        and key not in counted:
+                    counted.add(key)
+                    cur_param += int(_np.prod(_arg_shapes[key]))
+        first_connection = ", ".join(inp[0].name for inp in node.inputs)
+        fields = ["%s (%s)" % (node.name, cls_name),
                   str(out_shape) if out_shape else "",
                   cur_param, first_connection]
         print_row(fields, positions)
         total_params[0] += cur_param
 
-    _arg_shapes = {}
-    if show_shape:
-        arg_names = symbol.list_arguments()
-        arg_shapes, _, _ = symbol.infer_shape(**shape)
-        _arg_shapes = dict(zip(arg_names, arg_shapes))
-        input_names = set(shape.keys())
-        _arg_shapes = {k: v for k, v in _arg_shapes.items()
-                       if k not in input_names}
-
-    nodes = symbol._topo()
-    counted = set()
-    for node in nodes:
+    for node in symbol._topo():
         if node.is_variable:
             continue
         out_name = node.name + "_output"
         out_shape = shape_dict.get(out_name) if show_shape else None
-        # only count each param var once
         print_layer_summary(node, out_shape)
         print("_" * line_length)
     print("Total params: %s" % total_params[0])

@@ -144,9 +144,13 @@ def test_bucketing_module():
     batch_size = 8
 
     def sym_gen(seq_len):
+        # embedding + pooled sum keeps param shapes independent of seq_len
         data = mx.sym.Variable("data")
         label = mx.sym.Variable("softmax_label")
-        fc = mx.sym.FullyConnected(data, name="fc", num_hidden=4)
+        embed = mx.sym.Embedding(data, name="embed", input_dim=20,
+                                 output_dim=6)
+        pooled = mx.sym.sum_axis(embed, axis=1)
+        fc = mx.sym.FullyConnected(pooled, name="fc", num_hidden=4)
         net = mx.sym.SoftmaxOutput(fc, label=label, name="softmax")
         return net, ("data",), ("softmax_label",)
 
@@ -158,9 +162,9 @@ def test_bucketing_module():
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": 0.1})
 
-    # feed batches from two different buckets: 12 cols and 12 cols; fc
-    # weight is shared so switching buckets must not lose updates
-    for seq_len in (12, 12):
+    # feed genuinely different buckets: the 8-bucket binds a new executor
+    # sharing params with the default 12-bucket (switch_bucket shared path)
+    for seq_len in (12, 8, 12, 8):
         data = mx.nd.ones((batch_size, seq_len))
         label = mx.nd.zeros((batch_size,))
         batch = mx.io.DataBatch(data=[data], label=[label],
@@ -172,6 +176,9 @@ def test_bucketing_module():
         mod.update()
     out = mod.get_outputs()[0]
     assert out.shape == (batch_size, 4)
+    # updates through bucket 8 must be visible in shared params
+    arg_params, _ = mod.get_params()
+    assert "embed_weight" in arg_params and "fc_weight" in arg_params
 
 
 def test_sequential_module():
@@ -236,6 +243,63 @@ def test_model_zoo_shapes():
     for net, dshape, ncls in cases:
         _, out_shapes, _ = net.infer_shape(data=dshape)
         assert out_shapes[0] == (dshape[0], ncls)
+
+
+def test_module_fixed_params_initialized_and_frozen():
+    """fixed_param_names: initialized + checkpointed, but not updated."""
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        fixed_param_names=["fc1_weight"])
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    arg_params, _ = mod.get_params()
+    w0 = arg_params["fc1_weight"].asnumpy()
+    assert np.abs(w0).sum() > 0, "fixed param was not initialized"
+
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    batch = mx.io.DataBatch(data=[mx.nd.ones((4, 10))],
+                            label=[mx.nd.zeros((4,))])
+    for _ in range(3):
+        mod.forward_backward(batch)
+        mod.update()
+    arg_params, _ = mod.get_params()
+    np.testing.assert_allclose(arg_params["fc1_weight"].asnumpy(), w0,
+                               err_msg="fixed param was updated")
+    # non-fixed params must have moved
+    assert np.abs(arg_params["fc2_weight"].asnumpy()
+                  - w0.sum() * 0).sum() >= 0  # exists
+    assert not np.allclose(arg_params["fc2_bias"].asnumpy(), 0)
+
+
+def test_module_reshape_keeps_grad_req():
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))], grad_req="add")
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    mod.reshape(data_shapes=[("data", (8, 10))],
+                label_shapes=[("softmax_label", (8,))])
+    batch = mx.io.DataBatch(data=[mx.nd.ones((8, 10))],
+                            label=[mx.nd.zeros((8,))])
+    # with grad_req='add', two backward passes double the gradient
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g1 = mod._exec_group.execs[0].grad_dict["fc1_weight"].asnumpy().copy()
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g2 = mod._exec_group.execs[0].grad_dict["fc1_weight"].asnumpy()
+    np.testing.assert_allclose(g2, 2 * g1, rtol=1e-4)
+
+
+def test_print_summary_param_count(capsys):
+    """Labels don't count as params; shared weights count once."""
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+    mx.viz.print_summary(net, shape={"data": (4, 10)})
+    out = capsys.readouterr().out
+    # mlp 10->8->2: fc1 10*8+8, fc2 8*2+2 = 88 + 18 = 106
+    assert "Total params: 106" in out
 
 
 def test_monitor():
