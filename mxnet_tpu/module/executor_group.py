@@ -84,6 +84,9 @@ class DataParallelExecutorGroup(object):
                     grad_req_dict[name] = "null"
         else:
             grad_req_dict = dict(grad_req)
+            # fixed params stay frozen regardless of how grad_req was spelled
+            for name in self.fixed_param_names:
+                grad_req_dict[name] = "null"
 
         self.execs = []
         for i, ctx in enumerate(contexts):

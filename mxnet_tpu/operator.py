@@ -1,0 +1,412 @@
+"""Custom python operators.
+
+Parity: python/mxnet/operator.py — both generations of the reference's
+python-op API:
+
+- modern ``CustomOp``/``CustomOpProp`` + ``@mx.operator.register`` (reference
+  operator.py:394,440,552, backing ``src/operator/custom-inl.h:30``), created
+  in a graph via ``mx.symbol.Custom(..., op_type='name')``;
+- legacy ``NumpyOp`` / ``NDArrayOp`` (reference operator.py:124,224, the sync
+  C callbacks of ``native_op-inl.h`` / ``ndarray_op-inl.h``), created via
+  ``op_instance.get_symbol(...)``.
+
+TPU-first translation: the reference runs the python body on a dedicated
+thread via C callbacks (``custom-inl.h`` is ``kAsync`` exec-type); here the
+body runs on the *host* through ``jax.pure_callback`` embedded in the XLA
+program, and the backward contract (``CustomOp.backward`` writing ``in_grad``)
+is attached with ``jax.custom_vjp`` so jax AD routes cotangents through the
+user's python code.  The callback is the one part of the graph XLA cannot
+fuse or shard — exactly mirroring the reference, where Custom ops break the
+engine's bulk-execution segments (graph_executor.cc:860-875).
+"""
+from __future__ import annotations
+
+import inspect
+import weakref
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .registry import Registry
+from .ops.registry import (OperatorProperty, register_op, require_known,
+                           IncompleteShape)
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "NumpyOp", "NDArrayOp"]
+
+# registry of user CustomOpProp classes, keyed by op_type
+CUSTOM_OP_REGISTRY = Registry("custom_op")
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under ``reg_name``.
+
+    Parity: operator.py:552 ``mx.operator.register``.
+    """
+    def _wrap(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register(%r): expected a CustomOpProp subclass"
+                             % reg_name)
+        CUSTOM_OP_REGISTRY.register(reg_name, prop_cls)
+        return prop_cls
+    return _wrap
+
+
+def get_all_registered():
+    return dict(CUSTOM_OP_REGISTRY.items())
+
+
+class CustomOp(object):
+    """Base class for custom-op *compute*; subclass forward/backward.
+
+    Parity: operator.py:394.  ``in_data``/``out_data`` etc. are numpy arrays
+    (host side of the pure_callback); mutate ``out_data``/``in_grad`` via
+    ``self.assign`` exactly like the reference.
+    """
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Helper for assigning into dst honoring the OpReqType string."""
+        if req == "null":
+            return
+        elif req in ("write", "inplace"):
+            dst[...] = src
+        elif req == "add":
+            dst[...] += src
+        else:
+            raise MXNetError("unknown req %r" % req)
+
+
+class CustomOpProp(object):
+    """Metadata/factory for a custom op.  Parity: operator.py:440.
+
+    ``need_top_grad=False`` declares a loss-style op whose backward does not
+    consume the head gradient (DeclareBackwardDependency analog).
+    """
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = bool(need_top_grad)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        base = in_type[0] if in_type and in_type[0] is not None else np.float32
+        return ([base] * len(self.list_arguments()),
+                [base] * len(self.list_outputs()),
+                [base] * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+# ----------------------------------------------------------------------
+# Host-callback scaffolding shared by Custom and _Native
+# ----------------------------------------------------------------------
+def _run_host_op(host_forward, host_backward, inputs, aux, is_train,
+                 in_shapes, in_dtypes, out_shapes, out_dtypes):
+    """Embed a host-side python op into the traced graph.
+
+    ``host_forward(train_flag, in_data, aux_data) -> (out_data, aux_out)``
+    and ``host_backward(out_grad, in_data, out_data, aux_data) -> in_grad``
+    run on numpy arrays via ``jax.pure_callback``; gradients route through
+    ``host_backward`` via ``jax.custom_vjp``.  Aux states travel through the
+    callback as operands (they may be tracers) and their mutated values are
+    returned, matching the reference where aux NDArrays are visible to
+    CustomOp.forward (custom-inl.h).
+    """
+    n_in, n_out, n_aux = len(inputs), len(out_shapes), len(aux)
+    out_spec = tuple(jax.ShapeDtypeStruct(s, d)
+                     for s, d in zip(out_shapes, out_dtypes))
+    in_spec = tuple(jax.ShapeDtypeStruct(s, d)
+                    for s, d in zip(in_shapes, in_dtypes))
+    aux_spec = tuple(jax.ShapeDtypeStruct(tuple(int(d) for d in a.shape),
+                                          np.dtype(a.dtype)) for a in aux)
+
+    def _cb_forward(train_flag, *flat):
+        in_data = [np.asarray(a) for a in flat[:n_in]]
+        aux_data = [np.array(a) for a in flat[n_in:]]
+        out_data, aux_out = host_forward(train_flag, in_data, aux_data)
+        return (tuple(np.ascontiguousarray(o, dtype=d)
+                      for o, d in zip(out_data, out_dtypes))
+                + tuple(np.ascontiguousarray(a, dtype=s.dtype)
+                        for a, s in zip(aux_out, aux_spec)))
+
+    def _cb_backward(*flat):
+        out_grad = [np.asarray(g) for g in flat[:n_out]]
+        in_data = [np.asarray(a) for a in flat[n_out:n_out + n_in]]
+        out_data = [np.asarray(a)
+                    for a in flat[n_out + n_in:n_out + n_in + n_out]]
+        aux_data = [np.array(a) for a in flat[n_out + n_in + n_out:]]
+        in_grad = host_backward(out_grad, in_data, out_data, aux_data)
+        return tuple(np.ascontiguousarray(g, dtype=d)
+                     for g, d in zip(in_grad, in_dtypes))
+
+    @jax.custom_vjp
+    def _fn(xs, auxs):
+        flat = jax.pure_callback(_cb_forward, out_spec + aux_spec,
+                                 is_train, *xs, *auxs)
+        return tuple(flat[:n_out]), tuple(flat[n_out:])
+
+    def _fn_fwd(xs, auxs):
+        flat = jax.pure_callback(_cb_forward, out_spec + aux_spec,
+                                 True, *xs, *auxs)
+        outs, aux_out = tuple(flat[:n_out]), tuple(flat[n_out:])
+        return (outs, aux_out), (xs, auxs, outs)
+
+    def _fn_bwd(res_, cts):
+        xs, auxs, outs = res_
+        out_cts = cts[0]
+        grads = jax.pure_callback(_cb_backward, in_spec,
+                                  *out_cts, *xs, *outs, *auxs)
+        zero_aux = tuple(jnp.zeros(s.shape, s.dtype) for s in aux_spec)
+        return tuple(grads), zero_aux
+
+    _fn.defvjp(_fn_fwd, _fn_bwd)
+    outs, aux_out = _fn(tuple(inputs), tuple(aux))
+    return list(outs), list(aux_out)
+
+
+# ----------------------------------------------------------------------
+# The 'Custom' graph op: bridges a CustomOpProp into the symbolic registry
+# ----------------------------------------------------------------------
+@register_op("Custom")
+class Custom(OperatorProperty):
+    """Custom python op node (parity src/operator/custom-inl.h:30).
+
+    Created as ``mx.sym.Custom(data=..., op_type='myop', **user_kwargs)``.
+    All user kwargs are stored as string attrs (JSON-serializable, like the
+    reference's ``MXCustomOpRegister`` path) and handed to the registered
+    CustomOpProp constructor.
+    """
+    param_cls = None
+    hint = "custom"
+    accepts_any_attrs = True
+
+    def __init__(self, **attrs):
+        # arbitrary user kwargs: bypass OperatorProperty's field validation
+        self.attrs = {k: str(v) for k, v in attrs.items()}
+        if "op_type" not in self.attrs:
+            raise MXNetError("Custom op requires op_type=")
+        self.op_type = self.attrs["op_type"]
+        prop_cls = CUSTOM_OP_REGISTRY.get(self.op_type)
+        kwargs = {k: v for k, v in self.attrs.items()
+                  if k != "op_type" and k not in self._SYSTEM_ATTRS
+                  and not (k.startswith("__") and k.endswith("__"))}
+        # on load_json every node attr comes through here (user graph attrs
+        # included); keep only kwargs the prop constructor actually accepts
+        sig = inspect.signature(prop_cls.__init__)
+        has_var_kw = any(p.kind == p.VAR_KEYWORD
+                         for p in sig.parameters.values())
+        if not has_var_kw:
+            accepted = {n for n, p in sig.parameters.items()
+                        if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)}
+            accepted.discard("self")
+            kwargs = {k: v for k, v in kwargs.items() if k in accepted}
+        self.prop = prop_cls(**kwargs)
+        self.param = None
+
+    # -- metadata delegates to the user prop -------------------------------
+    def list_arguments(self):
+        return list(self.prop.list_arguments())
+
+    def list_outputs(self):
+        return list(self.prop.list_outputs())
+
+    def list_auxiliary_states(self):
+        return list(self.prop.list_auxiliary_states())
+
+    def infer_shape(self, in_shapes):
+        in_shapes = require_known("Custom(%s)" % self.op_type, in_shapes,
+                                  self.list_arguments())
+        res = self.prop.infer_shape([list(s) for s in in_shapes])
+        if len(res) == 2:
+            ins, outs = res
+            aux = []
+        else:
+            ins, outs, aux = res
+        to_t = lambda ss: [tuple(int(d) for d in s) for s in ss]
+        return to_t(ins), to_t(outs), to_t(aux)
+
+    def infer_type(self, in_types):
+        res = self.prop.infer_type(list(in_types))
+        if len(res) == 2:
+            ins, outs = res
+            aux = [np.float32] * len(self.list_auxiliary_states())
+        else:
+            ins, outs, aux = res
+        return list(ins), list(outs), list(aux)
+
+    # -- compute: host callback with custom_vjp ----------------------------
+    def forward(self, inputs, aux, is_train, rng):
+        in_shapes = [tuple(int(d) for d in x.shape) for x in inputs]
+        in_dtypes = [np.dtype(x.dtype) for x in inputs]
+        res = self.prop.infer_shape([list(s) for s in in_shapes])
+        out_shapes = [tuple(int(d) for d in s) for s in res[1]]
+        tres = self.prop.infer_type(list(in_dtypes))
+        out_dtypes = [np.dtype(t) for t in tres[1]]
+        op = self.prop.create_operator(None, in_shapes, in_dtypes)
+        n_out = len(out_shapes)
+        n_in = len(inputs)
+
+        def host_forward(train_flag, in_data, aux_data):
+            out_data = [np.zeros(s, d) for s, d in zip(out_shapes, out_dtypes)]
+            op.forward(is_train=bool(train_flag), req=["write"] * n_out,
+                       in_data=in_data, out_data=out_data, aux=aux_data)
+            return out_data, aux_data
+
+        def host_backward(out_grad, in_data, out_data, aux_data):
+            in_grad = [np.zeros(s, d) for s, d in zip(in_shapes, in_dtypes)]
+            op.backward(req=["write"] * n_in, out_grad=out_grad,
+                        in_data=in_data, out_data=out_data,
+                        in_grad=in_grad, aux=aux_data)
+            return in_grad
+
+        outs, aux_out = _run_host_op(host_forward, host_backward,
+                                     inputs, aux, is_train,
+                                     in_shapes, in_dtypes,
+                                     out_shapes, out_dtypes)
+        return outs, (aux_out if aux else None)
+
+
+# ----------------------------------------------------------------------
+# Legacy NumpyOp / NDArrayOp (operator.py:124,224) via a _Native node
+# ----------------------------------------------------------------------
+# The reference smuggles C function pointers through symbol attrs
+# (non-portable across processes); we do the moral equivalent with an
+# in-process token table.  Values are weak: the _Native node created by
+# get_symbol holds the strong reference, so ops die with their graphs
+# instead of accumulating for process lifetime.
+_LEGACY_OPS = weakref.WeakValueDictionary()
+_LEGACY_NEXT = [0]
+
+
+class PythonOp(object):
+    """Shared base for NumpyOp/NDArrayOp (parity operator.py:26)."""
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = bool(need_top_grad)
+
+    # metadata — same contract as CustomOpProp
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def get_symbol(self, *args, **kwargs):
+        """Create a Symbol running this op (parity operator.py:81)."""
+        from . import symbol as _sym
+        token = "_legacy_op_%d" % _LEGACY_NEXT[0]
+        _LEGACY_NEXT[0] += 1
+        _LEGACY_OPS[token] = self
+        return _sym._create("_Native", *args, info=token, **kwargs)
+
+
+class NumpyOp(PythonOp):
+    """Legacy numpy custom op (parity operator.py:124, native_op-inl.h)."""
+
+
+class NDArrayOp(PythonOp):
+    """Legacy NDArray custom op (parity operator.py:224, ndarray_op-inl.h).
+
+    In this build both legacy flavors execute on host numpy buffers — the
+    NDArray variant's device-side distinction has no meaning when the
+    callback boundary is host-side by construction.
+    """
+
+
+@register_op("_Native", aliases=("_NDArray",))
+class _Native(OperatorProperty):
+    """Graph node for legacy PythonOp instances (native_op-inl.h)."""
+    param_cls = None
+    hint = "native"
+    accepts_any_attrs = True
+
+    def __init__(self, **attrs):
+        self.attrs = {k: str(v) for k, v in attrs.items()}
+        token = self.attrs.get("info")
+        if token not in _LEGACY_OPS:
+            raise MXNetError("_Native: unknown or out-of-process op token %r "
+                             "(legacy python ops are not serializable, like "
+                             "the reference's pointer attrs)" % token)
+        self.pyop = _LEGACY_OPS[token]
+        self.param = None
+
+    def list_arguments(self):
+        return list(self.pyop.list_arguments())
+
+    def list_outputs(self):
+        return list(self.pyop.list_outputs())
+
+    def infer_shape(self, in_shapes):
+        in_shapes = require_known("_Native", in_shapes, self.list_arguments())
+        ins, outs = self.pyop.infer_shape([list(s) for s in in_shapes])
+        to_t = lambda ss: [tuple(int(d) for d in s) for s in ss]
+        return to_t(ins), to_t(outs), []
+
+    def forward(self, inputs, aux, is_train, rng):
+        pyop = self.pyop
+        in_shapes = [tuple(int(d) for d in x.shape) for x in inputs]
+        dtype = np.dtype(inputs[0].dtype) if inputs else np.dtype(np.float32)
+        in_dtypes = [dtype] * len(inputs)
+        _, out_shapes = pyop.infer_shape([list(s) for s in in_shapes])
+        out_shapes = [tuple(int(d) for d in s) for s in out_shapes]
+        out_dtypes = [dtype] * len(out_shapes)
+
+        def host_forward(train_flag, in_data, aux_data):
+            out_data = [np.zeros(s, dtype) for s in out_shapes]
+            pyop.forward(in_data=in_data, out_data=out_data)
+            return out_data, aux_data
+
+        def host_backward(out_grad, in_data, out_data, aux_data):
+            in_grad = [np.zeros(s, dtype) for s in in_shapes]
+            pyop.backward(out_grad=out_grad, in_data=in_data,
+                          out_data=out_data, in_grad=in_grad)
+            return in_grad
+
+        outs, _ = _run_host_op(host_forward, host_backward, inputs, aux,
+                               is_train, in_shapes, in_dtypes,
+                               out_shapes, out_dtypes)
+        return outs, None

@@ -401,8 +401,12 @@ def load_json(json_str):
             cls = OP_REGISTRY.get(spec["op"])
             fields = cls.param_cls._fields if cls.param_cls is not None else {}
             # nodes may carry arbitrary user/graph attrs (ctx_group, lr_mult,
-            # custom tags); only declared param fields configure the op
-            op_kwargs = {k: v for k, v in attrs.items() if k in fields}
+            # custom tags); only declared param fields configure the op —
+            # except ops that take free-form kwargs (Custom, _Native)
+            if getattr(cls, "accepts_any_attrs", False):
+                op_kwargs = dict(attrs)
+            else:
+                op_kwargs = {k: v for k, v in attrs.items() if k in fields}
             op = create_operator(spec["op"], **op_kwargs)
             node = _Node(op, spec["name"], inputs, attrs)
         nodes.append(node)

@@ -1,0 +1,190 @@
+"""Custom python ops: CustomOp/CustomOpProp + legacy NumpyOp
+(modeled on tests/python/unittest/test_operator.py test_custom_op and
+example/numpy-ops/custom_softmax.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu import operator as op_mod
+from mxnet_tpu.test_utils import assert_almost_equal
+
+rng = np.random.RandomState(7)
+
+
+# -- a differentiable custom op: scaled sigmoid ---------------------------
+class Sigmoid(op_mod.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        y = 1.0 / (1.0 + np.exp(-in_data[0]))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+
+@op_mod.register("test_sigmoid")
+class SigmoidProp(op_mod.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sigmoid()
+
+
+# -- a loss-style op: softmax with label (need_top_grad=False) ------------
+class CustomSoftmax(op_mod.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0], e / e.sum(axis=1, keepdims=True))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        lbl = in_data[1].astype(np.int64)
+        y = out_data[0].copy()
+        y[np.arange(y.shape[0]), lbl] -= 1.0
+        self.assign(in_grad[0], req[0], y)
+        self.assign(in_grad[1], req[1], np.zeros_like(in_grad[1]))
+
+
+@op_mod.register("test_softmax")
+class CustomSoftmaxProp(op_mod.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shape):
+        data = in_shape[0]
+        return [data, [data[0]]], [data], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return CustomSoftmax()
+
+
+def test_custom_forward_matches_native():
+    a = rng.uniform(-2, 2, size=(4, 5)).astype(np.float32)
+    x = sym.Variable("x")
+    s = sym.Custom(data=x, op_type="test_sigmoid")
+    ex = s.simple_bind(mx.cpu(), x=a.shape)
+    ex.arg_dict["x"][:] = a
+    out = ex.forward()[0].asnumpy()
+    assert_almost_equal(out, 1.0 / (1.0 + np.exp(-a)), rtol=1e-5, atol=1e-6)
+
+
+def test_custom_backward_via_user_code():
+    a = rng.uniform(-2, 2, size=(4, 5)).astype(np.float32)
+    og = rng.uniform(-1, 1, size=(4, 5)).astype(np.float32)
+    x = sym.Variable("x")
+    s = sym.Custom(data=x, op_type="test_sigmoid")
+    ex = s.simple_bind(mx.cpu(), x=a.shape, grad_req="write")
+    ex.arg_dict["x"][:] = a
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.array(og)])
+    y = 1.0 / (1.0 + np.exp(-a))
+    assert_almost_equal(ex.grad_dict["x"].asnumpy(), og * y * (1 - y),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_custom_softmax_loss_style():
+    a = rng.uniform(-2, 2, size=(6, 4)).astype(np.float32)
+    lbl = rng.randint(0, 4, size=(6,)).astype(np.float32)
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    s = sym.Custom(data=data, label=label, op_type="test_softmax")
+    ex = s.simple_bind(mx.cpu(), data=a.shape, label=lbl.shape,
+                       grad_req={"data": "write", "label": "null"})
+    ex.arg_dict["data"][:] = a
+    ex.arg_dict["label"][:] = lbl
+    out = ex.forward(is_train=True)[0].asnumpy()
+    e = np.exp(a - a.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    assert_almost_equal(out, want, rtol=1e-5, atol=1e-6)
+
+    ex.backward()  # loss-style: no head grad
+    g = want.copy()
+    g[np.arange(6), lbl.astype(np.int64)] -= 1.0
+    assert_almost_equal(ex.grad_dict["data"].asnumpy(), g,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_custom_kwargs_roundtrip_json():
+    x = sym.Variable("x")
+    s = sym.Custom(data=x, op_type="test_sigmoid")
+    s2 = sym.load_json(s.tojson())
+    assert s2.list_arguments() == s.list_arguments()
+
+
+def test_custom_in_network():
+    # custom op composed mid-graph with native ops; grads flow through
+    a = rng.uniform(-1, 1, size=(3, 4)).astype(np.float32)
+    x = sym.Variable("x")
+    s = sym.Custom(data=x * 2.0, op_type="test_sigmoid")
+    s = sym.sum(s)
+    ex = s.simple_bind(mx.cpu(), x=a.shape, grad_req="write")
+    ex.arg_dict["x"][:] = a
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.array(np.ones((1,), np.float32))])
+    y = 1.0 / (1.0 + np.exp(-2 * a))
+    assert_almost_equal(ex.grad_dict["x"].asnumpy(), 2 * y * (1 - y),
+                        rtol=1e-4, atol=1e-5)
+
+
+# -- legacy NumpyOp -------------------------------------------------------
+class LegacySquare(op_mod.NumpyOp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def forward(self, in_data, out_data):
+        out_data[0][...] = in_data[0] ** 2
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        in_grad[0][...] = 2.0 * in_data[0] * out_grad[0]
+
+
+def test_legacy_numpy_op():
+    a = rng.uniform(-1, 1, size=(3, 4)).astype(np.float32)
+    og = rng.uniform(-1, 1, size=(3, 4)).astype(np.float32)
+    x = sym.Variable("x")
+    s = LegacySquare().get_symbol(data=x)
+    ex = s.simple_bind(mx.cpu(), x=a.shape, grad_req="write")
+    ex.arg_dict["x"][:] = a
+    out = ex.forward(is_train=True)[0].asnumpy()
+    assert_almost_equal(out, a ** 2, rtol=1e-5, atol=1e-6)
+    ex.backward([mx.nd.array(og)])
+    assert_almost_equal(ex.grad_dict["x"].asnumpy(), 2 * a * og,
+                        rtol=1e-4, atol=1e-5)
+
+
+# -- custom op with auxiliary state (review finding: aux were np.asarray'd
+#    at trace time) ------------------------------------------------------
+class Counter(op_mod.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] + aux[0])
+        aux[0][...] = aux[0] + 1.0
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0])
+
+
+@op_mod.register("test_counter")
+class CounterProp(op_mod.CustomOpProp):
+    def list_auxiliary_states(self):
+        return ["count"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], [[1]]
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Counter()
+
+
+def test_custom_op_with_aux_state():
+    a = np.ones((2, 3), np.float32)
+    x = sym.Variable("x")
+    s = sym.Custom(data=x, op_type="test_counter", name="cnt")
+    ex = s.simple_bind(mx.cpu(), x=a.shape)
+    ex.arg_dict["x"][:] = a
+    ex.aux_dict["cnt_count"][:] = np.zeros((1,), np.float32)
+    out = ex.forward(is_train=True)[0].asnumpy()
+    assert_almost_equal(out, a, rtol=1e-6, atol=1e-7)

@@ -100,6 +100,7 @@ def test_module_input_grads():
 def test_module_multi_context_slicing():
     """Batch slicing across two CPU contexts (reference fakes multi-device
     with cpu dev_ids, test_multi_device_exec.py)."""
+    np.random.seed(0)  # NDArrayIter shuffles via the global numpy RNG
     X, y = _toy_problem()
     train = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=True)
     net = mx.models.get_mlp(num_classes=2, hidden=(16,))
@@ -255,6 +256,7 @@ def test_module_fixed_params_initialized_and_frozen():
     mod.init_params(initializer=mx.init.Uniform(0.1))
     arg_params, _ = mod.get_params()
     w0 = arg_params["fc1_weight"].asnumpy()
+    fc2_0 = arg_params["fc2_weight"].asnumpy()
     assert np.abs(w0).sum() > 0, "fixed param was not initialized"
 
     mod.init_optimizer(optimizer="sgd",
@@ -268,8 +270,7 @@ def test_module_fixed_params_initialized_and_frozen():
     np.testing.assert_allclose(arg_params["fc1_weight"].asnumpy(), w0,
                                err_msg="fixed param was updated")
     # non-fixed params must have moved
-    assert np.abs(arg_params["fc2_weight"].asnumpy()
-                  - w0.sum() * 0).sum() >= 0  # exists
+    assert not np.allclose(arg_params["fc2_weight"].asnumpy(), fc2_0)
     assert not np.allclose(arg_params["fc2_bias"].asnumpy(), 0)
 
 
