@@ -142,6 +142,12 @@ def _run_host_op(host_forward, host_backward, inputs, aux, is_train,
     callback as operands (they may be tracers) and their mutated values are
     returned, matching the reference where aux NDArrays are visible to
     CustomOp.forward (custom-inl.h).
+
+    Known divergence from the reference: aux mutations made inside
+    ``backward`` are NOT persisted — the functional graph only carries aux
+    updates out of the forward pass (executor trace contract).  Reference
+    custom ops that update aux in backward must move that update to the
+    next forward call.
     """
     n_in, n_out, n_aux = len(inputs), len(out_shapes), len(aux)
     out_spec = tuple(jax.ShapeDtypeStruct(s, d)
@@ -245,9 +251,20 @@ class Custom(OperatorProperty):
         return list(self.prop.list_auxiliary_states())
 
     def infer_shape(self, in_shapes):
-        in_shapes = require_known("Custom(%s)" % self.op_type, in_shapes,
-                                  self.list_arguments())
-        res = self.prop.infer_shape([list(s) for s in in_shapes])
+        # only data (first input) must be known: user props conventionally
+        # derive the rest (e.g. label = [data[0]]), and the symbol fixpoint
+        # loop backfills what we return (reference operator.py infer_shape
+        # contract)
+        if in_shapes[0] is None:
+            require_known("Custom(%s)" % self.op_type, in_shapes[:1],
+                          self.list_arguments()[:1])
+        try:
+            res = self.prop.infer_shape(
+                [list(s) if s is not None else None for s in in_shapes])
+        except (TypeError, IndexError, AttributeError):
+            # prop needs shapes we don't have yet
+            raise IncompleteShape(
+                "Custom(%s): not enough input shapes" % self.op_type)
         if len(res) == 2:
             ins, outs = res
             aux = []
@@ -381,8 +398,14 @@ class _Native(OperatorProperty):
         return list(self.pyop.list_outputs())
 
     def infer_shape(self, in_shapes):
-        in_shapes = require_known("_Native", in_shapes, self.list_arguments())
-        ins, outs = self.pyop.infer_shape([list(s) for s in in_shapes])
+        if in_shapes[0] is None:
+            require_known("_Native", in_shapes[:1],
+                          self.list_arguments()[:1])
+        try:
+            ins, outs = self.pyop.infer_shape(
+                [list(s) if s is not None else None for s in in_shapes])
+        except (TypeError, IndexError, AttributeError):
+            raise IncompleteShape("_Native: not enough input shapes")
         to_t = lambda ss: [tuple(int(d) for d in s) for s in ss]
         return to_t(ins), to_t(outs), []
 

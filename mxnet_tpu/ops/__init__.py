@@ -5,3 +5,5 @@ from . import tensor  # noqa: F401
 from . import nn      # noqa: F401
 from . import loss    # noqa: F401
 from . import sequence  # noqa: F401
+from . import rnn     # noqa: F401
+from . import vision  # noqa: F401

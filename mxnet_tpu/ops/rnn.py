@@ -1,0 +1,203 @@
+"""Fused RNN operator (LSTM / GRU / vanilla relu|tanh).
+
+Parity: src/operator/rnn-inl.h (shapes, argument list, flat parameter
+vector sizing via ``rnn_param_size`` at rnn-inl.h:52-67) and
+cudnn_rnn-inl.h:22 (the reference's only working implementation — the CPU
+path FATALs, rnn.cc:14).  TPU-first translation: the whole multi-layer
+sequence loop is a ``lax.scan`` per layer — XLA unrolls the gate matmuls
+onto the MXU, and the scan keeps compile time flat in sequence length
+(no per-timestep python unrolling as in example/rnn/lstm.py).
+
+Flat parameter layout (documented contract of this build; the reference's
+layout is cuDNN-opaque): per layer, directions in order [fwd, bwd], each
+direction packs ``W_x (G*h, in)``, ``W_h (G*h, h)``, ``b_x (G*h)``,
+``b_h (G*h)``; gate order LSTM = (i, f, g, o), GRU = (r, z, n) — cuDNN's
+order.  Total length equals rnn_param_size exactly.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..dparam import Field, ParamStruct
+from .registry import OperatorProperty, register_op, require_known
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_single_param_size(input_size, hidden, mode):
+    """Parity rnn-inl.h:31-51: hidden*(hidden+input+2) * gates."""
+    return hidden * (hidden + input_size + 2) * _GATES[mode]
+
+
+def rnn_param_size(num_layers, input_size, hidden, bidirectional, mode):
+    """Parity rnn-inl.h:52-67."""
+    size = rnn_single_param_size(input_size, hidden, mode)
+    if bidirectional:
+        size += (num_layers - 1) * rnn_single_param_size(2 * hidden, hidden,
+                                                         mode)
+        size *= 2
+    else:
+        size += (num_layers - 1) * rnn_single_param_size(hidden, hidden, mode)
+    return size
+
+
+class _RNNParam(ParamStruct):
+    state_size = Field(int, required=True, lower=1)
+    num_layers = Field(int, required=True, lower=1)
+    bidirectional = Field(bool, default=False)
+    mode = Field(str, required=True,
+                 enum=("rnn_relu", "rnn_tanh", "lstm", "gru"))
+    p = Field(float, default=0.0, lower=0.0, upper=1.0)
+    state_outputs = Field(bool, default=False)
+
+
+def _slice_layer_params(flat, offset, input_size, hidden, gates):
+    """Unpack one direction of one layer from the flat parameter vector."""
+    n_wx = gates * hidden * input_size
+    n_wh = gates * hidden * hidden
+    n_b = gates * hidden
+    w_x = flat[offset:offset + n_wx].reshape(gates * hidden, input_size)
+    offset += n_wx
+    w_h = flat[offset:offset + n_wh].reshape(gates * hidden, hidden)
+    offset += n_wh
+    b_x = flat[offset:offset + n_b]
+    offset += n_b
+    b_h = flat[offset:offset + n_b]
+    offset += n_b
+    return (w_x, w_h, b_x, b_h), offset
+
+
+def _cell_step(mode, hidden):
+    """Returns step(carry, gates_preact) -> (carry, out) for lax.scan."""
+    if mode == "lstm":
+        def step(carry, xw, w_h, b_h):
+            h, c = carry
+            g = xw + h @ w_h.T + b_h
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            gg = jnp.tanh(gg)
+            c_new = f * c + i * gg
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+    elif mode == "gru":
+        def step(carry, xw, w_h, b_h):
+            h = carry[0]
+            hw = h @ w_h.T + b_h
+            x_r, x_z, x_n = jnp.split(xw, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(hw, 3, axis=-1)
+            r = jax.nn.sigmoid(x_r + h_r)
+            z = jax.nn.sigmoid(x_z + h_z)
+            n = jnp.tanh(x_n + r * h_n)
+            h_new = (1.0 - z) * n + z * h
+            return (h_new,), h_new
+    else:
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+        def step(carry, xw, w_h, b_h):
+            h = carry[0]
+            h_new = act(xw + h @ w_h.T + b_h)
+            return (h_new,), h_new
+    return step
+
+
+@register_op("RNN")
+class RNN(OperatorProperty):
+    """Fused multi-layer RNN (rnn-inl.h; data [seq, batch, feat])."""
+    param_cls = _RNNParam
+    need_rng = True
+
+    def list_arguments(self):
+        if self.param.mode == "lstm":
+            return ["data", "parameters", "state", "state_cell"]
+        return ["data", "parameters", "state"]
+
+    def list_outputs(self):
+        outs = ["output"]
+        if self.param.state_outputs:
+            outs.append("state")
+            if self.param.mode == "lstm":
+                outs.append("state_cell")
+        return outs
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known("RNN", in_shapes[:1], ["data"])
+        if len(data) != 3:
+            raise MXNetError("RNN: data must be [seq_len, batch, input_size]")
+        p = self.param
+        seq_len, batch, input_size = data
+        ndir = 2 if p.bidirectional else 1
+        total_layers = ndir * p.num_layers
+        psize = rnn_param_size(p.num_layers, input_size, p.state_size,
+                               p.bidirectional, p.mode)
+        state = (total_layers, batch, p.state_size)
+        ins = [data, (psize,), state]
+        if p.mode == "lstm":
+            ins.append(state)
+        outs = [(seq_len, batch, ndir * p.state_size)]
+        if p.state_outputs:
+            outs.append(state)
+            if p.mode == "lstm":
+                outs.append(state)
+        return ins, outs, []
+
+    def forward(self, inputs, aux, is_train, rng):
+        p = self.param
+        data, flat = inputs[0], inputs[1]
+        state0 = inputs[2]
+        cell0 = inputs[3] if p.mode == "lstm" else None
+        gates = _GATES[p.mode]
+        hidden = p.state_size
+        ndir = 2 if p.bidirectional else 1
+        step = _cell_step(p.mode, hidden)
+
+        def run_direction(x, params, h0, c0, reverse):
+            w_x, w_h, b_x, b_h = params
+            xs = x[::-1] if reverse else x
+            xw = xs @ w_x.T + b_x  # (seq, batch, G*h): one big MXU matmul
+            carry0 = (h0, c0) if p.mode == "lstm" else (h0,)
+
+            def body(carry, xw_t):
+                return step(carry, xw_t, w_h, b_h)
+
+            carry, ys = lax.scan(body, carry0, xw)
+            if reverse:
+                ys = ys[::-1]
+            return carry, ys
+
+        offset = 0
+        x = data
+        h_finals, c_finals = [], []
+        for layer in range(p.num_layers):
+            input_size = int(x.shape[-1])
+            outs_dir = []
+            for d in range(ndir):
+                params, offset = _slice_layer_params(flat, offset, input_size,
+                                                     hidden, gates)
+                sl = layer * ndir + d
+                h0 = state0[sl]
+                c0 = cell0[sl] if cell0 is not None else None
+                carry, ys = run_direction(x, params, h0, c0, reverse=(d == 1))
+                outs_dir.append(ys)
+                h_finals.append(carry[0])
+                if p.mode == "lstm":
+                    c_finals.append(carry[1])
+            x = outs_dir[0] if ndir == 1 else jnp.concatenate(outs_dir, -1)
+            if is_train and p.p > 0.0 and layer < p.num_layers - 1 \
+                    and rng is not None:
+                keep = 1.0 - p.p
+                mask = jax.random.bernoulli(
+                    jax.random.fold_in(rng, layer), keep, x.shape)
+                x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+        outs = [x]
+        if p.state_outputs:
+            outs.append(jnp.stack(h_finals, 0))
+            if p.mode == "lstm":
+                outs.append(jnp.stack(c_finals, 0))
+        return outs, None

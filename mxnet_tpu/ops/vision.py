@@ -1,0 +1,266 @@
+"""Vision operators: ROIPooling, SpatialTransformer, Correlation.
+
+Parity: src/operator/roi_pooling-inl.h, spatial_transformer-inl.h,
+correlation-inl.h (+ correlation.cc CPU kernel for exact semantics).
+TPU-first translation: all three are expressed as dense masked/gather
+computations over static shapes so XLA can vectorize them — the reference's
+per-roi / per-displacement scalar loops (CUDA kernels) become vmapped
+tensor expressions.  Gradients come from jax AD (the reference hand-writes
+argmax-backprop for ROIPooling; AD through ``jnp.max`` of the masked
+window yields the same subgradient).
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..dparam import Field, ParamStruct
+from .registry import OperatorProperty, register_op, require_known
+
+
+# ----------------------------------------------------------------------
+# ROIPooling
+# ----------------------------------------------------------------------
+class _ROIPoolingParam(ParamStruct):
+    pooled_size = Field(tuple, required=True, length=2)
+    spatial_scale = Field(float, required=True, lower=0.0, upper=1.0)
+
+
+@register_op("ROIPooling")
+class ROIPooling(OperatorProperty):
+    """roi_pooling-inl.h: max-pool each roi into a fixed (ph, pw) grid.
+
+    rois are (num_rois, 5) rows [batch_index, x1, y1, x2, y2] in image
+    coordinates; scaled by spatial_scale and rounded, inclusive ends
+    (roi width = x2 - x1 + 1), empty bins produce 0.
+    """
+    param_cls = _ROIPoolingParam
+
+    def list_arguments(self):
+        return ["data", "rois"]
+
+    def infer_shape(self, in_shapes):
+        data, rois = require_known("ROIPooling", in_shapes,
+                                   self.list_arguments())
+        if len(data) != 4 or len(rois) != 2 or rois[1] != 5:
+            raise MXNetError("ROIPooling: data (N,C,H,W), rois (R,5)")
+        ph, pw = self.param.pooled_size
+        out = (rois[0], data[1], ph, pw)
+        return [data, rois], [out], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        data, rois = inputs
+        ph, pw = self.param.pooled_size
+        scale = self.param.spatial_scale
+        N, C, H, W = data.shape
+        hi = jnp.arange(H)
+        wi = jnp.arange(W)
+
+        def pool_one(roi):
+            batch_ind = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+            y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+            x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+            y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+            roi_h = jnp.maximum(y2 - y1 + 1, 1)
+            roi_w = jnp.maximum(x2 - x1 + 1, 1)
+            img = data[batch_ind]  # (C, H, W)
+
+            def pool_cell(iy, ix):
+                # exact integer bin boundaries: floor(i*rh/ph) and
+                # ceil((i+1)*rh/ph) as int ops — float division here is
+                # unsafe under jit (XLA rewrites x/c into x*(1/c), which
+                # can push an exact boundary like 7.0 up to 7.0000005 and
+                # flip the ceil)
+                hstart = jnp.clip((iy * roi_h) // ph + y1, 0, H)
+                hend = jnp.clip(-((-(iy + 1) * roi_h) // ph) + y1, 0, H)
+                wstart = jnp.clip((ix * roi_w) // pw + x1, 0, W)
+                wend = jnp.clip(-((-(ix + 1) * roi_w) // pw) + x1, 0, W)
+                mask = ((hi[:, None] >= hstart) & (hi[:, None] < hend) &
+                        (wi[None, :] >= wstart) & (wi[None, :] < wend))
+                is_empty = (hend <= hstart) | (wend <= wstart)
+                neg = jnp.asarray(-jnp.inf, data.dtype)
+                vals = jnp.where(mask[None], img, neg)
+                m = jnp.max(vals, axis=(1, 2))
+                return jnp.where(is_empty, jnp.zeros_like(m), m)
+
+            iy = jnp.arange(ph, dtype=jnp.int32)
+            ix = jnp.arange(pw, dtype=jnp.int32)
+            cells = jax.vmap(lambda y: jax.vmap(
+                lambda x: pool_cell(y, x))(ix))(iy)  # (ph, pw, C)
+            return jnp.transpose(cells, (2, 0, 1))
+
+        return [jax.vmap(pool_one)(rois)], None
+
+
+# ----------------------------------------------------------------------
+# SpatialTransformer
+# ----------------------------------------------------------------------
+class _SpatialTransformerParam(ParamStruct):
+    target_shape = Field(tuple, default=(0, 0), length=2)
+    transform_type = Field(str, required=True, enum=("affine",))
+    sampler_type = Field(str, required=True, enum=("bilinear",))
+
+
+@register_op("SpatialTransformer")
+class SpatialTransformer(OperatorProperty):
+    """spatial_transformer-inl.h: affine grid + bilinear sampling.
+
+    loc is (N, 6) affine params; target grid in [-1, 1] normalized coords
+    (spatial_transformer-inl.h:76-79); out-of-bounds samples read 0.
+    """
+    param_cls = _SpatialTransformerParam
+
+    def list_arguments(self):
+        return ["data", "loc"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known("SpatialTransformer", in_shapes[:1], ["data"])
+        if len(data) != 4:
+            raise MXNetError("SpatialTransformer: data must be (N,C,H,W)")
+        th, tw = self.param.target_shape
+        if th == 0:
+            th, tw = data[2], data[3]
+        out = (data[0], data[1], th, tw)
+        return [data, (data[0], 6)], [out], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        data, loc = inputs
+        N, C, H, W = data.shape
+        th, tw = self.param.target_shape
+        if th == 0:
+            th, tw = H, W
+        # normalized target grid, row-major (x varies fastest)
+        xs = -1.0 + jnp.arange(tw, dtype=data.dtype) * 2.0 / (tw - 1) \
+            if tw > 1 else jnp.zeros((1,), data.dtype)
+        ys = -1.0 + jnp.arange(th, dtype=data.dtype) * 2.0 / (th - 1) \
+            if th > 1 else jnp.zeros((1,), data.dtype)
+        gx, gy = jnp.meshgrid(xs, ys)  # (th, tw)
+        ones = jnp.ones_like(gx)
+        grid = jnp.stack([gx, gy, ones], 0).reshape(3, -1)  # (3, th*tw)
+
+        theta = loc.reshape(N, 2, 3)
+        src = jnp.einsum("nij,jk->nik", theta, grid)  # (N, 2, th*tw)
+        # normalized -> source pixel coords
+        x_src = (src[:, 0] + 1.0) * (W - 1) / 2.0
+        y_src = (src[:, 1] + 1.0) * (H - 1) / 2.0
+
+        x0 = jnp.floor(x_src)
+        y0 = jnp.floor(y_src)
+        wx = x_src - x0
+        wy = y_src - y0
+
+        def sample(img, yy, xx):
+            """img (C,H,W); yy/xx integer float coords (P,); 0 outside."""
+            valid = ((yy >= 0) & (yy < H) & (xx >= 0) & (xx < W))
+            yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            v = img[:, yc, xc]  # (C, P)
+            return jnp.where(valid[None], v, 0.0).astype(img.dtype)
+
+        def warp_one(img, y0n, x0n, wxn, wyn):
+            v00 = sample(img, y0n, x0n)
+            v01 = sample(img, y0n, x0n + 1)
+            v10 = sample(img, y0n + 1, x0n)
+            v11 = sample(img, y0n + 1, x0n + 1)
+            top = v00 * (1 - wxn) + v01 * wxn
+            bot = v10 * (1 - wxn) + v11 * wxn
+            return top * (1 - wyn) + bot * wyn  # (C, P)
+
+        out = jax.vmap(warp_one)(data, y0, x0, wx, wy)
+        return [out.reshape(N, C, th, tw)], None
+
+
+# ----------------------------------------------------------------------
+# Correlation
+# ----------------------------------------------------------------------
+class _CorrelationParam(ParamStruct):
+    kernel_size = Field(int, default=1)
+    max_displacement = Field(int, default=1)
+    stride1 = Field(int, default=1)
+    stride2 = Field(int, default=1)
+    pad_size = Field(int, default=0)
+    is_multiply = Field(bool, default=True)
+
+
+@register_op("Correlation")
+class Correlation(OperatorProperty):
+    """correlation-inl.h:78-97 / correlation.cc CorrelationForward.
+
+    FlowNet cost volume: for each displacement (s2p, s2o) in the
+    neighborhood grid, average data1·shift(data2) (or |diff|) over a
+    kernel window and channels.  The displacement grid is a static
+    python loop -> XLA sees a fixed stack of shifted elementwise
+    products, which it fuses into one pass over HBM.
+    """
+    param_cls = _CorrelationParam
+
+    def list_arguments(self):
+        return ["data1", "data2"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def _geom(self, H, W):
+        p = self.param
+        kr = (p.kernel_size - 1) // 2
+        border = p.max_displacement + kr
+        ph, pw = H + 2 * p.pad_size, W + 2 * p.pad_size
+        top_h = int(_math.ceil(float(ph - border * 2) / p.stride1))
+        top_w = int(_math.ceil(float(pw - border * 2) / p.stride1))
+        ngr = p.max_displacement // p.stride2
+        ngw = 2 * ngr + 1
+        return kr, border, top_h, top_w, ngr, ngw
+
+    def infer_shape(self, in_shapes):
+        d1, d2 = require_known("Correlation", in_shapes,
+                               self.list_arguments())
+        if d1 != d2:
+            raise MXNetError("Correlation: data1/data2 shapes must match")
+        if len(d1) != 4:
+            raise MXNetError("Correlation: data must be (N,C,H,W)")
+        _, _, top_h, top_w, _, ngw = self._geom(d1[2], d1[3])
+        if top_h < 1 or top_w < 1:
+            raise MXNetError("Correlation: displacement/kernel too large "
+                             "for input size")
+        out = (d1[0], ngw * ngw, top_h, top_w)
+        return [d1, d2], [out], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        p = self.param
+        data1, data2 = inputs
+        N, C, H, W = data1.shape
+        kr, border, top_h, top_w, ngr, ngw = self._geom(H, W)
+        pad = [(0, 0), (0, 0), (p.pad_size, p.pad_size),
+               (p.pad_size, p.pad_size)]
+        t1 = jnp.pad(data1, pad)
+        t2 = jnp.pad(data2, pad)
+        sumelems = p.kernel_size * p.kernel_size * C
+
+        # window top-left for output (i, j): y1 = i*stride1 + max_disp
+        outs = []
+        for ti in range(ngw * ngw):
+            s2o = (ti % ngw - ngr) * p.stride2
+            s2p = (ti // ngw - ngr) * p.stride2
+            prod = 0.0
+            for h in range(p.kernel_size):
+                for w in range(p.kernel_size):
+                    y1 = p.max_displacement + h
+                    x1 = p.max_displacement + w
+                    a = t1[:, :, y1:y1 + (top_h - 1) * p.stride1 + 1:p.stride1,
+                           x1:x1 + (top_w - 1) * p.stride1 + 1:p.stride1]
+                    b = t2[:, :, y1 + s2p:y1 + s2p +
+                           (top_h - 1) * p.stride1 + 1:p.stride1,
+                           x1 + s2o:x1 + s2o +
+                           (top_w - 1) * p.stride1 + 1:p.stride1]
+                    if p.is_multiply:
+                        prod = prod + a * b
+                    else:
+                        prod = prod + jnp.abs(a - b)
+            outs.append(jnp.sum(prod, axis=1) / sumelems)  # (N, th, tw)
+        return [jnp.stack(outs, axis=1)], None

@@ -188,3 +188,15 @@ def test_custom_op_with_aux_state():
     ex.aux_dict["cnt_count"][:] = np.zeros((1,), np.float32)
     out = ex.forward(is_train=True)[0].asnumpy()
     assert_almost_equal(out, a, rtol=1e-6, atol=1e-7)
+
+
+def test_custom_infers_label_shape_from_data():
+    # review finding: prop-derived shapes must backfill missing inputs
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    s = sym.Custom(data=data, label=label, op_type="test_softmax")
+    arg_shapes, out_shapes, _ = s.infer_shape(data=(6, 4))
+    assert arg_shapes == [(6, 4), (6,)]
+    assert out_shapes == [(6, 4)]
+    ex = s.simple_bind(mx.cpu(), data=(6, 4))
+    assert ex.arg_dict["label"].shape == (6,)

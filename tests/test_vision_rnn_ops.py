@@ -1,0 +1,224 @@
+"""RNN / ROIPooling / SpatialTransformer / Correlation checks vs numpy
+(modeled on tests/python/unittest/test_operator.py)."""
+import math
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.ops.rnn import rnn_param_size
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+rng = np.random.RandomState(99)
+
+
+def _bind_forward(s, arrays, **kwargs):
+    ex = s.simple_bind(mx.cpu(), **{k: v.shape for k, v in arrays.items()},
+                       **kwargs)
+    for k, v in arrays.items():
+        ex.arg_dict[k][:] = v
+    return ex, [o.asnumpy() for o in ex.forward()]
+
+
+# ------------------------------------------------------------------ RNN
+def _np_lstm(x, wx, wh, bx, bh, h0, c0):
+    seq, batch, _ = x.shape
+    H = h0.shape[-1]
+    h, c = h0.copy(), c0.copy()
+    ys = []
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for t in range(seq):
+        g = x[t] @ wx.T + bx + h @ wh.T + bh
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        i, f, o = sig(i), sig(f), sig(o)
+        c = f * c + i * np.tanh(gg)
+        h = o * np.tanh(c)
+        ys.append(h)
+    return np.stack(ys), h, c
+
+
+def test_rnn_lstm_forward_matches_numpy():
+    seq, batch, inp, H = 5, 3, 4, 6
+    psize = rnn_param_size(1, inp, H, False, "lstm")
+    assert psize == H * (H + inp + 2) * 4
+    x = rng.uniform(-1, 1, (seq, batch, inp)).astype(np.float32)
+    flat = rng.uniform(-0.5, 0.5, (psize,)).astype(np.float32)
+    h0 = rng.uniform(-1, 1, (1, batch, H)).astype(np.float32)
+    c0 = rng.uniform(-1, 1, (1, batch, H)).astype(np.float32)
+
+    data = sym.Variable("data")
+    s = sym.RNN(data=data, state_size=H, num_layers=1, mode="lstm",
+                state_outputs=True, name="rnn")
+    ex, outs = _bind_forward(s, {"data": x, "rnn_parameters": flat,
+                                 "rnn_state": h0, "rnn_state_cell": c0})
+
+    o = 0
+    wx = flat[o:o + 4 * H * inp].reshape(4 * H, inp); o += 4 * H * inp
+    wh = flat[o:o + 4 * H * H].reshape(4 * H, H); o += 4 * H * H
+    bx = flat[o:o + 4 * H]; o += 4 * H
+    bh = flat[o:o + 4 * H]
+    want_y, want_h, want_c = _np_lstm(x, wx, wh, bx, bh, h0[0], c0[0])
+    assert_almost_equal(outs[0], want_y, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(outs[1], want_h[None], rtol=1e-4, atol=1e-5)
+    assert_almost_equal(outs[2], want_c[None], rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_shapes_and_grad():
+    seq, batch, inp, H, L = 3, 2, 3, 4, 2
+    for mode, nstate in [("gru", 1), ("rnn_tanh", 1), ("lstm", 2)]:
+        psize = rnn_param_size(L, inp, H, True, mode)
+        data = sym.Variable("data")
+        s = sym.RNN(data=data, state_size=H, num_layers=L, mode=mode,
+                    bidirectional=True, name="r")
+        arg_shapes, out_shapes, _ = s.infer_shape(data=(seq, batch, inp))
+        assert arg_shapes[1] == (psize,)
+        assert out_shapes[0] == (seq, batch, 2 * H)
+
+    # gradient flows through the scan
+    data = sym.Variable("data")
+    s = sym.sum(sym.RNN(data=data, state_size=3, num_layers=1,
+                        mode="lstm", name="g"))
+    x = rng.uniform(-1, 1, (3, 2, 3)).astype(np.float64)
+    psize = rnn_param_size(1, 3, 3, False, "lstm")
+    check_numeric_gradient(
+        s, {"data": x,
+            "g_parameters": rng.uniform(-0.4, 0.4, (psize,)),
+            "g_state": np.zeros((1, 2, 3)),
+            "g_state_cell": np.zeros((1, 2, 3))},
+        grad_nodes=["data", "g_parameters"], rtol=1e-2, atol=1e-3)
+
+
+# ----------------------------------------------------------- ROIPooling
+def _np_roipool(data, rois, pooled, scale):
+    N, C, H, W = data.shape
+    ph, pw = pooled
+    out = np.zeros((rois.shape[0], C, ph, pw), data.dtype)
+    for r, roi in enumerate(rois):
+        b = int(roi[0])
+        x1, y1, x2, y2 = [int(round(v * scale)) for v in roi[1:]]
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        for i in range(ph):
+            for j in range(pw):
+                # exact rational floor/ceil (the op uses integer arithmetic)
+                hs = min(max(i * rh // ph + y1, 0), H)
+                he = min(max(-((-(i + 1) * rh) // ph) + y1, 0), H)
+                ws = min(max(j * rw // pw + x1, 0), W)
+                we = min(max(-((-(j + 1) * rw) // pw) + x1, 0), W)
+                if he <= hs or we <= ws:
+                    continue
+                out[r, :, i, j] = data[b, :, hs:he, ws:we].max(axis=(1, 2))
+    return out
+
+
+def test_roipooling_forward():
+    data = rng.uniform(-1, 1, (2, 3, 12, 16)).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 7],
+                     [1, 2, 2, 15, 11],
+                     [0, 4, 1, 10, 10]], np.float32)
+    d = sym.Variable("data")
+    r = sym.Variable("rois")
+    s = sym.ROIPooling(data=d, rois=r, pooled_size=(3, 3), spatial_scale=1.0)
+    _, outs = _bind_forward(s, {"data": data, "rois": rois})
+    want = _np_roipool(data, rois, (3, 3), 1.0)
+    assert_almost_equal(outs[0], want, rtol=1e-5, atol=1e-6)
+
+
+def test_roipooling_scale_and_shape():
+    data = rng.uniform(-1, 1, (1, 2, 8, 8)).astype(np.float32)
+    rois = np.array([[0, 0, 0, 15, 15]], np.float32)
+    d, r = sym.Variable("data"), sym.Variable("rois")
+    s = sym.ROIPooling(data=d, rois=r, pooled_size=(2, 2), spatial_scale=0.5)
+    _, outs = _bind_forward(s, {"data": data, "rois": rois})
+    assert outs[0].shape == (1, 2, 2, 2)
+    want = _np_roipool(data, rois, (2, 2), 0.5)
+    assert_almost_equal(outs[0], want, rtol=1e-5, atol=1e-6)
+
+
+# --------------------------------------------------- SpatialTransformer
+def test_spatial_transformer_identity():
+    data = rng.uniform(-1, 1, (2, 3, 6, 8)).astype(np.float32)
+    loc = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    d, l = sym.Variable("data"), sym.Variable("loc")
+    s = sym.SpatialTransformer(data=d, loc=l, target_shape=(6, 8),
+                               transform_type="affine",
+                               sampler_type="bilinear")
+    _, outs = _bind_forward(s, {"data": data, "loc": loc})
+    assert_almost_equal(outs[0], data, rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_shift_and_grad():
+    # shift right by one pixel in normalized coords: x_src = x_t - 2/(W-1)
+    W = 5
+    data = rng.uniform(-1, 1, (1, 1, 5, W)).astype(np.float32)
+    shift = 2.0 / (W - 1)
+    loc = np.array([[1, 0, -shift, 0, 1, 0]], np.float32)
+    d, l = sym.Variable("data"), sym.Variable("loc")
+    s = sym.SpatialTransformer(data=d, loc=l, target_shape=(5, 5),
+                               transform_type="affine",
+                               sampler_type="bilinear")
+    _, outs = _bind_forward(s, {"data": data, "loc": loc})
+    # column j of output = column j-1 of input; column 0 samples x=-1-eps -> 0
+    assert_almost_equal(outs[0][0, 0, :, 1:], data[0, 0, :, :-1],
+                        rtol=1e-4, atol=1e-5)
+
+    sg = sym.sum(sym.SpatialTransformer(
+        data=sym.Variable("data"), loc=sym.Variable("loc"),
+        target_shape=(4, 4), transform_type="affine",
+        sampler_type="bilinear"))
+    check_numeric_gradient(
+        sg, {"data": rng.uniform(-1, 1, (1, 2, 4, 4)),
+             "loc": np.array([[0.9, 0.05, 0.1, -0.05, 1.1, -0.1]])},
+        grad_nodes=["data", "loc"], rtol=1e-2, atol=1e-3)
+
+
+# --------------------------------------------------------- Correlation
+def _np_correlation(d1, d2, k, max_d, s1, s2, pad, is_mult):
+    N, C, H, W = d1.shape
+    t1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    t2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    kr = (k - 1) // 2
+    border = max_d + kr
+    th = int(math.ceil((H + 2 * pad - 2 * border) / s1))
+    tw = int(math.ceil((W + 2 * pad - 2 * border) / s1))
+    ngr = max_d // s2
+    ngw = 2 * ngr + 1
+    out = np.zeros((N, ngw * ngw, th, tw), d1.dtype)
+    sumelems = k * k * C
+    for i in range(th):
+        for j in range(tw):
+            x1, y1 = j * s1 + max_d, i * s1 + max_d
+            for tc in range(ngw * ngw):
+                s2o = (tc % ngw - ngr) * s2
+                s2p = (tc // ngw - ngr) * s2
+                a = t1[:, :, y1:y1 + k, x1:x1 + k]
+                b = t2[:, :, y1 + s2p:y1 + s2p + k, x1 + s2o:x1 + s2o + k]
+                v = a * b if is_mult else np.abs(a - b)
+                out[:, tc, i, j] = v.sum(axis=(1, 2, 3)) / sumelems
+    return out
+
+
+def test_correlation_forward():
+    d1 = rng.uniform(-1, 1, (2, 3, 10, 10)).astype(np.float32)
+    d2 = rng.uniform(-1, 1, (2, 3, 10, 10)).astype(np.float32)
+    for is_mult in (True, False):
+        a, b = sym.Variable("a"), sym.Variable("b")
+        s = sym.Correlation(data1=a, data2=b, kernel_size=3,
+                            max_displacement=2, stride1=1, stride2=1,
+                            pad_size=2, is_multiply=is_mult)
+        _, outs = _bind_forward(s, {"a": d1, "b": d2})
+        want = _np_correlation(d1, d2, 3, 2, 1, 1, 2, is_mult)
+        assert outs[0].shape == want.shape
+        assert_almost_equal(outs[0], want, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_strided():
+    d1 = rng.uniform(-1, 1, (1, 2, 12, 12)).astype(np.float32)
+    d2 = rng.uniform(-1, 1, (1, 2, 12, 12)).astype(np.float32)
+    a, b = sym.Variable("a"), sym.Variable("b")
+    s = sym.Correlation(data1=a, data2=b, kernel_size=1,
+                        max_displacement=2, stride1=2, stride2=2,
+                        pad_size=0, is_multiply=True)
+    _, outs = _bind_forward(s, {"a": d1, "b": d2})
+    want = _np_correlation(d1, d2, 1, 2, 2, 2, 0, True)
+    assert outs[0].shape == want.shape
+    assert_almost_equal(outs[0], want, rtol=1e-4, atol=1e-5)
