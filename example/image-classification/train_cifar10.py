@@ -1,0 +1,57 @@
+"""Train ResNet / Inception-BN on CIFAR-10.
+
+Parity: example/image-classification/train_cifar10.py (+ the mirroring
+demo train_cifar10_mirroring.py via --mirror, which tags conv outputs for
+recompute — SURVEY §2 'Memory-for-compute').
+Data: RecordIO file (``--data-dir/train.rec``) or synthetic fallback.
+"""
+import argparse
+import logging
+import os
+
+import mxnet_tpu as mx
+import common
+
+
+def get_net(network, mirror=False):
+    attr = {"force_mirroring": "True"} if mirror else None
+    with mx.AttrScope(**(attr or {})):
+        if network == "resnet":
+            return mx.models.resnet.get_symbol(
+                num_classes=10, num_layers=20, image_shape=(3, 28, 28))
+        return mx.models.inception_bn.get_symbol(num_classes=10)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train cifar10")
+    parser.add_argument("--network", type=str, default="resnet",
+                        choices=("resnet", "inception-bn"))
+    parser.add_argument("--data-dir", type=str, default="data/cifar10")
+    parser.add_argument("--mirror", action="store_true",
+                        help="recompute activations in backward "
+                             "(trade FLOPs for memory)")
+    common.add_common_args(parser)
+    parser.set_defaults(lr=0.05, num_epochs=20, batch_size=128)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(levelname)s %(message)s")
+
+    net = get_net(args.network, mirror=args.mirror)
+    shape = (3, 28, 28)
+    rec = os.path.join(args.data_dir, "train.rec")
+    if not args.synthetic and os.path.exists(rec):
+        train = mx.io.ImageRecordIter(
+            path_imgrec=rec, data_shape=shape,
+            batch_size=args.batch_size, shuffle=True, rand_mirror=True)
+        val_rec = os.path.join(args.data_dir, "test.rec")
+        val = mx.io.ImageRecordIter(
+            path_imgrec=val_rec, data_shape=shape,
+            batch_size=args.batch_size) if os.path.exists(val_rec) else None
+    else:
+        train, val = common.synthetic_iters(shape, 10, args.batch_size)
+    common.fit(args, net, train, val)
+
+
+if __name__ == "__main__":
+    main()

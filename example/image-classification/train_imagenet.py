@@ -1,0 +1,63 @@
+"""Train ResNet-50 / Inception-BN / AlexNet / VGG on ImageNet.
+
+Parity: example/image-classification/train_imagenet.py — the BASELINE
+north-star config.  Distributed data-parallel: pass
+``--kvstore dist_sync`` and launch one process per TPU host with
+``tools/launch.py``; the data iter shards by (num_workers, rank) exactly
+like the reference passes num_parts/part_index
+(train_imagenet.py:60-82 there).
+"""
+import argparse
+import logging
+import os
+
+import mxnet_tpu as mx
+import common
+
+
+NETS = {
+    "resnet-50": lambda n: mx.models.resnet.get_symbol(n, num_layers=50),
+    "resnet-101": lambda n: mx.models.resnet.get_symbol(n, num_layers=101),
+    "inception-bn": lambda n: mx.models.inception_bn.get_symbol(n),
+    "alexnet": lambda n: mx.models.alexnet.get_symbol(n),
+    "vgg": lambda n: mx.models.vgg.get_symbol(n),
+    "googlenet": lambda n: mx.models.googlenet.get_symbol(n),
+}
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train imagenet")
+    parser.add_argument("--network", type=str, default="resnet-50",
+                        choices=sorted(NETS))
+    parser.add_argument("--data-dir", type=str, default="data/imagenet")
+    parser.add_argument("--num-classes", type=int, default=1000)
+    common.add_common_args(parser)
+    parser.set_defaults(lr=0.1, num_epochs=90, batch_size=256)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(levelname)s %(message)s")
+
+    net = NETS[args.network](args.num_classes)
+    shape = (3, 224, 224)
+    kv = mx.kvstore.create(args.kvstore)
+    rec = os.path.join(args.data_dir, "train.rec")
+    if not args.synthetic and os.path.exists(rec):
+        train = mx.io.ImageRecordIter(
+            path_imgrec=rec, data_shape=shape, batch_size=args.batch_size,
+            shuffle=True, rand_crop=True, rand_mirror=True,
+            num_parts=kv.num_workers, part_index=kv.rank)
+        val_rec = os.path.join(args.data_dir, "val.rec")
+        val = mx.io.ImageRecordIter(
+            path_imgrec=val_rec, data_shape=shape,
+            batch_size=args.batch_size, num_parts=kv.num_workers,
+            part_index=kv.rank) if os.path.exists(val_rec) else None
+    else:
+        train, val = common.synthetic_iters(
+            shape, args.num_classes, args.batch_size,
+            train_n=8 * args.batch_size, val_n=2 * args.batch_size)
+    common.fit(args, net, train, val)
+
+
+if __name__ == "__main__":
+    main()

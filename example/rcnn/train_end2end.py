@@ -1,0 +1,65 @@
+"""Smoke-train the Faster R-CNN graph end-to-end on synthetic detections.
+
+Parity: example/rcnn/train_alternate.py reduced to the end-to-end smoke
+configuration (the BASELINE rcnn config exercises: multi-loss Group,
+ROIPooling, and the host-side Proposal custom op inside a compiled step).
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+import symbol as rcnn_symbol
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=3)
+    parser.add_argument("--image-size", type=int, default=128)
+    parser.add_argument("--num-classes", type=int, default=4)
+    parser.add_argument("--rois", type=int, default=16)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    S = args.image_size
+    feat = S // 16
+    num_anchors = 9
+    net = rcnn_symbol.get_rcnn_symbol(num_classes=args.num_classes,
+                                      rpn_post_nms_top_n=args.rois)
+
+    shapes = {"data": (1, 3, S, S), "im_info": (1, 3),
+              "rpn_label": (1, num_anchors * feat, feat),
+              "label": (args.rois,)}
+    exe = net.simple_bind(mx.cpu(), grad_req="write", **shapes)
+
+    rng = np.random.RandomState(0)
+    init = mx.init.Xavier(factor_type="in", magnitude=2.0)
+    for name, arr in exe.arg_dict.items():
+        if name in shapes:
+            continue
+        init(name, arr)
+    exe.arg_dict["data"][:] = rng.rand(1, 3, S, S).astype(np.float32)
+    exe.arg_dict["im_info"][:] = np.array([[S, S, 1.0]], np.float32)
+    rl = rng.randint(-1, 2, (1, num_anchors * feat, feat))
+    exe.arg_dict["rpn_label"][:] = rl.astype(np.float32)
+    exe.arg_dict["label"][:] = rng.randint(
+        0, args.num_classes, (args.rois,)).astype(np.float32)
+
+    lr = 0.01
+    for step in range(args.steps):
+        outs = exe.forward(is_train=True)
+        exe.backward()
+        for name, grad in exe.grad_dict.items():
+            if grad is not None and name.endswith(("weight", "bias")):
+                exe.arg_dict[name][:] = (exe.arg_dict[name].asnumpy()
+                                         - lr * grad.asnumpy())
+        rois = outs[3].asnumpy()
+        logging.info("step %d: rpn_prob %s cls_prob %s rois mean w=%.1f",
+                     step, outs[0].shape, outs[1].shape,
+                     float((rois[:, 3] - rois[:, 1]).mean()))
+    logging.info("rcnn end-to-end smoke OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -68,16 +68,29 @@ class Context:
     # -- jax integration ---------------------------------------------------
     @property
     def jax_device(self):
-        """Resolve to a concrete jax.Device (lazy; raises if absent)."""
+        """Resolve to a concrete jax.Device (lazy; raises if absent).
+
+        Under multi-process (jax.distributed) only THIS process's devices
+        are addressable, so contexts index local_devices — the reference's
+        dev_id is likewise host-local (a worker's gpu(0) is its own GPU).
+        """
         import jax
 
         if self.device_type in ("cpu", "cpu_pinned"):
-            devs = jax.devices("cpu")
+            devs = jax.local_devices(backend="cpu")
         else:
             # gpu and tpu both mean "the accelerator platform".
             devs = _accelerator_devices()
+            local = [d for d in devs
+                     if d.process_index == jax.process_index()]
+            if devs and not local:
+                raise MXNetError(
+                    "%s: no addressable accelerator on this process "
+                    "(cluster has %d remote devices); use the host-local "
+                    "device ids of this worker" % (self, len(devs)))
+            devs = local
             if not devs:  # CPU-only test environment: fall back gracefully
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu")
         if self.device_id >= len(devs):
             raise MXNetError(
                 "%s: device_id %d out of range (%d %s devices visible)"

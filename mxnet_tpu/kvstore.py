@@ -219,6 +219,44 @@ _VALID_TYPES = ("local", "local_update_cpu", "local_allreduce_cpu",
                 "dist_async_device")
 
 
+def _maybe_init_distributed():
+    """Join the jax.distributed cluster described by tools/launch.py's env
+    contract (MXTPU_COORDINATOR / MXTPU_NUM_WORKERS / MXTPU_WORKER_RANK).
+
+    The ps-lite rendezvous analog (SURVEY §3.4): the reference reads
+    DMLC_PS_ROOT_URI + DMLC_ROLE and dials the scheduler; here every worker
+    dials the jax coordinator (process 0).  No-op when the env vars are
+    absent (single-process dist, used by unit tests) or when the cluster is
+    already initialized (e.g. by user code on a TPU pod).
+    """
+    import os
+    coord = os.environ.get("MXTPU_COORDINATOR")
+    if not coord:
+        return
+    if getattr(_maybe_init_distributed, "_done", False):
+        return
+    already = False
+    try:
+        already = jax.distributed.is_initialized()
+    except AttributeError:  # older jax
+        from jax._src import distributed as _dist
+        already = _dist.global_state.client is not None
+    if already:
+        _maybe_init_distributed._done = True
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["MXTPU_NUM_WORKERS"]),
+            process_id=int(os.environ["MXTPU_WORKER_RANK"]))
+    except RuntimeError as exc:
+        raise MXNetError(
+            "kvstore.create('dist_*') must run before any jax/NDArray "
+            "work in a launched worker (jax.distributed.initialize needs "
+            "an uninitialized backend): %s" % exc)
+    _maybe_init_distributed._done = True
+
+
 def create(name="local"):
     """String factory (parity: kvstore.cc:17-45 + kvstore.py:360 create)."""
     if not isinstance(name, str):
@@ -227,4 +265,6 @@ def create(name="local"):
     if base not in _VALID_TYPES and not any(
             t in base for t in ("local", "device", "dist")):
         raise MXNetError("unknown KVStore type %r" % name)
+    if base.startswith("dist"):
+        _maybe_init_distributed()
     return KVStore(base)

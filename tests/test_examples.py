@@ -1,0 +1,53 @@
+"""Smoke tests over the example/ tree (parity: tests/python/train)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cwd, *argv, timeout=420):
+    env = dict(os.environ)
+    # PYTHONPATH is REPO only: an accelerator plugin registered via
+    # sitecustomize (e.g. a tunneled TPU) would make the subprocess block
+    # in jax.devices() when the accelerator is unreachable
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run([sys.executable] + list(argv), cwd=cwd, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_train_mnist_synthetic():
+    r = _run(os.path.join(REPO, "example/image-classification"),
+             "train_mnist.py", "--network", "mlp", "--num-epochs", "1",
+             "--batch-size", "64", "--synthetic", "--lr", "0.05")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Train-accuracy" in (r.stderr + r.stdout)
+
+
+def test_rcnn_end2end_smoke():
+    r = _run(os.path.join(REPO, "example/rcnn"), "train_end2end.py",
+             "--steps", "1", "--image-size", "64", "--rois", "8")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "smoke OK" in (r.stderr + r.stdout)
+
+
+def test_bucket_sentence_iter():
+    sys.path.insert(0, os.path.join(REPO, "example/rnn"))
+    try:
+        from bucket_io import BucketSentenceIter, synthetic_corpus
+    finally:
+        sys.path.pop(0)
+    sents = synthetic_corpus(num_sentences=100, vocab_size=30)
+    it = BucketSentenceIter(sents, batch_size=8, buckets=[8, 16, 24, 32])
+    seen = 0
+    for batch in it:
+        seen += 1
+        assert batch.data[0].shape == (8, batch.bucket_key)
+        lbl = batch.label[0].asnumpy()
+        dat = batch.data[0].asnumpy()
+        np.testing.assert_allclose(lbl[:, :-1], dat[:, 1:])
+    assert seen > 0

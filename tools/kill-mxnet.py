@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Kill stray training processes on the hosts of a job.
+
+Parity: tools/kill-mxnet.py — the reference ssh'es each host and pkills
+python jobs by program name.  Same here, with the host list optional
+(local only by default).
+"""
+import argparse
+import subprocess
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host-file", type=str, default=None)
+    parser.add_argument("--pattern", type=str, default="mxnet_tpu",
+                        help="pkill -f pattern")
+    args = parser.parse_args()
+    cmd = ["pkill", "-f", args.pattern]
+    if args.host_file:
+        for host in open(args.host_file):
+            host = host.strip()
+            if not host:
+                continue
+            print("killing on %s" % host)
+            subprocess.call(["ssh", "-o", "StrictHostKeyChecking=no",
+                             host] + cmd)
+    else:
+        subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    main()

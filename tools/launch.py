@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Multi-host training launcher.
+
+Parity: tools/launch.py — the reference spawns scheduler + servers +
+workers through dmlc-tracker (ssh/mpi/sge/yarn) and wires them with
+DMLC_* env vars.  TPU-native translation (SURVEY §2.10): there is no
+parameter server; every host runs the SAME program and joins a
+jax.distributed cluster (coordinator = host 0), with collectives over
+ICI/DCN doing what ps-lite push/pull did.
+
+Launchers:
+  local  — N processes on this machine (testing; each process gets
+           JAX_PLATFORMS=cpu and a private XLA host-device count)
+  ssh    — one process per host from --host-file via ssh
+  print  — emit the per-host command lines (for any external scheduler)
+
+Env contract consumed by mxnet_tpu.kvstore.create('dist_*'):
+  MXTPU_COORDINATOR   host:port of process 0
+  MXTPU_NUM_WORKERS   total process count
+  MXTPU_WORKER_RANK   this process's rank
+(The reference's DMLC_PS_ROOT_URI/DMLC_NUM_WORKER/DMLC_ROLE analogs.)
+
+IMPORTANT: worker scripts must call mx.kvstore.create('dist_*') BEFORE
+creating NDArrays or touching jax — jax.distributed.initialize has to run
+before the backend comes up (same rule as the reference, where the
+kvstore/ps rendezvous happens at import/create time, kvstore.py:360).
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def build_env(rank, args):
+    env = dict(os.environ)
+    env["MXTPU_COORDINATOR"] = "%s:%d" % (args.coordinator, args.port)
+    env["MXTPU_NUM_WORKERS"] = str(args.num_workers)
+    env["MXTPU_WORKER_RANK"] = str(rank)
+    # reference-compat aliases (kvstore.py reads these too)
+    env["DMLC_NUM_WORKER"] = str(args.num_workers)
+    env["DMLC_ROLE"] = "worker"
+    return env
+
+
+def launch_local(args, command):
+    procs = []
+    for rank in range(args.num_workers):
+        env = build_env(rank, args)
+        # hermetic local testing: force fake devices on CPU (the outer env
+        # may pin JAX_PLATFORMS to a real accelerator plugin)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d"
+                            % args.devices_per_worker)
+        procs.append(subprocess.Popen(command, env=env))
+
+    def _kill(*_):
+        for p in procs:
+            p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _kill)
+    signal.signal(signal.SIGTERM, _kill)
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def launch_ssh(args, command):
+    hosts = [h.strip() for h in open(args.host_file) if h.strip()]
+    if len(hosts) < args.num_workers:
+        raise SystemExit("host file has %d hosts < -n %d"
+                         % (len(hosts), args.num_workers))
+    procs = []
+    for rank in range(args.num_workers):
+        env = build_env(rank, args)
+        exports = " ".join("%s=%s" % (k, v) for k, v in env.items()
+                           if k.startswith(("MXTPU_", "DMLC_", "JAX_",
+                                            "XLA_")))
+        remote = "cd %s && env %s %s" % (args.workdir or "~", exports,
+                                         " ".join(command))
+        procs.append(subprocess.Popen(["ssh", "-o",
+                                       "StrictHostKeyChecking=no",
+                                       hosts[rank], remote]))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def launch_print(args, command):
+    for rank in range(args.num_workers):
+        env = build_env(rank, args)
+        exports = " ".join("%s=%s" % (k, v) for k, v in sorted(env.items())
+                           if k.startswith(("MXTPU_", "DMLC_")))
+        print("# rank %d" % rank)
+        print("env %s %s" % (exports, " ".join(command)))
+    return 0
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawTextHelpFormatter)
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("--launcher", choices=("local", "ssh", "print"),
+                        default="local")
+    parser.add_argument("-H", "--host-file", type=str, default=None)
+    parser.add_argument("--coordinator", type=str, default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=9870)
+    parser.add_argument("--workdir", type=str, default=None)
+    parser.add_argument("--devices-per-worker", type=int, default=2,
+                        help="fake devices per process for --launcher local")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if not args.command:
+        raise SystemExit("no command given")
+
+    if args.launcher == "local":
+        rc = launch_local(args, args.command)
+    elif args.launcher == "ssh":
+        rc = launch_ssh(args, args.command)
+    else:
+        rc = launch_print(args, args.command)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Parse training logs into a table / markdown.
+
+Parity: tools/parse_log.py — extracts per-epoch train/validation metrics
+and time cost from the logging format produced by Module.fit /
+FeedForward.fit (``Epoch[N] Train-accuracy=...``, ``Validation-...``,
+``Time cost=...``).
+"""
+import argparse
+import re
+import sys
+
+
+def parse(path):
+    rows = {}
+    pat = re.compile(
+        r"Epoch\[(\d+)\][^\n]*?("
+        r"Train-([\w-]+)=([\d.eE+-]+)|"
+        r"Validation-([\w-]+)=([\d.eE+-]+)|"
+        r"Time cost=([\d.eE+-]+))")
+    with open(path) as fin:
+        for line in fin:
+            m = pat.search(line)
+            if not m:
+                continue
+            ep = int(m.group(1))
+            row = rows.setdefault(ep, {})
+            if m.group(3):
+                row["train-" + m.group(3)] = float(m.group(4))
+            elif m.group(5):
+                row["val-" + m.group(5)] = float(m.group(6))
+            elif m.group(7):
+                row["time"] = float(m.group(7))
+    return rows
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("logfile")
+    parser.add_argument("--format", choices=("table", "markdown", "csv"),
+                        default="table")
+    args = parser.parse_args()
+    rows = parse(args.logfile)
+    if not rows:
+        print("no epochs found", file=sys.stderr)
+        return
+    cols = sorted({c for r in rows.values() for c in r})
+    header = ["epoch"] + cols
+    sep = {"table": "  ", "markdown": " | ", "csv": ","}[args.format]
+    if args.format == "markdown":
+        print("| " + sep.join(header) + " |")
+        print("|" + "|".join("---" for _ in header) + "|")
+    else:
+        print(sep.join(header))
+    for ep in sorted(rows):
+        vals = [str(ep)] + ["%g" % rows[ep].get(c, float("nan"))
+                            for c in cols]
+        line = sep.join(vals)
+        print("| " + line + " |" if args.format == "markdown" else line)
+
+
+if __name__ == "__main__":
+    main()
