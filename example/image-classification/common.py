@@ -94,8 +94,10 @@ def fit(args, net, train, val, data_names=("data",),
         batches_per_checkpoint=None):
     logging.basicConfig(level=getattr(logging, args.log_level.upper()),
                         format="%(asctime)s %(levelname)s %(message)s")
-    devs = parse_devices(args.devices)
+    # kvstore FIRST: dist_* joins the jax.distributed cluster, which must
+    # happen before anything (parse_devices included) initializes jax
     kv = mx.kvstore.create(args.kvstore)
+    devs = parse_devices(args.devices)
 
     lr_scheduler = None
     if args.lr_factor < 1.0:

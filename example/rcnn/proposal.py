@@ -139,9 +139,10 @@ class ProposalProp(mx.operator.CustomOpProp):
                  rpn_post_nms_top_n="300", nms_thresh="0.7",
                  min_size="16", output_score="False"):
         super().__init__(need_top_grad=False)
+        import ast
         self._feat_stride = int(feat_stride)
-        self._scales = tuple(eval(scales))
-        self._ratios = tuple(eval(ratios))
+        self._scales = tuple(ast.literal_eval(scales))
+        self._ratios = tuple(ast.literal_eval(ratios))
         self._pre = int(rpn_pre_nms_top_n)
         self._post = int(rpn_post_nms_top_n)
         self._thresh = float(nms_thresh)

@@ -19,6 +19,7 @@ from . import inception_bn
 from . import resnet
 from . import lstm
 
+from . import transformer
 from .mlp import get_symbol as get_mlp
 from .lenet import get_symbol as get_lenet
 from .alexnet import get_symbol as get_alexnet
@@ -27,6 +28,6 @@ from .googlenet import get_symbol as get_googlenet
 from .inception_bn import get_symbol as get_inception_bn
 from .resnet import get_symbol as get_resnet
 
-__all__ = ["mlp", "lenet", "alexnet", "vgg", "googlenet", "inception_bn",
+__all__ = ["transformer", "mlp", "lenet", "alexnet", "vgg", "googlenet", "inception_bn",
            "resnet", "lstm", "get_mlp", "get_lenet", "get_alexnet",
            "get_vgg", "get_googlenet", "get_inception_bn", "get_resnet"]

@@ -1,0 +1,48 @@
+"""Decoder-only transformer language model.
+
+TPU-native flagship for long-context training (no reference counterpart —
+the reference's sequence story is unrolled LSTM + bucketing, SURVEY §5).
+Attention lowers to the Pallas flash kernel on TPU; under a mesh with an
+``sp`` axis the ShardedTrainer can run it sequence-parallel with
+ring attention (parallel/ring_attention.py).
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+
+def transformer_block(x, name, num_heads, dim, seq_len, ffn_mult=4,
+                      dropout=0.0, causal=True):
+    ln1 = sym.LayerNorm(data=x, name="%s_ln1" % name)
+    att = sym.MultiHeadAttention(data=ln1, num_heads=num_heads,
+                                 causal=causal, dropout=dropout,
+                                 name="%s_att" % name)
+    x = x + att
+    ln2 = sym.LayerNorm(data=x, name="%s_ln2" % name)
+    h = sym.FullyConnected(data=sym.Reshape(data=ln2, shape=(-1, dim)),
+                           num_hidden=ffn_mult * dim, name="%s_ffn1" % name)
+    h = sym.Activation(data=h, act_type="relu")
+    h = sym.FullyConnected(data=h, num_hidden=dim, name="%s_ffn2" % name)
+    h = sym.Reshape(data=h, shape=(-1, seq_len, dim),
+                    name="%s_ffn_out" % name)
+    return x + h
+
+
+def get_symbol(vocab_size=32000, num_layers=4, num_heads=8, dim=256,
+               seq_len=512, ffn_mult=4, dropout=0.0):
+    """LM symbol: data (B, S) token ids, softmax_label (B, S) next tokens."""
+    data = sym.Variable("data")
+    pos = sym.Variable("pos_embed_weight", shape=(seq_len, dim))
+    tok = sym.Embedding(data=data, input_dim=vocab_size, output_dim=dim,
+                        name="tok_embed")
+    x = sym.broadcast_add(tok, sym.expand_dims(pos, axis=0))
+    for i in range(num_layers):
+        x = transformer_block(x, "layer%d" % i, num_heads, dim, seq_len,
+                              ffn_mult=ffn_mult, dropout=dropout)
+    x = sym.LayerNorm(data=x, name="final_ln")
+    logits = sym.FullyConnected(
+        data=sym.Reshape(data=x, shape=(-1, dim)),
+        num_hidden=vocab_size, name="lm_head")
+    label = sym.Reshape(data=sym.Variable("softmax_label"),
+                        shape=(-1,), name="label_flat")
+    return sym.SoftmaxOutput(data=logits, label=label, name="softmax")

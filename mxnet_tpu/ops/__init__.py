@@ -7,3 +7,4 @@ from . import loss    # noqa: F401
 from . import sequence  # noqa: F401
 from . import rnn     # noqa: F401
 from . import vision  # noqa: F401
+from . import attention  # noqa: F401

@@ -1,0 +1,110 @@
+"""Transformer operators: LayerNorm, MultiHeadAttention.
+
+TPU-native extensions beyond the reference op set (the reference predates
+transformers; SURVEY §5 notes its only long-sequence tools are bucketing
+and pipeline LSTM).  These ops complete the symbolic surface needed by
+``models/transformer.py`` and lower to the flash/ring attention kernels
+in ``parallel/ring_attention.py``.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..dparam import Field, ParamStruct
+from .registry import OperatorProperty, register_op, require_known
+
+
+class _LayerNormParam(ParamStruct):
+    axis = Field(int, default=-1)
+    eps = Field(float, default=1e-5)
+
+
+@register_op("LayerNorm")
+class LayerNorm(OperatorProperty):
+    """y = (x - mean) / sqrt(var + eps) * gamma + beta over ``axis``."""
+    param_cls = _LayerNormParam
+
+    def list_arguments(self):
+        return ["data", "gamma", "beta"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known("LayerNorm", in_shapes[:1], ["data"])
+        d = (data[self.param.axis],)
+        return [data, d, d], [data], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        x, gamma, beta = inputs
+        ax = self.param.axis
+        mu = jnp.mean(x, axis=ax, keepdims=True)
+        var = jnp.var(x, axis=ax, keepdims=True)
+        y = (x - mu) * jnp.reciprocal(jnp.sqrt(var + self.param.eps))
+        shape = [1] * x.ndim
+        shape[ax] = x.shape[ax]
+        return [y * gamma.reshape(shape) + beta.reshape(shape)], None
+
+
+class _MHAParam(ParamStruct):
+    num_heads = Field(int, required=True, lower=1)
+    causal = Field(bool, default=False)
+    dropout = Field(float, default=0.0)
+    use_flash = Field(bool, default=True)
+
+
+@register_op("MultiHeadAttention")
+class MultiHeadAttention(OperatorProperty):
+    """Fused self-attention block: qkv projection + attention + out proj.
+
+    data (B, S, E); qkv_weight (3E, E), out_weight (E, E) with reference-
+    style (out_features, in_features) layout; lowers to the Pallas flash
+    kernel on TPU (parallel/ring_attention.flash_attention).
+    """
+    param_cls = _MHAParam
+    need_rng = True
+
+    def list_arguments(self):
+        return ["data", "qkv_weight", "qkv_bias", "out_weight", "out_bias"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known("MultiHeadAttention", in_shapes[:1], ["data"])
+        if len(data) != 3:
+            raise MXNetError("MultiHeadAttention: data must be (B, S, E)")
+        E = data[2]
+        if E % self.param.num_heads:
+            raise MXNetError("embed dim %d not divisible by num_heads %d"
+                             % (E, self.param.num_heads))
+        return ([data, (3 * E, E), (3 * E,), (E, E), (E,)],
+                [data], [])
+
+    def forward(self, inputs, aux, is_train, rng):
+        x, wqkv, bqkv, wo, bo = inputs
+        B, S, E = x.shape
+        H = self.param.num_heads
+        D = E // H
+        qkv = x @ wqkv.T + bqkv  # (B, S, 3E)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # (B, S, E) -> (B, H, S, D)
+            return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+
+        if self.param.use_flash:
+            from ..parallel.ring_attention import sharded_self_attention
+            o = sharded_self_attention(heads(q), heads(k), heads(v),
+                                       causal=self.param.causal)
+        else:
+            from ..parallel.ring_attention import attention_reference
+            o = attention_reference(heads(q), heads(k), heads(v),
+                                    causal=self.param.causal)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
+        if is_train and self.param.dropout > 0.0 and rng is not None:
+            import jax
+            keep = 1.0 - self.param.dropout
+            mask = jax.random.bernoulli(rng, keep, o.shape)
+            o = jnp.where(mask, o / keep, 0.0).astype(o.dtype)
+        return [o @ wo.T + bo], None

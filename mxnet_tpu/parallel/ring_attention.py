@@ -1,0 +1,312 @@
+"""Long-context attention: flash kernel + ring sequence parallelism.
+
+This subsystem has no reference counterpart (SURVEY §5 "Long-context /
+sequence parallelism": the reference only offers bucketing and pipeline
+LSTM) — it is the TPU-native capability that replaces those workarounds
+for long sequences:
+
+- ``flash_attention``: fused online-softmax attention as a Pallas TPU
+  kernel (MXU matmuls, no (seq, seq) materialization in HBM).  Falls back
+  to the jnp reference implementation off-TPU so tests/CPU paths stay
+  exact.
+- ``ring_attention``: blockwise attention over a ``Mesh`` axis ("sp"):
+  each device holds a sequence chunk of q/k/v; k/v chunks rotate around
+  the ring via ``lax.ppermute`` while the online-softmax state (o, m, l)
+  accumulates — compute and ICI transfer overlap, HBM stays O(seq/sp).
+  Use inside ``shard_map`` (see tests/test_ring_attention.py) or through
+  ``models/transformer.py``'s trainer integration.
+
+Math (online softmax): for each incoming kv block,
+    m' = max(m, rowmax(s));  c = exp(m - m')
+    l  = l*c + rowsum(exp(s - m'));  o = o*c + exp(s - m') @ v
+final output o / l — associative across blocks, so ring order is free.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["attention_reference", "flash_attention", "ring_attention",
+           "blockwise_combine", "sequence_parallel",
+           "current_sequence_parallel"]
+
+_NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, causal=False, scale=None,
+                        q_offset=0, kv_offset=0):
+    """Plain softmax attention; q (..., Sq, D), k/v (..., Sk, D).
+
+    ``q_offset``/``kv_offset`` are the global positions of element 0 (used
+    for causal masking of sequence chunks).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if causal:
+        qpos = jnp.arange(q.shape[-2])[:, None] + q_offset
+        kpos = jnp.arange(k.shape[-2])[None, :] + kv_offset
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p, v.astype(p.dtype)) \
+        .astype(q.dtype)
+
+
+def _block_step(q, k, v, scale, causal, q_offset, kv_offset, m, l, o):
+    """One online-softmax accumulation step (see module docstring)."""
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qpos = jnp.arange(q.shape[-2])[:, None] + q_offset
+        kpos = jnp.arange(k.shape[-2])[None, :] + kv_offset
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    c = jnp.exp(m - m_new)
+    l_new = l * c + jnp.sum(p, axis=-1)
+    o_new = o * c[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p, v.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def blockwise_combine(q, kv_blocks, causal=False, scale=None, q_offset=0,
+                      kv_offsets=None):
+    """Attention over a list of (k, v) blocks with online-softmax combine.
+    The building block ring_attention distributes over devices."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    batch_shape = q.shape[:-1]
+    m = jnp.full(batch_shape, _NEG_INF, jnp.float32)
+    l = jnp.zeros(batch_shape, jnp.float32)
+    o = jnp.zeros(q.shape, jnp.float32)
+    if kv_offsets is None:
+        kv_offsets = []
+        off = 0
+        for k, _ in kv_blocks:
+            kv_offsets.append(off)
+            off += k.shape[-2]
+    for (k, v), koff in zip(kv_blocks, kv_offsets):
+        m, l, o = _block_step(q, k, v, scale, causal, q_offset, koff,
+                              m, l, o)
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+# ----------------------------------------------------------------------
+# Pallas flash attention (TPU)
+# ----------------------------------------------------------------------
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
+                  seq_k):
+    """Grid: (batch*heads, q_blocks).  One q block vs all k blocks."""
+    q = q_ref[...].astype(jnp.float32)  # (block_q, d)
+    block_q = q.shape[0]
+    import jax.experimental.pallas as pl
+
+    q_block_idx = pl.program_id(1)
+    q_offset = q_block_idx * block_q
+
+    m = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    o = jnp.zeros(q.shape, jnp.float32)
+
+    n_k_blocks = seq_k // block_k
+
+    def body(i, carry):
+        m, l, o = carry
+        k = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        c = jnp.exp(m - m_new)
+        l_new = l * c + jnp.sum(p, axis=-1)
+        o_new = o * c[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, o_new
+
+    m, l, o = lax.fori_loop(0, n_k_blocks, body, (m, l, o))
+    o_ref[...] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward_kernel_call(q, k, v, causal, scale, block_q, block_k,
+                               interpret):
+    import jax.experimental.pallas as pl
+
+    B, H, Sq, D = q.shape
+    sk = k.shape[-2]
+    q3 = q.reshape(B * H, Sq, D)
+    k3 = k.reshape(B * H, sk, D)
+    v3 = v.reshape(B * H, sk, D)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k,
+                               causal=causal, scale=scale, seq_k=sk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, sk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(B, H, Sq, D)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Fused attention; q/k/v (B, H, S, D).  Pallas on TPU, jnp elsewhere.
+
+    Differentiable: the forward runs the fused kernel; the backward is the
+    VJP of the (mathematically identical) reference attention, attached
+    via custom_vjp — pallas_call itself has no transpose rule.
+
+    Sequence lengths must be multiples of the block sizes for the kernel
+    path (pad upstream); otherwise falls back to the reference
+    implementation.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    sq, sk = q.shape[-2], k.shape[-2]
+    if interpret is None:
+        # default: real kernel on TPU, fast jnp reference elsewhere
+        # (pass interpret=True to exercise the kernel off-TPU in tests)
+        interpret = False
+    if (not on_tpu and not interpret) or sq % block_q or sk % block_k:
+        return attention_reference(q, k, v, causal=causal, scale=scale)
+
+    @jax.custom_vjp
+    def _fa(q, k, v):
+        return _flash_forward_kernel_call(q, k, v, causal, scale,
+                                          block_q, block_k, interpret)
+
+    def _fa_fwd(q, k, v):
+        return _fa(q, k, v), (q, k, v)
+
+    def _fa_bwd(res, ct):
+        q, k, v = res
+        _, vjp_fn = jax.vjp(
+            lambda a, b, c: attention_reference(a, b, c, causal=causal,
+                                                scale=scale), q, k, v)
+        return vjp_fn(ct)
+
+    _fa.defvjp(_fa_fwd, _fa_bwd)
+    return _fa(q, k, v)
+
+
+# ----------------------------------------------------------------------
+# Ring attention over a mesh axis
+# ----------------------------------------------------------------------
+def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
+    """Sequence-parallel attention inside shard_map.
+
+    Every device holds the (B, H, S/n, D) chunk of q, k, v for its slice
+    of the sequence (chunks in ring order = sequence order).  k/v rotate
+    one hop per step via ppermute; each device accumulates online-softmax
+    state for its q chunk.  After n steps every q chunk has attended to
+    the full sequence.  Communication: each step moves 2·B·H·(S/n)·D
+    elements over ICI, overlapped with the attention compute of the
+    previous block (XLA schedules the ppermute DMA concurrently).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    chunk = q.shape[-2]
+
+    # derive the init state arithmetically from q so the scan carry
+    # inherits q's varying-manual-axes type (dp, sp, ...) under shard_map
+    zero = q[..., 0].astype(jnp.float32) * 0.0
+    m0 = zero + _NEG_INF
+    l0 = zero
+    o0 = q.astype(jnp.float32) * 0.0
+    q_offset = my * chunk
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(s, carry):
+        k, v, m, l, o = carry
+        # kv currently originates from shard (my - s) mod n
+        src = (my - s) % n
+        kv_offset = src * chunk
+        if causal:
+            m, l, o = _block_step(q, k, v, scale, True, q_offset,
+                                  kv_offset, m, l, o)
+        else:
+            m, l, o = _block_step(q, k, v, scale, False, 0, 0, m, l, o)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return k, v, m, l, o
+
+    k, v, m, l, o = lax.fori_loop(0, n, step, (k, v, m0, l0, o0))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+# ----------------------------------------------------------------------
+# Sequence-parallel context: routes symbolic MultiHeadAttention to the ring
+# ----------------------------------------------------------------------
+import contextlib as _contextlib
+import threading as _threading
+
+_SP_STATE = _threading.local()
+
+
+class _SPContext(object):
+    __slots__ = ("mesh", "seq_axis", "batch_axis")
+
+    def __init__(self, mesh, seq_axis, batch_axis):
+        self.mesh = mesh
+        self.seq_axis = seq_axis
+        self.batch_axis = batch_axis
+
+
+@_contextlib.contextmanager
+def sequence_parallel(mesh, seq_axis="sp", batch_axis="dp"):
+    """While active, MultiHeadAttention lowers to ring_attention over
+    ``seq_axis`` of ``mesh`` (must be active when the step is traced —
+    ShardedTrainer(seq_axis=...) does this automatically)."""
+    prev = getattr(_SP_STATE, "ctx", None)
+    _SP_STATE.ctx = _SPContext(
+        mesh, seq_axis,
+        batch_axis if batch_axis in mesh.axis_names else None)
+    try:
+        yield
+    finally:
+        _SP_STATE.ctx = prev
+
+
+def current_sequence_parallel():
+    return getattr(_SP_STATE, "ctx", None)
+
+
+def sharded_self_attention(q, k, v, causal=False):
+    """Attention dispatch for (B, H, S, D): ring attention when a
+    sequence_parallel context is active, flash/reference otherwise."""
+    ctx = current_sequence_parallel()
+    if ctx is None or ctx.seq_axis not in ctx.mesh.axis_names:
+        return flash_attention(q, k, v, causal=causal)
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(ctx.batch_axis, None, ctx.seq_axis, None)
+
+    def att(q, k, v):
+        return ring_attention(q, k, v, axis_name=ctx.seq_axis,
+                              causal=causal)
+
+    return shard_map(att, mesh=ctx.mesh, in_specs=(spec,) * 3,
+                     out_specs=spec)(q, k, v)

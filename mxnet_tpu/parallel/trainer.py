@@ -187,11 +187,22 @@ class ShardedTrainer(object):
             from .. import random as _random
             rng = _random.next_key() if self._needs_rng \
                 else jax.random.PRNGKey(0)
-        return self._jit_step(params, opt_state, aux, batch, rng,
-                              jnp.float32(lr), jnp.float32(opt.wd),
-                              jnp.int32(self.num_update))
+        with self._sp_scope():
+            return self._jit_step(params, opt_state, aux, batch, rng,
+                                  jnp.float32(lr), jnp.float32(opt.wd),
+                                  jnp.int32(self.num_update))
 
     def eval(self, params, aux, batch, rng=None):
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        return self._jit_eval(params, aux, batch, rng)
+        with self._sp_scope():
+            return self._jit_eval(params, aux, batch, rng)
+
+    def _sp_scope(self):
+        """Active sequence-parallel context while tracing/running the step:
+        MultiHeadAttention nodes lower to ring attention over 'sp'."""
+        import contextlib
+        if self.seq_axis is not None and "sp" in self.mesh.axis_names:
+            from .ring_attention import sequence_parallel
+            return sequence_parallel(self.mesh)
+        return contextlib.nullcontext()

@@ -1,0 +1,212 @@
+"""Ring attention / flash kernel / transformer ops.
+
+Ring vs full-attention equality runs on the 8-device CPU mesh from
+conftest (the multi-chip stand-in, SURVEY §4)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.parallel.ring_attention import (
+    attention_reference, blockwise_combine, flash_attention, ring_attention)
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+rng = np.random.RandomState(11)
+
+
+def _qkv(B=2, H=2, S=32, D=8):
+    q = rng.randn(B, H, S, D).astype(np.float32)
+    k = rng.randn(B, H, S, D).astype(np.float32)
+    v = rng.randn(B, H, S, D).astype(np.float32)
+    return q, k, v
+
+
+def test_blockwise_combine_matches_full():
+    q, k, v = _qkv()
+    full = attention_reference(q, k, v)
+    blocks = [(k[..., i:i + 8, :], v[..., i:i + 8, :])
+              for i in range(0, 32, 8)]
+    blk = blockwise_combine(q, blocks)
+    assert_almost_equal(np.asarray(blk), np.asarray(full),
+                        rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_interpret_matches_reference(causal):
+    q, k, v = _qkv(B=1, H=2, S=16, D=8)
+    want = attention_reference(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8,
+                          interpret=True)
+    assert_almost_equal(np.asarray(got), np.asarray(want),
+                        rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    n_sp = 4
+    B, H, S, D = 2, 2, 32, 8
+    q, k, v = _qkv(B, H, S, D)
+    want = attention_reference(q, k, v, causal=causal)
+
+    devs = np.array(jax.devices()[:n_sp])
+    mesh = Mesh(devs, ("sp",))
+
+    def f(q, k, v):
+        return ring_attention(q, k, v, axis_name="sp", causal=causal)
+
+    sharded = shard_map(f, mesh=mesh,
+                        in_specs=(P(None, None, "sp", None),) * 3,
+                        out_specs=P(None, None, "sp", None))
+    got = jax.jit(sharded)(q, k, v)
+    assert_almost_equal(np.asarray(got), np.asarray(want),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_grad_flows():
+    n_sp = 2
+    B, H, S, D = 1, 1, 16, 4
+    q, k, v = _qkv(B, H, S, D)
+    devs = np.array(jax.devices()[:n_sp])
+    mesh = Mesh(devs, ("sp",))
+
+    def loss_ring(q, k, v):
+        f = shard_map(
+            lambda a, b, c: ring_attention(a, b, c, axis_name="sp"),
+            mesh=mesh, in_specs=(P(None, None, "sp", None),) * 3,
+            out_specs=P(None, None, "sp", None))
+        return jnp.sum(f(q, k, v) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(attention_reference(q, k, v) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_full):
+        assert_almost_equal(np.asarray(gr), np.asarray(gf),
+                            rtol=1e-3, atol=1e-4)
+
+
+# ------------------------------------------------- symbolic ops
+def test_layernorm_forward_backward():
+    x = rng.randn(4, 6).astype(np.float64)
+    d = sym.Variable("x")
+    s = sym.LayerNorm(data=d, name="ln")
+    ex = s.simple_bind(mx.cpu(), x=x.shape)
+    ex.arg_dict["x"][:] = x.astype(np.float32)
+    ex.arg_dict["ln_gamma"][:] = np.ones(6, np.float32)
+    ex.arg_dict["ln_beta"][:] = np.zeros(6, np.float32)
+    out = ex.forward()[0].asnumpy()
+    mu = x.mean(-1, keepdims=True)
+    want = (x - mu) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, want, rtol=1e-4, atol=1e-5)
+
+    check_numeric_gradient(sym.sum(s * s), {
+        "x": x, "ln_gamma": rng.rand(6) + 0.5, "ln_beta": rng.randn(6)},
+        rtol=2e-2, atol=2e-3)
+
+
+def test_mha_matches_manual():
+    B, S, E, H = 2, 8, 16, 2
+    x = rng.randn(B, S, E).astype(np.float32)
+    wqkv = rng.randn(3 * E, E).astype(np.float32) * 0.2
+    bqkv = rng.randn(3 * E).astype(np.float32) * 0.1
+    wo = rng.randn(E, E).astype(np.float32) * 0.2
+    bo = rng.randn(E).astype(np.float32) * 0.1
+
+    d = sym.Variable("x")
+    s = sym.MultiHeadAttention(data=d, num_heads=H, causal=True, name="att")
+    ex = s.simple_bind(mx.cpu(), x=x.shape)
+    ex.arg_dict["x"][:] = x
+    ex.arg_dict["att_qkv_weight"][:] = wqkv
+    ex.arg_dict["att_qkv_bias"][:] = bqkv
+    ex.arg_dict["att_out_weight"][:] = wo
+    ex.arg_dict["att_out_bias"][:] = bo
+    out = ex.forward()[0].asnumpy()
+
+    qkv = x @ wqkv.T + bqkv
+    q, k, v = np.split(qkv, 3, axis=-1)
+    to_heads = lambda t: t.reshape(B, S, H, E // H).transpose(0, 2, 1, 3)
+    o = attention_reference(to_heads(q), to_heads(k), to_heads(v),
+                            causal=True)
+    o = np.asarray(o).transpose(0, 2, 1, 3).reshape(B, S, E)
+    want = o @ wo.T + bo
+    assert_almost_equal(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_trains():
+    np.random.seed(0)
+    V, S = 30, 12
+    net = mx.models.transformer.get_symbol(vocab_size=V, num_layers=1,
+                                           num_heads=2, dim=16, seq_len=S)
+    # learn to predict the next token of a fixed cyclic sequence
+    seq = (np.arange(64 * S) * 7 % V).reshape(64, S).astype(np.float32)
+    lbl = np.roll(seq.reshape(-1), -1).reshape(64, S)
+    it = mx.io.NDArrayIter(seq, lbl, batch_size=16, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=15, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier())
+    score = dict(mod.score(mx.io.NDArrayIter(
+        seq, lbl, batch_size=16, label_name="softmax_label"), "acc"))
+    assert score["accuracy"] > 0.8, score
+
+
+def test_transformer_sharded_trainer_sp():
+    """Full fused train step over a dp×sp mesh: MHA lowers to ring
+    attention; outputs match the single-device step bit-for-bit-ish."""
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu import optimizer as opt_mod
+
+    V, S, B = 20, 16, 4
+    net = mx.models.transformer.get_symbol(vocab_size=V, num_layers=1,
+                                           num_heads=2, dim=8, seq_len=S)
+    r = np.random.RandomState(0)
+    data = r.randint(0, V, (B, S)).astype(np.float32)
+    label = r.randint(0, V, (B, S)).astype(np.float32)
+
+    outs = {}
+    for tag, kwargs in [("single", dict(dp=1)),
+                        ("sp", dict(dp=2, sp=2))]:
+        mesh = make_mesh(jax.devices()[:np.prod(
+            [v for v in kwargs.values()])], **kwargs)
+        mx.random.seed(42)  # identical param init across both runs
+        opt = opt_mod.create("sgd", learning_rate=0.1)
+        tr = ShardedTrainer(net, opt, mesh,
+                            seq_axis=1 if "sp" in kwargs else None)
+        params, opt_state, aux = tr.init_params(
+            {"data": (B, S)}, label_shapes={"softmax_label": (B, S)},
+            initializer=mx.init.Xavier(rnd_type="gaussian"))
+        batch = tr.shard_batch({"data": data, "softmax_label": label})
+        params, opt_state, aux, out = tr.step(params, opt_state, aux,
+                                              batch)
+        outs[tag] = np.asarray(out[0])
+    assert_almost_equal(outs["single"], outs["sp"], rtol=1e-3, atol=1e-4)
+
+
+def test_flash_kernel_differentiable():
+    """review finding: pallas forward must carry a VJP (TPU training path)."""
+    q, k, v = _qkv(B=1, H=1, S=16, D=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=8,
+                                       block_k=8, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert_almost_equal(np.asarray(a), np.asarray(b),
+                            rtol=1e-3, atol=1e-4)

@@ -27,6 +27,7 @@ kvstore/ps rendezvous happens at import/create time, kvstore.py:360).
 """
 import argparse
 import os
+import shlex
 import signal
 import subprocess
 import sys
@@ -75,11 +76,13 @@ def launch_ssh(args, command):
     procs = []
     for rank in range(args.num_workers):
         env = build_env(rank, args)
-        exports = " ".join("%s=%s" % (k, v) for k, v in env.items()
+        exports = " ".join("%s=%s" % (k, shlex.quote(v))
+                           for k, v in env.items()
                            if k.startswith(("MXTPU_", "DMLC_", "JAX_",
                                             "XLA_")))
-        remote = "cd %s && env %s %s" % (args.workdir or "~", exports,
-                                         " ".join(command))
+        remote = "cd %s && env %s %s" % (
+            shlex.quote(args.workdir) if args.workdir else "~", exports,
+            " ".join(shlex.quote(c) for c in command))
         procs.append(subprocess.Popen(["ssh", "-o",
                                        "StrictHostKeyChecking=no",
                                        hosts[rank], remote]))
