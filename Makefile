@@ -1,0 +1,29 @@
+# Native components: threaded dependency engine + RecordIO fast path.
+# Parity: the reference's Makefile builds libmxnet.so from src/; here the
+# XLA path needs no native kernels, so the native library covers the
+# host-side runtime (src/engine.cc, src/recordio.cc).
+CXX ?= g++
+CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -pthread
+
+LIBDIR := lib
+SRCS := src/engine.cc src/recordio.cc
+OBJS := $(SRCS:src/%.cc=$(LIBDIR)/%.o)
+
+all: $(LIBDIR)/libmxtpu.so
+
+$(LIBDIR):
+	mkdir -p $(LIBDIR)
+
+$(LIBDIR)/%.o: src/%.cc | $(LIBDIR)
+	$(CXX) $(CXXFLAGS) -c $< -o $@
+
+$(LIBDIR)/libmxtpu.so: $(OBJS)
+	$(CXX) $(CXXFLAGS) -shared $(OBJS) -o $@
+
+clean:
+	rm -rf $(LIBDIR)
+
+test: all
+	python -m pytest tests/ -q
+
+.PHONY: all clean test

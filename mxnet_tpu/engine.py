@@ -1,0 +1,157 @@
+"""Dependency engine: host-side async scheduler with var read/write sets.
+
+Parity: include/mxnet/engine.h:74 + src/engine/ (SURVEY §2 "Dependency
+engine").  On TPU the device schedule is XLA's; this engine orders
+*host-side* work (IO, prefetch, checkpoint writes) and provides the
+reference's engine API surface (NewVariable/Push/WaitForVar/WaitForAll).
+
+Engines (selected by MXNET_ENGINE_TYPE, parity engine.cc:13-39):
+- ``ThreadedEngine``  — the native C++ var-queue engine (src/engine.cc),
+  loaded via ctypes.  Ops run on a worker pool; callbacks re-enter python
+  holding the GIL only for the op body.
+- ``NaiveEngine``     — synchronous, for debugging (naive_engine.cc:14).
+The factory falls back to Naive when the native library is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from .base import MXNetError
+
+__all__ = ["Engine", "NaiveEngine", "ThreadedEngine", "get", "create"]
+
+_ENGINE_FN_TYPE = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+class Engine(object):
+    """Interface (engine.h:74)."""
+
+    def new_variable(self):
+        raise NotImplementedError
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        raise NotImplementedError
+
+    def wait_for_var(self, var):
+        raise NotImplementedError
+
+    def wait_for_all(self):
+        raise NotImplementedError
+
+    def delete_variable(self, var):
+        raise NotImplementedError
+
+
+class NaiveEngine(Engine):
+    """Synchronous debug engine (naive_engine.cc:14): push == run."""
+
+    def __init__(self):
+        self._next = 1
+
+    def new_variable(self):
+        v = self._next
+        self._next += 1
+        return v
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        fn()
+
+    def wait_for_var(self, var):
+        pass
+
+    def wait_for_all(self):
+        pass
+
+    def delete_variable(self, var):
+        pass
+
+
+class ThreadedEngine(Engine):
+    """ctypes facade over the native var-queue engine (src/engine.cc)."""
+
+    def __init__(self, num_threads=None):
+        from .libinfo import find_lib
+        lib = find_lib()
+        if lib is None:
+            raise MXNetError("native engine unavailable (lib/libmxtpu.so "
+                             "missing and build failed)")
+        self._lib = lib
+        if num_threads is None:
+            num_threads = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS",
+                                             "4"))
+        self._h = lib.MXTPUEngineCreate(num_threads)
+        # keep callbacks alive until they run; keyed by token
+        self._cbs = {}
+        self._cb_lock = threading.Lock()
+        self._next_token = [1]
+
+        def _trampoline(token):
+            with self._cb_lock:
+                fn = self._cbs.pop(token)
+            fn()
+
+        self._tramp = _ENGINE_FN_TYPE(
+            lambda token: _trampoline(int(token)))
+
+    def new_variable(self):
+        return self._lib.MXTPUEngineNewVar(self._h)
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        mutable = list(dict.fromkeys(mutable_vars))
+        # dedup: a var that is written must not also appear as a read
+        # (the reference dedups in Push, threaded_engine.cc:255)
+        const = [v for v in dict.fromkeys(const_vars) if v not in mutable]
+        with self._cb_lock:
+            token = self._next_token[0]
+            self._next_token[0] += 1
+            self._cbs[token] = fn
+        n_c, n_m = len(const), len(mutable)
+        c_arr = (ctypes.c_uint64 * max(n_c, 1))(*const)
+        m_arr = (ctypes.c_uint64 * max(n_m, 1))(*mutable)
+        self._lib.MXTPUEnginePush(self._h, self._tramp,
+                                  ctypes.c_void_p(token), c_arr, n_c,
+                                  m_arr, n_m)
+
+    def wait_for_var(self, var):
+        self._lib.MXTPUEngineWaitForVar(self._h, var)
+
+    def wait_for_all(self):
+        self._lib.MXTPUEngineWaitForAll(self._h)
+
+    def delete_variable(self, var):
+        self._lib.MXTPUEngineDeleteVar(self._h, var)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.MXTPUEngineFree(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+_ENGINE = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def create(engine_type=None, num_threads=None):
+    """Factory (parity engine.cc:13-39 CreateEngine)."""
+    engine_type = engine_type or os.environ.get("MXNET_ENGINE_TYPE",
+                                                "ThreadedEngine")
+    if engine_type == "NaiveEngine":
+        return NaiveEngine()
+    try:
+        return ThreadedEngine(num_threads)
+    except MXNetError:
+        return NaiveEngine()
+
+
+def get():
+    """Process singleton (parity Engine::Get)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        if _ENGINE is None:
+            _ENGINE = create()
+        return _ENGINE

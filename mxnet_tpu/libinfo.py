@@ -1,0 +1,95 @@
+"""Locate / build / load the native library (lib/libmxtpu.so).
+
+Parity: python/mxnet/base.py's ctypes loading of libmxnet.so — with one
+difference by design: the native library is an accelerator for host-side
+subsystems (dependency engine, RecordIO); every consumer has a pure-python
+fallback, so a missing compiler degrades performance, not capability.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_LIB = None
+_TRIED = False
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(_ROOT, "lib", "libmxtpu.so")
+
+
+def _try_build():
+    """Best-effort `make` of the native lib (once per process)."""
+    try:
+        subprocess.run(["make", "-s", "-C", _ROOT],
+                       check=True, capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def find_lib(build=True):
+    """Return a loaded ctypes CDLL or None.
+
+    MXTPU_NO_NATIVE=1 disables the native path entirely (load AND build) —
+    checked on every call so the kill-switch works even after the lib was
+    loaded earlier in the process.
+    """
+    global _LIB, _TRIED
+    if os.environ.get("MXTPU_NO_NATIVE"):
+        return None
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.exists(_LIB_PATH) and build:
+        import shutil
+        if shutil.which("make") is None or shutil.which("g++") is None:
+            return None
+        if not _try_build():
+            import warnings
+            warnings.warn("mxnet_tpu: native library build failed; "
+                          "falling back to pure-python engine/recordio "
+                          "(run `make` in %s for details)" % _ROOT)
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+
+    lib.MXTPUEngineCreate.restype = ctypes.c_void_p
+    lib.MXTPUEngineCreate.argtypes = [ctypes.c_int]
+    lib.MXTPUEngineFree.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineNewVar.restype = ctypes.c_uint64
+    lib.MXTPUEngineNewVar.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEnginePush.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+    lib.MXTPUEngineWaitForVar.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.MXTPUEngineWaitForAll.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineDeleteVar.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+
+    lib.MXTPURecordIOWriterCreate.restype = ctypes.c_void_p
+    lib.MXTPURecordIOWriterCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTPURecordIOWriterWrite.restype = ctypes.c_int
+    lib.MXTPURecordIOWriterWrite.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.MXTPURecordIOWriterTell.restype = ctypes.c_long
+    lib.MXTPURecordIOWriterTell.argtypes = [ctypes.c_void_p]
+    lib.MXTPURecordIOWriterFree.restype = ctypes.c_int
+    lib.MXTPURecordIOWriterFree.argtypes = [ctypes.c_void_p]
+    lib.MXTPURecordIOReaderCreate.restype = ctypes.c_void_p
+    lib.MXTPURecordIOReaderCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_long]
+    lib.MXTPURecordIOReaderNext.restype = ctypes.c_long
+    lib.MXTPURecordIOReaderNext.argtypes = [ctypes.c_void_p]
+    lib.MXTPURecordIOReaderData.restype = ctypes.POINTER(ctypes.c_char)
+    lib.MXTPURecordIOReaderData.argtypes = [ctypes.c_void_p]
+    lib.MXTPURecordIOReaderTell.restype = ctypes.c_long
+    lib.MXTPURecordIOReaderTell.argtypes = [ctypes.c_void_p]
+    lib.MXTPURecordIOReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.MXTPURecordIOReaderFree.argtypes = [ctypes.c_void_p]
+
+    _LIB = lib
+    return _LIB

@@ -56,29 +56,68 @@ def _encode_record(data):
 
 
 class MXRecordIO(object):
-    """Sequential reader/writer (parity: recordio.py:14 MXRecordIO)."""
+    """Sequential reader/writer (parity: recordio.py:14 MXRecordIO).
+
+    Uses the native reader/writer (src/recordio.cc via lib/libmxtpu.so)
+    when available — same wire format, C-speed scan — with this python
+    implementation as the fallback.
+    """
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.handle = None
+        self._native = None
+        self._lib = None
         self.is_open = False
         self.open()
 
+    def _try_native(self):
+        from .libinfo import find_lib  # honors MXTPU_NO_NATIVE
+        return find_lib()
+
     def open(self):
+        lib = self._try_native()
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
+            if lib is not None:
+                h = lib.MXTPURecordIOWriterCreate(self.uri.encode())
+                if h:
+                    self._lib, self._native = lib, h
+                else:
+                    raise IOError("cannot open %s for writing" % self.uri)
+            else:
+                self.handle = open(self.uri, "wb")
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
+            if lib is not None:
+                h = lib.MXTPURecordIOReaderCreate(self.uri.encode(), 0, -1)
+                if h:
+                    self._lib, self._native = lib, h
+                else:
+                    raise IOError("cannot open %s for reading" % self.uri)
+            else:
+                self.handle = open(self.uri, "rb")
         else:
             raise ValueError("Invalid flag %s" % self.flag)
         self.is_open = True
 
     def close(self):
         if self.is_open:
-            self.handle.close()
+            if self._native is not None:
+                if self.writable:
+                    rc = self._lib.MXTPURecordIOWriterFree(self._native)
+                    self._native = None
+                    if rc != 0:
+                        self.is_open = False
+                        raise IOError("error closing %s (earlier write "
+                                      "failed?)" % self.uri)
+                else:
+                    self._lib.MXTPURecordIOReaderFree(self._native)
+                self._native = None
+            if self.handle is not None:
+                self.handle.close()
+                self.handle = None
             self.is_open = False
 
     def __del__(self):
@@ -92,15 +131,41 @@ class MXRecordIO(object):
         self.open()
 
     def tell(self):
+        if self._native is not None:
+            if self.writable:
+                return self._lib.MXTPURecordIOWriterTell(self._native)
+            return self._lib.MXTPURecordIOReaderTell(self._native)
         return self.handle.tell()
+
+    def _seek_to(self, pos):
+        assert not self.writable
+        if self._native is not None:
+            self._lib.MXTPURecordIOReaderSeek(self._native, pos)
+        else:
+            self.handle.seek(pos)
 
     def write(self, buf):
         assert self.writable
+        if self._native is not None:
+            if self._lib.MXTPURecordIOWriterWrite(self._native, buf,
+                                                  len(buf)) != 0:
+                raise IOError("write failed on %s (disk full?)" % self.uri)
+            return
         self.handle.write(_encode_record(buf))
 
     def read(self):
         """Read one logical record; None at EOF."""
         assert not self.writable
+        if self._native is not None:
+            import ctypes
+            n = self._lib.MXTPURecordIOReaderNext(self._native)
+            if n == -1:
+                return None
+            if n == -2:
+                raise IOError("Invalid/truncated RecordIO file %s"
+                              % self.uri)
+            ptr = self._lib.MXTPURecordIOReaderData(self._native)
+            return ctypes.string_at(ptr, n)
         parts = []
         while True:
             head = self.handle.read(8)
@@ -171,8 +236,7 @@ class MXIndexedRecordIO(MXRecordIO):
         super().close()
 
     def seek(self, idx):
-        assert not self.writable
-        self.handle.seek(self.idx[idx])
+        self._seek_to(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)

@@ -1,0 +1,305 @@
+// Threaded dependency engine — native scheduler for host-side work.
+//
+// Parity: src/engine/threaded_engine.{h,cc} of the reference (SURVEY §2
+// "Dependency engine"): operations declare read/write sets over variables;
+// an op becomes ready when every variable grants it access (concurrent
+// reads, exclusive writes, program order preserved per variable); ready ops
+// run on a worker-thread pool.  On TPU the *device* schedule belongs to
+// XLA, so this engine schedules the host side: prefetch pipelines, IO,
+// checkpoint writes, and the NDArray WaitToRead/WaitForAll API surface.
+//
+// Differences from the reference (deliberate, TPU-first):
+//  - ops are synchronous std::function bodies (the reference's async
+//    on_complete exists for CUDA stream callbacks; host work is sync);
+//  - variables are ids in a table, not pointer-juggled linked lists — the
+//    grant logic is the same read/write queue protocol
+//    (threaded_engine.cc:32-79) expressed with explicit deques.
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace mxtpu {
+
+using Fn = std::function<void()>;
+
+struct Opr;
+
+// One scheduling queue per variable (ThreadedVar analog).
+struct Var {
+  std::deque<std::pair<Opr*, bool>> queue;  // (op, is_write) program order
+  int running_reads = 0;
+  bool running_write = false;
+  bool to_delete = false;
+};
+
+struct Opr {
+  Fn fn;
+  std::vector<uint64_t> const_vars;
+  std::vector<uint64_t> mutable_vars;
+  std::atomic<int> wait{0};
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_threads) : shutdown_(false) {
+    if (num_threads <= 0) num_threads = 2;
+    for (int i = 0; i < num_threads; ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::unique_lock<std::mutex> lk(ready_mu_);
+      shutdown_ = true;
+    }
+    ready_cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  uint64_t NewVariable() {
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    uint64_t id = next_var_++;
+    vars_.emplace(id, std::make_unique<Var>());
+    return id;
+  }
+
+  // Parity Engine::PushAsync (engine.h:120): dedup vars, register with each
+  // queue, self-decrement the +1 guard, dispatch if already ready.
+  void Push(Fn fn, std::vector<uint64_t> const_vars,
+            std::vector<uint64_t> mutable_vars) {
+    // enforce disjoint read/write sets here (not just in wrappers): a var
+    // queued as both read and write would deadlock its own grant
+    Dedup(&mutable_vars);
+    Dedup(&const_vars);
+    if (!mutable_vars.empty() && !const_vars.empty()) {
+      std::vector<uint64_t> filtered;
+      filtered.reserve(const_vars.size());
+      for (uint64_t v : const_vars) {
+        bool in_mut = false;
+        for (uint64_t m : mutable_vars) in_mut |= (m == v);
+        if (!in_mut) filtered.push_back(v);
+      }
+      const_vars.swap(filtered);
+    }
+    auto* opr = new Opr();
+    opr->fn = std::move(fn);
+    opr->const_vars = std::move(const_vars);
+    opr->mutable_vars = std::move(mutable_vars);
+    pending_.fetch_add(1, std::memory_order_relaxed);
+
+    int nvars = static_cast<int>(opr->const_vars.size() +
+                                 opr->mutable_vars.size());
+    opr->wait.store(nvars + 1, std::memory_order_relaxed);
+    {
+      std::lock_guard<std::mutex> lk(vars_mu_);
+      for (uint64_t v : opr->const_vars) Enqueue(v, opr, /*write=*/false);
+      for (uint64_t v : opr->mutable_vars) Enqueue(v, opr, /*write=*/true);
+      // grant whatever is immediately available
+      for (uint64_t v : opr->const_vars) TryGrant(v);
+      for (uint64_t v : opr->mutable_vars) TryGrant(v);
+    }
+    if (opr->wait.fetch_sub(1) == 1) Dispatch(opr);
+  }
+
+  void WaitForVar(uint64_t var) {
+    // probe-reader op + condvar (threaded_engine.cc:300-327)
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    Push([&] {
+      std::lock_guard<std::mutex> lk(mu);
+      done = true;
+      cv.notify_all();
+    }, {var}, {});
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return done; });
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(all_mu_);
+    all_cv_.wait(lk, [this] {
+      return pending_.load(std::memory_order_acquire) == 0;
+    });
+  }
+
+  // Deferred delete: reclaim after all outstanding uses (engine.cc:239).
+  void DeleteVariable(uint64_t var) {
+    Push([this, var] {
+      std::lock_guard<std::mutex> lk(vars_mu_);
+      auto it = vars_.find(var);
+      if (it != vars_.end()) it->second->to_delete = true;
+    }, {}, {var});
+  }
+
+ private:
+  static void Dedup(std::vector<uint64_t>* v) {
+    std::vector<uint64_t> out;
+    out.reserve(v->size());
+    for (uint64_t x : *v) {
+      bool seen = false;
+      for (uint64_t y : out) seen |= (y == x);
+      if (!seen) out.push_back(x);
+    }
+    v->swap(out);
+  }
+
+  // requires vars_mu_
+  void Enqueue(uint64_t v, Opr* opr, bool write) {
+    auto it = vars_.find(v);
+    if (it == vars_.end()) {
+      // unknown/deleted var: grant immediately
+      if (opr->wait.fetch_sub(1) == 1) Dispatch(opr);
+      return;
+    }
+    it->second->queue.emplace_back(opr, write);
+  }
+
+  // requires vars_mu_ — the grant protocol (threaded_engine.cc:32-79)
+  void TryGrant(uint64_t v) {
+    auto it = vars_.find(v);
+    if (it == vars_.end()) return;
+    Var* var = it->second.get();
+    while (!var->queue.empty()) {
+      auto [opr, is_write] = var->queue.front();
+      if (is_write) {
+        if (var->running_reads == 0 && !var->running_write) {
+          var->running_write = true;
+          var->queue.pop_front();
+          if (opr->wait.fetch_sub(1) == 1) Dispatch(opr);
+        }
+        break;  // write at head blocks everything behind it
+      } else {
+        if (var->running_write) break;
+        var->running_reads++;
+        var->queue.pop_front();
+        if (opr->wait.fetch_sub(1) == 1) Dispatch(opr);
+      }
+    }
+  }
+
+  void Dispatch(Opr* opr) {
+    {
+      std::lock_guard<std::mutex> lk(ready_mu_);
+      ready_.push_back(opr);
+    }
+    ready_cv_.notify_one();
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr* opr = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(ready_mu_);
+        ready_cv_.wait(lk, [this] { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        opr = ready_.front();
+        ready_.pop_front();
+      }
+      if (opr->fn) opr->fn();
+      OnComplete(opr);
+    }
+  }
+
+  // completion walk (threaded_engine.cc:82-168)
+  void OnComplete(Opr* opr) {
+    {
+      std::lock_guard<std::mutex> lk(vars_mu_);
+      for (uint64_t v : opr->const_vars) {
+        auto it = vars_.find(v);
+        if (it == vars_.end()) continue;
+        it->second->running_reads--;
+        TryGrant(v);
+        MaybeReclaim(it->first);
+      }
+      for (uint64_t v : opr->mutable_vars) {
+        auto it = vars_.find(v);
+        if (it == vars_.end()) continue;
+        it->second->running_write = false;
+        TryGrant(v);
+        MaybeReclaim(it->first);
+      }
+    }
+    delete opr;
+    if (pending_.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      std::lock_guard<std::mutex> lk(all_mu_);
+      all_cv_.notify_all();
+    }
+  }
+
+  // requires vars_mu_
+  void MaybeReclaim(uint64_t v) {
+    auto it = vars_.find(v);
+    if (it != vars_.end() && it->second->to_delete &&
+        it->second->queue.empty() && it->second->running_reads == 0 &&
+        !it->second->running_write) {
+      vars_.erase(it);
+    }
+  }
+
+  std::mutex vars_mu_;
+  std::unordered_map<uint64_t, std::unique_ptr<Var>> vars_;
+  uint64_t next_var_ = 1;
+
+  std::mutex ready_mu_;
+  std::condition_variable ready_cv_;
+  std::deque<Opr*> ready_;
+  bool shutdown_;
+
+  std::atomic<int64_t> pending_{0};
+  std::mutex all_mu_;
+  std::condition_variable all_cv_;
+
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace mxtpu
+
+// ----------------------------------------------------------------------
+// C ABI (subset of the reference's engine surface in c_api.cc)
+// ----------------------------------------------------------------------
+extern "C" {
+
+typedef void (*MXTPUEngineFn)(void* param);
+
+void* MXTPUEngineCreate(int num_threads) {
+  return new mxtpu::Engine(num_threads);
+}
+
+void MXTPUEngineFree(void* h) { delete static_cast<mxtpu::Engine*>(h); }
+
+uint64_t MXTPUEngineNewVar(void* h) {
+  return static_cast<mxtpu::Engine*>(h)->NewVariable();
+}
+
+void MXTPUEnginePush(void* h, MXTPUEngineFn fn, void* param,
+                     const uint64_t* const_vars, int n_const,
+                     const uint64_t* mutable_vars, int n_mut) {
+  std::vector<uint64_t> cv(const_vars, const_vars + n_const);
+  std::vector<uint64_t> mv(mutable_vars, mutable_vars + n_mut);
+  static_cast<mxtpu::Engine*>(h)->Push(
+      [fn, param] { if (fn) fn(param); }, std::move(cv), std::move(mv));
+}
+
+void MXTPUEngineWaitForVar(void* h, uint64_t var) {
+  static_cast<mxtpu::Engine*>(h)->WaitForVar(var);
+}
+
+void MXTPUEngineWaitForAll(void* h) {
+  static_cast<mxtpu::Engine*>(h)->WaitForAll();
+}
+
+void MXTPUEngineDeleteVar(void* h, uint64_t var) {
+  static_cast<mxtpu::Engine*>(h)->DeleteVariable(var);
+}
+
+}  // extern "C"

@@ -1,0 +1,184 @@
+"""Native engine + recordio tests.
+
+Randomized read/write workload replay (parity:
+tests/cpp/threaded_engine_test.cc:20-50 — run random dependency graphs,
+check result equality vs serial execution).
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import engine as eng
+from mxnet_tpu import recordio as rio
+from mxnet_tpu.libinfo import find_lib
+
+HAS_NATIVE = find_lib() is not None
+
+
+def _random_workload(engine, n_vars=8, n_ops=200, seed=0):
+    """Each op reads some vars and writes others; bodies append to a log
+    guarded by the engine's ordering only (a data race corrupts the
+    per-var sequence check)."""
+    rng = np.random.RandomState(seed)
+    variables = [engine.new_variable() for _ in range(n_vars)]
+    state = {v: [] for v in variables}  # written only by ops holding v
+    expected_counts = {v: 0 for v in variables}
+
+    for op_id in range(n_ops):
+        n_read = rng.randint(0, 3)
+        n_write = rng.randint(1, 3)
+        picks = rng.permutation(n_vars)
+        reads = [variables[i] for i in picks[:n_read]]
+        writes = [variables[i] for i in picks[n_read:n_read + n_write]]
+        for w in writes:
+            expected_counts[w] += 1
+
+        def body(reads=tuple(reads), writes=tuple(writes), op_id=op_id):
+            # reading is safe concurrently; writing appends — if two
+            # writers overlap, list.append ordering may interleave but
+            # the final length check still holds, so ALSO verify
+            # exclusivity with a guard flag
+            for w in writes:
+                lst = state[w]
+                lst.append(("begin", op_id))
+            for w in writes:
+                state[w].append(("end", op_id))
+
+        engine.push(body, const_vars=reads, mutable_vars=writes)
+
+    engine.wait_for_all()
+    # exclusivity: per var the log must be begin/end strictly paired
+    for v in variables:
+        log = state[v]
+        assert len(log) == 2 * expected_counts[v]
+        open_op = None
+        for kind, op_id in log:
+            if kind == "begin":
+                assert open_op is None, \
+                    "writers overlapped on var %s" % v
+                open_op = op_id
+            else:
+                assert open_op == op_id
+                open_op = None
+
+
+@pytest.mark.skipif(not HAS_NATIVE, reason="native lib not built")
+def test_threaded_engine_randomized_replay():
+    engine = eng.ThreadedEngine(num_threads=4)
+    for seed in range(3):
+        _random_workload(engine, seed=seed)
+
+
+@pytest.mark.skipif(not HAS_NATIVE, reason="native lib not built")
+def test_threaded_engine_read_write_ordering():
+    """Writes to a var are serialized in program order; reads see the
+    preceding write."""
+    engine = eng.ThreadedEngine(num_threads=4)
+    v = engine.new_variable()
+    results = []
+    box = [0]
+
+    def writer(val):
+        def f():
+            box[0] = val
+        return f
+
+    def reader():
+        results.append(box[0])
+
+    for i in range(1, 21):
+        engine.push(writer(i), mutable_vars=[v])
+        engine.push(reader, const_vars=[v])
+    engine.wait_for_all()
+    assert results == list(range(1, 21))
+
+
+@pytest.mark.skipif(not HAS_NATIVE, reason="native lib not built")
+def test_engine_wait_for_var():
+    engine = eng.ThreadedEngine(num_threads=2)
+    v = engine.new_variable()
+    evt = threading.Event()
+    out = []
+
+    def slow():
+        evt.wait(2.0)
+        out.append(1)
+
+    engine.push(slow, mutable_vars=[v])
+    evt.set()
+    engine.wait_for_var(v)
+    assert out == [1]
+
+
+def test_naive_engine_fallback():
+    engine = eng.NaiveEngine()
+    v = engine.new_variable()
+    out = []
+    engine.push(lambda: out.append(1), mutable_vars=[v])
+    engine.wait_for_all()
+    assert out == [1]
+
+
+# ---------------------------------------------------------------- recordio
+@pytest.mark.skipif(not HAS_NATIVE, reason="native lib not built")
+def test_native_python_recordio_interop(tmp_path):
+    """Bytes written by the native writer read back identically through
+    the python decoder and vice versa (incl. embedded-magic splitting)."""
+    magic = (0xced7230a).to_bytes(4, "little")
+    payloads = [b"hello", b"x" * 1000, b"a" + magic + b"b" + magic,
+                magic * 3, b"", b"tail"]
+
+    # native write -> python read
+    p1 = str(tmp_path / "n.rec")
+    w = rio.MXRecordIO(p1, "w")
+    assert w._native is not None
+    for p in payloads:
+        w.write(p)
+    w.close()
+    os.environ["MXTPU_NO_NATIVE"] = "1"
+    try:
+        r = rio.MXRecordIO(p1, "r")
+        assert r._native is None
+        got = []
+        while True:
+            item = r.read()
+            if item is None:
+                break
+            got.append(item)
+        r.close()
+        assert got == payloads
+
+        # python write -> native read
+        p2 = str(tmp_path / "p.rec")
+        w2 = rio.MXRecordIO(p2, "w")
+        for p in payloads:
+            w2.write(p)
+        w2.close()
+    finally:
+        del os.environ["MXTPU_NO_NATIVE"]
+    r2 = rio.MXRecordIO(p2, "r")
+    assert r2._native is not None
+    got2 = []
+    while True:
+        item = r2.read()
+        if item is None:
+            break
+        got2.append(item)
+    r2.close()
+    assert got2 == payloads
+
+
+@pytest.mark.skipif(not HAS_NATIVE, reason="native lib not built")
+def test_native_indexed_recordio(tmp_path):
+    idx = str(tmp_path / "d.idx")
+    rec = str(tmp_path / "d.rec")
+    w = rio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(20):
+        w.write_idx(i, b"rec-%03d" % i)
+    w.close()
+    r = rio.MXIndexedRecordIO(idx, rec, "r")
+    for i in (5, 0, 19, 7):
+        assert r.read_idx(i) == b"rec-%03d" % i
+    r.close()
