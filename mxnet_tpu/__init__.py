@@ -40,6 +40,10 @@ from . import recordio
 from . import image
 from . import kvstore
 from . import kvstore as kv
+from . import kvstore_server
+# a DMLC_ROLE=server/scheduler process parks here and exits instead of
+# training (parity: reference __init__.py:35 _init_kvstore_server_module)
+kvstore_server._init_kvstore_server_module()
 from . import parallel
 from . import model
 from .model import FeedForward, save_checkpoint, load_checkpoint

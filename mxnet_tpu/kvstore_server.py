@@ -1,0 +1,65 @@
+"""Parameter-server role entry point (compatibility shim).
+
+Parity: python/mxnet/kvstore_server.py — in the reference, a process
+launched with DMLC_ROLE=server (or scheduler) never returns from
+``import mxnet``: ``_init_kvstore_server_module`` creates a dist kvstore,
+installs the controller (which unpickles the optimizer sent by workers as
+command 0, kvstore_server.py:36-46) and blocks in RunServer.
+
+On TPU there are no parameter servers: gradients ride ICI collectives and
+the optimizer update runs inside the compiled step (SURVEY §5 mapping
+"set_optimizer on servers → in-step update").  The shim preserves the
+process contract — a server/scheduler-role process parks and exits
+cleanly instead of training — so reference launch scripts that spawn
+server roles keep working.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import sys
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer(object):
+    """Parity: kvstore_server.py:14 KVStoreServer."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.handle = getattr(kvstore, "handle", None)
+        self.init_logging()
+
+    def init_logging(self):
+        self.logger = logging.getLogger("mxnet_tpu.kvstore_server")
+
+    def _controller(self):
+        """Command handler (head 0 = pickled optimizer)."""
+        def server_controller(cmd_id, cmd_body):
+            if cmd_id == 0:
+                optimizer = pickle.loads(cmd_body)
+                self.kvstore.set_optimizer(optimizer)
+            else:
+                self.logger.info("server command %d ignored (no PS on "
+                                 "TPU)", cmd_id)
+        return server_controller
+
+    def run(self):
+        """In the reference: blocks in ps RunServer.  Here: no server
+        work exists; log and return."""
+        self.logger.info(
+            "kvstore server role is a no-op on TPU: aggregation + updates "
+            "run inside the compiled step on workers (dist_sync ≡ psum "
+            "over ICI/DCN)")
+
+
+def _init_kvstore_server_module():
+    """Parity kvstore_server.py:58-68: park server/scheduler processes."""
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role in ("server", "scheduler"):
+        from . import kvstore
+        kv = kvstore.create("dist_sync")
+        server = KVStoreServer(kv)
+        server.run()
+        sys.exit(0)

@@ -10,9 +10,11 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 
 _LIB = None
 _TRIED = False
+_LOCK = threading.Lock()
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _LIB_PATH = os.path.join(_ROOT, "lib", "libmxtpu.so")
@@ -38,6 +40,12 @@ def find_lib(build=True):
     global _LIB, _TRIED
     if os.environ.get("MXTPU_NO_NATIVE"):
         return None
+    with _LOCK:
+        return _find_lib_locked(build)
+
+
+def _find_lib_locked(build):
+    global _LIB, _TRIED
     if _LIB is not None or _TRIED:
         return _LIB
     _TRIED = True

@@ -1,0 +1,6 @@
+"""Optional operator packs (parity: plugin/ — torch, warpctc bridges).
+
+Import a submodule to register its operators:
+    import mxnet_tpu.plugin.warpctc       # registers WarpCTC
+    import mxnet_tpu.plugin.torch_bridge  # registers _TorchModule
+"""

@@ -1,0 +1,111 @@
+"""Run PyTorch modules as graph operators.
+
+Parity: plugin/torch (torch_module-inl.h — the reference embeds Lua Torch
+nn modules as mxnet operators).  The modern analog embeds a
+``torch.nn.Module`` (CPU) via the host-callback machinery: forward runs
+the module under ``torch.enable_grad``; backward replays torch autograd
+and returns input + parameter gradients into the graph.
+
+    import mxnet_tpu.plugin.torch_bridge as tb
+    sym = tb.torch_module(my_module, data, name="t0")   # data: Symbol
+
+Parameters of the torch module stay INSIDE torch (updated by whoever owns
+the module) — matching the reference, where torch modules own their
+weights and mxnet only sees data in/out (torch_module-inl.h).
+"""
+from __future__ import annotations
+
+import weakref
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ops.registry import OperatorProperty, register_op, require_known
+
+_MODULES = weakref.WeakValueDictionary()
+_NEXT = [0]
+
+
+def torch_module(module, data, **kwargs):
+    """Wrap a torch.nn.Module taking one input tensor as a Symbol op."""
+    from .. import symbol as _sym
+    token = "_torch_module_%d" % _NEXT[0]
+    _NEXT[0] += 1
+    _MODULES[token] = module
+    return _sym._create("_TorchModule", data, info=token, **kwargs)
+
+
+@register_op("_TorchModule")
+class _TorchModule(OperatorProperty):
+    param_cls = None
+    hint = "torch"
+    accepts_any_attrs = True
+
+    def __init__(self, **attrs):
+        self.attrs = {k: str(v) for k, v in attrs.items()}
+        token = self.attrs.get("info")
+        if token not in _MODULES:
+            raise MXNetError("_TorchModule: unknown module token %r "
+                             "(torch modules are not serializable, like "
+                             "the reference's lua state)" % token)
+        self.module = _MODULES[token]
+        self.param = None
+        self._shape_cache = {}
+
+    def list_arguments(self):
+        return ["data"]
+
+    def _probe_out_shape(self, in_shape):
+        """Shape-probe WITHOUT side effects: eval() suppresses BatchNorm/
+        Dropout buffer updates during the zero-tensor dry run; the
+        training flag is restored afterwards."""
+        in_shape = tuple(int(d) for d in in_shape)
+        if in_shape in self._shape_cache:
+            return self._shape_cache[in_shape]
+        import torch
+        was_training = self.module.training
+        self.module.eval()
+        try:
+            with torch.no_grad():
+                out = self.module(torch.zeros(*in_shape))
+        finally:
+            if was_training:
+                self.module.train()
+        self._shape_cache[in_shape] = tuple(out.shape)
+        return self._shape_cache[in_shape]
+
+    def infer_shape(self, in_shapes):
+        in_shapes = require_known("_TorchModule", in_shapes, ["data"])
+        return list(in_shapes), [self._probe_out_shape(in_shapes[0])], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        module = self.module
+        x = inputs[0]
+        in_shape = tuple(int(d) for d in x.shape)
+        dtype = np.dtype(x.dtype)
+        import torch
+        out_shape = self._probe_out_shape(in_shape)
+
+        def host_forward(train_flag, in_data, aux_data):
+            t = torch.from_numpy(np.ascontiguousarray(in_data[0]))
+            with torch.no_grad():
+                y = module(t)
+            return [y.numpy().astype(dtype)], aux_data
+
+        def host_backward(out_grad, in_data, out_data, aux_data):
+            t = torch.from_numpy(
+                np.ascontiguousarray(in_data[0])).requires_grad_(True)
+            y = module(t)
+            y.backward(torch.from_numpy(
+                np.ascontiguousarray(out_grad[0])))
+            return [t.grad.numpy().astype(dtype)]
+
+        from ..operator import _run_host_op
+        outs, _ = _run_host_op(host_forward, host_backward, inputs, aux,
+                               is_train, [in_shape], [dtype],
+                               [out_shape], [dtype])
+        return outs, None
+
+
+from .. import symbol as _symbol  # noqa: E402
+_symbol._init_symbol_module()

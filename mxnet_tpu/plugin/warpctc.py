@@ -1,0 +1,94 @@
+"""CTC loss operator (parity: plugin/warpctc/warpctc-inl.h).
+
+The reference binds Baidu's warp-ctc CUDA kernels; the TPU-native loss is
+the log-space CTC forward recursion that XLA compiles (optax.ctc_loss —
+a lax.scan over time steps, batched on the MXU).  Same graph contract as
+the reference op:
+
+- arguments: data (T*N, alphabet), label (N, label_length) — data rows
+  are time-major flattened exactly like warpctc-inl.h:136-141 (T fixed =
+  ``input_length``), blank id 0, labels 0-padded (pad value ``0`` is the
+  blank, real labels start at 1, warpctc-inl.h:93 labelLengths);
+- forward output: softmax(data) (warpctc outputs activations);
+- backward: d(CTC)/d(activations), ignoring the head gradient (loss-style
+  op, like SoftmaxOutput).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..dparam import Field, ParamStruct
+from ..ops.registry import OperatorProperty, register_op, require_known
+
+
+class _WarpCTCParam(ParamStruct):
+    label_length = Field(int, required=True, lower=1)
+    input_length = Field(int, required=True, lower=1)
+
+
+def _ctc_grad_and_softmax(acts, labels, T, N, L):
+    """acts (T*N, K) time-major; labels (N, L) 0-padded (0 = blank)."""
+    K = acts.shape[-1]
+    logits = acts.reshape(T, N, K).transpose(1, 0, 2)  # (N, T, K)
+
+    import optax
+    label_paddings = (labels == 0).astype(jnp.float32)
+    logit_paddings = jnp.zeros((N, T), jnp.float32)
+
+    def total_loss(lg):
+        per_seq = optax.ctc_loss(lg, logit_paddings,
+                                 labels.astype(jnp.int32), label_paddings,
+                                 blank_id=0)
+        return jnp.sum(per_seq)
+
+    grad = jax.grad(total_loss)(logits)           # (N, T, K)
+    grad = grad.transpose(1, 0, 2).reshape(T * N, K)
+    return grad
+
+
+@register_op("WarpCTC")
+class WarpCTC(OperatorProperty):
+    param_cls = _WarpCTCParam
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known("WarpCTC", in_shapes[:1], ["data"])
+        p = self.param
+        if data[0] % p.input_length:
+            raise MXNetError("WarpCTC: data rows %d not divisible by "
+                             "input_length %d" % (data[0], p.input_length))
+        batch = data[0] // p.input_length
+        return [data, (batch, p.label_length)], [data], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        acts, labels = inputs
+        p = self.param
+        T = p.input_length
+        N = acts.shape[0] // T
+        L = p.label_length
+
+        @jax.custom_vjp
+        def _ctc(acts, labels):
+            return jax.nn.softmax(acts, axis=-1)
+
+        def _fwd(acts, labels):
+            return jax.nn.softmax(acts, axis=-1), (acts, labels)
+
+        def _bwd(res, ct):
+            acts, labels = res
+            g = _ctc_grad_and_softmax(acts, labels, T, N, L)
+            return g.astype(acts.dtype), jnp.zeros_like(labels)
+
+        _ctc.defvjp(_fwd, _bwd)
+        return [_ctc(acts, labels)], None
+
+
+# expose the creator on mxnet_tpu.symbol (ops registered post-import)
+from .. import symbol as _symbol  # noqa: E402
+_symbol._init_symbol_module()

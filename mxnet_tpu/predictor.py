@@ -1,0 +1,125 @@
+"""Standalone inference API.
+
+Parity: src/c_api/c_predict_api.cc + amalgamation (the reference's
+predict-only surface for deployment: load symbol JSON + params blob, set
+inputs, forward, read outputs — no training machinery).  One XLA
+computation per input shape, cached, so repeated predict calls hit the
+compile cache (the reference pre-allocates one executor; XLA's cache is
+the equivalent).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import symbol as sym
+from .context import Context, cpu
+
+__all__ = ["Predictor", "load_ndarray_file"]
+
+
+def load_ndarray_file(fname_or_bytes):
+    """Parity: MXNDListCreate (c_predict_api.cc): load a saved named-array
+    file (the `prefix-0000.params` format) into a dict."""
+    import io as _io
+    import os
+    if isinstance(fname_or_bytes, (bytes, bytearray)):
+        import tempfile
+        with tempfile.NamedTemporaryFile(delete=False) as f:
+            f.write(fname_or_bytes)
+            tmp = f.name
+        try:
+            return nd.load(tmp)
+        finally:
+            os.unlink(tmp)
+    return nd.load(fname_or_bytes)
+
+
+class Predictor(object):
+    """Parity: MXPredCreate / MXPredForward / MXPredGetOutput.
+
+    Parameters
+    ----------
+    symbol_json : str — symbol JSON text or path ending in .json
+    param_file : str | bytes | dict — params file/bytes ('arg:'/'aux:'
+        prefixed names, the save_checkpoint format) or a plain dict
+    input_shapes : dict name -> shape
+    ctx : Context (default cpu; pass mx.tpu() for the chip)
+    """
+
+    def __init__(self, symbol_json, param_file, input_shapes, ctx=None):
+        if isinstance(symbol_json, str) and symbol_json.endswith(".json"):
+            self.symbol = sym.load(symbol_json)
+        else:
+            self.symbol = sym.load_json(symbol_json)
+        ctx = ctx or cpu()
+        if not isinstance(ctx, Context):
+            ctx = Context(ctx)
+
+        if isinstance(param_file, dict):
+            raw = param_file
+        else:
+            raw = load_ndarray_file(param_file)
+        arg_params, aux_params = {}, {}
+        for k, v in raw.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+
+        self._input_names = list(input_shapes)
+        arg_names = self.symbol.list_arguments()
+        args = {}
+        for name in arg_names:
+            if name in input_shapes:
+                args[name] = nd.zeros(input_shapes[name])
+            elif name in arg_params:
+                args[name] = arg_params[name]
+            else:
+                raise MXNetError("Predictor: missing parameter %r" % name)
+        aux = {}
+        for name in self.symbol.list_auxiliary_states():
+            if name not in aux_params:
+                raise MXNetError("Predictor: missing aux state %r" % name)
+            aux[name] = aux_params[name]
+        self._exec = self.symbol.bind(ctx, args, aux_states=aux,
+                                      grad_req="null")
+        self._ctx = ctx
+        self._arg_params = arg_params
+        self._aux_params = aux_params
+
+    def set_input(self, name, value):
+        """Parity MXPredSetInput (incl. its size validation)."""
+        if name not in self._input_names:
+            raise MXNetError("unknown input %r (inputs: %s)"
+                             % (name, self._input_names))
+        value = _np.asarray(value)
+        want = self._exec.arg_dict[name].shape
+        if tuple(value.shape) != tuple(want):
+            raise MXNetError(
+                "input %r has shape %s but the predictor was bound with "
+                "%s (use reshape() for new shapes)"
+                % (name, value.shape, want))
+        self._exec.arg_dict[name][:] = value
+
+    def forward(self, **inputs):
+        """Set any given inputs, run, return list of numpy outputs."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        return [o.asnumpy() for o in self._exec.forward(is_train=False)]
+
+    def get_output(self, index):
+        """Parity MXPredGetOutput."""
+        return self._exec.outputs[index].asnumpy()
+
+    def reshape(self, input_shapes):
+        """Parity MXPredReshape: rebind for new input shapes (compile
+        cache keyed on shape, SURVEY §7 stage 5)."""
+        return Predictor(self.symbol.tojson(),
+                         dict(self._arg_params,
+                              **{"aux:" + k: v
+                                 for k, v in self._aux_params.items()}),
+                         input_shapes, self._ctx)

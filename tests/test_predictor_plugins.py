@@ -1,0 +1,115 @@
+"""Predictor API + plugin ops (warpctc CTC, torch bridge)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+rng = np.random.RandomState(5)
+
+
+# ---------------------------------------------------------------- predictor
+def test_predictor_roundtrip(tmp_path):
+    net = mx.models.get_mlp(num_classes=3, hidden=(8,))
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    prefix = str(tmp_path / "m")
+    arg_params, aux_params = mod.get_params()
+    mx.model.save_checkpoint(prefix, 1, net, arg_params, aux_params)
+
+    from mxnet_tpu.predictor import Predictor
+    x = rng.rand(4, 10).astype(np.float32)
+    pred = Predictor(prefix + "-symbol.json", prefix + "-0001.params",
+                     {"data": (4, 10), "softmax_label": (4,)})
+    out = pred.forward(data=x)[0]
+
+    batch = mx.io.DataBatch(data=[mx.nd.array(x)],
+                            label=[mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=False)
+    want = mod.get_outputs()[0].asnumpy()
+    assert_almost_equal(out, want, rtol=1e-5, atol=1e-6)
+
+    # reshape path
+    pred2 = pred.reshape({"data": (2, 10), "softmax_label": (2,)})
+    out2 = pred2.forward(data=x[:2])[0]
+    assert_almost_equal(out2, want[:2], rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------- warpctc
+def test_warpctc_forward_and_grad():
+    import mxnet_tpu.plugin.warpctc  # noqa: F401  registers WarpCTC
+    optax = pytest.importorskip("optax")
+    import jax
+    import jax.numpy as jnp
+
+    T, N, K, L = 6, 2, 5, 3
+    acts = rng.randn(T * N, K).astype(np.float32)
+    labels = np.array([[1, 2, 0], [3, 0, 0]], np.float32)  # 0-padded
+
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    s = sym.WarpCTC(data=data, label=label, label_length=L,
+                    input_length=T)
+    ex = s.simple_bind(mx.cpu(), data=acts.shape, label=labels.shape,
+                       grad_req={"data": "write", "label": "null"})
+    ex.arg_dict["data"][:] = acts
+    ex.arg_dict["label"][:] = labels
+    out = ex.forward(is_train=True)[0].asnumpy()
+    want_soft = np.exp(acts - acts.max(-1, keepdims=True))
+    want_soft /= want_soft.sum(-1, keepdims=True)
+    assert_almost_equal(out, want_soft, rtol=1e-4, atol=1e-5)
+
+    ex.backward()
+    got_grad = ex.grad_dict["data"].asnumpy()
+
+    # independent reference: optax ctc grad computed directly
+    logits = acts.reshape(T, N, K).transpose(1, 0, 2)
+    lp = (labels == 0).astype(np.float32)
+
+    def loss(lg):
+        return jnp.sum(optax.ctc_loss(lg, jnp.zeros((N, T)),
+                                      labels.astype(np.int32), lp,
+                                      blank_id=0))
+
+    g = np.asarray(jax.grad(loss)(jnp.asarray(logits)))
+    want_grad = g.transpose(1, 0, 2).reshape(T * N, K)
+    assert_almost_equal(got_grad, want_grad, rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------- torch
+def test_torch_bridge_forward_backward():
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu.plugin import torch_bridge
+
+    lin = torch.nn.Linear(4, 3)
+    x = rng.rand(5, 4).astype(np.float32)
+
+    data = sym.Variable("x")
+    s = torch_bridge.torch_module(lin, data, name="t0")
+    ex = s.simple_bind(mx.cpu(), x=x.shape, grad_req="write")
+    ex.arg_dict["x"][:] = x
+    out = ex.forward(is_train=True)[0].asnumpy()
+    with torch.no_grad():
+        want = lin(torch.from_numpy(x)).numpy()
+    assert_almost_equal(out, want, rtol=1e-5, atol=1e-6)
+
+    og = rng.rand(5, 3).astype(np.float32)
+    ex.backward([mx.nd.array(og)])
+    want_grad = og @ lin.weight.detach().numpy()
+    assert_almost_equal(ex.grad_dict["x"].asnumpy(), want_grad,
+                        rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------- server shim
+def test_kvstore_server_shim_runs():
+    from mxnet_tpu import kvstore_server
+    kv = mx.kv.create("local")
+    server = kvstore_server.KVStoreServer(kv)
+    server.run()  # no-op, must not raise
+    ctrl = server._controller()
+    import pickle
+    ctrl(0, pickle.dumps(mx.optimizer.create("sgd", learning_rate=0.1)))
+    assert kv._updater is not None
