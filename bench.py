@@ -31,12 +31,18 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     num_layers = int(os.environ.get("BENCH_LAYERS", "50"))
     global_batch = per_dev_batch * n_dev
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    # bf16 compute by default on TPU (2x MXU rate; f32 master weights) —
+    # the policy knob the fp32-only reference never had (SURVEY §7)
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16" if on_tpu else "")
+    remat = os.environ.get("BENCH_REMAT", "") not in ("", "0")
 
     mesh = make_mesh(jax.devices(), dp=n_dev)
     sym = resnet.get_symbol(num_classes=1000, num_layers=num_layers)
     optimizer = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9,
                                wd=1e-4, rescale_grad=1.0 / global_batch)
-    trainer = ShardedTrainer(sym, optimizer, mesh)
+    trainer = ShardedTrainer(sym, optimizer, mesh,
+                             compute_dtype=dtype or None, remat=remat)
 
     params, opt_state, aux = trainer.init_params(
         {"data": (global_batch, 3, 224, 224)},

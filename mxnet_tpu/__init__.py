@@ -52,6 +52,9 @@ from . import module as mod
 from .module import Module
 from . import monitor
 from .monitor import Monitor
+from . import profiler
+from . import predictor
+from .predictor import Predictor
 from . import visualization
 from . import visualization as viz
 from . import models

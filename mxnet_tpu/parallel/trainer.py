@@ -45,7 +45,7 @@ class ShardedTrainer(object):
 
     def __init__(self, symbol, optimizer, mesh, data_names=("data",),
                  label_names=("softmax_label",), rules=None, seq_axis=None,
-                 donate=True):
+                 donate=True, compute_dtype=None, remat=False):
         self.symbol = symbol
         self.optimizer = optimizer
         self.mesh = mesh
@@ -53,6 +53,15 @@ class ShardedTrainer(object):
         self.label_names = tuple(label_names)
         self.rules = rules
         self.seq_axis = seq_axis
+        # mixed precision: master params/opt-state/aux stay f32; the
+        # forward+backward trace runs in compute_dtype (bf16 feeds the MXU
+        # at 2x f32 rate); grads come back f32 via the cast's transpose.
+        # The reference is fp32-only (real_t = float) — this is the policy
+        # decision SURVEY §7 flags for TPU ("bf16/f32 policy decisions the
+        # reference never faced").
+        self.compute_dtype = (jnp.dtype(compute_dtype)
+                              if compute_dtype is not None else None)
+        self.remat = bool(remat)
 
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
@@ -68,14 +77,39 @@ class ShardedTrainer(object):
         opt_update = optimizer.update_fn
         preprocess = optimizer._preprocess_grad
         trace = self._trace
-        data_keys = self.data_names + self.label_names
+        if self.remat:
+            base_trace = trace
+
+            def trace(args, aux, rng, is_train):
+                return jax.checkpoint(
+                    lambda a: base_trace(a, aux, rng, is_train))(args)
+        cdt = self.compute_dtype
+        label_keys = frozenset(self.label_names)
+
+        def _to_compute(tree):
+            if cdt is None:
+                return tree
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(cdt)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+        def _batch_to_compute(batch):
+            # labels stay f32: class ids above 256 are not bf16-exact and
+            # would one-hot to the wrong class
+            if cdt is None:
+                return batch
+            return {k: (v if k in label_keys else _to_compute(v))
+                    for k, v in batch.items()}
 
         def train_step(params, opt_state, aux, batch, rng, lr, wd, t):
             """One fused step: fwd + bwd + psum(grad) + update."""
             def run(p):
-                args = dict(p)
-                args.update(batch)
-                outs, aux_out = trace(args, aux, rng, True)
+                args = dict(_to_compute(p))
+                args.update(_batch_to_compute(batch))
+                outs, aux_out = trace(args, _to_compute(aux), rng, True)
+                if cdt is not None:  # aux (bn stats) stored f32
+                    aux_out = {k: v.astype(aux[k].dtype)
+                               for k, v in aux_out.items()}
                 return outs, aux_out
 
             (outs, aux_out), vjp_fn = jax.vjp(run, params)
@@ -98,9 +132,9 @@ class ShardedTrainer(object):
         self._jit_step = jax.jit(train_step, donate_argnums=donate_argnums)
 
         def eval_step(params, aux, batch, rng):
-            args = dict(params)
-            args.update(batch)
-            outs, _ = trace(args, aux, rng, False)
+            args = dict(_to_compute(params))
+            args.update(_batch_to_compute(batch))
+            outs, _ = trace(args, _to_compute(aux), rng, False)
             return outs
 
         self._jit_eval = jax.jit(eval_step)

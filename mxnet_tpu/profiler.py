@@ -1,0 +1,95 @@
+"""Profiler: per-step timing + XLA trace capture.
+
+The reference has no working profiler (SURVEY §5: USE_PROFILER is a
+placeholder; observability = Monitor + Speedometer).  The TPU build gets a
+real one by delegating to jax.profiler (xprof traces viewable in
+TensorBoard / Perfetto) and keeping a reference-flavored API:
+
+    mx.profiler.profiler_set_config(filename='profile_dir')
+    mx.profiler.profiler_set_state('run')   # start trace
+    ... training ...
+    mx.profiler.profiler_set_state('stop')  # write trace
+
+plus a lightweight ``StepTimer`` (start/stop/summary) for quick
+throughput numbers without a trace viewer.
+"""
+from __future__ import annotations
+
+import time
+
+from .base import MXNetError
+
+__all__ = ["profiler_set_config", "profiler_set_state", "StepTimer",
+           "annotate"]
+
+_config = {"filename": "mxtpu_profile", "mode": "symbolic"}
+_state = "stop"
+
+
+def profiler_set_config(mode="symbolic", filename="mxtpu_profile"):
+    """Parity: MXSetProfilerConfig (c_api surface of later forks)."""
+    _config["mode"] = mode
+    _config["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """'run' starts a jax.profiler trace into the configured dir;
+    'stop' ends it.  Parity: MXSetProfilerState."""
+    global _state
+    import jax
+    if state == "run":
+        if _state != "run":
+            jax.profiler.start_trace(_config["filename"])
+            _state = "run"
+    elif state == "stop":
+        if _state == "run":
+            jax.profiler.stop_trace()
+            _state = "stop"
+    else:
+        raise MXNetError("profiler state must be 'run' or 'stop'")
+
+
+class annotate:
+    """Context manager naming a region in the trace (TraceAnnotation)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._ctx = None
+
+    def __enter__(self):
+        import jax
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+
+class StepTimer(object):
+    """Cheap step timing: wall clock per step + derived throughput."""
+
+    def __init__(self, batch_size=None):
+        self.batch_size = batch_size
+        self.times = []
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is None:
+            raise MXNetError("StepTimer.stop before start")
+        self.times.append(time.perf_counter() - self._t0)
+        self._t0 = None
+
+    def summary(self, skip_first=1):
+        ts = self.times[skip_first:] or self.times
+        if not ts:
+            return {}
+        mean = sum(ts) / len(ts)
+        out = {"steps": len(ts), "mean_s": mean,
+               "min_s": min(ts), "max_s": max(ts)}
+        if self.batch_size:
+            out["samples_per_sec"] = self.batch_size / mean
+        return out

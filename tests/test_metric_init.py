@@ -149,3 +149,15 @@ def test_speedometer_and_batch_end():
     m.update([mx.nd.array([1])], [mx.nd.array([[0.2, 0.8]])])
     for i in range(3):
         s(BatchEndParam(epoch=0, nbatch=i, eval_metric=m, locals=None))
+
+
+def test_profiler_step_timer_and_annotate():
+    from mxnet_tpu import profiler
+    t = profiler.StepTimer(batch_size=8)
+    for _ in range(3):
+        t.start()
+        t.stop()
+    s = t.summary()
+    assert s["steps"] == 2 and s["samples_per_sec"] > 0
+    with profiler.annotate("region"):
+        pass

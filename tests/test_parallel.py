@@ -139,3 +139,85 @@ def test_batchnorm_aux_updates_in_sharded_step():
     params, opt_state, aux, _ = tr.step(params, opt_state, aux, batch)
     after = np.asarray(aux["bn_moving_mean"])
     assert not np.allclose(before, after)
+
+
+def test_sharded_trainer_bf16_compute():
+    """bf16 compute / f32 master params: step runs, params & aux stay f32,
+    outputs track the f32 run loosely."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu import optimizer as opt_mod
+
+    net = mx.models.get_mlp(num_classes=4, hidden=(16,))
+    r = np.random.RandomState(0)
+    X = r.rand(8, 10).astype(np.float32)
+    y = r.randint(0, 4, (8,)).astype(np.float32)
+
+    outs = {}
+    for tag, cdt in [("f32", None), ("bf16", "bfloat16")]:
+        mesh = make_mesh(jax.devices()[:2], dp=2)
+        mx.random.seed(7)
+        opt = opt_mod.create("sgd", learning_rate=0.1)
+        tr = ShardedTrainer(net, opt, mesh, compute_dtype=cdt)
+        params, opt_state, aux = tr.init_params(
+            {"data": (8, 10)}, label_shapes={"softmax_label": (8,)})
+        batch = tr.shard_batch({"data": X, "softmax_label": y})
+        params, opt_state, aux, out = tr.step(params, opt_state, aux, batch)
+        assert all(v.dtype == jnp.float32 for v in params.values())
+        outs[tag] = np.asarray(out[0], np.float32)
+    # bf16 mantissa is 8 bits: outputs agree to ~1e-2
+    np.testing.assert_allclose(outs["f32"], outs["bf16"],
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_sharded_trainer_remat():
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu import optimizer as opt_mod
+
+    net = mx.models.get_mlp(num_classes=4, hidden=(16,))
+    mesh = make_mesh(jax.devices()[:2], dp=2)
+    opt = opt_mod.create("sgd", learning_rate=0.1)
+    tr = ShardedTrainer(net, opt, mesh, remat=True)
+    params, opt_state, aux = tr.init_params(
+        {"data": (8, 10)}, label_shapes={"softmax_label": (8,)})
+    r = np.random.RandomState(0)
+    batch = tr.shard_batch({
+        "data": r.rand(8, 10).astype(np.float32),
+        "softmax_label": r.randint(0, 4, (8,)).astype(np.float32)})
+    params, opt_state, aux, out = tr.step(params, opt_state, aux, batch)
+    assert np.isfinite(np.asarray(out[0])).all()
+
+
+def test_bf16_labels_stay_exact():
+    """review finding: class ids > 256 must not round through bf16."""
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu import optimizer as opt_mod
+
+    n_cls = 1000
+    net = mx.models.get_mlp(num_classes=n_cls, hidden=(8,))
+    mesh = make_mesh(jax.devices()[:1], dp=1)
+    opt = opt_mod.create("sgd", learning_rate=1.0)
+    tr = ShardedTrainer(net, opt, mesh, compute_dtype="bfloat16")
+    params, opt_state, aux = tr.init_params(
+        {"data": (2, 10)}, label_shapes={"softmax_label": (2,)})
+    X = np.zeros((2, 10), np.float32)
+    y = np.array([999.0, 257.0], np.float32)  # not bf16-representable
+    batch = tr.shard_batch({"data": X, "softmax_label": y})
+    p2, _, _, _ = tr.step(params, opt_state, aux, batch)
+    # the SoftmaxOutput gradient is p - onehot(label): after one big step
+    # from zero-init, the bias column of the TRUE class must move up
+    bias = np.asarray(p2["fc2_bias"], np.float32)
+    assert bias[999] > bias[998] and bias[257] > bias[256], (
+        bias[[256, 257, 998, 999]])
