@@ -16,7 +16,8 @@ from .ndarray import NDArray, array, zeros
 
 __all__ = ["reldiff", "same", "assert_almost_equal", "numeric_grad",
            "check_numeric_gradient", "check_symbolic_forward",
-           "check_symbolic_backward", "default_context", "rand_ndarray"]
+           "check_symbolic_backward", "default_context", "rand_ndarray",
+           "check_consistency"]
 
 _DEFAULT_RTOL = 1e-4
 _DEFAULT_ATOL = 1e-6
@@ -156,3 +157,69 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
         agrad = exe.grad_dict[name].asnumpy()
         assert_almost_equal(agrad, ngrad.astype(_np.float32), rtol, atol,
                             names=("autograd_" + name, "numeric_" + name))
+
+
+def check_consistency(sym, location, ctx_list=None, aux_states=None,
+                      dtypes=(_np.float32,), rtol=1e-3, atol=1e-4,
+                      grad_req="write", scale=1.0):
+    """Cross-configuration consistency harness.
+
+    Parity: test_utils.check_consistency (the reference compares cpu vs
+    gpu executors across dtypes, tests/python/gpu/test_operator_gpu.py).
+    The TPU analog compares, for each dtype:
+      - the compiled path (jit executor) on each ctx in ``ctx_list``
+        (default: every distinct jax platform visible), and
+      - the interpret path (jax.disable_jit) on the first ctx,
+    asserting outputs and input gradients agree with the first
+    configuration.  Returns the list of (outputs, grads) per config.
+    """
+    import jax
+    from .context import Context, cpu as _cpu, tpu as _tpu
+
+    if ctx_list is None:
+        platforms = {d.platform for d in jax.devices()}
+        ctx_list = [_cpu()]
+        if platforms - {"cpu"}:
+            ctx_list.append(_tpu())
+
+    arg_names = sym.list_arguments()
+    if not isinstance(location, dict):
+        location = dict(zip(arg_names, location))
+
+    results = []
+    for dtype in dtypes:
+        loc = {k: _np.asarray(v, dtype=dtype) * scale
+               for k, v in location.items()}
+        configs = [("compiled:%s" % c, c, False) for c in ctx_list]
+        configs.append(("interpret:%s" % ctx_list[0], ctx_list[0], True))
+        base = None
+        for tag, ctx, interpret in configs:
+            def run():
+                exe = _bind(sym, loc, aux_states, grad_req=grad_req,
+                            ctx=ctx)
+                outs = [o.asnumpy()
+                        for o in exe.forward(is_train=True)]
+                exe.backward([array(_np.ones_like(o)) for o in outs])
+                grads = {n: exe.grad_dict[n].asnumpy()
+                         for n in arg_names
+                         if exe.grad_dict.get(n) is not None}
+                return outs, grads
+            if interpret:
+                with jax.disable_jit():
+                    got = run()
+            else:
+                got = run()
+            if base is None:
+                base = (tag, got)
+            else:
+                b_tag, (b_outs, b_grads) = base
+                outs, grads = got
+                for i, (a, b) in enumerate(zip(outs, b_outs)):
+                    assert_almost_equal(a, b, rtol, atol,
+                                        names=(tag, b_tag))
+                for n in b_grads:
+                    assert_almost_equal(grads[n], b_grads[n], rtol, atol,
+                                        names=("grad(%s)@%s" % (n, tag),
+                                               "grad(%s)@%s" % (n, b_tag)))
+            results.append((tag, got))
+    return results

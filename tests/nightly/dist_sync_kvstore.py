@@ -1,0 +1,58 @@
+"""Distributed kvstore semantics test (multi-process on one box).
+
+Parity: tests/nightly/dist_sync_kvstore.py — launched via
+``tools/launch.py -n K --launcher local``; asserts that a pull after
+every worker pushed sees the sum of all workers' contributions
+(sync-mode semantics, kvstore_dist_server.h:164-198 in the reference),
+including a big tensor (the reference's big-array server-sharding case;
+here the collective shards nothing but must still sum correctly).
+
+Run directly:
+    python tools/launch.py -n 2 --launcher local \
+        python tests/nightly/dist_sync_kvstore.py
+"""
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+SHAPE = (2, 3)
+BIG_SHAPE = (1200, 1200)  # > the reference's BIGARRAY_BOUND
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+
+    kv.init(3, mx.nd.ones(SHAPE))
+    kv.init(99, mx.nd.ones(BIG_SHAPE))
+
+    # every worker pushes rank+1; sync pull must see sum(1..nw)
+    kv.push(3, mx.nd.ones(SHAPE) * (rank + 1))
+    kv.push(99, mx.nd.ones(BIG_SHAPE) * (rank + 1))
+    kv.barrier()
+
+    want = sum(range(1, nw + 1))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), want)
+
+    big = mx.nd.zeros(BIG_SHAPE)
+    kv.pull(99, out=big)
+    np.testing.assert_allclose(big.asnumpy(), want)
+
+    # updater path: server-side SGD-like update (set_optimizer contract)
+    kv.set_optimizer(mx.optimizer.create("test"))
+    kv.push(3, mx.nd.ones(SHAPE))
+    out2 = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out2)
+    assert np.isfinite(out2.asnumpy()).all()
+
+    kv.barrier()
+    print("dist_sync_kvstore rank %d/%d OK" % (rank, nw), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Nightly distributed tests (parity: tests/nightly/test_all.sh).
+# Multi-process on one box via the local launcher.
+set -e
+cd "$(dirname "$0")/../.."
+
+echo "== dist_sync_kvstore (2 workers) =="
+python tools/launch.py -n 2 --launcher local \
+    python tests/nightly/dist_sync_kvstore.py
+
+echo "== dist_lenet (2 workers) =="
+python tools/launch.py -n 2 --launcher local \
+    python tests/nightly/dist_lenet.py
+
+echo "ALL NIGHTLY TESTS PASSED"

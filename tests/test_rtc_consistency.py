@@ -1,0 +1,60 @@
+"""Rtc custom kernels + check_consistency harness + nightly smoke."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import assert_almost_equal, check_consistency
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+rng = np.random.RandomState(3)
+
+
+def test_rtc_jax_function():
+    from mxnet_tpu.rtc import Rtc
+    a = mx.nd.array(rng.rand(4, 5).astype(np.float32))
+    b = mx.nd.array(rng.rand(4, 5).astype(np.float32))
+    rtc = Rtc(lambda x, y: x + 2.0 * y, n_outputs=1)
+    (out,) = rtc.push([a, b])
+    assert_almost_equal(out.asnumpy(), a.asnumpy() + 2 * b.asnumpy(),
+                        rtol=1e-6, atol=1e-7)
+
+
+def test_rtc_pallas_kernel():
+    from mxnet_tpu.rtc import Rtc
+
+    def kern(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...] * y_ref[...] + 1.0
+
+    a = mx.nd.array(rng.rand(8, 8).astype(np.float32))
+    b = mx.nd.array(rng.rand(8, 8).astype(np.float32))
+    rtc = Rtc(kern, n_outputs=1, pallas=True)
+    (out,) = rtc.push([a, b])
+    assert_almost_equal(out.asnumpy(), a.asnumpy() * b.asnumpy() + 1.0,
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_check_consistency_catches_agreement():
+    x = sym.Variable("x")
+    net = sym.FullyConnected(x, num_hidden=4, name="fc")
+    net = sym.Activation(net, act_type="tanh")
+    check_consistency(net, {
+        "x": rng.rand(3, 5).astype(np.float32),
+        "fc_weight": rng.rand(4, 5).astype(np.float32) * 0.3,
+        "fc_bias": np.zeros(4, np.float32)})
+
+
+def test_nightly_dist_sync_kvstore_smoke():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/launch.py"), "-n", "2",
+         "--launcher", "local", sys.executable,
+         os.path.join(REPO, "tests/nightly/dist_sync_kvstore.py")],
+        env=env, capture_output=True, text=True, timeout=360)
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-1000:])
+    assert r.stdout.count("OK") == 2, r.stdout
