@@ -1,43 +1,174 @@
 #!/usr/bin/env python
 """Benchmark: ResNet-50 fused training-step throughput (images/sec).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Always prints exactly ONE JSON line:
+    {"metric", "value", "unit", "vs_baseline", ...extras}
+even when the backend is unavailable (value 0 + "error" key) — a bench
+that can exit numberless on a backend hiccup is not a bench.
+
+Architecture: this process is a thin orchestrator that never imports jax
+(the environment's TPU plugin can HANG backend init — it did in round 1).
+The measurement runs in a child subprocess with a hard timeout; on
+timeout/failure the child is retried, then retried on the forced-CPU
+platform, and the last resort is an error JSON line from the parent.
 
 Baseline: the reference's only citable training-throughput figure —
-~170 images/sec, ImageNet-22k Inception on 4×GTX-980 data-parallel
-(docs/tutorials/imagenet_full.md:45; BASELINE.md).  The whole step
-(fwd + bwd + SGD-momentum update, buffers donated) is one XLA
+~170 images/sec, ImageNet-22k Inception on 4×GTX-980 data parallel
+(reference docs/tutorials/imagenet_full.md:45; BASELINE.md).  Here the
+whole step (fwd + bwd + SGD-momentum update, buffers donated) is one XLA
 computation over every visible chip, batch sharded dp.
 
 Env knobs: BENCH_BATCH (per-device batch, default 64), BENCH_STEPS
-(timed steps, default 10), BENCH_LAYERS (default 50).
+(timed steps, default 20), BENCH_LAYERS (default 50), BENCH_DTYPE,
+BENCH_REMAT, BENCH_TIMEOUT (child seconds, default 1500),
+BENCH_PEAK_TFLOPS (override chip peak for the MFU figure).
 """
 import json
 import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+BASELINE_IMAGES_PER_SEC = 170.0
+
+# bf16 peak TFLOPs per chip, keyed on substrings of jax device_kind.
+# Sources: public TPU/GPU spec sheets.  Used only for the MFU extra.
+_PEAK_TFLOPS = [
+    ("v6e", 918.0), ("v6", 918.0),
+    ("v5p", 459.0), ("v5e", 197.0), ("v5litepod", 197.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+    ("H100", 989.0), ("A100", 312.0),
+]
 
 
-def main():
+def _emit(payload):
+    sys.stdout.write(json.dumps(payload) + "\n")
+    sys.stdout.flush()
+
+
+def _run_child(extra_env, timeout):
+    env = dict(os.environ)
+    env.update(extra_env)
+    here = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXTPU_BENCH_CHILD"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            cwd=here, env=env, timeout=timeout,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    except subprocess.TimeoutExpired:
+        return None, "child timed out after %ds" % timeout
+    # the child prints its JSON as the last stdout line
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except ValueError:
+                continue
+    tail = (proc.stderr or "").strip().splitlines()[-3:]
+    return None, "child rc=%s: %s" % (proc.returncode, " | ".join(tail))
+
+
+def _probe_backend(timeout):
+    """Cheap subprocess probe: does ambient backend init even complete?
+    (The TPU plugin here can hang indefinitely — never probe in-process.)"""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print(d[0].platform)"],
+            cwd=here, env=dict(os.environ), timeout=timeout,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    except subprocess.TimeoutExpired:
+        return None, "backend probe timed out after %ds" % timeout
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-2:]
+        return None, "backend probe rc=%s: %s" % (proc.returncode,
+                                                  " | ".join(tail))
+    return proc.stdout.strip(), None
+
+
+def orchestrate():
+    timeout = int(os.environ.get("BENCH_TIMEOUT", "1500"))
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+    errors = []
+    # probe the ambient platform (TPU when the tunnel is live); retry once —
+    # transient UNAVAILABLE from the plugin was the round-1 failure mode
+    platform = None
+    for _ in range(2):
+        platform, err = _probe_backend(probe_timeout)
+        if platform is not None:
+            break
+        errors.append(err)
+        time.sleep(5)
+    if platform is not None:
+        result, err = _run_child({}, timeout)
+        if result is not None:
+            _emit(result)
+            return 0
+        errors.append(err)
+        # one retry on a clean failure (compile caches make it cheaper)
+        result, err = _run_child({}, timeout)
+        if result is not None:
+            _emit(result)
+            return 0
+        errors.append(err)
+    # attempt 3: forced-CPU fallback with tiny shapes — a real (if slow)
+    # number beats no number; platform recorded in the JSON
+    cpu_env = {
+        # BENCH_FORCE_PLATFORM makes the child jax.config.update() the
+        # platform: env vars alone lose to this environment's
+        # sitecustomize, which force-registers the (hanging) TPU plugin
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_FORCE_PLATFORM": "cpu",
+        "BENCH_BATCH": os.environ.get("BENCH_CPU_BATCH", "8"),
+        "BENCH_STEPS": os.environ.get("BENCH_CPU_STEPS", "3"),
+        "BENCH_FALLBACK": "cpu",
+    }
+    result, err = _run_child(cpu_env, min(timeout, 900))
+    if result is not None:
+        _emit(result)
+        return 0
+    errors.append(err)
+    _emit({
+        "metric": "resnet50_train_images_per_sec",
+        "value": 0.0,
+        "unit": "images/sec",
+        "vs_baseline": 0.0,
+        "error": "; ".join(e for e in errors if e),
+    })
+    return 0
+
+
+def measure():
+    """Child: the actual measurement.  May crash/hang — parent defends."""
+    import numpy as np
     import jax
+    forced = os.environ.get("BENCH_FORCE_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
     from mxnet_tpu.models import resnet
     from mxnet_tpu import optimizer as opt_mod
     from mxnet_tpu.parallel import make_mesh
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
-    n_dev = len(jax.devices())
+    devices = jax.devices()
+    n_dev = len(devices)
+    platform = devices[0].platform
+    device_kind = getattr(devices[0], "device_kind", platform)
     per_dev_batch = int(os.environ.get("BENCH_BATCH", "64"))
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
     num_layers = int(os.environ.get("BENCH_LAYERS", "50"))
     global_batch = per_dev_batch * n_dev
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    on_tpu = platform == "tpu"
     # bf16 compute by default on TPU (2x MXU rate; f32 master weights) —
     # the policy knob the fp32-only reference never had (SURVEY §7)
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16" if on_tpu else "")
     remat = os.environ.get("BENCH_REMAT", "") not in ("", "0")
 
-    mesh = make_mesh(jax.devices(), dp=n_dev)
+    mesh = make_mesh(devices, dp=n_dev)
     sym = resnet.get_symbol(num_classes=1000, num_layers=num_layers)
     optimizer = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9,
                                wd=1e-4, rescale_grad=1.0 / global_batch)
@@ -68,14 +199,61 @@ def main():
     dt = time.perf_counter() - t0
 
     images_per_sec = global_batch * steps / dt
-    baseline = 170.0  # ref: 4-GPU data-parallel training throughput
-    print(json.dumps({
+    step_time = dt / steps
+
+    # MFU = model FLOPs per step / step time / total peak FLOPs.
+    # Model FLOPs from XLA's own cost analysis of the compiled step
+    # (counts fwd+bwd+update exactly as executed).
+    flops_per_step = None
+    try:
+        cost = trainer.compiled_step_cost_analysis()
+        if cost and cost.get("flops"):
+            flops_per_step = float(cost["flops"])
+    except Exception:
+        pass
+    if flops_per_step is None:
+        # analytic fallback: ResNet-50 fwd ≈ 4.1e9 FLOPs/img @224², bwd ≈ 2×
+        flops_per_step = 3.0 * 4.1e9 * global_batch * (num_layers / 50.0)
+    peak = None
+    if os.environ.get("BENCH_PEAK_TFLOPS"):
+        peak = float(os.environ["BENCH_PEAK_TFLOPS"])
+    else:
+        for key, val in _PEAK_TFLOPS:
+            if key.lower() in str(device_kind).lower():
+                peak = val
+                break
+    mfu = None
+    if peak:
+        mfu = flops_per_step / step_time / (peak * 1e12 * n_dev)
+
+    donated = None
+    try:
+        donated = trainer.donation_verified()
+    except Exception:
+        pass
+
+    payload = {
         "metric": "resnet%d_train_images_per_sec" % num_layers,
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / baseline, 3),
-    }))
+        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
+        "platform": platform,
+        "device_kind": str(device_kind),
+        "n_devices": n_dev,
+        "global_batch": global_batch,
+        "step_time_ms": round(step_time * 1e3, 2),
+        "compute_dtype": dtype or "float32",
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "model_tflops_per_step": round(flops_per_step / 1e12, 3),
+        "donation_ok": donated,
+    }
+    if os.environ.get("BENCH_FALLBACK"):
+        payload["fallback"] = os.environ["BENCH_FALLBACK"]
+    _emit(payload)
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("MXTPU_BENCH_CHILD"):
+        measure()
+    else:
+        sys.exit(orchestrate())

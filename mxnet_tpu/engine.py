@@ -86,14 +86,30 @@ class ThreadedEngine(Engine):
         self._cbs = {}
         self._cb_lock = threading.Lock()
         self._next_token = [1]
+        # first exception raised by any pushed fn; ctypes swallows
+        # exceptions escaping into the native worker thread (prints and
+        # returns), so record it here and re-raise from wait_* — the
+        # analog of the reference engine aborting on op error.
+        self._first_exc = None
 
         def _trampoline(token):
             with self._cb_lock:
                 fn = self._cbs.pop(token)
-            fn()
+            try:
+                fn()
+            except BaseException as exc:  # noqa: BLE001
+                with self._cb_lock:
+                    if self._first_exc is None:
+                        self._first_exc = exc
 
         self._tramp = _ENGINE_FN_TYPE(
             lambda token: _trampoline(int(token)))
+
+    def _reraise(self):
+        with self._cb_lock:
+            exc, self._first_exc = self._first_exc, None
+        if exc is not None:
+            raise exc
 
     def new_variable(self):
         return self._lib.MXTPUEngineNewVar(self._h)
@@ -116,9 +132,11 @@ class ThreadedEngine(Engine):
 
     def wait_for_var(self, var):
         self._lib.MXTPUEngineWaitForVar(self._h, var)
+        self._reraise()
 
     def wait_for_all(self):
         self._lib.MXTPUEngineWaitForAll(self._h)
+        self._reraise()
 
     def delete_variable(self, var):
         self._lib.MXTPUEngineDeleteVar(self._h, var)

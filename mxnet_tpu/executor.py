@@ -206,6 +206,12 @@ class Executor:
         self._needs_rng = self._program.needs_rng
         self._jit_forward = self._program.jit_forward
         self._jit_fwd_bwd = self._program.jit_fwd_bwd
+        # dispatch counters (one fused call per fit step is the contract
+        # the tests assert — graph_executor.cc:842 bulk-segment analog)
+        self._n_forward = 0
+        self._n_fwd_bwd = 0
+        self._n_fused_step = 0
+        self._fused_cache = None  # (optimizer id, jitted step)
 
     @property
     def _trace(self):
@@ -222,6 +228,7 @@ class Executor:
                 self.arg_dict[name]._set_data(arr.data)
             else:
                 self.arg_dict[name]._set_data(jnp.asarray(arr))
+        self._n_forward += 1
         arg_values = {n: a.data for n, a in self.arg_dict.items()}
         aux_values = {n: a.data for n, a in self.aux_dict.items()}
         rng = _random.next_key() if self._needs_rng else _zero_key()
@@ -256,6 +263,7 @@ class Executor:
             ograds = [g.data if isinstance(g, NDArray) else jnp.asarray(g)
                       for g in out_grads]
         wrt = {n: arg_values[n] for n in wrt_names}
+        self._n_fwd_bwd += 1
         _outs, _aux, grads = self._jit_fwd_bwd(arg_values, aux_values, rng,
                                                ograds, wrt)
         for n in wrt_names:
@@ -282,6 +290,7 @@ class Executor:
             ograds = [g.data if isinstance(g, NDArray) else jnp.asarray(g)
                       for g in out_grads]
         wrt = {n: arg_values[n] for n in wrt_names}
+        self._n_fwd_bwd += 1
         outs, aux_out, grads = self._jit_fwd_bwd(arg_values, aux_values, rng,
                                                  ograds, wrt)
         for i, o in enumerate(outs):
@@ -295,6 +304,99 @@ class Executor:
             else:
                 tgt._set_data(grads[n])
         return self.outputs
+
+    # -- fused train step (fwd + bwd + optimizer update, ONE dispatch) --
+    def _build_fused_step(self, optimizer):
+        """Jit fwd+bwd+update as one XLA computation — the full analog of
+        the reference's bulk segments (graph_executor.cc:842-892): the
+        whole fit step is one dispatch, with the optimizer math fused in
+        (≡ server-side update, kvstore_dist_server.h:164, run on-device)."""
+        trace = self._program.trace
+        wrt_names = tuple(n for n in self._arg_names
+                          if self._grad_req.get(n, "null") != "null")
+        upd = optimizer.update_fn
+        pre = optimizer._preprocess_grad
+        # per-param lr/wd multipliers are static floats at trace time
+        # (reference _get_lr/_get_wd, optimizer.py:122-141)
+        name2idx = {n: i for i, n in optimizer.idx2name.items()}
+        lrm, wdm = {}, {}
+        for n in wrt_names:
+            idx = name2idx.get(n, n)
+            lrm[n] = optimizer.lr_mult.get(
+                idx, optimizer.lr_mult.get(n, 1.0))
+            wdm[n] = optimizer.wd_mult.get(
+                idx, optimizer.wd_mult.get(n, 1.0))
+
+        def step(arg_values, aux_values, rng, states, lr, wd, t):
+            def f(wrt_values):
+                merged = dict(arg_values)
+                merged.update(wrt_values)
+                return trace(merged, aux_values, rng, True)
+
+            wrt = {n: arg_values[n] for n in wrt_names}
+            (outs, aux_out), vjp_fn = jax.vjp(f, wrt)
+            ones = [jnp.ones_like(o) for o in outs]
+            grads = vjp_fn(
+                (ones, jax.tree_util.tree_map(jnp.zeros_like, aux_out)))[0]
+            new_w, new_s = {}, {}
+            for n in wrt_names:
+                g = pre(grads[n])
+                w, s = upd(arg_values[n], g, states.get(n),
+                           lr * lrm[n], wd * wdm[n], t)
+                new_w[n] = w
+                if s is not None:
+                    new_s[n] = s
+            return outs, aux_out, grads, new_w, new_s
+
+        return wrt_names, jax.jit(step, donate_argnums=(3,))
+
+    def fused_step(self, optimizer, states, num_update, **kwargs):
+        """Run one full train step (forward + backward + optimizer update)
+        as a single XLA dispatch.  Writes updated params into the bound
+        arg arrays, grads into grad arrays, aux/outputs as forward does.
+        ``states`` is a dict name -> optimizer-state pytree (jax arrays),
+        mutated-by-replacement and returned.
+        """
+        if self._fused_cache is None or \
+                self._fused_cache[0] is not optimizer:
+            self._fused_cache = (optimizer,
+                                 self._build_fused_step(optimizer))
+        wrt_names, jit_step = self._fused_cache[1]
+        for name, arr in kwargs.items():
+            self.arg_dict[name]._set_data(
+                arr.data if isinstance(arr, NDArray) else jnp.asarray(arr))
+        arg_values = {n: a.data for n, a in self.arg_dict.items()}
+        aux_values = {n: a.data for n, a in self.aux_dict.items()}
+        rng = _random.next_key() if self._needs_rng else _zero_key()
+        if optimizer.lr_scheduler is not None:
+            lr = optimizer.lr_scheduler(num_update)
+        else:
+            lr = optimizer.lr
+        self._n_fused_step += 1
+        outs, aux_out, grads, new_w, new_s = jit_step(
+            arg_values, aux_values, rng, states,
+            jnp.float32(lr), jnp.float32(optimizer.wd),
+            jnp.int32(num_update))
+        for i, o in enumerate(outs):
+            self.outputs[i] = NDArray(o, ctx=self._ctx)
+        for n, a in self.aux_dict.items():
+            a._set_data(aux_out[n])
+        for n in wrt_names:
+            self.grad_dict[n]._set_data(grads[n])
+            self.arg_dict[n]._set_data(new_w[n])
+        return new_s
+
+    def init_fused_states(self, optimizer):
+        """Optimizer-state arrays for every learnable arg (fused path)."""
+        states = {}
+        for n in self._arg_names:
+            if self._grad_req.get(n, "null") == "null":
+                continue
+            a = self.arg_dict[n]
+            s = optimizer.create_state_arrays(a.shape, a.dtype)
+            if s is not None:
+                states[n] = s
+        return states
 
     # -- monitor (MXExecutorSetMonitorCallback parity) ------------------
     def set_monitor_callback(self, callback):

@@ -152,6 +152,12 @@ class DataParallelExecutorGroup(object):
         for texec in self.train_execs:
             texec.backward()
 
+    def forward_backward(self):
+        """Fused fwd+bwd: one XLA dispatch per executor per fit step
+        (never the forward-then-recompute pair)."""
+        for texec in self.train_execs:
+            texec.forward_backward()
+
     def update_metric(self, metric, labels):
         for texec, islice in zip(self.train_execs, self.slices):
             labels_slice = [label[islice] for label in labels]
@@ -297,6 +303,9 @@ class DataParallelExecutorManager(object):
 
     def backward(self):
         self.curr_execgrp.backward()
+
+    def forward_backward(self):
+        self.curr_execgrp.forward_backward()
 
     def update_metric(self, metric, labels):
         self.curr_execgrp.update_metric(metric, labels)

@@ -244,6 +244,16 @@ def _maybe_init_distributed():
     if already:
         _maybe_init_distributed._done = True
         return
+    missing = [k for k in ("MXTPU_NUM_WORKERS", "MXTPU_WORKER_RANK")
+               if k not in os.environ]
+    if missing:
+        raise MXNetError(
+            "partially-configured distributed launch: MXTPU_COORDINATOR is "
+            "set but %s %s missing. tools/launch.py exports all three "
+            "(MXTPU_COORDINATOR, MXTPU_NUM_WORKERS, MXTPU_WORKER_RANK); "
+            "set them together or unset MXTPU_COORDINATOR for single-"
+            "process mode." % (" and ".join(missing),
+                               "is" if len(missing) == 1 else "are"))
     try:
         jax.distributed.initialize(
             coordinator_address=coord,

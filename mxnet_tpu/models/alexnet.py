@@ -1,37 +1,42 @@
-"""AlexNet (reference: example/image-classification/symbols/alexnet.py)."""
+"""AlexNet (Krizhevsky et al., 2012), spec-table construction.
+
+Architecture constants match the reference zoo entry
+(example/image-classification/symbol_alexnet.py) so checkpoints and the
+BASELINE configs line up; the builder itself is table-driven: each row of
+``_CONV_STAGES`` is one conv stage (filters, kernel, stride, pad, then
+optional max-pool / local-response-norm), and the classifier is two
+dropout-regularized FC layers ahead of the softmax head.
+"""
 from .. import symbol as sym
+
+# (num_filter, kernel, stride, pad, pool_after, lrn_after)
+_CONV_STAGES = (
+    (96,  (11, 11), (4, 4), (0, 0), True,  True),
+    (256, (5, 5),   (1, 1), (2, 2), True,  True),
+    (384, (3, 3),   (1, 1), (1, 1), False, False),
+    (384, (3, 3),   (1, 1), (1, 1), False, False),
+    (256, (3, 3),   (1, 1), (1, 1), True,  False),
+)
+
+_FC_WIDTH = 4096
+_DROP_P = 0.5
 
 
 def get_symbol(num_classes=1000):
-    data = sym.Variable("data")
-    # stage 1
-    conv1 = sym.Convolution(data=data, kernel=(11, 11), stride=(4, 4),
-                            num_filter=96)
-    relu1 = sym.Activation(data=conv1, act_type="relu")
-    pool1 = sym.Pooling(data=relu1, pool_type="max", kernel=(3, 3), stride=(2, 2))
-    lrn1 = sym.LRN(data=pool1, alpha=0.0001, beta=0.75, knorm=1, nsize=5)
-    # stage 2
-    conv2 = sym.Convolution(data=lrn1, kernel=(5, 5), pad=(2, 2), num_filter=256)
-    relu2 = sym.Activation(data=conv2, act_type="relu")
-    pool2 = sym.Pooling(data=relu2, kernel=(3, 3), stride=(2, 2), pool_type="max")
-    lrn2 = sym.LRN(data=pool2, alpha=0.0001, beta=0.75, knorm=1, nsize=5)
-    # stage 3
-    conv3 = sym.Convolution(data=lrn2, kernel=(3, 3), pad=(1, 1), num_filter=384)
-    relu3 = sym.Activation(data=conv3, act_type="relu")
-    conv4 = sym.Convolution(data=relu3, kernel=(3, 3), pad=(1, 1), num_filter=384)
-    relu4 = sym.Activation(data=conv4, act_type="relu")
-    conv5 = sym.Convolution(data=relu4, kernel=(3, 3), pad=(1, 1), num_filter=256)
-    relu5 = sym.Activation(data=conv5, act_type="relu")
-    pool3 = sym.Pooling(data=relu5, kernel=(3, 3), stride=(2, 2), pool_type="max")
-    # stage 4
-    flatten = sym.Flatten(data=pool3)
-    fc1 = sym.FullyConnected(data=flatten, num_hidden=4096)
-    relu6 = sym.Activation(data=fc1, act_type="relu")
-    dropout1 = sym.Dropout(data=relu6, p=0.5)
-    # stage 5
-    fc2 = sym.FullyConnected(data=dropout1, num_hidden=4096)
-    relu7 = sym.Activation(data=fc2, act_type="relu")
-    dropout2 = sym.Dropout(data=relu7, p=0.5)
-    # stage 6
-    fc3 = sym.FullyConnected(data=dropout2, num_hidden=num_classes)
-    return sym.SoftmaxOutput(data=fc3, name="softmax")
+    x = sym.Variable("data")
+    for filters, kernel, stride, pad, pool, lrn in _CONV_STAGES:
+        x = sym.Convolution(data=x, num_filter=filters, kernel=kernel,
+                            stride=stride, pad=pad)
+        x = sym.Activation(data=x, act_type="relu")
+        if pool:
+            x = sym.Pooling(data=x, pool_type="max", kernel=(3, 3),
+                            stride=(2, 2))
+        if lrn:
+            x = sym.LRN(data=x, alpha=0.0001, beta=0.75, knorm=1, nsize=5)
+    x = sym.Flatten(data=x)
+    for _ in range(2):
+        x = sym.FullyConnected(data=x, num_hidden=_FC_WIDTH)
+        x = sym.Activation(data=x, act_type="relu")
+        x = sym.Dropout(data=x, p=_DROP_P)
+    x = sym.FullyConnected(data=x, num_hidden=num_classes)
+    return sym.SoftmaxOutput(data=x, name="softmax")

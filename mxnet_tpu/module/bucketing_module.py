@@ -180,6 +180,14 @@ class BucketingModule(BaseModule):
         assert self.binded and self.params_initialized
         self._curr_module.backward(out_grads=out_grads)
 
+    def forward_backward(self, data_batch):
+        """Route through the bucket's Module so its fused-step path (one
+        XLA dispatch per fit step) applies per bucket."""
+        assert self.binded and self.params_initialized
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward_backward(data_batch)
+
     def update(self):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized

@@ -138,6 +138,23 @@ class DataParallelExecutorGroup(object):
         for exec_ in self.execs:
             exec_.forward(is_train=is_train)
 
+    def forward_backward(self, data_batch):
+        """Fused fwd+bwd: ONE XLA dispatch per executor instead of the
+        forward-then-recompute-in-backward pair (the fit-path hot loop)."""
+        if not self.for_training:
+            raise MXNetError("re-bind with for_training=True to run backward")
+        self.load_data_batch(data_batch)
+        for exec_ in self.execs:
+            exec_.forward_backward()
+
+    def fused_step(self, data_batch, optimizer, states, num_update):
+        """Whole train step (fwd+bwd+optimizer update) as one dispatch;
+        single-executor groups only (multi-ctx keeps the host reduce)."""
+        if len(self.execs) != 1:
+            raise MXNetError("fused_step requires a single-context group")
+        self.load_data_batch(data_batch)
+        return self.execs[0].fused_step(optimizer, states, num_update)
+
     def backward(self, out_grads=None):
         if not self.for_training:
             raise MXNetError("re-bind with for_training=True to run backward")

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import logging
 
+import numpy as _np
+
 from ..base import MXNetError
 from .. import context as ctx_mod
 from .. import optimizer as opt_mod
@@ -61,6 +63,11 @@ class Module(BaseModule):
         self._updater = None
         self._exec_group = None
         self._preload_opt_states = None
+        # fused-step state (fwd+bwd+update as one XLA dispatch); the
+        # holder is shared across modules that borrow_optimizer (bucketing)
+        # so momentum/num_update stay consistent between buckets
+        self._fused_holder = None       # {"states": name->pytree, "num_update": int}
+        self._fused_update_done = False
 
     # ------------------------------------------------------------------
     @property
@@ -252,6 +259,8 @@ class Module(BaseModule):
             kvstore.set_optimizer(self._optimizer)
         else:
             self._updater = opt_mod.get_updater(optimizer)
+        self._fused_holder = {"states": None,
+                              "num_update": optimizer.begin_num_update}
 
         self.optimizer_initialized = True
         if self._preload_opt_states is not None:
@@ -264,6 +273,7 @@ class Module(BaseModule):
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        self._fused_holder = shared_module._fused_holder
         self.optimizer_initialized = True
 
     # ------------------------------------------------------------------
@@ -275,11 +285,54 @@ class Module(BaseModule):
         self._assert_binded()
         self._exec_group.backward(out_grads=out_grads)
 
+    def _fused_step_ok(self):
+        """The whole-step fusion is valid when the update is local (no
+        kvstore), single-context, grad_req=write, the optimizer uses the
+        pure update_fn path, and no monitor wants per-op eager output."""
+        import os
+        if os.environ.get("MXNET_MODULE_FUSED", "1") == "0":
+            return False
+        return (self.optimizer_initialized
+                and not self._update_on_kvstore
+                and self._kvstore is None
+                and self._exec_group is not None
+                and len(self._exec_group.execs) == 1
+                and self._grad_req == "write"
+                and type(self._optimizer).update is opt_mod.Optimizer.update
+                and self._exec_group.execs[0]._monitor_callback is None)
+
+    def forward_backward(self, data_batch):
+        """Fit-path hot loop: one fused XLA dispatch per step.  When the
+        optimizer update can be folded in (local single-ctx training) the
+        dispatch includes it and the following update() is a no-op —
+        ≡ the reference's bulk segments + server-side update combined
+        (graph_executor.cc:842, kvstore_dist_server.h:164)."""
+        self._assert_binded()
+        if self._fused_step_ok():
+            holder = self._fused_holder
+            exec_ = self._exec_group.execs[0]
+            if holder["states"] is None:
+                holder["states"] = exec_.init_fused_states(self._optimizer)
+            holder["num_update"] += 1
+            self._optimizer.num_update = holder["num_update"]
+            holder["states"] = self._exec_group.fused_step(
+                data_batch, self._optimizer, holder["states"],
+                holder["num_update"])
+            self._params_dirty = True
+            self._fused_update_done = True
+        else:
+            self._exec_group.forward_backward(data_batch)
+            self._fused_update_done = False
+
     def update(self):
         self._assert_binded()
         if not self.optimizer_initialized:
             raise MXNetError("init_optimizer before update")
         self._params_dirty = True
+        if self._fused_update_done:
+            # params were updated inside the fused step dispatch
+            self._fused_update_done = False
+            return
         from ..model import _update_params_on_kvstore, _update_params
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
@@ -340,10 +393,18 @@ class Module(BaseModule):
             self._kvstore.save_optimizer_states(fname)
         else:
             import pickle
+            payload = self._updater.states \
+                if hasattr(self._updater, "states") else {}
+            holder = self._fused_holder
+            if holder and holder["states"] is not None:
+                import jax as _jax
+                payload = {
+                    "__fused__": _jax.tree_util.tree_map(
+                        lambda a: _np.asarray(a), holder["states"]),
+                    "__num_update__": holder["num_update"],
+                }
             with open(fname, "wb") as fout:
-                fout.write(pickle.dumps(self._updater.states
-                                        if hasattr(self._updater, "states")
-                                        else {}))
+                fout.write(pickle.dumps(payload))
 
     def load_optimizer_states(self, fname):
         if not self.optimizer_initialized:
@@ -354,7 +415,14 @@ class Module(BaseModule):
             import pickle
             with open(fname, "rb") as fin:
                 states = pickle.loads(fin.read())
-            if hasattr(self._updater, "states"):
+            if isinstance(states, dict) and "__fused__" in states:
+                import jax as _jax
+                import jax.numpy as _jnp
+                holder = self._fused_holder
+                holder["states"] = _jax.tree_util.tree_map(
+                    _jnp.asarray, states["__fused__"])
+                holder["num_update"] = states.get("__num_update__", 0)
+            elif hasattr(self._updater, "states"):
                 self._updater.states.update(states)
 
     def reshape(self, data_shapes, label_shapes=None):

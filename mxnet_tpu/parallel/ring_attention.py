@@ -97,9 +97,11 @@ def blockwise_combine(q, kv_blocks, causal=False, scale=None, q_offset=0,
 # ----------------------------------------------------------------------
 # Pallas flash attention (TPU)
 # ----------------------------------------------------------------------
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
-                  seq_k):
-    """Grid: (batch*heads, q_blocks).  One q block vs all k blocks."""
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
+                  scale, seq_k):
+    """Grid: (batch*heads, q_blocks).  One q block vs all k blocks.
+    Outputs the normalized o block and the logsumexp stats (saved for the
+    blockwise backward)."""
     q = q_ref[...].astype(jnp.float32)  # (block_q, d)
     block_q = q.shape[0]
     import jax.experimental.pallas as pl
@@ -133,7 +135,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
         return m_new, l_new, o_new
 
     m, l, o = lax.fori_loop(0, n_k_blocks, body, (m, l, o))
-    o_ref[...] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[...] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = (m + jnp.log(l_safe)).astype(jnp.float32)
 
 
 def _flash_forward_kernel_call(q, k, v, causal, scale, block_q, block_k,
@@ -148,7 +152,7 @@ def _flash_forward_kernel_call(q, k, v, causal, scale, block_q, block_k,
 
     kernel = functools.partial(_flash_kernel, block_k=block_k,
                                causal=causal, scale=scale, seq_k=sk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Sq // block_q),
         in_specs=[
@@ -156,20 +160,73 @@ def _flash_forward_kernel_call(q, k, v, causal, scale, block_q, block_k,
             pl.BlockSpec((None, sk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, sk, D), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sq), jnp.float32),
+        ],
         interpret=interpret,
     )(q3, k3, v3)
-    return out.reshape(B, H, Sq, D)
+    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
+
+
+def _flash_backward_blockwise(q, k, v, o, lse, do, causal, scale, block_k):
+    """Flash-attention backward: blockwise recompute from the saved
+    logsumexp stats — per-iteration footprint is O(Sq · block_k), never
+    the full (Sq, Sk) score matrix (the training-path memory guarantee
+    the fused forward alone does not give).
+
+    Standard identities (p = exp(s·scale − lse)):
+        dv_j = pᵀ @ do
+        ds   = p ⊙ (do @ vᵀ − rowsum(do ⊙ o)) · scale
+        dq  += ds @ k_j,   dk_j = dsᵀ @ q
+    """
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)   # (B, H, Sq)
+    sq = q.shape[-2]
+    sk = k.shape[-2]
+    n_blocks = sk // block_k
+
+    def body(i, carry):
+        dq, dk, dv = carry
+        kb = lax.dynamic_slice_in_dim(k, i * block_k, block_k,
+                                      axis=-2).astype(jnp.float32)
+        vb = lax.dynamic_slice_in_dim(v, i * block_k, block_k,
+                                      axis=-2).astype(jnp.float32)
+        s = jnp.einsum("...qd,...kd->...qk", qf, kb) * scale
+        if causal:
+            qpos = jnp.arange(sq)[:, None]
+            kpos = i * block_k + jnp.arange(block_k)[None, :]
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])
+        dvb = jnp.einsum("...qk,...qd->...kd", p, dof)
+        dp = jnp.einsum("...qd,...kd->...qk", dof, vb)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("...qk,...kd->...qd", ds, kb)
+        dkb = jnp.einsum("...qk,...qd->...kd", ds, qf)
+        dk = lax.dynamic_update_slice_in_dim(dk, dkb, i * block_k, axis=-2)
+        dv = lax.dynamic_update_slice_in_dim(dv, dvb, i * block_k, axis=-2)
+        return dq, dk, dv
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    dq, dk, dv = lax.fori_loop(0, n_blocks, body, (dq0, dk0, dv0))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
                     block_k=128, interpret=None):
     """Fused attention; q/k/v (B, H, S, D).  Pallas on TPU, jnp elsewhere.
 
-    Differentiable: the forward runs the fused kernel; the backward is the
-    VJP of the (mathematically identical) reference attention, attached
-    via custom_vjp — pallas_call itself has no transpose rule.
+    Differentiable: the forward runs the fused kernel and saves the
+    logsumexp stats; the backward is the blockwise flash backward
+    (recompute per kv block from the stats — O(Sq·block_k) live memory,
+    never the (Sq, Sk) score matrix), attached via custom_vjp.
 
     Sequence lengths must be multiples of the block sizes for the kernel
     path (pad upstream); otherwise falls back to the reference
@@ -188,18 +245,19 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
 
     @jax.custom_vjp
     def _fa(q, k, v):
-        return _flash_forward_kernel_call(q, k, v, causal, scale,
-                                          block_q, block_k, interpret)
+        out, _ = _flash_forward_kernel_call(q, k, v, causal, scale,
+                                            block_q, block_k, interpret)
+        return out
 
     def _fa_fwd(q, k, v):
-        return _fa(q, k, v), (q, k, v)
+        out, lse = _flash_forward_kernel_call(q, k, v, causal, scale,
+                                              block_q, block_k, interpret)
+        return out, (q, k, v, out, lse)
 
     def _fa_bwd(res, ct):
-        q, k, v = res
-        _, vjp_fn = jax.vjp(
-            lambda a, b, c: attention_reference(a, b, c, causal=causal,
-                                                scale=scale), q, k, v)
-        return vjp_fn(ct)
+        q, k, v, out, lse = res
+        return _flash_backward_blockwise(q, k, v, out, lse, ct, causal,
+                                         scale, block_k)
 
     _fa.defvjp(_fa_fwd, _fa_bwd)
     return _fa(q, k, v)

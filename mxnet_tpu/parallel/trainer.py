@@ -29,6 +29,14 @@ from .sharding import param_pspec, batch_pspec
 __all__ = ["ShardedTrainer"]
 
 
+def _abstractify(a):
+    """ShapeDtypeStruct (with sharding when present) for jit.lower()."""
+    if hasattr(a, "sharding"):
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+    a = jnp.asarray(a)
+    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+
 class ShardedTrainer(object):
     """Compile a Symbol's train step over a Mesh.
 
@@ -45,7 +53,8 @@ class ShardedTrainer(object):
 
     def __init__(self, symbol, optimizer, mesh, data_names=("data",),
                  label_names=("softmax_label",), rules=None, seq_axis=None,
-                 donate=True, compute_dtype=None, remat=False):
+                 donate=True, compute_dtype=None, remat=False,
+                 cast_exempt=()):
         self.symbol = symbol
         self.optimizer = optimizer
         self.mesh = mesh
@@ -84,7 +93,20 @@ class ShardedTrainer(object):
                 return jax.checkpoint(
                     lambda a: base_trace(a, aux, rng, is_train))(args)
         cdt = self.compute_dtype
-        label_keys = frozenset(self.label_names)
+        # integer-valued inputs must never be cast to bf16: bf16 represents
+        # integers exactly only up to 256, so class labels and Embedding
+        # vocab ids above that would silently round to the wrong id.
+        # Exempt labels, caller-listed names, and any variable feeding an
+        # Embedding's id slot (detected from the graph).
+        exempt = set(self.label_names) | set(cast_exempt)
+        for node in symbol._topo():
+            if node.op is not None \
+                    and getattr(node.op, "op_name", "") == "Embedding":
+                src, _ = node.inputs[0]
+                if src.is_variable:
+                    exempt.add(src.name)
+        self._cast_exempt = frozenset(exempt)
+        exempt_keys = self._cast_exempt
 
         def _to_compute(tree):
             if cdt is None:
@@ -94,11 +116,9 @@ class ShardedTrainer(object):
                 if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
 
         def _batch_to_compute(batch):
-            # labels stay f32: class ids above 256 are not bf16-exact and
-            # would one-hot to the wrong class
             if cdt is None:
                 return batch
-            return {k: (v if k in label_keys else _to_compute(v))
+            return {k: (v if k in exempt_keys else _to_compute(v))
                     for k, v in batch.items()}
 
         def train_step(params, opt_state, aux, batch, rng, lr, wd, t):
@@ -130,6 +150,8 @@ class ShardedTrainer(object):
 
         donate_argnums = (0, 1, 2) if donate else ()
         self._jit_step = jax.jit(train_step, donate_argnums=donate_argnums)
+        self._abstract_args = None   # ShapeDtypeStructs of the step args
+        self._lowered = None         # cached jax.stages.Lowered
 
         def eval_step(params, aux, batch, rng):
             args = dict(_to_compute(params))
@@ -221,16 +243,56 @@ class ShardedTrainer(object):
             from .. import random as _random
             rng = _random.next_key() if self._needs_rng \
                 else jax.random.PRNGKey(0)
+        step_args = (params, opt_state, aux, batch, rng,
+                     jnp.float32(lr), jnp.float32(opt.wd),
+                     jnp.int32(self.num_update))
+        if self._abstract_args is None:
+            self._abstract_args = jax.tree_util.tree_map(
+                _abstractify, step_args)
         with self._sp_scope():
-            return self._jit_step(params, opt_state, aux, batch, rng,
-                                  jnp.float32(lr), jnp.float32(opt.wd),
-                                  jnp.int32(self.num_update))
+            return self._jit_step(*step_args)
 
     def eval(self, params, aux, batch, rng=None):
         if rng is None:
             rng = jax.random.PRNGKey(0)
         with self._sp_scope():
             return self._jit_eval(params, aux, batch, rng)
+
+    # ------------------------------------------------------------------
+    # introspection (bench/MFU support)
+    # ------------------------------------------------------------------
+    def _lower(self):
+        """Lowered form of the step at the shapes/shardings of the first
+        executed step (needs one step() call first)."""
+        if self._lowered is None and self._abstract_args is not None:
+            with self._sp_scope():
+                self._lowered = self._jit_step.lower(*self._abstract_args)
+        return self._lowered
+
+    def compiled_step_cost_analysis(self):
+        """XLA cost analysis of the whole train step (dict with 'flops'),
+        or None before the first step."""
+        lowered = self._lower()
+        if lowered is None:
+            return None
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else None
+        return cost
+
+    def donation_verified(self):
+        """True iff XLA actually aliased donated inputs to outputs (the
+        in-place-update guarantee), from the executable's memory analysis."""
+        lowered = self._lower()
+        if lowered is None:
+            return None
+        mem = lowered.compile().memory_analysis()
+        if mem is None:
+            return None
+        alias = getattr(mem, "alias_size_in_bytes", None)
+        if alias is None:
+            return None
+        return alias > 0
 
     def _sp_scope(self):
         """Active sequence-parallel context while tracing/running the step:

@@ -93,6 +93,10 @@ class _TorchModule(OperatorProperty):
             return [y.numpy().astype(dtype)], aux_data
 
         def host_backward(out_grad, in_data, out_data, aux_data):
+            # zero module param grads first: this backward replays once per
+            # step (and per jit replay), and torch .grad accumulates —
+            # without this the owner's parameter grads grow without bound
+            module.zero_grad(set_to_none=False)
             t = torch.from_numpy(
                 np.ascontiguousarray(in_data[0])).requires_grad_(True)
             y = module(t)

@@ -182,3 +182,26 @@ def test_native_indexed_recordio(tmp_path):
     for i in (5, 0, 19, 7):
         assert r.read_idx(i) == b"rec-%03d" % i
     r.close()
+
+
+@pytest.mark.skipif(not HAS_NATIVE, reason="native lib not built")
+def test_pushed_fn_exception_reraised_from_wait():
+    """An exception in a pushed fn must not vanish into the ctypes
+    trampoline on the native worker thread: the engine records the first
+    failure and re-raises it from wait_for_all / wait_for_var (the analog
+    of the reference engine aborting on op error)."""
+    e = eng.ThreadedEngine(num_threads=2)
+    v = e.new_variable()
+
+    def boom():
+        raise ValueError("op failed on worker")
+
+    e.push(boom, mutable_vars=[v])
+    with pytest.raises(ValueError, match="op failed on worker"):
+        e.wait_for_all()
+    # failure is consumed: the engine stays usable afterwards
+    hits = []
+    e.push(lambda: hits.append(1), mutable_vars=[v])
+    e.wait_for_var(v)
+    assert hits == [1]
+    e.delete_variable(v)

@@ -319,3 +319,50 @@ def test_monitor():
     assert len(res) > 0
     names = [k for _, k, _ in res]
     assert any("weight" in n for n in names)
+
+
+def test_fit_step_is_one_fused_dispatch():
+    """VERDICT r1: the fit hot loop must be ONE trace execution per step —
+    fwd+bwd+update fused (no forward-then-recompute-in-backward pair)."""
+    X, y = _toy_problem()
+    n_batches = len(X) // 20
+    train = mx.io.NDArrayIter(X, y, batch_size=20)
+    net = mx.models.get_mlp(num_classes=2, hidden=(16,))
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Uniform(0.1), num_epoch=2)
+    exec_ = mod._exec_group.execs[0]
+    assert exec_._n_fused_step == 2 * n_batches, (
+        exec_._n_fused_step, n_batches)
+    assert exec_._n_forward == 0, exec_._n_forward
+    assert exec_._n_fwd_bwd == 0, exec_._n_fwd_bwd
+    # and the fused path must actually learn
+    score = dict(mod.score(mx.io.NDArrayIter(X, y, batch_size=20), "acc"))
+    assert score["accuracy"] > 0.9, score
+
+
+def test_fused_and_host_update_paths_agree():
+    """Fused in-step optimizer update ≡ the host updater path (same math,
+    one dispatch instead of 1 + P)."""
+    X, y = _toy_problem(n=100)
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+    params = {}
+    for tag, env in (("fused", "1"), ("host", "0")):
+        import os
+        os.environ["MXNET_MODULE_FUSED"] = env
+        try:
+            mx.random.seed(42)
+            train = mx.io.NDArrayIter(X, y, batch_size=20)
+            mod = mx.mod.Module(net, context=mx.cpu())
+            mod.fit(train, optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.1,
+                                      "momentum": 0.9, "wd": 1e-3},
+                    initializer=mx.init.Uniform(0.1), num_epoch=3)
+            params[tag] = {k: v.asnumpy()
+                           for k, v in mod.get_params()[0].items()}
+        finally:
+            del os.environ["MXNET_MODULE_FUSED"]
+    for k in params["fused"]:
+        np.testing.assert_allclose(params["fused"][k], params["host"][k],
+                                   rtol=1e-4, atol=1e-5)

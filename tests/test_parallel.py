@@ -221,3 +221,34 @@ def test_bf16_labels_stay_exact():
     bias = np.asarray(p2["fc2_bias"], np.float32)
     assert bias[999] > bias[998] and bias[257] > bias[256], (
         bias[[256, 257, 998, 999]])
+
+
+def test_bf16_embedding_ids_stay_exact():
+    """advisor finding: vocab ids > 256 are not bf16-representable; inputs
+    feeding an Embedding's id slot must be exempt from the compute cast."""
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.models import transformer
+
+    V, S = 1000, 4
+    net = transformer.get_symbol(vocab_size=V, num_layers=1, num_heads=2,
+                                 dim=16, seq_len=S)
+    mesh = make_mesh(jax.devices()[:1], dp=1)
+    tr = ShardedTrainer(net, opt_mod.create("sgd", learning_rate=1.0),
+                        mesh, compute_dtype="bfloat16")
+    assert "data" in tr._cast_exempt  # detected from the Embedding node
+    params, opt_state, aux = tr.init_params(
+        {"data": (2, S)}, label_shapes={"softmax_label": (2, S)})
+    ids = np.full((2, S), 999.0, np.float32)  # 999 rounds to 1000 in bf16
+    batch = tr.shard_batch({"data": ids, "softmax_label": ids})
+    p0 = {k: np.asarray(v) for k, v in params.items()}
+    p2, _, _, _ = tr.step(params, opt_state, aux, batch)
+    # only embedding row 999 (not 1000's neighborhood via rounding) moves
+    emb_delta = np.abs(np.asarray(p2["tok_embed_weight"], np.float32)
+                       - p0["tok_embed_weight"]).sum(axis=1)
+    assert emb_delta[999] > 0
+    assert emb_delta[996] == 0 and emb_delta[992] == 0

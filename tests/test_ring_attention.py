@@ -194,16 +194,19 @@ def test_transformer_sharded_trainer_sp():
     assert_almost_equal(outs["single"], outs["sp"], rtol=1e-3, atol=1e-4)
 
 
-def test_flash_kernel_differentiable():
-    """review finding: pallas forward must carry a VJP (TPU training path)."""
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_differentiable(causal):
+    """The pallas forward carries a blockwise flash backward (recompute
+    from saved logsumexp, O(Sq·block_k) memory) — must match reference
+    grads exactly."""
     q, k, v = _qkv(B=1, H=1, S=16, D=8)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=8,
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=8,
                                        block_k=8, interpret=True) ** 2)
 
     def loss_ref(q, k, v):
-        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
