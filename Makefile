@@ -6,8 +6,13 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -pthread
 
 LIBDIR := lib
-SRCS := src/engine.cc src/recordio.cc
+SRCS := src/engine.cc src/recordio.cc src/image.cc
 OBJS := $(SRCS:src/%.cc=$(LIBDIR)/%.o)
+# link libjpeg only where the header (and thus the decode kernel) exists;
+# src/image.cc degrades to a stub otherwise and the engine/recordio parts
+# of the library still build
+HAS_JPEG := $(shell printf '\043include <cstdio>\n\043include <jpeglib.h>\nint main(){return 0;}\n' | $(CXX) -x c++ - -ljpeg -o /dev/null 2>/dev/null && echo 1)
+LDLIBS := $(if $(HAS_JPEG),-ljpeg,)
 
 all: $(LIBDIR)/libmxtpu.so
 
@@ -18,7 +23,7 @@ $(LIBDIR)/%.o: src/%.cc | $(LIBDIR)
 	$(CXX) $(CXXFLAGS) -c $< -o $@
 
 $(LIBDIR)/libmxtpu.so: $(OBJS)
-	$(CXX) $(CXXFLAGS) -shared $(OBJS) -o $@
+	$(CXX) $(CXXFLAGS) -shared $(OBJS) -o $@ $(LDLIBS)
 
 clean:
 	rm -rf $(LIBDIR)

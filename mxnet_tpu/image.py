@@ -85,11 +85,60 @@ def imresize(img, w, h):
     return out
 
 
-def augment(img, data_shape, rand_crop=False, rand_mirror=False, rng=None):
+def _rotate(img, angle):
+    """Rotate about the centre, keeping size (image_aug_default.cc rotate)."""
+    if _cv2 is not None:
+        h, w = img.shape[:2]
+        mat = _cv2.getRotationMatrix2D((w / 2.0, h / 2.0), angle, 1.0)
+        out = _cv2.warpAffine(img, mat, (w, h), flags=_cv2.INTER_LINEAR)
+    else:
+        pimg = _PILImage.fromarray(img.squeeze() if img.shape[2] == 1 else img)
+        out = _np.asarray(pimg.rotate(angle, _PILImage.BILINEAR),
+                          dtype=img.dtype)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return out
+
+
+def _jitter_hsl(img, dh, ds, dl, rng):
+    """Random hue/saturation/lightness shift (image_aug_default.cc HSL).
+
+    Offsets are drawn uniform in [-d, d] per channel, matching the
+    reference's random_h/random_s/random_l semantics on 0-255 images.
+    """
+    if dh <= 0 and ds <= 0 and dl <= 0:
+        return img
+    if img.shape[2] != 3 or _cv2 is None:
+        # grayscale or no cv2: lightness jitter only
+        off = rng.uniform(-dl, dl) if dl > 0 else 0.0
+        return _np.clip(img.astype(_np.float32) + off, 0, 255).astype(img.dtype)
+    hls = _cv2.cvtColor(img, _cv2.COLOR_RGB2HLS).astype(_np.float32)
+    if dh > 0:
+        hls[:, :, 0] = (hls[:, :, 0] + rng.uniform(-dh, dh) * 180.0 / 255.0) % 180.0
+    if dl > 0:
+        hls[:, :, 1] = hls[:, :, 1] + rng.uniform(-dl, dl)
+    if ds > 0:
+        hls[:, :, 2] = hls[:, :, 2] + rng.uniform(-ds, ds)
+    hls = _np.clip(hls, 0, 255)
+    hls[:, :, 0] = _np.clip(hls[:, :, 0], 0, 179)
+    return _cv2.cvtColor(hls.astype(_np.uint8), _cv2.COLOR_HLS2RGB)
+
+
+def augment(img, data_shape, rand_crop=False, rand_mirror=False, rng=None,
+            max_rotate_angle=0, min_random_scale=1.0, max_random_scale=1.0,
+            random_h=0, random_s=0, random_l=0):
     """Default augmenter (parity: image_aug_default.cc DefaultImageAugmenter):
-    resize-to-fit + (random|center) crop to data_shape (C,H,W) + mirror."""
+    random scale + rotate + (random|center) crop to data_shape (C,H,W) +
+    mirror + HSL jitter.  All knobs default off, matching the reference's
+    ImageRecordIter parameter defaults."""
     rng = rng or _np.random
     c, th, tw = data_shape
+    if max_rotate_angle > 0:
+        img = _rotate(img, rng.uniform(-max_rotate_angle, max_rotate_angle))
+    if max_random_scale != 1.0 or min_random_scale != 1.0:
+        s = rng.uniform(min_random_scale, max_random_scale)
+        h, w = img.shape[:2]
+        img = imresize(img, max(tw, int(w * s + 0.5)), max(th, int(h * s + 0.5)))
     h, w = img.shape[:2]
     # upscale if needed so a crop fits
     if h < th or w < tw:
@@ -106,6 +155,8 @@ def augment(img, data_shape, rand_crop=False, rand_mirror=False, rng=None):
     img = img[y:y + th, x:x + tw]
     if rand_mirror and rng.randint(0, 2):
         img = img[:, ::-1]
+    if random_h or random_s or random_l:
+        img = _jitter_hsl(img, random_h, random_s, random_l, rng)
     if img.shape[2] != c:
         if c == 1:
             img = img.mean(axis=2, keepdims=True).astype(img.dtype)

@@ -13,6 +13,7 @@ with its shard so a pod host only touches 1/num_parts of the data.
 """
 from __future__ import annotations
 
+import functools as _functools
 import threading
 from collections import namedtuple
 
@@ -435,59 +436,421 @@ def MNISTIter(image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
                        shuffle=False, last_batch_handle="discard")
 
 
-def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
-                    shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
-                    scale=1.0, rand_crop=False, rand_mirror=False,
-                    num_parts=1, part_index=0, preprocess_threads=4,
-                    seed=0, **kwargs):
-    """Image RecordIO iterator (parity: iter_image_recordio.cc ImageRecordIter).
+def _scan_record_offsets(path, begin, end):
+    """Byte offsets of record starts in ``[begin, end)`` — headers only,
+    payloads are seeked over, so the scan touches ~16 bytes/record and the
+    whole-dataset RSS stays flat (parity: the dmlc chunked InputSplit the
+    reference's parser scans, iter_image_recordio.cc:108-133).
 
-    Reads packed image records (recordio.py IRHeader format), decodes JPEG
-    via the native pipeline when available (mxnet_tpu.libmxnet_tpu) else
-    PIL/numpy fallback, applies mean/scale + crop/mirror augmentation, and
-    yields NCHW float32 batches.  num_parts/part_index shard the record file
-    across workers exactly like the reference.
+    Uses the native chunked reader (src/recordio.cc: seek + magic resync)
+    when built; the pure-python fallback walks headers from offset 0 and
+    filters, which yields the identical partition (a record belongs to the
+    part its first byte falls in).
     """
-    from . import recordio as rio
-    from .image import imdecode_bytes, augment
+    from .libinfo import find_lib
+    lib = find_lib()
+    offsets = []
+    if lib is not None:
+        h = lib.MXTPURecordIOReaderCreate(path.encode(), begin,
+                                          -1 if end is None else end)
+        if not h:
+            raise IOError("cannot open %s" % path)
+        try:
+            while True:
+                pos = lib.MXTPURecordIOReaderTell(h)
+                rc = lib.MXTPURecordIOReaderSkip(h)
+                if rc == -1:
+                    break
+                if rc == -2:
+                    raise IOError("corrupt RecordIO file %s" % path)
+                offsets.append(pos)
+        finally:
+            lib.MXTPURecordIOReaderFree(h)
+        return _np.asarray(offsets, dtype=_np.int64)
+    import struct
+    with open(path, "rb") as f:
+        while True:
+            pos = f.tell()
+            head = f.read(8)
+            if len(head) < 8:
+                break
+            magic, lrec = struct.unpack("<II", head)
+            if magic != 0xced7230a:
+                raise IOError("corrupt RecordIO file %s @%d" % (path, pos))
+            cflag = lrec >> 29
+            length = lrec & ((1 << 29) - 1)
+            f.seek(length + ((4 - (length & 3)) & 3), 1)
+            if cflag in (0, 1) and pos >= begin and (end is None or pos < end):
+                offsets.append(pos)
+    return _np.asarray(offsets, dtype=_np.int64)
 
-    reader = rio.MXRecordIO(path_imgrec, "r")
-    records = []
-    while True:
-        item = reader.read()
-        if item is None:
-            break
-        records.append(item)
-    reader.close()
-    if num_parts > 1:
-        per = len(records) // num_parts
-        lo = part_index * per
-        hi = (part_index + 1) * per if part_index < num_parts - 1 else len(records)
-        records = records[lo:hi]
 
-    datas, labels = [], []
-    rng = _np.random.RandomState(seed)
-    for rec in records:
+class ImageRecordIter(DataIter):
+    """Streaming image RecordIO iterator (parity: iter_image_recordio.cc
+    ImageRecordIter + iter_prefetcher.h:45 PrefetcherIter).
+
+    Pipeline, mirroring the reference's parser → batcher → prefetcher stack:
+
+    - **index**: one cheap offset scan of this worker's byte range; the
+      decoded dataset is never materialised (flat RSS on multi-GB files).
+    - **shard**: ``num_parts``/``part_index`` split the *file byte range*
+      and resync on record boundaries — the reference's seek-based protocol
+      (iter_image_recordio.cc:108-133), so pod workers touch disjoint data.
+    - **shuffle**: per-epoch permutation of record offsets (not arrays).
+    - **decode pool**: each record is seek-read by a per-thread reader and
+      JPEG-decoded + augmented by ``preprocess_threads`` workers of the
+      dependency engine (src/engine.cc) — the analog of the reference's OMP
+      decode loop (iter_image_recordio.cc:184-234).  Falls back to inline
+      decode under NaiveEngine / pure-python builds.
+    - **prefetch**: finished batches land in a bounded queue
+      (``prefetch_buffer`` deep) so decode overlaps device compute.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 mean_img=None, scale=1.0, rand_crop=False, rand_mirror=False,
+                 num_parts=1, part_index=0, preprocess_threads=4,
+                 prefetch_buffer=4, seed=0, round_batch=True,
+                 max_rotate_angle=0, min_random_scale=1.0,
+                 max_random_scale=1.0, random_h=0, random_s=0, random_l=0,
+                 data_name="data", label_name="softmax_label",
+                 dtype="float32", **kwargs):
+        super().__init__()
+        import os
+        self.path_imgrec = path_imgrec
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.round_batch = round_batch
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = _np.dtype(dtype)
+        assert self.dtype in (_np.float32, _np.uint8), \
+            "ImageRecordIter dtype must be float32 or uint8"
+        self._aug = dict(rand_crop=rand_crop, rand_mirror=rand_mirror,
+                         max_rotate_angle=max_rotate_angle,
+                         min_random_scale=min_random_scale,
+                         max_random_scale=max_random_scale,
+                         random_h=random_h, random_s=random_s,
+                         random_l=random_l)
+        # the native kernel covers the default augmenter (scale/crop/mirror);
+        # rotation/HSL jitter route through the python augmenter
+        self._native_aug_ok = (max_rotate_angle == 0 and random_h == 0
+                               and random_s == 0 and random_l == 0)
+        # per-channel mean vector (native-kernel friendly) vs full mean image
+        self._mean_vec = None
+        self._mean_full = None
+        if mean_img is not None:
+            if not os.path.isfile(mean_img):
+                raise MXNetError("mean_img %r does not exist" % mean_img)
+            from .ndarray import load as nd_load
+            loaded = nd_load(mean_img)
+            arr = (loaded["mean_img"] if isinstance(loaded, dict)
+                   else loaded[0]).asnumpy()
+            self._mean_full = arr.astype(_np.float32)      # CHW
+        elif mean_r or mean_g or mean_b:
+            self._mean_vec = _np.ascontiguousarray(
+                [mean_r, mean_g, mean_b][:self.data_shape[0]],
+                dtype=_np.float32)
+        self._scale = scale
+        self._seed_base = seed * 131 + part_index
+        self._rng = _np.random.RandomState(seed + part_index)
+        self._raw_nbytes = int(_np.prod(self.data_shape))
+        from .libinfo import find_lib
+        self._native_lib = find_lib()
+
+        size = os.path.getsize(path_imgrec)
+        if num_parts > 1:
+            begin = size * part_index // num_parts
+            end = size * (part_index + 1) // num_parts
+            if part_index == num_parts - 1:
+                end = None
+        else:
+            begin, end = 0, None
+        self._offsets = _scan_record_offsets(path_imgrec, begin, end)
+        if self._offsets.size == 0:
+            raise MXNetError("no records in %s part %d/%d"
+                             % (path_imgrec, part_index, num_parts))
+
+        # Force jax backend init NOW, before any worker thread exists:
+        # lazy init inside the first device transfer deadlocks against
+        # GIL-holding decode callbacks (observed with the axon client).
+        import jax
+        jax.devices()
+
+        # decode pool: dedicated engine so preprocess_threads is honored
+        # independently of the global engine (reference: per-iterator OMP
+        # thread count).  ThreadedEngine -> native worker pool; NaiveEngine
+        # (no native lib / MXNET_ENGINE_TYPE override) -> inline decode.
+        from . import engine as _engine
+        self._engine = _engine.create(num_threads=max(1, preprocess_threads))
+        self._threaded = not isinstance(self._engine, _engine.NaiveEngine)
+        self._local = threading.local()
+
+        import queue as _queue
+        self._queue = _queue.Queue(maxsize=max(1, int(prefetch_buffer)))
+        self._gen = 0
+        self._producer = None
+        self._cur = None
+        self._exhausted = False
+        self._start_producer()
+
+    # -- readers ----------------------------------------------------------
+    def _reader(self):
+        """Per-thread sequential reader handle (seek + read one record)."""
+        r = getattr(self._local, "reader", None)
+        if r is None:
+            from . import recordio as rio
+            r = rio.MXRecordIO(self.path_imgrec, "r")
+            self._local.reader = r
+        return r
+
+    def _decode_into(self, offset, data, label, slot, epoch):
+        from . import recordio as rio
+        r = self._reader()
+        r._seek_to(int(offset))
+        rec = r.read()
         header, img_bytes = rio.unpack(rec)
-        img = imdecode_bytes(img_bytes)          # HWC uint8
-        img = augment(img, data_shape, rand_crop=rand_crop,
-                      rand_mirror=rand_mirror, rng=rng)
+        # per-record deterministic augmentation seed (no shared-RNG races)
+        seed = (int(offset) * 2654435761 + epoch * 40503 + self._seed_base) \
+            & 0xffffffff
+        encoded = len(img_bytes) > 4 and (
+            (img_bytes[0] == 0xFF and img_bytes[1] == 0xD8)      # JPEG SOI
+            or img_bytes[:4] == b"\x89PNG")
+        if len(img_bytes) == self._raw_nbytes and not encoded:
+            # raw pre-decoded record (im2rec --pack-raw): uint8 CHW matching
+            # data_shape exactly; no decode, no augmentation — the
+            # full-rate path for pre-processed datasets
+            raw = _np.frombuffer(img_bytes, dtype=_np.uint8).reshape(
+                self.data_shape)
+            if self.dtype == _np.uint8:
+                data[slot] = raw
+            else:
+                img = raw.astype(_np.float32)
+                if self._mean_vec is not None:
+                    img -= self._mean_vec.reshape(-1, 1, 1)
+                if self._mean_full is not None:
+                    img -= self._mean_full
+                if self._scale != 1.0:
+                    img *= self._scale
+                data[slot] = img
+        elif not self._decode_native(img_bytes, data, slot, seed):
+            self._decode_python(img_bytes, data, slot, seed)
+        lbl = _np.asarray(header.label, dtype=_np.float32).ravel()
+        if self.label_width > 1:
+            label[slot, :] = lbl[:self.label_width]
+        else:
+            label[slot] = lbl[0]
+
+    def _decode_native(self, img_bytes, data, slot, seed):
+        """One ctypes call: decode+augment+normalize with the GIL released
+        (src/image.cc MXTPUDecodeAugment) — the engine's native workers
+        scale linearly, unlike cv2/PIL whose decode holds the GIL."""
+        lib = self._native_lib
+        if lib is None or not self._native_aug_ok:
+            return False
+        if not (len(img_bytes) > 2 and img_bytes[0] == 0xFF
+                and img_bytes[1] == 0xD8):
+            return False                      # not JPEG (e.g. PNG): fallback
+        import ctypes
+        c, h, w = self.data_shape
+        slot_view = data[slot]
+        out_ptr = slot_view.ctypes.data_as(ctypes.c_void_p)
+        is_u8 = self.dtype == _np.uint8
+        mean_ptr = None
+        if not is_u8 and self._mean_vec is not None:
+            mean_ptr = self._mean_vec.ctypes.data_as(ctypes.c_void_p)
+        # with a full mean image, normalization must stay (v - mean) * scale:
+        # decode raw f32 natively, then subtract+scale in numpy
+        defer_norm = (not is_u8) and self._mean_full is not None
+        rc = lib.MXTPUDecodeAugment(
+            img_bytes, len(img_bytes), c, h, w,
+            1 if self._aug["rand_crop"] else 0,
+            1 if self._aug["rand_mirror"] else 0,
+            float(self._aug["min_random_scale"]),
+            float(self._aug["max_random_scale"]),
+            seed,
+            None if is_u8 else out_ptr, out_ptr if is_u8 else None,
+            mean_ptr,
+            1.0 if (is_u8 or defer_norm) else float(self._scale))
+        if rc != 0:
+            return False
+        if defer_norm:
+            slot_view -= self._mean_full
+            if self._scale != 1.0:
+                slot_view *= self._scale
+        return True
+
+    def _decode_python(self, img_bytes, data, slot, seed):
+        from .image import imdecode_bytes, augment
+        img = imdecode_bytes(img_bytes,
+                             iscolor=1 if self.data_shape[0] == 3 else 0)
+        rng = _np.random.RandomState(seed)
+        img = augment(img, self.data_shape, rng=rng, **self._aug)
+        img = img.transpose(2, 0, 1)                       # HWC -> CHW
+        if self.dtype == _np.uint8:
+            data[slot] = img
+            return
         img = img.astype(_np.float32)
-        img[:, :, 0] -= mean_r
-        if img.shape[2] > 1:
-            img[:, :, 1] -= mean_g
-            img[:, :, 2] -= mean_b
-        img *= scale
-        datas.append(img.transpose(2, 0, 1))     # HWC -> CHW
-        lbl = header.label
-        labels.append(lbl if label_width > 1 else float(_np.asarray(lbl).ravel()[0]))
-    data = _np.stack(datas) if datas else _np.zeros((0,) + tuple(data_shape))
-    label = _np.asarray(labels, dtype=_np.float32)
-    if 0 < data.shape[0] < batch_size:
-        # fewer records than one batch: pad by wrapping so one full batch
-        # exists (the reference's C++ batcher pads the tail the same way)
-        reps = -(-batch_size // data.shape[0])
-        data = _np.tile(data, (reps,) + (1,) * (data.ndim - 1))[:batch_size]
-        label = _np.tile(label, reps)[:batch_size]
-    return NDArrayIter(data, label, batch_size=batch_size, shuffle=shuffle,
-                       last_batch_handle="discard")
+        if self._mean_vec is not None:
+            img -= self._mean_vec.reshape(-1, 1, 1)
+        if self._mean_full is not None:
+            img -= self._mean_full
+        if self._scale != 1.0:
+            img *= self._scale
+        data[slot] = img
+
+    # -- producer ---------------------------------------------------------
+    # The producer thread holds the iterator only through a weakref: an
+    # abandoned (dropped, non-exhausted) iterator is garbage-collected,
+    # which makes wself() return None and the thread exit — no leaked
+    # threads, engines, or prefetch buffers.
+    _DISCARD_TAIL = object()
+
+    @staticmethod
+    def _put_weak(q, wself, gen, item):
+        import queue as _queue
+        while True:
+            s = wself()
+            if s is None or gen != s._gen:
+                return False
+            del s
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                pass
+
+    def _make_batch(self, order, start, epoch):
+        n, bs = order.size, self.batch_size
+        idxs = order[start:start + bs]
+        pad = bs - idxs.size
+        if pad:
+            if not self.round_batch and n >= bs:
+                return ImageRecordIter._DISCARD_TAIL
+            wrap = _np.resize(order, pad) if pad > n else order[:pad]
+            idxs = _np.concatenate([idxs, wrap])
+        lshape = (bs, self.label_width) if self.label_width > 1 else (bs,)
+        data = _np.empty((bs,) + self.data_shape, self.dtype)
+        label = _np.empty(lshape, _np.float32)
+        if self._threaded:
+            vars_ = [self._engine.new_variable() for _ in range(bs)]
+            for slot, off in enumerate(idxs):
+                self._engine.push(
+                    _functools.partial(self._decode_into, off,
+                                       data, label, slot, epoch),
+                    mutable_vars=[vars_[slot]])
+            for v in vars_:
+                self._engine.wait_for_var(v)
+                self._engine.delete_variable(v)
+        else:
+            for slot, off in enumerate(idxs):
+                self._decode_into(off, data, label, slot, epoch)
+        return (data, label, pad)
+
+    @staticmethod
+    def _produce(wself, gen, epoch):
+        self = wself()
+        if self is None:
+            return
+        q = self._queue
+        try:
+            order = self._offsets.copy()
+            if self.shuffle:
+                self._rng.shuffle(order)
+            starts = list(range(0, order.size, self.batch_size))
+            del self
+            for start in starts:
+                self = wself()
+                if self is None or gen != self._gen:
+                    return
+                item = self._make_batch(order, start, epoch)
+                del self
+                if item is ImageRecordIter._DISCARD_TAIL:
+                    break
+                if not ImageRecordIter._put_weak(q, wself, gen, item):
+                    return
+            ImageRecordIter._put_weak(q, wself, gen, None)   # epoch end
+        except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
+            ImageRecordIter._put_weak(q, wself, gen, exc)
+
+    def _start_producer(self):
+        import weakref
+        gen = self._gen
+        self._epoch = getattr(self, "_epoch", -1) + 1
+        self._producer = threading.Thread(
+            target=ImageRecordIter._produce,
+            args=(weakref.ref(self), gen, self._epoch), daemon=True)
+        self._producer.start()
+
+    # -- DataIter protocol -------------------------------------------------
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shp = ((self.batch_size, self.label_width) if self.label_width > 1
+               else (self.batch_size,))
+        return [DataDesc(self.label_name, shp)]
+
+    @property
+    def num_records(self):
+        """Records in this worker's shard."""
+        return int(self._offsets.size)
+
+    def reset(self):
+        import queue as _queue
+        self._gen += 1
+        while self._producer.is_alive():
+            try:
+                self._queue.get_nowait()
+            except _queue.Empty:
+                self._producer.join(timeout=0.02)
+        while True:
+            try:
+                self._queue.get_nowait()
+            except _queue.Empty:
+                break
+        self._exhausted = False
+        self._start_producer()
+
+    def iter_next(self):
+        if self._exhausted:
+            return False
+        item = self._queue.get()
+        if item is None:
+            self._exhausted = True
+            return False
+        if isinstance(item, BaseException):
+            self._exhausted = True
+            raise item
+        data, label, pad = item
+        d = nd_array(data, dtype=data.dtype)
+        # bound in-flight transfers: without this, a consumer that is not
+        # compute-bound lets async device puts pile up unboundedly
+        d.data.block_until_ready()
+        self._cur = DataBatch([d], [nd_array(label)], pad=pad)
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self._cur
+        raise StopIteration
+
+    def getdata(self):
+        return self._cur.data
+
+    def getlabel(self):
+        return self._cur.label
+
+    def getpad(self):
+        return self._cur.pad
+
+    def __del__(self):
+        try:
+            self._gen += 1
+        except Exception:
+            pass

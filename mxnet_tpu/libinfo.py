@@ -92,12 +92,24 @@ def _find_lib_locked(build):
         ctypes.c_char_p, ctypes.c_long, ctypes.c_long]
     lib.MXTPURecordIOReaderNext.restype = ctypes.c_long
     lib.MXTPURecordIOReaderNext.argtypes = [ctypes.c_void_p]
+    lib.MXTPURecordIOReaderSkip.restype = ctypes.c_int
+    lib.MXTPURecordIOReaderSkip.argtypes = [ctypes.c_void_p]
     lib.MXTPURecordIOReaderData.restype = ctypes.POINTER(ctypes.c_char)
     lib.MXTPURecordIOReaderData.argtypes = [ctypes.c_void_p]
     lib.MXTPURecordIOReaderTell.restype = ctypes.c_long
     lib.MXTPURecordIOReaderTell.argtypes = [ctypes.c_void_p]
     lib.MXTPURecordIOReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_long]
     lib.MXTPURecordIOReaderFree.argtypes = [ctypes.c_void_p]
+
+    lib.MXTPUDecodeAugment.restype = ctypes.c_int
+    lib.MXTPUDecodeAugment.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64,                  # img, len
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,          # tc, th, tw
+        ctypes.c_int, ctypes.c_int,                        # rand_crop, mirror
+        ctypes.c_float, ctypes.c_float,                    # scale_lo, scale_hi
+        ctypes.c_uint32,                                   # seed
+        ctypes.c_void_p, ctypes.c_void_p,                  # out_f32, out_u8
+        ctypes.c_void_p, ctypes.c_float]                   # mean, scale
 
     _LIB = lib
     return _LIB

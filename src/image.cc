@@ -1,0 +1,214 @@
+// Native JPEG decode + default augmentation — the hot host-side loop of the
+// streaming ImageRecordIter.
+//
+// Parity: the reference's multithreaded decode+augment
+// (src/io/iter_image_recordio.cc:184-234 OMP loop +
+// src/io/image_aug_default.cc crop/mirror).  Python threads cannot
+// parallelize this (the bundled cv2 holds the GIL through imdecode), so the
+// engine's native workers call this via ctypes — the GIL is released for
+// the whole decode+augment+normalize of one record, restoring the
+// reference's thread-scaling behavior on the TPU host.
+//
+// One call does: JPEG decode -> bilinear resize (iff a crop would not fit
+// or random-scale is requested) -> center/random crop -> mirror ->
+// HWC->CHW transpose + mean/scale normalize (f32) or raw u8 output.
+#include <cstdint>
+
+#if !__has_include(<jpeglib.h>)
+// No libjpeg on this host: export a stub that reports "cannot decode" so
+// callers fall back to the python path; the engine/recordio parts of
+// libmxtpu.so stay fully functional.
+extern "C" int MXTPUDecodeAugment(const uint8_t*, uint64_t, int, int, int,
+                                  int, int, float, float, uint32_t, float*,
+                                  uint8_t*, const float*, float) {
+  return -1;
+}
+#else
+
+#ifndef MEM_SRCDST_SUPPORTED
+#define MEM_SRCDST_SUPPORTED 1
+#endif
+#include <csetjmp>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace mxtpu {
+
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+static void JpegErrExit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
+}
+
+// xorshift PRNG — deterministic per (seed) augmentation draws.
+static inline uint32_t NextRand(uint32_t* s) {
+  uint32_t x = *s ? *s : 0x9e3779b9u;
+  x ^= x << 13;
+  x ^= x >> 17;
+  x ^= x << 5;
+  *s = x;
+  return x;
+}
+
+// Decode JPEG to HWC u8 (RGB or grayscale).  Returns 0 and fills (h,w)
+// on success; -1 on malformed input.  `out` grows as needed.
+static int Decode(const uint8_t* buf, unsigned long len, int gray,
+                  std::vector<uint8_t>* out, int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrExit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf), len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = gray ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int W = cinfo.output_width, H = cinfo.output_height;
+  const int C = cinfo.output_components;
+  out->resize(static_cast<size_t>(W) * H * C);
+  JSAMPROW row;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    row = out->data() + static_cast<size_t>(cinfo.output_scanline) * W * C;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *h = H;
+  *w = W;
+  return 0;
+}
+
+// Bilinear resize HWC u8 (same channel count).
+static void Resize(const uint8_t* src, int sh, int sw, int c,
+                   uint8_t* dst, int dh, int dw) {
+  const float ry = dh > 1 ? static_cast<float>(sh - 1) / (dh - 1) : 0.f;
+  const float rx = dw > 1 ? static_cast<float>(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * ry;
+    int y0 = static_cast<int>(fy);
+    int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = x * rx;
+      int x0 = static_cast<int>(fx);
+      int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      float wx = fx - x0;
+      for (int k = 0; k < c; ++k) {
+        float v00 = src[(static_cast<size_t>(y0) * sw + x0) * c + k];
+        float v01 = src[(static_cast<size_t>(y0) * sw + x1) * c + k];
+        float v10 = src[(static_cast<size_t>(y1) * sw + x0) * c + k];
+        float v11 = src[(static_cast<size_t>(y1) * sw + x1) * c + k];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(static_cast<size_t>(y) * dw + x) * c + k] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+}  // namespace mxtpu
+
+extern "C" {
+
+// Decode + augment + write one record into its batch slot.
+//   img/len      : encoded JPEG bytes
+//   tc/th/tw     : target C,H,W (CHW layout of the slot)
+//   rand_crop    : 1 = random crop position, 0 = center
+//   rand_mirror  : 1 = coin-flip horizontal mirror
+//   scale_lo/hi  : random resize factor range (1.0/1.0 = off)
+//   seed         : PRNG seed for this record's draws
+//   out_f32      : slot pointer when out_u8 is null — normalized
+//                  (v - mean[c]) * scale per channel
+//   out_u8       : slot pointer for raw u8 output (mean/scale skipped)
+// Returns 0 ok, -1 decode error (caller falls back to the python path).
+int MXTPUDecodeAugment(const uint8_t* img, uint64_t len,
+                       int tc, int th, int tw,
+                       int rand_crop, int rand_mirror,
+                       float scale_lo, float scale_hi,
+                       uint32_t seed,
+                       float* out_f32, uint8_t* out_u8,
+                       const float* mean, float scale) {
+  thread_local std::vector<uint8_t> dec_buf, aux_buf;
+  int h = 0, w = 0;
+  const int gray = (tc == 1);
+  if (mxtpu::Decode(img, len, gray, &dec_buf, &h, &w) != 0) return -1;
+  const int c = gray ? 1 : 3;
+  uint8_t* cur = dec_buf.data();
+
+  uint32_t rs = seed;
+  // random scale, then guarantee the crop fits
+  float f = 1.0f;
+  if (scale_hi != 1.0f || scale_lo != 1.0f) {
+    float u = (mxtpu::NextRand(&rs) >> 8) * (1.0f / 16777216.0f);
+    f = scale_lo + u * (scale_hi - scale_lo);
+  }
+  int nh = static_cast<int>(h * f + 0.5f), nw = static_cast<int>(w * f + 0.5f);
+  if (nh < th || nw < tw) {
+    // scale uniformly so both dims cover the target
+    float cover_h = static_cast<float>(th) / nh;
+    float cover_w = static_cast<float>(tw) / nw;
+    float ff = cover_h > cover_w ? cover_h : cover_w;
+    nh = static_cast<int>(nh * ff + 0.9999f);
+    nw = static_cast<int>(nw * ff + 0.9999f);
+    if (nh < th) nh = th;
+    if (nw < tw) nw = tw;
+  }
+  if (nh != h || nw != w) {
+    aux_buf.resize(static_cast<size_t>(nh) * nw * c);
+    mxtpu::Resize(cur, h, w, c, aux_buf.data(), nh, nw);
+    cur = aux_buf.data();
+    h = nh;
+    w = nw;
+  }
+
+  int y0, x0;
+  if (rand_crop) {
+    y0 = h > th ? static_cast<int>(mxtpu::NextRand(&rs) % (h - th + 1)) : 0;
+    x0 = w > tw ? static_cast<int>(mxtpu::NextRand(&rs) % (w - tw + 1)) : 0;
+  } else {
+    y0 = (h - th) / 2;
+    x0 = (w - tw) / 2;
+  }
+  const int mirror = rand_mirror ? static_cast<int>(mxtpu::NextRand(&rs) & 1)
+                                 : 0;
+
+  // crop + mirror + HWC->CHW (+ channel replicate if tc != c)
+  const size_t plane = static_cast<size_t>(th) * tw;
+  for (int y = 0; y < th; ++y) {
+    const uint8_t* srow = cur + (static_cast<size_t>(y0 + y) * w + x0) * c;
+    for (int x = 0; x < tw; ++x) {
+      int sx = mirror ? (tw - 1 - x) : x;
+      const uint8_t* px = srow + static_cast<size_t>(sx) * c;
+      for (int k = 0; k < tc; ++k) {
+        uint8_t v = px[k < c ? k : 0];
+        size_t di = static_cast<size_t>(k) * plane + y * tw + x;
+        if (out_u8) {
+          out_u8[di] = v;
+        } else {
+          out_f32[di] = (static_cast<float>(v) - (mean ? mean[k] : 0.f)) *
+                        scale;
+        }
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
+
+#endif  // __has_include(<jpeglib.h>)

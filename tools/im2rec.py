@@ -66,7 +66,32 @@ def pack(args):
         path = os.path.join(args.root, rel)
         with open(path, "rb") as f:
             img_bytes = f.read()
-        if not args.raw:
+        if args.pack_raw:
+            # decode + center-crop to --pack-raw CxHxW and store raw uint8
+            # CHW pixels: ImageRecordIter's zero-decode fast path (the way
+            # to feed a TPU from a host with few/slow cores)
+            try:
+                from PIL import Image
+                import io as _io
+                import numpy as np
+            except ImportError:
+                raise SystemExit("PIL required for --pack-raw")
+            c, th, tw = args.pack_raw
+            im = Image.open(_io.BytesIO(img_bytes))
+            im = im.convert("L" if c == 1 else "RGB")
+            w, h = im.size
+            if w < tw or h < th:
+                s = max(tw / w, th / h)
+                im = im.resize((max(tw, int(w * s + 0.5)),
+                                max(th, int(h * s + 0.5))))
+                w, h = im.size
+            x0, y0 = (w - tw) // 2, (h - th) // 2
+            arr = np.asarray(im.crop((x0, y0, x0 + tw, y0 + th)),
+                             dtype=np.uint8)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+            img_bytes = arr.transpose(2, 0, 1).tobytes()   # HWC -> CHW
+        elif not args.raw:
             try:
                 from PIL import Image
                 import io as _io
@@ -108,6 +133,10 @@ def main():
     parser.add_argument("--quality", type=int, default=95)
     parser.add_argument("--raw", action="store_true",
                         help="pass file bytes through unmodified")
+    parser.add_argument("--pack-raw", type=int, nargs=3, default=None,
+                        metavar=("C", "H", "W"),
+                        help="store raw uint8 CHW pixels center-cropped to "
+                             "CxHxW (ImageRecordIter zero-decode fast path)")
     parser.add_argument("--pack-index", action="store_true",
                         help="also write prefix.idx for random access")
     args = parser.parse_args()
