@@ -350,6 +350,14 @@ class Executor:
 
         return wrt_names, jax.jit(step, donate_argnums=(3,))
 
+    def _get_fused(self, optimizer):
+        """(wrt_names, jitted step) for this optimizer, cached by identity."""
+        if self._fused_cache is None or \
+                self._fused_cache[0] is not optimizer:
+            self._fused_cache = (optimizer,
+                                 self._build_fused_step(optimizer))
+        return self._fused_cache[1]
+
     def fused_step(self, optimizer, states, num_update, **kwargs):
         """Run one full train step (forward + backward + optimizer update)
         as a single XLA dispatch.  Writes updated params into the bound
@@ -357,11 +365,7 @@ class Executor:
         ``states`` is a dict name -> optimizer-state pytree (jax arrays),
         mutated-by-replacement and returned.
         """
-        if self._fused_cache is None or \
-                self._fused_cache[0] is not optimizer:
-            self._fused_cache = (optimizer,
-                                 self._build_fused_step(optimizer))
-        wrt_names, jit_step = self._fused_cache[1]
+        wrt_names, jit_step = self._get_fused(optimizer)
         for name, arr in kwargs.items():
             self.arg_dict[name]._set_data(
                 arr.data if isinstance(arr, NDArray) else jnp.asarray(arr))
@@ -385,6 +389,18 @@ class Executor:
             self.grad_dict[n]._set_data(grads[n])
             self.arg_dict[n]._set_data(new_w[n])
         return new_s
+
+    def lower_fused_step(self, optimizer, states):
+        """Optimized-HLO text of the fused step for the currently bound
+        arrays — introspection hook (tests assert the sharded step carries
+        an all-reduce; the perf story's equivalent of debug_str)."""
+        _wrt_names, jit_step = self._get_fused(optimizer)
+        arg_values = {n: a.data for n, a in self.arg_dict.items()}
+        aux_values = {n: a.data for n, a in self.aux_dict.items()}
+        lowered = jit_step.lower(arg_values, aux_values, _zero_key(), states,
+                                 jnp.float32(0.01), jnp.float32(0.0),
+                                 jnp.int32(1))
+        return lowered.compile().as_text()
 
     def init_fused_states(self, optimizer):
         """Optimizer-state arrays for every learnable arg (fused path)."""

@@ -1,14 +1,25 @@
 """DataParallelExecutorGroup for the Module API.
 
-TPU-native counterpart of ``python/mxnet/module/executor_group.py:21``: a
-group of bound executors, one per context, each holding a batch slice.  On a
-single TPU context this degenerates to one Executor — i.e. one fused XLA
-computation per forward/backward — which is the common case; multi-ctx
-slicing is kept for API parity and CPU-mesh tests.  (The genuinely parallel
-multi-chip path is parallel.ShardedTrainer, where slicing is replaced by
-``jax.sharding`` over the batch axis.)
+TPU-native counterpart of ``python/mxnet/module/executor_group.py:21``.
+
+Device placement is TPU-first: a homogeneous multi-context bind builds ONE
+executor over a ``jax.sharding.Mesh`` of those devices — the batch is
+sharded along the mesh's data axis and parameters are replicated, so the
+backward pass carries an XLA ``all-reduce`` over the mesh *inside* the
+compiled step.  That single executor is what lets the fused
+fwd+bwd+optimizer step (one dispatch per fit step) apply to multi-device
+and multi-host training — the TPU collapse of the reference's per-device
+executors + host/PS gradient reduction (``comm.h:186-345``,
+``kvstore_dist.h:181-226``).
+
+The legacy per-context slicing group (reference semantics,
+``executor_group.py:104``) remains for heterogeneous contexts, indivisible
+batches, or ``MXNET_MODULE_SHARDED=0``.
 """
 from __future__ import annotations
+
+import logging
+import os
 
 import numpy as _np
 
@@ -61,7 +72,27 @@ class DataParallelExecutorGroup(object):
         self.label_names = [l.name for l in self.label_shapes]
 
         self.batch_size = self.data_shapes[0].shape[0]
-        self.slices = _split_input_slice(self.batch_size, self.workload)
+
+        # -- sharded single-executor mode ------------------------------
+        self.sharded = False
+        self._mesh = None
+        self._data_sharding = None
+        self._repl_sharding = None
+        if shared_group is not None:
+            self.sharded = shared_group.sharded
+            self._mesh = shared_group._mesh
+            self._data_sharding = shared_group._data_sharding
+            self._repl_sharding = shared_group._repl_sharding
+            self._n_proc = shared_group._num_proc
+        elif len(contexts) > 1 and os.environ.get(
+                "MXNET_MODULE_SHARDED", "1") != "0":
+            self._try_init_mesh(contexts, logger)
+        if self.sharded:
+            # one executor over the mesh sees the full (global) batch
+            self.slices = [slice(0, self.batch_size)]
+            contexts = [contexts[0]]
+        else:
+            self.slices = _split_input_slice(self.batch_size, self.workload)
 
         if shared_group is None:
             self.shared_data_arrays = [{} for _ in contexts]
@@ -92,6 +123,9 @@ class DataParallelExecutorGroup(object):
         for i, ctx in enumerate(contexts):
             islice = self.slices[i]
             shard = islice.stop - islice.start
+            if self.sharded:
+                # the mesh executor sees the global batch (local x hosts)
+                shard = self.batch_size * self._n_proc
             input_shapes = {}
             for d in self.data_shapes + self.label_shapes:
                 input_shapes[d.name] = (shard,) + tuple(d.shape[1:])
@@ -125,7 +159,94 @@ class DataParallelExecutorGroup(object):
                            for name in self.aux_names]
 
     # ------------------------------------------------------------------
+    # sharded-mode plumbing
+    # ------------------------------------------------------------------
+    def _try_init_mesh(self, contexts, logger):
+        """One mesh axis 'dp' over the context devices (all processes'
+        devices under jax.distributed).  Falls back to legacy slicing when
+        contexts are heterogeneous/duplicated or the batch doesn't divide."""
+        import jax
+        log = logger or logging
+        if len({c.device_type for c in contexts}) != 1:
+            return
+        try:
+            devices = [c.jax_device for c in contexts]
+        except Exception:
+            return
+        if len(set(devices)) != len(devices):
+            return
+        n_proc = jax.process_count()
+        if n_proc > 1:
+            # SPMD over the pod: every process binds the same global
+            # computation over all devices (its ctx list = local devices)
+            devices = list(jax.devices())
+        if (self.batch_size * n_proc) % len(devices) != 0:
+            log.warning(
+                "batch %d not divisible by %d devices: using per-device "
+                "slicing instead of the sharded executor",
+                self.batch_size * n_proc, len(devices))
+            return
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        self._mesh = Mesh(_np.asarray(devices), ("dp",))
+        self._data_sharding = NamedSharding(self._mesh, P("dp"))
+        self._repl_sharding = NamedSharding(self._mesh, P())
+        self._n_proc = n_proc
+        self.sharded = True
+
+    @property
+    def _num_proc(self):
+        return getattr(self, "_n_proc", 1)
+
+    def _put_sharded(self, value, sharding):
+        """numpy/NDArray -> global jax array with the given sharding; the
+        value is this process's local portion (= the whole array when
+        single-process)."""
+        import jax
+        if isinstance(value, NDArray):
+            value = value.asnumpy()
+        value = _np.asarray(value)
+        if self._num_proc == 1:
+            return jax.device_put(value, sharding)
+        return jax.make_array_from_process_local_data(sharding, value)
+
+    def _ensure_on_mesh(self, extra_trees=()):
+        """Commit params/aux (replicated) and any extra pytrees onto the
+        mesh; loads/checkpoint restores leave arrays on the default device
+        otherwise.  Data/label arrays are committed by load_data_batch."""
+        import jax
+        if not self.sharded:
+            return [t for t in extra_trees]
+        exec_ = self.execs[0]
+        repl = self._repl_sharding
+
+        def _committed(arr):
+            return getattr(arr, "sharding", None) == repl
+
+        for d in (exec_.arg_dict, exec_.aux_dict):
+            for name, nd in d.items():
+                if name in self.data_names or name in self.label_names:
+                    continue
+                if not _committed(nd.data):
+                    nd._set_data(self._put_sharded(nd.data, repl))
+        out = []
+        for tree in extra_trees:
+            out.append(jax.tree_util.tree_map(
+                lambda a: a if _committed(a)
+                else self._put_sharded(_np.asarray(a), repl), tree))
+        return out
+
+    # ------------------------------------------------------------------
     def load_data_batch(self, data_batch):
+        if self.sharded:
+            exec_ = self.execs[0]
+            for name, src in zip(self.data_names, data_batch.data):
+                exec_.arg_dict[name]._set_data(
+                    self._put_sharded(src, self._data_sharding))
+            if self.label_arrays and data_batch.label:
+                for name, src in zip(self.label_names, data_batch.label):
+                    exec_.arg_dict[name]._set_data(
+                        self._put_sharded(src, self._data_sharding))
+            return
         _load_data(data_batch, self.data_arrays)
         if self.label_arrays and data_batch.label:
             _load_label(data_batch, self.label_arrays)
@@ -135,6 +256,7 @@ class DataParallelExecutorGroup(object):
             self.load_data_batch(data_batch)
         if is_train is None:
             is_train = self.for_training
+        self._ensure_on_mesh()
         for exec_ in self.execs:
             exec_.forward(is_train=is_train)
 
@@ -144,16 +266,46 @@ class DataParallelExecutorGroup(object):
         if not self.for_training:
             raise MXNetError("re-bind with for_training=True to run backward")
         self.load_data_batch(data_batch)
+        self._ensure_on_mesh()
         for exec_ in self.execs:
             exec_.forward_backward()
 
     def fused_step(self, data_batch, optimizer, states, num_update):
-        """Whole train step (fwd+bwd+optimizer update) as one dispatch;
-        single-executor groups only (multi-ctx keeps the host reduce)."""
+        """Whole train step (fwd+bwd+optimizer update) as one dispatch.
+        Single-executor groups: one context, or a sharded mesh group —
+        where the dispatch also carries the gradient all-reduce over the
+        'dp' axis (the in-step collapse of kvstore device/dist_sync)."""
         if len(self.execs) != 1:
-            raise MXNetError("fused_step requires a single-context group")
+            raise MXNetError("fused_step requires a single-context or "
+                             "sharded group")
         self.load_data_batch(data_batch)
+        if self.sharded:
+            states = self._ensure_on_mesh((states,))[0]
         return self.execs[0].fused_step(optimizer, states, num_update)
+
+    def fused_step_hlo(self, optimizer):
+        """Lowered HLO text of the fused step (introspection/tests: the
+        sharded step must contain an all-reduce over the mesh)."""
+        exec_ = self.execs[0]
+        states = self._ensure_on_mesh(
+            (exec_.init_fused_states(optimizer),))[0]
+        if self.sharded and self._num_proc == 1:
+            # lower with batch inputs committed the way load_data_batch
+            # commits them, else the trace sees unsharded data
+            for name in self.data_names + self.label_names:
+                nd = exec_.arg_dict[name]
+                nd._set_data(self._put_sharded(nd.data,
+                                               self._data_sharding))
+        elif self.sharded:
+            # multi-process: the bind-time buffers are global-shaped, so
+            # re-putting them as "local" data would square the batch —
+            # require a loaded batch instead
+            for name in self.data_names + self.label_names:
+                if exec_.arg_dict[name].data.sharding != self._data_sharding:
+                    raise MXNetError("fused_step_hlo under multi-process "
+                                     "needs a batch loaded first "
+                                     "(load_data_batch)")
+        return exec_.lower_fused_step(optimizer, states)
 
     def backward(self, out_grads=None):
         if not self.for_training:
@@ -198,6 +350,18 @@ class DataParallelExecutorGroup(object):
             exec_.copy_params_from(arg_params, aux_params)
 
     def update_metric(self, eval_metric, labels):
+        if self.sharded and self._num_proc > 1:
+            # outputs are global (batch x hosts); this process owns the
+            # local batch — evaluate on our addressable output shards
+            exec_ = self.execs[0]
+            local_outs = []
+            for out in exec_.outputs:
+                shards = sorted(out.data.addressable_shards,
+                                key=lambda s: s.index[0].start or 0)
+                local_outs.append(NDArray(
+                    _np.concatenate([_np.asarray(s.data) for s in shards])))
+            eval_metric.update(list(labels), local_outs)
+            return
         for texec, islice in zip(self.execs, self.slices):
             labels_slice = [label[islice] for label in labels]
             eval_metric.update(labels_slice, texec.outputs)

@@ -219,8 +219,23 @@ class Module(BaseModule):
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
 
+        # Sharded mesh group + device/dist kvstore: gradients are reduced
+        # by the XLA all-reduce INSIDE the fused step — the kvstore object
+        # is kept for rank/num_workers/barrier API but carries no per-step
+        # traffic (the TPU collapse of kvstore_dist.h:181-226 push/pull).
+        self._kv_inline = bool(
+            kvstore is not None
+            and getattr(self._exec_group, "sharded", False)
+            and ("device" in kvstore.type or "dist" in kvstore.type))
+        if self._kv_inline:
+            update_on_kvstore = False
+
         batch_size = self._exec_group.batch_size
-        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+        if getattr(self._exec_group, "sharded", False):
+            # the mesh spans every process: the in-step all-reduce sums
+            # over batch x n_proc samples whatever the kvstore type is
+            batch_size *= self._exec_group._num_proc
+        elif kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
             batch_size *= kvstore.num_workers
         rescale_grad = 1.0 / batch_size
 
@@ -229,9 +244,10 @@ class Module(BaseModule):
             if update_on_kvstore:
                 idx2name.update(enumerate(self._exec_group.param_names))
             else:
-                for k in range(len(self._context)):
+                n_exec = len(self._exec_group.execs)
+                for k in range(n_exec):
                     idx2name.update(
-                        {i * len(self._context) + k: n for i, n
+                        {i * n_exec + k: n for i, n
                          in enumerate(self._exec_group.param_names)})
             optimizer_params = dict(optimizer_params)
             if "rescale_grad" not in optimizer_params:
@@ -248,7 +264,9 @@ class Module(BaseModule):
         self._updater = None
 
         if kvstore:
-            # copy initialized params into the kvstore
+            # copy initialized params into the kvstore; for the inline
+            # (in-step allreduce) path this is the once-only rank-0 init
+            # broadcast (kvstore_dist.h:58-76) — not a per-step channel
             from ..model import _initialize_kvstore
             _initialize_kvstore(kvstore=kvstore,
                                 param_arrays=self._exec_group.param_arrays,
@@ -274,6 +292,7 @@ class Module(BaseModule):
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
         self._fused_holder = shared_module._fused_holder
+        self._kv_inline = getattr(shared_module, "_kv_inline", False)
         self.optimizer_initialized = True
 
     # ------------------------------------------------------------------
@@ -294,7 +313,8 @@ class Module(BaseModule):
             return False
         return (self.optimizer_initialized
                 and not self._update_on_kvstore
-                and self._kvstore is None
+                and (self._kvstore is None
+                     or getattr(self, "_kv_inline", False))
                 and self._exec_group is not None
                 and len(self._exec_group.execs) == 1
                 and self._grad_req == "write"
@@ -339,11 +359,15 @@ class Module(BaseModule):
                                       self._exec_group.grad_arrays,
                                       self._kvstore)
         else:
+            # inline-allreduce groups already hold globally-reduced grads
+            # (XLA all-reduce in backward) — routing them through the
+            # kvstore again would double-count across workers
+            kv = None if getattr(self, "_kv_inline", False) else self._kvstore
             _update_params(self._exec_group.param_arrays,
                            self._exec_group.grad_arrays,
                            updater=self._updater,
-                           num_device=len(self._context),
-                           kvstore=self._kvstore)
+                           num_device=len(self._exec_group.execs),
+                           kvstore=kv)
 
     def get_outputs(self, merge_multi_context=True):
         self._assert_binded()
