@@ -31,14 +31,31 @@ import time
 
 BASELINE_IMAGES_PER_SEC = 170.0
 
-# bf16 peak TFLOPs per chip, keyed on substrings of jax device_kind.
-# Sources: public TPU/GPU spec sheets.  Used only for the MFU extra.
+# bf16 peak TFLOPs per chip, keyed on substrings of jax device_kind
+# (matched case-insensitively on the raw AND space-stripped string: the
+# real chip reports "TPU v5 lite", which must hit the v5e entry — the
+# silent r2 MFU:null bug).  Sources: public TPU/GPU spec sheets.
 _PEAK_TFLOPS = [
     ("v6e", 918.0), ("v6", 918.0),
     ("v5p", 459.0), ("v5e", 197.0), ("v5litepod", 197.0),
+    ("v5lite", 197.0), ("v5 lite", 197.0),
     ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
     ("H100", 989.0), ("A100", 312.0),
 ]
+
+
+def _lookup_peak_tflops(device_kind):
+    """Peak bf16 TFLOPs for the chip, or (None, note)."""
+    if os.environ.get("BENCH_PEAK_TFLOPS"):
+        return float(os.environ["BENCH_PEAK_TFLOPS"]), None
+    kind = str(device_kind).lower()
+    flat = kind.replace(" ", "").replace("-", "")
+    for key, val in _PEAK_TFLOPS:
+        k = key.lower()
+        if k in kind or k.replace(" ", "") in flat:
+            return val, None
+    return None, ("unknown device_kind %r: set BENCH_PEAK_TFLOPS to get "
+                  "an MFU figure" % str(device_kind))
 
 
 def _emit(payload):
@@ -52,21 +69,35 @@ def _run_child(extra_env, timeout):
     here = os.path.dirname(os.path.abspath(__file__))
     env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
     env["MXTPU_BENCH_CHILD"] = "1"
+    def _last_json(text):
+        for line in reversed((text or "").strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    continue
+        return None
+
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
             cwd=here, env=env, timeout=timeout,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as exc:
+        # the child emits the primary metric BEFORE the optional
+        # secondary measurements: salvage it from the captured stdout
+        out = exc.stdout
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        payload = _last_json(out)
+        if payload is not None:
+            payload["note"] = "secondary metrics timed out"
+            return payload, None
         return None, "child timed out after %ds" % timeout
-    # the child prints its JSON as the last stdout line
-    for line in reversed(proc.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None
-            except ValueError:
-                continue
+    payload = _last_json(proc.stdout)
+    if payload is not None:
+        return payload, None
     tail = (proc.stderr or "").strip().splitlines()[-3:]
     return None, "child rc=%s: %s" % (proc.returncode, " | ".join(tail))
 
@@ -170,58 +201,79 @@ def measure():
 
     mesh = make_mesh(devices, dp=n_dev)
     sym = resnet.get_symbol(num_classes=1000, num_layers=num_layers)
-    optimizer = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9,
-                               wd=1e-4, rescale_grad=1.0 / global_batch)
-    trainer = ShardedTrainer(sym, optimizer, mesh,
-                             compute_dtype=dtype or None, remat=remat)
-
-    params, opt_state, aux = trainer.init_params(
-        {"data": (global_batch, 3, 224, 224)},
-        label_shapes={"softmax_label": (global_batch,)})
     rng = np.random.RandomState(0)
-    batch = trainer.shard_batch({
-        "data": rng.rand(global_batch, 3, 224, 224).astype(np.float32),
-        "softmax_label": rng.randint(
-            0, 1000, size=(global_batch,)).astype(np.float32),
-    })
 
-    # warmup (compile)
-    for _ in range(2):
-        params, opt_state, aux, outs = trainer.step(params, opt_state, aux,
-                                                    batch)
-    jax.block_until_ready(outs)
+    def run_once(per_dev, n_steps):
+        """Build + time the fused step at one per-device batch size.
+        Returns (images_per_sec, step_time, trainer)."""
+        gbatch = per_dev * n_dev
+        optimizer = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9,
+                                   wd=1e-4, rescale_grad=1.0 / gbatch)
+        trainer = ShardedTrainer(sym, optimizer, mesh,
+                                 compute_dtype=dtype or None, remat=remat)
+        params, opt_state, aux = trainer.init_params(
+            {"data": (gbatch, 3, 224, 224)},
+            label_shapes={"softmax_label": (gbatch,)})
+        batch = trainer.shard_batch({
+            "data": rng.rand(gbatch, 3, 224, 224).astype(np.float32),
+            "softmax_label": rng.randint(
+                0, 1000, size=(gbatch,)).astype(np.float32),
+        })
+        for _ in range(2):      # warmup (compile)
+            params, opt_state, aux, outs = trainer.step(
+                params, opt_state, aux, batch)
+        jax.block_until_ready(outs)
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            params, opt_state, aux, outs = trainer.step(
+                params, opt_state, aux, batch)
+        jax.block_until_ready(outs)
+        dt = time.perf_counter() - t0
+        return gbatch * n_steps / dt, dt / n_steps, trainer
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, aux, outs = trainer.step(params, opt_state, aux,
-                                                    batch)
-    jax.block_until_ready(outs)
-    dt = time.perf_counter() - t0
+    sweep = None
+    if os.environ.get("BENCH_AUTOTUNE"):
+        # short sweep over per-device batch, then full run at the winner
+        candidates = [int(x) for x in os.environ.get(
+            "BENCH_AUTOTUNE_BATCHES", "64,128,256").split(",")]
+        sweep = {}
+        for cand in candidates:
+            try:
+                ips, _st, _tr = run_once(cand, max(3, steps // 4))
+                sweep[cand] = round(ips, 1)
+            except Exception as exc:  # noqa: BLE001 (OOM at big batch)
+                sweep[cand] = "failed: %r" % exc
+        survivors = [(v, k) for k, v in sweep.items()
+                     if not isinstance(v, str)]
+        if survivors:   # else: every candidate failed — keep the default
+            per_dev_batch = max(survivors)[1]
+            global_batch = per_dev_batch * n_dev
 
-    images_per_sec = global_batch * steps / dt
-    step_time = dt / steps
+    images_per_sec, step_time, trainer = run_once(per_dev_batch, steps)
 
     # MFU = model FLOPs per step / step time / total peak FLOPs.
     # Model FLOPs from XLA's own cost analysis of the compiled step
-    # (counts fwd+bwd+update exactly as executed).
+    # (counts fwd+bwd+update exactly as executed).  Failures are
+    # REPORTED, not swallowed — the r2 "mfu": null was two silent holes.
+    notes = []
     flops_per_step = None
     try:
         cost = trainer.compiled_step_cost_analysis()
         if cost and cost.get("flops"):
             flops_per_step = float(cost["flops"])
-    except Exception:
-        pass
+        else:
+            notes.append("cost_analysis returned %r" % (
+                None if not cost else sorted(cost)[:4]))
+    except Exception as exc:  # noqa: BLE001
+        notes.append("cost_analysis failed: %r" % exc)
+    flops_src = "xla_cost_analysis"
     if flops_per_step is None:
         # analytic fallback: ResNet-50 fwd ≈ 4.1e9 FLOPs/img @224², bwd ≈ 2×
         flops_per_step = 3.0 * 4.1e9 * global_batch * (num_layers / 50.0)
-    peak = None
-    if os.environ.get("BENCH_PEAK_TFLOPS"):
-        peak = float(os.environ["BENCH_PEAK_TFLOPS"])
-    else:
-        for key, val in _PEAK_TFLOPS:
-            if key.lower() in str(device_kind).lower():
-                peak = val
-                break
+        flops_src = "analytic"
+    peak, peak_note = _lookup_peak_tflops(device_kind)
+    if peak_note:
+        notes.append(peak_note)
     mfu = None
     if peak:
         mfu = flops_per_step / step_time / (peak * 1e12 * n_dev)
@@ -245,11 +297,135 @@ def measure():
         "compute_dtype": dtype or "float32",
         "mfu": round(mfu, 4) if mfu is not None else None,
         "model_tflops_per_step": round(flops_per_step / 1e12, 3),
+        "flops_source": flops_src,
         "donation_ok": donated,
     }
+    if notes:
+        payload["mfu_notes"] = "; ".join(notes)
+    if sweep:
+        payload["batch_sweep"] = {str(k): v for k, v in sweep.items()}
     if os.environ.get("BENCH_FALLBACK"):
         payload["fallback"] = os.environ["BENCH_FALLBACK"]
+
+    # Emit the primary metric NOW: a hang in the optional secondary
+    # measurements below must not cost the number already in hand (the
+    # parent takes the LAST JSON line, so the richer payload wins when
+    # the secondaries do complete).
     _emit(payload)
+
+    # secondary metrics (VERDICT r2 #8): the user-facing Module+DataIter
+    # path and the allreduce bandwidth, each time-bounded and optional
+    if os.environ.get("BENCH_SECONDARY", "1") != "0":
+        try:
+            payload.update(_measure_module_path(jax, platform))
+        except Exception as exc:  # noqa: BLE001
+            payload["module_path_error"] = repr(exc)
+        try:
+            payload.update(_measure_allreduce(jax))
+        except Exception as exc:  # noqa: BLE001
+            payload["allreduce_error"] = repr(exc)
+        _emit(payload)
+
+
+def _measure_module_path(jax, platform):
+    """Time the path users actually call: ImageRecordIter (raw records,
+    uint8 to device) -> Module.fit fused steps.  train_imagenet-shaped,
+    sized down to bound runtime."""
+    import tempfile
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio as rio
+
+    per_dev = int(os.environ.get("BENCH_MODULE_BATCH", "64"))
+    n_dev = len(jax.devices())
+    batch = per_dev * n_dev
+    layers = int(os.environ.get("BENCH_MODULE_LAYERS", "50"))
+    n_batches = int(os.environ.get("BENCH_MODULE_BATCHES", "8"))
+    if platform == "cpu":
+        layers, per_dev = 18, 8
+        batch = per_dev * n_dev
+        n_batches = 2
+
+    # synthetic raw .rec: enough records for the timed batches
+    import shutil
+    tmp = tempfile.mkdtemp()
+    try:
+        path = os.path.join(tmp, "bench.rec")
+        w = rio.MXRecordIO(path, "w")
+        rng = np.random.RandomState(0)
+        img = rng.randint(0, 255, (3, 224, 224), np.uint8)
+        n_rec = batch * 2
+        for i in range(n_rec):
+            w.write(rio.pack(rio.IRHeader(0, float(i % 1000), i, 0),
+                             img.tobytes()))
+        w.close()
+
+        it = mx.io.ImageRecordIter(path_imgrec=path,
+                                   data_shape=(3, 224, 224),
+                                   batch_size=batch, dtype="uint8",
+                                   preprocess_threads=4, prefetch_buffer=3)
+        from mxnet_tpu.models import resnet
+        sym = resnet.get_symbol(num_classes=1000, num_layers=layers)
+        ctxs = [mx.context.Context(platform if platform != "cpu" else "cpu",
+                                   i) for i in range(n_dev)]
+        mod = mx.mod.Module(sym, context=ctxs if n_dev > 1 else ctxs[0])
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(kvstore="device" if n_dev > 1 else None,
+                           optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+
+        def batches():
+            while True:
+                it.reset()
+                for b in it:
+                    yield b
+
+        def _sync():
+            mod.get_outputs()[0].data.block_until_ready()
+
+        gen = batches()
+        for _ in range(2):      # warmup/compile
+            mod.forward_backward(next(gen))
+            mod.update()
+        _sync()                 # drain warmup before the timer starts
+        t0 = time.perf_counter()
+        done = 0
+        for b in gen:
+            mod.forward_backward(b)
+            mod.update()
+            done += 1
+            if done >= n_batches:
+                break
+        _sync()
+        dt = time.perf_counter() - t0
+        return {
+            "module_path_images_per_sec": round(batch * done / dt, 2),
+            "module_path_batches": done,
+            "module_path_fused":
+                mod._exec_group.execs[0]._n_fused_step > 0,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _measure_allreduce(jax):
+    """Allreduce bandwidth over every visible device (the kvstore
+    push/pull -> psum secondary metric, BASELINE.md)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "bandwidth"))
+    import measure as bw
+    size = int(os.environ.get("BENCH_ALLREDUCE_BYTES", str(64 << 20)))
+    n, results = bw.measure_psum([size], repeat=5)
+    _size, dt, gbps = results[0]
+    return {
+        "allreduce_bytes": size,
+        "allreduce_time_ms": round(dt * 1e3, 3),
+        "allreduce_gbps": round(gbps, 2),
+        "allreduce_devices": n,
+    }
 
 
 if __name__ == "__main__":

@@ -23,6 +23,12 @@ _DROP_P = 0.5
 
 
 def get_symbol(num_classes=1000):
+    from ..name import NameManager
+    with NameManager():       # deterministic auto-names per build
+        return _build(num_classes)
+
+
+def _build(num_classes):
     x = sym.Variable("data")
     for filters, kernel, stride, pad, pool, lrn in _CONV_STAGES:
         x = sym.Convolution(data=x, num_filter=filters, kernel=kernel,

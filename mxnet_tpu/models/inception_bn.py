@@ -1,89 +1,100 @@
-"""Inception-BN (reference: example/image-classification/symbols/inception-bn.py).
+"""Inception-BN (Ioffe & Szegedy, 2015), spec-table construction.
 
 The BASELINE ImageNet-22k throughput config (~170 img/s on 4 GTX-980s,
-docs/tutorials/imagenet_full.md:45) trains this network.
+reference docs/tutorials/imagenet_full.md:45) trains this network; width
+constants match the reference zoo entry
+(example/image-classification/symbol_inception-bn.py).
+
+Builder layout: every inception block — regular ("A") or downsampling
+("B") — is a row of branch chains, where a chain is a sequence of
+(filters, kernel, stride, pad) conv+BN+relu units; the block concatenates
+its branch outputs with a pooled projection (A) or a bare max-pool (B).
 """
 from .. import symbol as sym
 
-eps = 0.001 + 1e-5
-bn_mom = 0.9
+_BN_EPS = 0.001 + 1e-5
+_BN_MOM = 0.9
+
+_K1, _K3 = (1, 1), (3, 3)
+_S1, _S2 = (1, 1), (2, 2)
+_P0, _P1 = (0, 0), (1, 1)
 
 
-def ConvFactory(data, num_filter, kernel, stride=(1, 1), pad=(0, 0),
-                name=None, suffix=""):
-    conv = sym.Convolution(data=data, num_filter=num_filter, kernel=kernel,
-                           stride=stride, pad=pad, no_bias=True,
-                           name="conv_%s%s" % (name, suffix))
-    bn = sym.BatchNorm(data=conv, fix_gamma=False, eps=eps, momentum=bn_mom,
-                       name="bn_%s%s" % (name, suffix))
-    act = sym.Activation(data=bn, act_type="relu",
-                         name="relu_%s%s" % (name, suffix))
-    return act
+def _unit(x, filters, kernel, stride=_S1, pad=_P0):
+    """conv (no bias) + batch-norm + relu."""
+    x = sym.Convolution(data=x, num_filter=filters, kernel=kernel,
+                        stride=stride, pad=pad, no_bias=True)
+    x = sym.BatchNorm(data=x, fix_gamma=False, eps=_BN_EPS,
+                      momentum=_BN_MOM)
+    return sym.Activation(data=x, act_type="relu")
 
 
-def InceptionFactoryA(data, num_1x1, num_3x3red, num_3x3, num_d3x3red,
-                      num_d3x3, pool, proj, name):
-    c1x1 = ConvFactory(data=data, num_filter=num_1x1, kernel=(1, 1),
-                       name=("%s_1x1" % name))
-    c3x3r = ConvFactory(data=data, num_filter=num_3x3red, kernel=(1, 1),
-                        name=("%s_3x3" % name), suffix="_reduce")
-    c3x3 = ConvFactory(data=c3x3r, num_filter=num_3x3, kernel=(3, 3),
-                       pad=(1, 1), name=("%s_3x3" % name))
-    cd3x3r = ConvFactory(data=data, num_filter=num_d3x3red, kernel=(1, 1),
-                         name=("%s_double_3x3" % name), suffix="_reduce")
-    cd3x3 = ConvFactory(data=cd3x3r, num_filter=num_d3x3, kernel=(3, 3),
-                        pad=(1, 1), name=("%s_double_3x3_0" % name))
-    cd3x3 = ConvFactory(data=cd3x3, num_filter=num_d3x3, kernel=(3, 3),
-                        pad=(1, 1), name=("%s_double_3x3_1" % name))
-    pooling = sym.Pooling(data=data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                          pool_type=pool, name=("%s_pool_%s_pool" % (pool, name)))
-    cproj = ConvFactory(data=pooling, num_filter=proj, kernel=(1, 1),
-                        name=("%s_proj" % name))
-    return sym.Concat(c1x1, c3x3, cd3x3, cproj,
-                      name="ch_concat_%s_chconcat" % name)
+def _block_a(widths):
+    """Regular block: 1x1 / 3x3 / double-3x3 branches + pooled projection.
+    widths = (b1, r3, n3, rd, nd, pool_type, proj)."""
+    b1, r3, n3, rd, nd, pool_type, proj = widths
+    return (
+        ((b1, _K1, _S1, _P0),),
+        ((r3, _K1, _S1, _P0), (n3, _K3, _S1, _P1)),
+        ((rd, _K1, _S1, _P0), (nd, _K3, _S1, _P1), (nd, _K3, _S1, _P1)),
+    ), (pool_type, _S1, proj)
 
 
-def InceptionFactoryB(data, num_3x3red, num_3x3, num_d3x3red, num_d3x3, name):
-    c3x3r = ConvFactory(data=data, num_filter=num_3x3red, kernel=(1, 1),
-                        name=("%s_3x3" % name), suffix="_reduce")
-    c3x3 = ConvFactory(data=c3x3r, num_filter=num_3x3, kernel=(3, 3),
-                       pad=(1, 1), stride=(2, 2), name=("%s_3x3" % name))
-    cd3x3r = ConvFactory(data=data, num_filter=num_d3x3red, kernel=(1, 1),
-                         name=("%s_double_3x3" % name), suffix="_reduce")
-    cd3x3 = ConvFactory(data=cd3x3r, num_filter=num_d3x3, kernel=(3, 3),
-                        pad=(1, 1), name=("%s_double_3x3_0" % name))
-    cd3x3 = ConvFactory(data=cd3x3, num_filter=num_d3x3, kernel=(3, 3),
-                        pad=(1, 1), stride=(2, 2), name=("%s_double_3x3_1" % name))
-    pooling = sym.Pooling(data=data, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
-                          pool_type="max", name=("max_pool_%s_pool" % name))
-    return sym.Concat(c3x3, cd3x3, pooling,
-                      name="ch_concat_%s_chconcat" % name)
+def _block_b(widths):
+    """Stride-2 downsampling block: 3x3 / double-3x3 branches + max-pool.
+    widths = (r3, n3, rd, nd)."""
+    r3, n3, rd, nd = widths
+    return (
+        ((r3, _K1, _S1, _P0), (n3, _K3, _S2, _P1)),
+        ((rd, _K1, _S1, _P0), (nd, _K3, _S1, _P1), (nd, _K3, _S2, _P1)),
+    ), ("max", _S2, None)
+
+_BODY = (
+    ("A", (64, 64, 64, 64, 96, "avg", 32)),
+    ("A", (64, 64, 96, 64, 96, "avg", 64)),
+    ("B", (128, 160, 64, 96)),
+    ("A", (224, 64, 96, 96, 128, "avg", 128)),
+    ("A", (192, 96, 128, 96, 128, "avg", 128)),
+    ("A", (160, 128, 160, 128, 160, "avg", 128)),
+    ("A", (96, 128, 192, 160, 192, "avg", 128)),
+    ("B", (128, 192, 192, 256)),
+    ("A", (352, 192, 320, 160, 224, "avg", 128)),
+    ("A", (352, 192, 320, 192, 224, "max", 128)),
+)
+
+
+def _inception(x, kind, widths):
+    chains, (pool_type, pool_stride, proj) = \
+        (_block_a if kind == "A" else _block_b)(widths)
+    branches = []
+    for chain in chains:
+        b = x
+        for filters, kernel, stride, pad in chain:
+            b = _unit(b, filters, kernel, stride, pad)
+        branches.append(b)
+    pooled = sym.Pooling(data=x, kernel=_K3, stride=pool_stride, pad=_P1,
+                         pool_type=pool_type)
+    branches.append(pooled if proj is None else _unit(pooled, proj, _K1))
+    return sym.Concat(*branches)
 
 
 def get_symbol(num_classes=1000):
-    data = sym.Variable("data")
-    conv1 = ConvFactory(data=data, num_filter=64, kernel=(7, 7),
-                        stride=(2, 2), pad=(3, 3), name="conv1")
-    pool1 = sym.Pooling(data=conv1, kernel=(3, 3), stride=(2, 2),
-                        pool_type="max", name="pool_1")
-    conv2red = ConvFactory(data=pool1, num_filter=64, kernel=(1, 1),
-                           name="conv2red")
-    conv2 = ConvFactory(data=conv2red, num_filter=192, kernel=(3, 3),
-                        pad=(1, 1), name="conv2")
-    pool2 = sym.Pooling(data=conv2, kernel=(3, 3), stride=(2, 2),
-                        pool_type="max", name="pool_2")
-    in3a = InceptionFactoryA(pool2, 64, 64, 64, 64, 96, "avg", 32, "3a")
-    in3b = InceptionFactoryA(in3a, 64, 64, 96, 64, 96, "avg", 64, "3b")
-    in3c = InceptionFactoryB(in3b, 128, 160, 64, 96, "3c")
-    in4a = InceptionFactoryA(in3c, 224, 64, 96, 96, 128, "avg", 128, "4a")
-    in4b = InceptionFactoryA(in4a, 192, 96, 128, 96, 128, "avg", 128, "4b")
-    in4c = InceptionFactoryA(in4b, 160, 128, 160, 128, 160, "avg", 128, "4c")
-    in4d = InceptionFactoryA(in4c, 96, 128, 192, 160, 192, "avg", 128, "4d")
-    in4e = InceptionFactoryB(in4d, 128, 192, 192, 256, "4e")
-    in5a = InceptionFactoryA(in4e, 352, 192, 320, 160, 224, "avg", 128, "5a")
-    in5b = InceptionFactoryA(in5a, 352, 192, 320, 192, 224, "max", 128, "5b")
-    avg = sym.Pooling(data=in5b, kernel=(7, 7), stride=(1, 1),
-                      global_pool=True, pool_type="avg", name="global_pool")
-    flatten = sym.Flatten(data=avg, name="flatten")
-    fc1 = sym.FullyConnected(data=flatten, num_hidden=num_classes, name="fc1")
-    return sym.SoftmaxOutput(data=fc1, name="softmax")
+    from ..name import NameManager
+    with NameManager():       # deterministic auto-names per build
+        return _build(num_classes)
+
+
+def _build(num_classes):
+    x = sym.Variable("data")
+    x = _unit(x, 64, (7, 7), _S2, (3, 3))
+    x = sym.Pooling(data=x, kernel=_K3, stride=_S2, pool_type="max")
+    x = _unit(x, 64, _K1)
+    x = _unit(x, 192, _K3, _S1, _P1)
+    x = sym.Pooling(data=x, kernel=_K3, stride=_S2, pool_type="max")
+    for kind, widths in _BODY:
+        x = _inception(x, kind, widths)
+    x = sym.Pooling(data=x, kernel=(7, 7), stride=_S1, global_pool=True,
+                    pool_type="avg")
+    x = sym.FullyConnected(data=sym.Flatten(data=x), num_hidden=num_classes,
+                           name="fc1")
+    return sym.SoftmaxOutput(data=x, name="softmax")

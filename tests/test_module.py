@@ -426,3 +426,15 @@ def test_sharded_matches_single_device():
     for k in results["one"]:
         np.testing.assert_allclose(results["mesh"][k], results["one"][k],
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_zoo_builders_deterministic_names():
+    """Auto-named zoo builders must produce identical parameter names on
+    every build (NameManager scope per get_symbol) — checkpoint load in a
+    fresh process depends on it."""
+    from mxnet_tpu.models import alexnet, googlenet, inception_bn
+    for mod in (alexnet, googlenet, inception_bn):
+        first = mod.get_symbol(num_classes=10).list_arguments()
+        mx.sym.Variable("noise")  # perturb the ambient manager
+        second = mod.get_symbol(num_classes=10).list_arguments()
+        assert first == second, mod.__name__
