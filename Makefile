@@ -31,4 +31,9 @@ clean:
 test: all
 	python -m pytest tests/ -q
 
-.PHONY: all clean test
+# multi-process distributed tests (tools/launch.py local tracker); slower,
+# so they gate on MXTPU_NIGHTLY (reference: tests/nightly/test_all.sh)
+test-nightly: all
+	MXTPU_NIGHTLY=1 python -m pytest tests/test_nightly_dist.py -q
+
+.PHONY: all clean test test-nightly

@@ -84,10 +84,12 @@ class KVStore(object):
     """
 
     def __init__(self, kvtype="local"):
+        import time as _time
         self.type = kvtype
         self._store = {}
         self._updater = None
         self._barrier_before_exit = True
+        self._created = _time.time()
 
     # -- identity (include/mxnet/kvstore.h:222-241) -----------------------
     @property
@@ -167,6 +169,53 @@ class KVStore(object):
         optimizer = pickle.loads(pickle.dumps(optimizer))
         self.set_updater(get_updater(optimizer))
 
+    # -- fault surface (kvstore.h:242 get_num_dead_node parity) ------------
+    def num_dead_nodes(self, node_id=None, timeout=None):
+        """Count workers whose liveness heartbeat is stale/missing.
+
+        Parity: ``KVStore::get_num_dead_node(node_id, timeout)``
+        (include/mxnet/kvstore.h:242, impl kvstore_dist.h:149-158 over
+        ps-lite heartbeats).  Here every dist worker runs a heartbeat
+        thread stamping ``mxtpu_hb/<rank>`` in the jax coordination
+        service (started by create('dist_*')); the check is a
+        non-blocking key scan, safe to call while peers are down.
+
+        ``node_id`` narrows the check to one rank (None = all workers).
+        ``timeout`` defaults to 5 heartbeat intervals — enough slack for
+        RPC jitter and modest cross-host clock skew.  Returns 0 for
+        non-dist stores.
+        """
+        import time as _time
+        if timeout is None:
+            timeout = 5 * _HB_INTERVAL
+        client = _dist_client()
+        if client is None or not self.type.startswith("dist"):
+            return 0
+        try:
+            entries = dict(client.key_value_dir_get(_HB_PREFIX))
+        except Exception:
+            # coordination service unreachable (rank-0/coordinator death
+            # included): the cluster is lost — report everyone dead so
+            # restart watchdogs fire rather than report a healthy 0
+            return self.num_workers
+        now = _time.time()
+        ranks = [node_id] if node_id is not None \
+            else range(self.num_workers)
+        dead = 0
+        for r in ranks:
+            stamp = entries.get("%s%d" % (_HB_PREFIX, r))
+            if stamp is None:
+                # no stamp yet: dead only once the peer has had longer
+                # than `timeout` since this store came up to write one
+                # (avoids a startup race counting slow starters as dead)
+                if now - self._created > timeout:
+                    dead += 1
+            elif now - float(stamp) > timeout:
+                dead += 1
+        return dead
+
+    get_num_dead_node = num_dead_nodes
+
     # -- misc --------------------------------------------------------------
     def barrier(self):
         """Global worker barrier (parity kvstore.h:249; ps Postoffice barrier)."""
@@ -211,6 +260,47 @@ def _states_from_host(states):
     return {k: jax.tree_util.tree_map(
         lambda a: NDArray(a) if a is not None else None, v)
         for k, v in states.items()}
+
+
+_HB_PREFIX = "mxtpu_hb/"
+_HB_INTERVAL = 2.0
+
+
+def _dist_client():
+    """The jax coordination-service client, or None."""
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client
+    except Exception:
+        return None
+
+
+def _start_heartbeat():
+    """Background liveness stamping for num_dead_nodes (ps-lite heartbeat
+    analog).  Idempotent per process."""
+    if getattr(_start_heartbeat, "_thread", None) is not None:
+        return
+    client = _dist_client()
+    if client is None:
+        return
+    import threading
+    import time as _time
+    rank = jax.process_index()
+    key = "%s%d" % (_HB_PREFIX, rank)
+
+    def _beat():
+        while True:
+            try:
+                client.key_value_set(key, repr(_time.time()),
+                                     allow_overwrite=True)
+            except Exception:
+                return       # cluster shut down
+            _time.sleep(_HB_INTERVAL)
+
+    t = threading.Thread(target=_beat, daemon=True,
+                         name="mxtpu-kv-heartbeat")
+    t.start()
+    _start_heartbeat._thread = t
 
 
 _VALID_TYPES = ("local", "local_update_cpu", "local_allreduce_cpu",
@@ -277,4 +367,5 @@ def create(name="local"):
         raise MXNetError("unknown KVStore type %r" % name)
     if base.startswith("dist"):
         _maybe_init_distributed()
+        _start_heartbeat()
     return KVStore(base)

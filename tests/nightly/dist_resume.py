@@ -1,0 +1,113 @@
+"""Kill-one-worker -> detect -> resume-from-checkpoint integration test.
+
+Parity story: the reference's fault surface is ps-lite heartbeats exposed
+as ``get_num_dead_node`` (kvstore_dist.h:149-158) plus "worker may rejoin"
+recovery branches; the TPU-native recovery model (SURVEY §5) is
+checkpoint/resume with pod restart.  This script exercises both halves:
+
+Phase A (``MXTPU_FAULT_RANK`` set): all workers train one epoch and
+checkpoint; then the fault rank dies without warning (os._exit).  The
+survivor detects it via ``kv.num_dead_nodes`` within a few heartbeats and
+aborts cleanly with exit code 3 (the restart signal) instead of hanging
+in a collective.
+
+Phase B (``MXTPU_RESUME=1``): a fresh launch loads the phase-A checkpoint
+and trains one more epoch, asserting the loss kept improving — the
+restart half of kill-and-resume.
+
+Run (the wrapper in tests/test_nightly_dist.py does this):
+    python tools/launch.py -n 2 --launcher local \
+        python tests/nightly/dist_resume.py
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+PREFIX = os.environ.get("MXTPU_RESUME_PREFIX", "/tmp/mxtpu_dist_resume")
+
+
+def build_data(rank, nw):
+    rng = np.random.RandomState(7)           # same data, sharded by rank
+    X = rng.randn(240, 16).astype(np.float32)
+    w = rng.randn(16)
+    y = (X @ w > 0).astype(np.float32)
+    shard = slice(rank * len(X) // nw, (rank + 1) * len(X) // nw)
+    return X[shard], y[shard]
+
+
+def softmax_ce(mod, it):
+    losses = []
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        p = mod.get_outputs()[0].asnumpy()
+        lbl = batch.label[0].asnumpy().astype(int)
+        losses.append(-np.log(p[np.arange(len(lbl)), lbl] + 1e-8).mean())
+    it.reset()
+    return float(np.mean(losses))
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    fault_rank = os.environ.get("MXTPU_FAULT_RANK")
+    resume = os.environ.get("MXTPU_RESUME") == "1"
+
+    X, y = build_data(rank, nw)
+    train = mx.io.NDArrayIter(X, y, batch_size=30)
+    net = mx.models.get_mlp(num_classes=2, hidden=(16,))
+    mod = mx.mod.Module(net, context=mx.context.cpu())
+
+    epoch0 = 0
+    if resume:
+        mod = mx.mod.Module.load(PREFIX, 1, load_optimizer_states=True,
+                                 context=mx.context.cpu())
+        epoch0 = 1
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.3})
+
+    loss_before = softmax_ce(mod, train)
+    for batch in train:
+        mod.forward_backward(batch)
+        mod.update()
+    train.reset()
+    loss_after = softmax_ce(mod, train)
+    print("rank %d epoch %d loss %.4f -> %.4f" % (rank, epoch0,
+                                                  loss_before, loss_after),
+          flush=True)
+    assert loss_after < loss_before
+
+    if resume:
+        print("rank %d resume OK" % rank, flush=True)
+        sys.stdout.flush()
+        os._exit(0)
+
+    # phase A: checkpoint, then inject the fault
+    if rank == 0:
+        mod.save_checkpoint(PREFIX, 1, save_optimizer_states=True)
+    kv.barrier()
+    if fault_rank is not None and rank == int(fault_rank):
+        os._exit(1)                      # dies without saying goodbye
+    # survivors: poll the fault surface instead of walking into a
+    # collective that would hang on the dead peer
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        time.sleep(2)
+        dead = kv.num_dead_nodes(timeout=6.0)
+        if dead > 0:
+            print("rank %d detected %d dead node(s); aborting for restart"
+                  % (rank, dead), flush=True)
+            sys.stdout.flush()
+            os._exit(3)                  # restart signal
+    print("rank %d FAILED to detect dead worker" % rank, flush=True)
+    os._exit(4)
+
+
+if __name__ == "__main__":
+    main()

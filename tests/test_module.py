@@ -435,6 +435,7 @@ def test_zoo_builders_deterministic_names():
     from mxnet_tpu.models import alexnet, googlenet, inception_bn
     for mod in (alexnet, googlenet, inception_bn):
         first = mod.get_symbol(num_classes=10).list_arguments()
-        mx.sym.Variable("noise")  # perturb the ambient manager
+        # bump the ambient manager's counters with an UNNAMED op
+        mx.sym.FullyConnected(mx.sym.Variable("noise"), num_hidden=1)
         second = mod.get_symbol(num_classes=10).list_arguments()
         assert first == second, mod.__name__

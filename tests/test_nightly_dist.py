@@ -1,0 +1,67 @@
+"""Gated wrapper running the multi-process nightly dist tests through
+``tools/launch.py --launcher local`` (the reference pattern:
+tests/nightly/test_all.sh invoking dist scripts via the tracker).
+
+Enabled with MXTPU_NIGHTLY=1 (``make test-nightly``); skipped in the fast
+suite — each case boots real jax.distributed worker processes.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("MXTPU_NIGHTLY"),
+    reason="multi-process dist tests are nightly (set MXTPU_NIGHTLY=1)")
+
+
+def _launch(script, n=2, port=9890, extra_env=None, expect_rc=0):
+    cmd = [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+           "-n", str(n), "--launcher", "local", "--workdir", _ROOT,
+           "--port", str(port),
+           sys.executable, os.path.join("tests", "nightly", script)]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env.update(extra_env or {})
+    proc = subprocess.run(cmd, cwd=_ROOT, env=env, timeout=600,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                          text=True)
+    assert proc.returncode == expect_rc, (proc.returncode,
+                                          proc.stdout[-2000:])
+    return proc.stdout
+
+
+def test_dist_sync_kvstore():
+    out = _launch("dist_sync_kvstore.py", port=9890)
+    assert out.count("OK") >= 2
+
+
+def test_dist_lenet_converges():
+    out = _launch("dist_lenet.py", port=9891)
+    accs = [float(line.rsplit(None, 1)[-1]) for line in out.splitlines()
+            if "accuracy" in line]
+    assert len(accs) >= 2 and min(accs) > 0.9, out[-500:]
+
+
+def test_kill_worker_detect_and_resume(tmp_path):
+    """VERDICT r2 #7: kill one worker mid-job; the survivor's
+    kv.num_dead_nodes notices within a few heartbeats and aborts for
+    restart; a fresh launch resumes from the checkpoint and keeps
+    improving."""
+    prefix = str(tmp_path / "resume")
+    # phase A: rank 1 dies after the first checkpoint; rank 0 detects it
+    # (exit 3 = restart signal) instead of hanging -> launcher rc 1|3 = 3
+    out = _launch("dist_resume.py", port=9893,
+                  extra_env={"MXTPU_FAULT_RANK": "1",
+                             "MXTPU_RESUME_PREFIX": prefix},
+                  expect_rc=3)
+    assert "detected 1 dead node" in out, out[-1500:]
+    assert os.path.exists(prefix + "-0001.params")
+    # phase B: restart resumes from the checkpoint
+    out = _launch("dist_resume.py", port=9894,
+                  extra_env={"MXTPU_RESUME": "1",
+                             "MXTPU_RESUME_PREFIX": prefix})
+    assert out.count("resume OK") == 2, out[-1500:]

@@ -33,6 +33,9 @@ import subprocess
 import sys
 
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 def build_env(rank, args):
     env = dict(os.environ)
     env["MXTPU_COORDINATOR"] = "%s:%d" % (args.coordinator, args.port)
@@ -41,19 +44,28 @@ def build_env(rank, args):
     # reference-compat aliases (kvstore.py reads these too)
     env["DMLC_NUM_WORKER"] = str(args.num_workers)
     env["DMLC_ROLE"] = "worker"
+    # spawned roles must find mxnet_tpu no matter where the user launched
+    # from (the reference tracker syncs the workdir; we ship PYTHONPATH)
+    env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     return env
 
 
 def launch_local(args, command):
     procs = []
+    workdir = args.workdir or os.getcwd()
     for rank in range(args.num_workers):
         env = build_env(rank, args)
         # hermetic local testing: force fake devices on CPU (the outer env
-        # may pin JAX_PLATFORMS to a real accelerator plugin)
+        # may pin JAX_PLATFORMS to a real accelerator plugin); drop
+        # sitecustomize-injected accelerator-plugin paths outright — a
+        # plugin whose backend hangs at init would wedge every worker
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d"
                             % args.devices_per_worker)
-        procs.append(subprocess.Popen(command, env=env))
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in env["PYTHONPATH"].split(os.pathsep)
+            if p and not os.path.isfile(os.path.join(p, "sitecustomize.py")))
+        procs.append(subprocess.Popen(command, env=env, cwd=workdir))
 
     def _kill(*_):
         for p in procs:
@@ -79,7 +91,7 @@ def launch_ssh(args, command):
         exports = " ".join("%s=%s" % (k, shlex.quote(v))
                            for k, v in env.items()
                            if k.startswith(("MXTPU_", "DMLC_", "JAX_",
-                                            "XLA_")))
+                                            "XLA_", "PYTHONPATH")))
         remote = "cd %s && env %s %s" % (
             shlex.quote(args.workdir) if args.workdir else "~", exports,
             " ".join(shlex.quote(c) for c in command))
