@@ -14,7 +14,23 @@ OBJS := $(SRCS:src/%.cc=$(LIBDIR)/%.o)
 HAS_JPEG := $(shell printf '\043include <cstdio>\n\043include <jpeglib.h>\nint main(){return 0;}\n' | $(CXX) -x c++ - -ljpeg -o /dev/null 2>/dev/null && echo 1)
 LDLIBS := $(if $(HAS_JPEG),-ljpeg,)
 
+PY_INCLUDES := $(shell python3-config --includes 2>/dev/null)
+PY_LDFLAGS := $(shell python3-config --ldflags --embed 2>/dev/null || python3-config --ldflags 2>/dev/null)
+
 all: $(LIBDIR)/libmxtpu.so
+
+# flat C ABI (src/c_api.cc) — embeds/attaches the Python interpreter
+capi: $(LIBDIR)/libmxtpu_capi.so
+
+$(LIBDIR)/libmxtpu_capi.so: src/c_api.cc | $(LIBDIR)
+	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) -shared $< -o $@ $(PY_LDFLAGS)
+
+$(LIBDIR)/capi_smoke: tests/capi/capi_smoke.c $(LIBDIR)/libmxtpu_capi.so
+	$(CC) -O2 -Wall -Iinclude $< -o $@ -L$(LIBDIR) -lmxtpu_capi \
+	    -Wl,-rpath,'$$ORIGIN'
+
+test-capi: $(LIBDIR)/capi_smoke
+	python -m pytest tests/test_capi.py -q
 
 $(LIBDIR):
 	mkdir -p $(LIBDIR)

@@ -1,0 +1,60 @@
+/* Flat C ABI for mxnet_tpu (parity subset of the reference's c_api.h).
+ * Conventions match the reference: opaque handles, 0/-1 return codes,
+ * MXGetLastError() for the failure message.  Implemented in
+ * src/c_api.cc over an embedded/attached Python interpreter. */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef void* KVStoreHandle;
+
+const char* MXGetLastError(void);
+
+int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim,
+                    NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle h);
+int MXNDArrayGetShape(NDArrayHandle h, uint32_t* ndim, uint32_t* shape,
+                      uint32_t cap);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const float* data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle h, float* data, size_t size);
+int MXNDArrayWaitAll(void);
+
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXSymbolFree(SymbolHandle h);
+int MXSymbolGetNumArguments(SymbolHandle h, uint32_t* out);
+int MXSymbolGetArgument(SymbolHandle h, uint32_t index, char* buf,
+                        size_t cap);
+
+/* shapes_json example: {"data": [4, 10], "softmax_label": [4]} */
+int MXExecutorSimpleBind(SymbolHandle sym, const char* shapes_json,
+                         ExecutorHandle* out);
+int MXExecutorFree(ExecutorHandle h);
+int MXExecutorSetArg(ExecutorHandle h, const char* name,
+                     const float* data, size_t size);
+int MXExecutorForward(ExecutorHandle h, int is_train,
+                      uint32_t* num_outputs);
+int MXExecutorOutputShape(ExecutorHandle h, uint32_t index,
+                          uint32_t* ndim, uint32_t* shape, uint32_t cap);
+int MXExecutorOutputCopy(ExecutorHandle h, uint32_t index, float* data,
+                         size_t size);
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreFree(KVStoreHandle h);
+int MXKVStoreInit(KVStoreHandle h, int key, NDArrayHandle val);
+int MXKVStorePush(KVStoreHandle h, int key, NDArrayHandle val);
+int MXKVStorePull(KVStoreHandle h, int key, NDArrayHandle out);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* MXTPU_C_API_H_ */

@@ -19,15 +19,38 @@ BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
 
 
-def do_checkpoint(prefix, period=1):
+def do_checkpoint(prefix, period=1, run_async=False):
     """Epoch-end callback to checkpoint the model (parity: callback.py
-    do_checkpoint -> model.save_checkpoint)."""
+    do_checkpoint -> model.save_checkpoint).
+
+    ``run_async=True`` pushes the serialization+write through the
+    dependency engine so the next epoch's compute overlaps the disk write
+    (the engine's write-var serializes checkpoints to the same prefix in
+    order).  Call ``mxnet_tpu.engine.get().wait_for_all()`` (or
+    ``nd.waitall``) before reading the files.
+    """
     period = int(max(1, period))
+    state = {"var": None}
+
+    def _save(iter_no, sym, arg, aux):
+        from .model import save_checkpoint
+        save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
 
     def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            from .model import save_checkpoint
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+        if (iter_no + 1) % period != 0:
+            return
+        if not run_async:
+            _save(iter_no, sym, arg, aux)
+            return
+        from . import engine as _engine
+        eng = _engine.get()
+        if state["var"] is None:
+            state["var"] = eng.new_variable()
+        # snapshot copies NOW: the epoch loop mutates the live params
+        arg = {k: v.copy() for k, v in arg.items()}
+        aux = {k: v.copy() for k, v in aux.items()}
+        eng.push(lambda: _save(iter_no, sym, arg, aux),
+                 mutable_vars=[state["var"]])
     return _callback
 
 

@@ -1,0 +1,112 @@
+"""Python side of the C ABI (src/c_api.cc).
+
+The reference's ABI is ~100 flat ``MX*`` functions over its C++ core
+(src/c_api/c_api.cc:104-1454); here the core is Python, so the ABI embeds
+the interpreter and calls these helpers.  Every helper takes/returns only
+primitives, buffers, or opaque objects the C side holds as handles —
+no mxnet types cross the boundary.
+
+Keep signatures in sync with src/c_api.cc.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+
+def ndarray_create(shape):
+    from .ndarray import zeros
+    return zeros(tuple(int(d) for d in shape))
+
+
+def ndarray_shape(nd):
+    return tuple(int(d) for d in nd.shape)
+
+
+def ndarray_copy_from(nd, buf):
+    import jax.numpy as jnp
+    src = _np.frombuffer(buf, dtype=_np.float32).reshape(nd.shape)
+    nd._set_data(jnp.asarray(_np.array(src)))
+    return 0
+
+
+def ndarray_copy_to(nd, buf):
+    out = _np.frombuffer(buf, dtype=_np.float32)
+    arr = nd.asnumpy().astype(_np.float32).ravel()
+    if out.size != arr.size:
+        raise ValueError("buffer size %d != ndarray size %d"
+                         % (out.size, arr.size))
+    out[:] = arr
+    return 0
+
+
+def ndarray_waitall():
+    from .ndarray import waitall
+    waitall()
+    return 0
+
+
+def symbol_from_json(text):
+    import json
+    from . import symbol as sym_mod
+    import os
+    import tempfile
+    # symbol.load reads a file; round-trip through a temp file keeps the
+    # public loader the single deserialization path
+    with tempfile.NamedTemporaryFile("w", suffix="-symbol.json",
+                                     delete=False) as f:
+        f.write(text)
+        path = f.name
+    try:
+        return sym_mod.load(path)
+    finally:
+        os.unlink(path)
+
+
+def symbol_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def executor_bind(sym, shapes_json):
+    import json
+    from .context import cpu, current_context
+    shapes = {k: tuple(v) for k, v in json.loads(shapes_json).items()}
+    return sym.simple_bind(current_context(), grad_req="null", **shapes)
+
+
+def executor_set_arg(exec_, name, buf):
+    nd = exec_.arg_dict[name]
+    ndarray_copy_from(nd, buf)
+    return 0
+
+
+def executor_forward(exec_, is_train):
+    exec_.forward(is_train=bool(is_train))
+    return len(exec_.outputs)
+
+
+def executor_output_shape(exec_, index):
+    return tuple(int(d) for d in exec_.outputs[index].shape)
+
+
+def executor_output_to(exec_, index, buf):
+    return ndarray_copy_to(exec_.outputs[index], buf)
+
+
+def kvstore_create(kvtype):
+    from . import kvstore
+    return kvstore.create(kvtype)
+
+
+def kvstore_init(kv, key, nd):
+    kv.init(int(key), nd)
+    return 0
+
+
+def kvstore_push(kv, key, nd):
+    kv.push(int(key), nd)
+    return 0
+
+
+def kvstore_pull(kv, key, nd):
+    kv.pull(int(key), nd)
+    return 0

@@ -1,0 +1,317 @@
+// Flat C ABI over the mxnet_tpu core — the layer that makes non-Python
+// bindings possible, mirroring the reference's src/c_api/c_api.cc
+// (:104-1454): opaque handles, int return codes, MXGetLastError.
+//
+// The reference's core is C++ and its Python layer sits ON TOP of this
+// ABI; here the core is Python/XLA, so the ABI EMBEDS the interpreter
+// (attaching to an existing one when the host process is Python) and
+// drives mxnet_tpu.capi_impl.  Handles are PyObject references.
+//
+// Build: make lib/libmxtpu_capi.so (links libpython).  Smoke-tested by a
+// real C consumer, tests/capi/capi_smoke.c.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+PyObject* g_impl = nullptr;  // mxnet_tpu.capi_impl module
+
+void SetError(const char* what) { g_last_error = what ? what : "unknown"; }
+
+void SetErrorFromPython() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  PyObject* s = value ? PyObject_Str(value) : nullptr;
+  if (s) {
+    const char* msg = PyUnicode_AsUTF8(s);
+    g_last_error = msg ? msg : "python error";
+    Py_DECREF(s);
+  } else {
+    g_last_error = "python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Scoped interpreter attach: initializes Python on first use when the
+// host process is plain C; otherwise just takes the GIL.
+class Gil {
+ public:
+  Gil() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // drop the GIL acquired by initialization so Ensure below nests
+      owner_init_ = true;
+    }
+    state_ = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+  bool owner_init_ = false;
+};
+
+int EnsureImpl() {
+  if (g_impl) return 0;
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.capi_impl");
+  if (!mod) {
+    SetErrorFromPython();
+    return -1;
+  }
+  g_impl = mod;  // leaked on purpose: lives for the process
+  return 0;
+}
+
+// Call impl.<fn>(args...) returning the result object (new ref) or null.
+PyObject* Call(const char* fn, PyObject* args) {
+  if (EnsureImpl() != 0) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(g_impl, fn);
+  if (!f) {
+    SetErrorFromPython();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (!out) SetErrorFromPython();
+  return out;
+}
+
+// rc-style call: discard the result, 0 ok / -1 error.
+int CallRC(const char* fn, PyObject* args) {
+  PyObject* out = Call(fn, args);
+  if (!out) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+PyObject* WritableView(void* data, size_t nbytes) {
+  return PyMemoryView_FromMemory(static_cast<char*>(data),
+                                 static_cast<Py_ssize_t>(nbytes),
+                                 PyBUF_WRITE);
+}
+
+PyObject* ReadView(const void* data, size_t nbytes) {
+  return PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)),
+      static_cast<Py_ssize_t>(nbytes), PyBUF_READ);
+}
+
+int FillShape(PyObject* tup, uint32_t* ndim, uint32_t* shape,
+              uint32_t cap) {
+  Py_ssize_t n = PyTuple_Size(tup);
+  if (n < 0 || static_cast<uint32_t>(n) > cap) {
+    SetError("shape rank exceeds caller buffer");
+    return -1;
+  }
+  *ndim = static_cast<uint32_t>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    shape[i] = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(tup, i)));
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef void* KVStoreHandle;
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+// ---- NDArray (c_api.cc:116-363 parity subset) ----------------------
+int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim,
+                    NDArrayHandle* out) {
+  Gil gil;
+  PyObject* dims = PyTuple_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyTuple_SetItem(dims, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* nd = Call("ndarray_create", PyTuple_Pack(1, dims));
+  Py_DECREF(dims);
+  if (!nd) return -1;
+  *out = nd;
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle h) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle h, uint32_t* ndim, uint32_t* shape,
+                      uint32_t cap) {
+  Gil gil;
+  PyObject* tup = Call("ndarray_shape",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!tup) return -1;
+  int rc = FillShape(tup, ndim, shape, cap);
+  Py_DECREF(tup);
+  return rc;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const float* data,
+                             size_t size) {
+  Gil gil;
+  // "N" steals the view reference: no leak
+  return CallRC("ndarray_copy_from",
+                Py_BuildValue("(ON)", static_cast<PyObject*>(h),
+                              ReadView(data, size * sizeof(float))));
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle h, float* data, size_t size) {
+  Gil gil;
+  return CallRC("ndarray_copy_to",
+                Py_BuildValue("(ON)", static_cast<PyObject*>(h),
+                              WritableView(data, size * sizeof(float))));
+}
+
+int MXNDArrayWaitAll() {
+  Gil gil;
+  return CallRC("ndarray_waitall", PyTuple_New(0));
+}
+
+// ---- Symbol (c_api.cc:447-937 parity subset) -----------------------
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  Gil gil;
+  PyObject* sym = Call("symbol_from_json",
+                       Py_BuildValue("(s)", json));
+  if (!sym) return -1;
+  *out = sym;
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle h) { return MXNDArrayFree(h); }
+
+int MXSymbolGetNumArguments(SymbolHandle h, uint32_t* out) {
+  Gil gil;
+  PyObject* lst = Call("symbol_arguments",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!lst) return -1;
+  *out = static_cast<uint32_t>(PyList_Size(lst));
+  Py_DECREF(lst);
+  return 0;
+}
+
+int MXSymbolGetArgument(SymbolHandle h, uint32_t index, char* buf,
+                        size_t cap) {
+  Gil gil;
+  PyObject* lst = Call("symbol_arguments",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!lst) return -1;
+  if (index >= static_cast<uint32_t>(PyList_Size(lst))) {
+    Py_DECREF(lst);
+    SetError("argument index out of range");
+    return -1;
+  }
+  const char* name = PyUnicode_AsUTF8(PyList_GetItem(lst, index));
+  snprintf(buf, cap, "%s", name ? name : "");
+  Py_DECREF(lst);
+  return 0;
+}
+
+// ---- Executor (c_api.cc:939-1099 parity subset) --------------------
+// shapes_json: {"data": [4, 10], "softmax_label": [4]}
+int MXExecutorSimpleBind(SymbolHandle sym, const char* shapes_json,
+                         ExecutorHandle* out) {
+  Gil gil;
+  PyObject* exec_ = Call("executor_bind",
+                         Py_BuildValue("(Os)",
+                                       static_cast<PyObject*>(sym),
+                                       shapes_json));
+  if (!exec_) return -1;
+  *out = exec_;
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle h) { return MXNDArrayFree(h); }
+
+int MXExecutorSetArg(ExecutorHandle h, const char* name,
+                     const float* data, size_t size) {
+  Gil gil;
+  return CallRC("executor_set_arg",
+                Py_BuildValue("(OsN)", static_cast<PyObject*>(h), name,
+                              ReadView(data, size * sizeof(float))));
+}
+
+int MXExecutorForward(ExecutorHandle h, int is_train,
+                      uint32_t* num_outputs) {
+  Gil gil;
+  PyObject* n = Call("executor_forward",
+                     Py_BuildValue("(Oi)", static_cast<PyObject*>(h),
+                                   is_train));
+  if (!n) return -1;
+  if (num_outputs) *num_outputs = static_cast<uint32_t>(PyLong_AsLong(n));
+  Py_DECREF(n);
+  return 0;
+}
+
+int MXExecutorOutputShape(ExecutorHandle h, uint32_t index,
+                          uint32_t* ndim, uint32_t* shape, uint32_t cap) {
+  Gil gil;
+  PyObject* tup = Call("executor_output_shape",
+                       Py_BuildValue("(OI)", static_cast<PyObject*>(h),
+                                     index));
+  if (!tup) return -1;
+  int rc = FillShape(tup, ndim, shape, cap);
+  Py_DECREF(tup);
+  return rc;
+}
+
+int MXExecutorOutputCopy(ExecutorHandle h, uint32_t index, float* data,
+                         size_t size) {
+  Gil gil;
+  return CallRC("executor_output_to",
+                Py_BuildValue("(OIN)", static_cast<PyObject*>(h), index,
+                              WritableView(data, size * sizeof(float))));
+}
+
+// ---- KVStore (c_api.cc:1199-1375 parity subset) --------------------
+int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  Gil gil;
+  PyObject* kv = Call("kvstore_create", Py_BuildValue("(s)", type));
+  if (!kv) return -1;
+  *out = kv;
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle h) { return MXNDArrayFree(h); }
+
+int MXKVStoreInit(KVStoreHandle h, int key, NDArrayHandle val) {
+  Gil gil;
+  return CallRC("kvstore_init",
+                Py_BuildValue("(OiO)", static_cast<PyObject*>(h), key,
+                              static_cast<PyObject*>(val)));
+}
+
+int MXKVStorePush(KVStoreHandle h, int key, NDArrayHandle val) {
+  Gil gil;
+  return CallRC("kvstore_push",
+                Py_BuildValue("(OiO)", static_cast<PyObject*>(h), key,
+                              static_cast<PyObject*>(val)));
+}
+
+int MXKVStorePull(KVStoreHandle h, int key, NDArrayHandle out) {
+  Gil gil;
+  return CallRC("kvstore_pull",
+                Py_BuildValue("(OiO)", static_cast<PyObject*>(h), key,
+                              static_cast<PyObject*>(out)));
+}
+
+}  // extern "C"

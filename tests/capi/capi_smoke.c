@@ -1,0 +1,111 @@
+/* Real C consumer of the mxnet_tpu ABI (the proof the reference's
+ * language-binding story survives the TPU rewrite): ndarray round trip,
+ * kvstore push/pull aggregation, symbol load -> bind -> forward.
+ *
+ * Built and run by `make test-capi`; expects MXTPU_SYMBOL_JSON to point
+ * at a saved -symbol.json (the pytest wrapper generates one). */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+#define CHECK(rc) do { \
+    if ((rc) != 0) { \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, \
+              MXGetLastError()); \
+      return 1; \
+    } } while (0)
+
+int main(void) {
+  /* --- ndarray round trip --- */
+  uint32_t shape[2] = {2, 3};
+  NDArrayHandle a;
+  CHECK(MXNDArrayCreate(shape, 2, &a));
+  float in[6] = {1, 2, 3, 4, 5, 6}, out[6] = {0};
+  CHECK(MXNDArraySyncCopyFromCPU(a, in, 6));
+  CHECK(MXNDArraySyncCopyToCPU(a, out, 6));
+  for (int i = 0; i < 6; ++i) {
+    if (out[i] != in[i]) {
+      fprintf(stderr, "FAIL roundtrip at %d: %f\n", i, out[i]);
+      return 1;
+    }
+  }
+  uint32_t ndim, got[8];
+  CHECK(MXNDArrayGetShape(a, &ndim, got, 8));
+  if (ndim != 2 || got[0] != 2 || got[1] != 3) {
+    fprintf(stderr, "FAIL shape\n");
+    return 1;
+  }
+
+  /* --- kvstore aggregation --- */
+  KVStoreHandle kv;
+  CHECK(MXKVStoreCreate("local", &kv));
+  CHECK(MXKVStoreInit(kv, 3, a));
+  CHECK(MXKVStorePush(kv, 3, a));
+  NDArrayHandle pulled;
+  CHECK(MXNDArrayCreate(shape, 2, &pulled));
+  CHECK(MXKVStorePull(kv, 3, pulled));
+  CHECK(MXNDArraySyncCopyToCPU(pulled, out, 6));
+  if (out[5] != 6.0f) {
+    fprintf(stderr, "FAIL kvstore pull: %f\n", out[5]);
+    return 1;
+  }
+
+  /* --- symbol -> executor -> forward --- */
+  const char* path = getenv("MXTPU_SYMBOL_JSON");
+  if (path != NULL) {
+    FILE* f = fopen(path, "rb");
+    if (!f) {
+      fprintf(stderr, "FAIL: cannot open %s\n", path);
+      return 1;
+    }
+    fseek(f, 0, SEEK_END);
+    long n = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    char* json = (char*)malloc(n + 1);
+    if (fread(json, 1, n, f) != (size_t)n) {
+      fprintf(stderr, "FAIL: short read of %s\n", path);
+      return 1;
+    }
+    json[n] = 0;
+    fclose(f);
+
+    SymbolHandle sym;
+    CHECK(MXSymbolCreateFromJSON(json, &sym));
+    free(json);
+    uint32_t nargs;
+    CHECK(MXSymbolGetNumArguments(sym, &nargs));
+    char name[64];
+    CHECK(MXSymbolGetArgument(sym, 0, name, sizeof(name)));
+    printf("symbol: %u args, first=%s\n", nargs, name);
+
+    ExecutorHandle exec;
+    CHECK(MXExecutorSimpleBind(
+        sym, "{\"data\": [4, 10], \"softmax_label\": [4]}", &exec));
+    float data[40];
+    for (int i = 0; i < 40; ++i) data[i] = (float)i / 40.0f;
+    CHECK(MXExecutorSetArg(exec, "data", data, 40));
+    uint32_t nout;
+    CHECK(MXExecutorForward(exec, 0, &nout));
+    uint32_t oshape[8], ondim;
+    CHECK(MXExecutorOutputShape(exec, 0, &ondim, oshape, 8));
+    float probs[8];
+    CHECK(MXExecutorOutputCopy(exec, 0, probs, oshape[0] * oshape[1]));
+    float rowsum = probs[0] + probs[1];
+    if (rowsum < 0.99f || rowsum > 1.01f) {
+      fprintf(stderr, "FAIL softmax rowsum %f\n", rowsum);
+      return 1;
+    }
+    printf("forward: %u outputs, shape (%u,%u), row0 sum=%f\n",
+           nout, oshape[0], oshape[1], rowsum);
+    CHECK(MXExecutorFree(exec));
+    CHECK(MXSymbolFree(sym));
+  }
+
+  CHECK(MXNDArrayFree(a));
+  CHECK(MXNDArrayFree(pulled));
+  CHECK(MXKVStoreFree(kv));
+  printf("CAPI SMOKE OK\n");
+  return 0;
+}

@@ -205,3 +205,29 @@ def test_pushed_fn_exception_reraised_from_wait():
     e.wait_for_var(v)
     assert hits == [1]
     e.delete_variable(v)
+
+
+def test_async_checkpoint_via_engine(tmp_path):
+    """do_checkpoint(run_async=True) pushes writes through the engine;
+    epochs overlap the disk write and wait_for_all makes them durable."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine as eng
+
+    X = np.random.RandomState(0).randn(80, 10).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y, batch_size=20)
+    prefix = str(tmp_path / "ck")
+    model = mx.model.FeedForward(mx.models.get_mlp(2, (8,)),
+                                 ctx=mx.context.cpu(), num_epoch=3,
+                                 optimizer="sgd", learning_rate=0.1)
+    model.fit(X, y,
+              epoch_end_callback=mx.callback.do_checkpoint(prefix,
+                                                           run_async=True))
+    eng.get().wait_for_all()
+    import os
+    for epoch in (1, 2, 3):
+        assert os.path.exists("%s-%04d.params" % (prefix, epoch)), epoch
+    # resumable
+    m2 = mx.model.FeedForward.load(prefix, 3, ctx=mx.context.cpu())
+    assert m2.predict(X).shape == (80, 2)
