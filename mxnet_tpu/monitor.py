@@ -6,6 +6,13 @@ installs a C callback fired per-op by the graph executor
 trace in interpret mode capturing intermediate outputs (the analog of
 PartialForward debugging), so stats are exact without perturbing the
 compiled fast path.
+
+.. warning::
+   Installing a monitor DISABLES compiled execution on the monitored
+   executors: every forward runs op-by-op in interpret mode (and the
+   fused one-dispatch fit step turns off), typically 10-100x slower.
+   That is the debugging trade-off by design — the reference's NaiveEngine
+   story (SURVEY §5 race detection).  Remove the monitor for timing runs.
 """
 from __future__ import annotations
 
@@ -45,6 +52,12 @@ class Monitor(object):
 
     def install(self, exe):
         """Install the monitor callback on an executor (monitor.py:51)."""
+        if not self.exes:
+            logging.warning(
+                "Monitor installed: monitored executors run op-by-op in "
+                "interpret mode (compiled/fused dispatch disabled) — "
+                "expect a large slowdown; remove the monitor for timing "
+                "runs")
         exe.set_monitor_callback(self.stat_helper)
         self.exes.append(exe)
 

@@ -23,6 +23,7 @@ TPU-native reimplementation of the reference's NDArray
 from __future__ import annotations
 
 import struct
+import weakref
 
 import numpy as _np
 
@@ -36,6 +37,10 @@ __all__ = [
 
 import jax
 import jax.numpy as jnp
+
+# weak registry of this framework's arrays; waitall() blocks on these
+# instead of scanning the process-wide jax heap
+_LIVE = weakref.WeakSet()
 
 
 def _ctx_device(ctx):
@@ -55,10 +60,12 @@ class NDArray:
     roles (buffer + dependency token).
     """
 
-    __slots__ = ("_storage", "_ctx", "_writable", "_parent", "_getter", "_setter")
+    __slots__ = ("_storage", "_ctx", "_writable", "_parent", "_getter",
+                 "_setter", "__weakref__")
 
     def __init__(self, data, ctx=None, writable=True, _parent=None,
                  _getter=None, _setter=None):
+        _LIVE.add(self)
         self._parent = _parent
         self._getter = _getter
         self._setter = _setter
@@ -396,12 +403,21 @@ def concatenate(arrays, axis=0, always_copy=True):
 
 
 def waitall():
-    """Block until all launched work completes (Engine::WaitForAll parity)."""
-    for arr in jax.live_arrays():
-        try:
-            arr.block_until_ready()
-        except Exception:
-            pass
+    """Block until all launched work completes (Engine::WaitForAll parity):
+    drains the host-side dependency engine (prefetch/decode/checkpoint
+    pushes), then blocks on every live NDArray's buffer — a weak registry
+    of this framework's arrays, not a scan of the whole process heap."""
+    from . import engine as _engine
+    eng = _engine._ENGINE
+    if eng is not None:
+        eng.wait_for_all()
+    for arr in list(_LIVE):
+        data = arr._storage
+        if data is not None and hasattr(data, "block_until_ready"):
+            try:
+                data.block_until_ready()
+            except Exception:
+                pass
 
 
 # ----------------------------------------------------------------------

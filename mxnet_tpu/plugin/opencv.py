@@ -1,0 +1,41 @@
+"""OpenCV bridge plugin (capability parity: plugin/opencv — the
+reference's cv2-backed imdecode/resize/copyMakeBorder NDArray functions).
+
+Backed by the shared host-side image layer (mxnet_tpu.image: cv2 when
+importable, else PIL), so the plugin works wherever the IO pipeline does;
+results are NDArrays ready for the compute path.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..ndarray import NDArray, array as nd_array
+
+__all__ = ["imdecode", "imresize", "copy_make_border"]
+
+
+def imdecode(buf, iscolor=1, to_rgb=True):
+    """Decode an encoded image buffer to an HWC uint8 NDArray
+    (parity: plugin/opencv imdecode; to_rgb mirrors its BGR/RGB flag)."""
+    from ..image import imdecode_bytes
+    img = imdecode_bytes(bytes(buf), iscolor=iscolor)
+    if not to_rgb and img.shape[2] == 3:
+        img = img[:, :, ::-1]
+    return nd_array(_np.ascontiguousarray(img), dtype=_np.uint8)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize an HWC image NDArray (parity: plugin/opencv resize)."""
+    from ..image import imresize as _resize
+    img = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    out = _resize(img.astype(_np.uint8), int(w), int(h))
+    return nd_array(out, dtype=_np.uint8)
+
+
+def copy_make_border(src, top, bot, left, right, fill_value=0):
+    """Pad an HWC image with a constant border
+    (parity: plugin/opencv copyMakeBorder)."""
+    img = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    out = _np.pad(img, ((top, bot), (left, right), (0, 0)),
+                  mode="constant", constant_values=fill_value)
+    return nd_array(out, dtype=img.dtype)
