@@ -83,3 +83,26 @@ def test_opencv_plugin_roundtrip():
     padded = cv.copy_make_border(dec, 2, 2, 3, 3, fill_value=7)
     assert padded.shape == (28, 38, 3)
     assert (padded.asnumpy()[:2] == 7).all()
+
+
+def _load_example(rel, name):
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "example", rel)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_autoencoder_example_reconstructs():
+    ae = _load_example("autoencoder/autoencoder.py", "ae_example")
+    mse, power = ae.train(epochs=15)
+    assert mse < 0.1 * power, (mse, power)
+
+
+def test_adversary_fgsm_example():
+    fg = _load_example("adversary/fgsm.py", "fgsm_example")
+    clean, adv = fg.run(eps=0.3, epochs=6)
+    assert clean > 0.9
+    assert adv < clean - 0.2, (clean, adv)
