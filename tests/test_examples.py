@@ -106,3 +106,9 @@ def test_adversary_fgsm_example():
     clean, adv = fg.run(eps=0.3, epochs=6)
     assert clean > 0.9
     assert adv < clean - 0.2, (clean, adv)
+
+
+def test_neural_style_example_descends():
+    ns = _load_example("neural-style/neural_style.py", "ns_example")
+    hist = ns.run(steps=40)
+    assert hist[-1] < hist[0] * 0.5, (hist[0], hist[-1])
