@@ -113,3 +113,43 @@ def test_kvstore_server_shim_runs():
     import pickle
     ctrl(0, pickle.dumps(mx.optimizer.create("sgd", learning_rate=0.1)))
     assert kv._updater is not None
+
+
+def test_caffe_converter_lenet():
+    """tools/caffe_converter: prototxt -> Symbol (no caffe install needed);
+    the classic LeNet deploy definition binds and runs."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.caffe_converter.convert_symbol import convert
+    import numpy as np
+    import mxnet_tpu as mx
+
+    prototxt = '''
+    name: "LeNet"
+    input: "data"
+    layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+      convolution_param { num_output: 20 kernel_size: 5 stride: 1 } }
+    layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+      pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+    layer { name: "conv2" type: "Convolution" bottom: "pool1" top: "conv2"
+      convolution_param { num_output: 50 kernel_size: 5 } }
+    layer { name: "pool2" type: "Pooling" bottom: "conv2" top: "pool2"
+      pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+    layer { name: "ip1" type: "InnerProduct" bottom: "pool2" top: "ip1"
+      inner_product_param { num_output: 500 } }
+    layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+    layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+      inner_product_param { num_output: 10 } }
+    layer { name: "prob" type: "Softmax" bottom: "ip2" top: "prob" }
+    '''
+    sym, inputs = convert(prototxt)
+    assert inputs == ["data"]
+    args = sym.list_arguments()
+    assert "conv1_weight" in args and "ip2_weight" in args
+    exe = sym.simple_bind(mx.cpu(), grad_req="null",
+                          data=(2, 1, 28, 28), softmax_label=(2,))
+    out = exe.forward(is_train=False)[0]
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), np.ones(2),
+                               rtol=1e-5)

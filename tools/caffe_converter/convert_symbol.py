@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Convert a Caffe prototxt network definition to an mxnet_tpu Symbol.
+
+Capability parity: tools/caffe_converter/convert_symbol.py — the
+reference walks a caffe.proto message; this implementation ships its own
+small prototxt (protobuf text format) parser so no caffe install is
+needed, and maps the common layer vocabulary:
+
+    Convolution, Pooling (MAX/AVE), InnerProduct, ReLU, Sigmoid, TanH,
+    LRN, Dropout, Concat, Flatten, Softmax/SoftmaxWithLoss, Eltwise(SUM),
+    BatchNorm(+Scale), Data/Input (-> Variable)
+
+Usage:
+    python tools/caffe_converter/convert_symbol.py deploy.prototxt out.json
+or  from tools.caffe_converter.convert_symbol import convert
+"""
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+# ----------------------------------------------------------------------
+# prototxt (protobuf text format) parser -> nested dict/list structure
+# ----------------------------------------------------------------------
+_TOKEN = re.compile(r"""
+    (?P<brace>[{}])
+  | (?P<name>[A-Za-z_][A-Za-z0-9_]*)\s*(?P<colon>:)?
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<number>-?\d+(?:\.\d*)?(?:[eE][+-]?\d+)?)
+""", re.VERBOSE)
+
+
+def _tokenize(text):
+    text = re.sub(r"#.*", "", text)
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m:
+            if text[pos].isspace():
+                pos += 1
+                continue
+            raise ValueError("prototxt parse error at %r" % text[pos:pos + 20])
+        pos = m.end()
+        if m.group("brace"):
+            yield ("brace", m.group("brace"))
+        elif m.group("name"):
+            yield ("key" if m.group("colon") else "ident", m.group("name"))
+        elif m.group("string"):
+            yield ("value", m.group("string")[1:-1])
+        else:
+            num = m.group("number")
+            yield ("value", float(num) if "." in num or "e" in num.lower()
+                   else int(num))
+
+
+def _parse_block(tokens):
+    """Parse until the matching '}'; repeated fields become lists."""
+    out = {}
+
+    def put(key, value):
+        if key in out:
+            if not isinstance(out[key], list):
+                out[key] = [out[key]]
+            out[key].append(value)
+        else:
+            out[key] = value
+
+    for kind, tok in tokens:
+        if kind == "brace" and tok == "}":
+            return out
+        if kind == "key":                      # key: value
+            k2, v2 = next(tokens)
+            if k2 == "brace" and v2 == "{":    # "key: {" style
+                put(tok, _parse_block(tokens))
+            else:
+                put(tok, v2)
+        elif kind == "ident":                  # key { ... }
+            k2, v2 = next(tokens)
+            assert k2 == "brace" and v2 == "{", (tok, k2, v2)
+            put(tok, _parse_block(tokens))
+    return out
+
+
+def parse_prototxt(text):
+    tokens = iter(list(_tokenize(text)) + [("brace", "}")])
+    return _parse_block(tokens)
+
+
+# ----------------------------------------------------------------------
+# layer translation
+# ----------------------------------------------------------------------
+def _aslist(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _pair(param, key, default=0):
+    """Caffe's kernel_size/stride/pad may be scalar or (h, w) fields."""
+    v = param.get(key)
+    if v is None:
+        h = param.get(key + "_h", default)
+        w = param.get(key + "_w", default)
+        return (int(h), int(w))
+    if isinstance(v, list):
+        v = v[0]
+    return (int(v), int(v))
+
+
+def convert(prototxt_text, input_name="data"):
+    """prototxt text -> (Symbol, input_names)."""
+    import mxnet_tpu as mx
+    sym = mx.sym
+
+    net = parse_prototxt(prototxt_text)
+    layers = _aslist(net.get("layer")) or _aslist(net.get("layers"))
+    blobs = {}
+    inputs = []
+
+    for iname in _aslist(net.get("input")):
+        blobs[iname] = sym.Variable(iname)
+        inputs.append(iname)
+
+    def top_of(layer):
+        tops = _aslist(layer.get("top"))
+        return tops[0] if tops else layer.get("name")
+
+    def bottom_syms(layer):
+        return [blobs[b] for b in _aslist(layer.get("bottom"))]
+
+    for layer in layers:
+        ltype = str(layer.get("type", "")).strip('"').upper()
+        name = layer.get("name", ltype.lower())
+        top = top_of(layer)
+        if ltype in ("DATA", "INPUT", "MEMORYDATA", "IMAGEDATA"):
+            blobs[top] = sym.Variable(top or input_name)
+            inputs.append(top or input_name)
+            continue
+        bots = bottom_syms(layer)
+        x = bots[0] if bots else None
+        if ltype == "CONVOLUTION":
+            p = layer.get("convolution_param", {})
+            blobs[top] = sym.Convolution(
+                x, num_filter=int(p.get("num_output")),
+                kernel=_pair(p, "kernel_size"),
+                stride=_pair(p, "stride", 1), pad=_pair(p, "pad", 0),
+                no_bias=not bool(p.get("bias_term", 1)), name=name)
+        elif ltype == "POOLING":
+            p = layer.get("pooling_param", {})
+            pool = {0: "max", 1: "avg"}.get(p.get("pool"), "max")
+            if str(p.get("pool", "")).upper() in ("MAX", "AVE"):
+                pool = "max" if str(p["pool"]).upper() == "MAX" else "avg"
+            if p.get("global_pooling"):
+                blobs[top] = sym.Pooling(x, kernel=(1, 1), global_pool=True,
+                                         pool_type=pool, name=name)
+            else:
+                blobs[top] = sym.Pooling(
+                    x, kernel=_pair(p, "kernel_size"),
+                    stride=_pair(p, "stride", 1), pad=_pair(p, "pad", 0),
+                    pool_type=pool, name=name)
+        elif ltype == "INNERPRODUCT":
+            p = layer.get("inner_product_param", {})
+            blobs[top] = sym.FullyConnected(
+                sym.Flatten(x), num_hidden=int(p.get("num_output")),
+                name=name)
+        elif ltype == "RELU":
+            blobs[top] = sym.Activation(x, act_type="relu", name=name)
+        elif ltype == "SIGMOID":
+            blobs[top] = sym.Activation(x, act_type="sigmoid", name=name)
+        elif ltype == "TANH":
+            blobs[top] = sym.Activation(x, act_type="tanh", name=name)
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            blobs[top] = sym.LRN(x, alpha=float(p.get("alpha", 1e-4)),
+                                 beta=float(p.get("beta", 0.75)),
+                                 knorm=float(p.get("k", 2)),
+                                 nsize=int(p.get("local_size", 5)),
+                                 name=name)
+        elif ltype == "DROPOUT":
+            p = layer.get("dropout_param", {})
+            blobs[top] = sym.Dropout(x, p=float(p.get("dropout_ratio", 0.5)),
+                                     name=name)
+        elif ltype == "CONCAT":
+            blobs[top] = sym.Concat(*bots, name=name)
+        elif ltype == "FLATTEN":
+            blobs[top] = sym.Flatten(x, name=name)
+        elif ltype == "ELTWISE":
+            out = bots[0]
+            for b in bots[1:]:
+                out = out + b
+            blobs[top] = out
+        elif ltype in ("BATCHNORM",):
+            blobs[top] = sym.BatchNorm(x, fix_gamma=False, name=name)
+        elif ltype in ("SCALE",):
+            blobs[top] = x        # folded into the preceding BatchNorm
+        elif ltype in ("SOFTMAX", "SOFTMAXWITHLOSS"):
+            blobs[top] = sym.SoftmaxOutput(x, name="softmax")
+        elif ltype in ("ACCURACY", "LOSS"):
+            continue
+        else:
+            raise NotImplementedError("caffe layer type %r (layer %s)"
+                                      % (ltype, name))
+    # the network output is the last top produced
+    return blobs[top], inputs
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prototxt")
+    ap.add_argument("out_json")
+    args = ap.parse_args()
+    with open(args.prototxt) as f:
+        symbol, inputs = convert(f.read())
+    symbol.save(args.out_json)
+    print("converted: inputs=%s -> %s" % (inputs, args.out_json))
+
+
+if __name__ == "__main__":
+    main()
