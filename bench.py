@@ -335,6 +335,9 @@ def _measure_module_path(jax, platform):
     import mxnet_tpu as mx
     from mxnet_tpu import recordio as rio
 
+    if platform == "tpu":
+        # module fused step at MXU rate; f32 master weights
+        os.environ.setdefault("MXNET_COMPUTE_DTYPE", "bfloat16")
     per_dev = int(os.environ.get("BENCH_MODULE_BATCH", "64"))
     n_dev = len(jax.devices())
     batch = per_dev * n_dev

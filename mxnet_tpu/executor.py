@@ -306,6 +306,27 @@ class Executor:
         return self.outputs
 
     # -- fused train step (fwd + bwd + optimizer update, ONE dispatch) --
+    def _fused_compute_dtype(self):
+        """Optional reduced-precision compute for the fused step
+        (MXNET_COMPUTE_DTYPE=bfloat16): fwd+bwd run at MXU rate while
+        master weights, optimizer state, grads and aux stay f32 — the
+        policy knob the fp32-only reference never had (SURVEY §7)."""
+        import os
+        name = os.environ.get("MXNET_COMPUTE_DTYPE", "").strip()
+        if not name or name in ("float32", "f32"):
+            return None, frozenset()
+        cdt = jnp.dtype(name)
+        # never cast integer-valued float inputs: labels and Embedding
+        # vocab ids above 256 would silently round in bf16
+        exempt = {n for n in self._arg_names if n.endswith("label")}
+        for node in self._symbol._topo():
+            if node.op is not None and \
+                    getattr(node.op, "op_name", "") == "Embedding":
+                src, _ = node.inputs[0]
+                if src.is_variable:
+                    exempt.add(src.name)
+        return cdt, frozenset(exempt)
+
     def _build_fused_step(self, optimizer):
         """Jit fwd+bwd+update as one XLA computation — the full analog of
         the reference's bulk segments (graph_executor.cc:842-892): the
@@ -327,11 +348,27 @@ class Executor:
             wdm[n] = optimizer.wd_mult.get(
                 idx, optimizer.wd_mult.get(n, 1.0))
 
+        cdt, exempt = self._fused_compute_dtype()
+
+        def cast(name, a):
+            if cdt is None or name in exempt or \
+                    not jnp.issubdtype(a.dtype, jnp.floating):
+                return a
+            return a.astype(cdt)
+
         def step(arg_values, aux_values, rng, states, lr, wd, t):
             def f(wrt_values):
-                merged = dict(arg_values)
-                merged.update(wrt_values)
-                return trace(merged, aux_values, rng, True)
+                # the cast is INSIDE f: vjp through astype returns f32
+                # cotangents for the f32 master weights
+                merged = {n: cast(n, v) for n, v in arg_values.items()}
+                merged.update({n: cast(n, v)
+                               for n, v in wrt_values.items()})
+                aux_in = {n: cast(n, v) for n, v in aux_values.items()}
+                outs, aux_out = trace(merged, aux_in, rng, True)
+                if cdt is not None:     # aux (bn stats) stored f32
+                    aux_out = {k: v.astype(aux_values[k].dtype)
+                               for k, v in aux_out.items()}
+                return outs, aux_out
 
             wrt = {n: arg_values[n] for n in wrt_names}
             (outs, aux_out), vjp_fn = jax.vjp(f, wrt)

@@ -439,3 +439,33 @@ def test_zoo_builders_deterministic_names():
         mx.sym.FullyConnected(mx.sym.Variable("noise"), num_hidden=1)
         second = mod.get_symbol(num_classes=10).list_arguments()
         assert first == second, mod.__name__
+
+
+def test_fused_step_bf16_compute():
+    """MXNET_COMPUTE_DTYPE=bfloat16: fwd/bwd run reduced-precision (the
+    compiled step carries bf16 math) while master weights stay f32, and
+    training still converges."""
+    import os
+    X, y = _toy_problem(n=120)
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+    os.environ["MXNET_COMPUTE_DTYPE"] = "bfloat16"
+    try:
+        mx.random.seed(7)
+        train = mx.io.NDArrayIter(X, y, batch_size=30)
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.fit(train, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5},
+                initializer=mx.init.Uniform(0.1), num_epoch=10)
+        score = dict(mod.score(mx.io.NDArrayIter(X, y, batch_size=30),
+                               "acc"))
+        assert score["accuracy"] > 0.9, score
+        exec_ = mod._exec_group.execs[0]
+        assert exec_._n_fused_step > 0
+        states = exec_.init_fused_states(mod._optimizer)
+        hlo = exec_.lower_fused_step(mod._optimizer, states)
+        assert "bf16" in hlo                      # compute in bf16
+        args, _ = mod.get_params()
+        assert all(v.asnumpy().dtype == np.float32
+                   for v in args.values())        # f32 master weights
+    finally:
+        del os.environ["MXNET_COMPUTE_DTYPE"]
