@@ -48,6 +48,20 @@ int MXExecutorOutputShape(ExecutorHandle h, uint32_t index,
 int MXExecutorOutputCopy(ExecutorHandle h, uint32_t index, float* data,
                          size_t size);
 
+/* standalone inference (c_predict_api parity subset); param_path points
+ * at a saved prefix-NNNN.params file */
+typedef void* PredictorHandle;
+int MXPredCreate(const char* symbol_json, const char* param_path,
+                 const char* shapes_json, PredictorHandle* out);
+int MXPredFree(PredictorHandle h);
+int MXPredSetInput(PredictorHandle h, const char* name, const float* data,
+                   size_t size);
+int MXPredForward(PredictorHandle h);
+int MXPredGetOutputShape(PredictorHandle h, uint32_t index, uint32_t* ndim,
+                         uint32_t* shape, uint32_t cap);
+int MXPredGetOutput(PredictorHandle h, uint32_t index, float* data,
+                    size_t size);
+
 int MXKVStoreCreate(const char* type, KVStoreHandle* out);
 int MXKVStoreFree(KVStoreHandle h);
 int MXKVStoreInit(KVStoreHandle h, int key, NDArrayHandle val);

@@ -92,6 +92,48 @@ def executor_output_to(exec_, index, buf):
     return ndarray_copy_to(exec_.outputs[index], buf)
 
 
+def pred_create(symbol_json, param_path, shapes_json):
+    import json
+    from .predictor import Predictor
+    shapes = {k: tuple(v) for k, v in json.loads(shapes_json).items()}
+    import os
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix="-symbol.json",
+                                     delete=False) as f:
+        f.write(symbol_json)
+        spath = f.name
+    try:
+        return Predictor(spath, param_path, shapes)
+    finally:
+        os.unlink(spath)
+
+
+def pred_set_input(pred, name, buf):
+    shape = pred._exec.arg_dict[name].shape
+    pred.set_input(name, _np.frombuffer(buf, dtype=_np.float32)
+                   .reshape(shape))
+    return 0
+
+
+def pred_forward(pred):
+    pred.forward()
+    return 0
+
+
+def pred_output_shape(pred, index):
+    return tuple(int(d) for d in pred.get_output(index).shape)
+
+
+def pred_output_to(pred, index, buf):
+    out = _np.frombuffer(buf, dtype=_np.float32)
+    arr = pred.get_output(index).astype(_np.float32).ravel()
+    if out.size != arr.size:
+        raise ValueError("buffer size %d != output size %d"
+                         % (out.size, arr.size))
+    out[:] = arr
+    return 0
+
+
 def kvstore_create(kvtype):
     from . import kvstore
     return kvstore.create(kvtype)

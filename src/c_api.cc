@@ -282,6 +282,56 @@ int MXExecutorOutputCopy(ExecutorHandle h, uint32_t index, float* data,
                               WritableView(data, size * sizeof(float))));
 }
 
+// ---- Predict API (c_predict_api.cc parity subset) ------------------
+typedef void* PredictorHandle;
+
+int MXPredCreate(const char* symbol_json, const char* param_path,
+                 const char* shapes_json, PredictorHandle* out) {
+  Gil gil;
+  PyObject* pred = Call("pred_create",
+                        Py_BuildValue("(sss)", symbol_json, param_path,
+                                      shapes_json));
+  if (!pred) return -1;
+  *out = pred;
+  return 0;
+}
+
+int MXPredFree(PredictorHandle h) { return MXNDArrayFree(h); }
+
+int MXPredSetInput(PredictorHandle h, const char* name, const float* data,
+                   size_t size) {
+  Gil gil;
+  return CallRC("pred_set_input",
+                Py_BuildValue("(OsN)", static_cast<PyObject*>(h), name,
+                              ReadView(data, size * sizeof(float))));
+}
+
+int MXPredForward(PredictorHandle h) {
+  Gil gil;
+  return CallRC("pred_forward",
+                PyTuple_Pack(1, static_cast<PyObject*>(h)));
+}
+
+int MXPredGetOutputShape(PredictorHandle h, uint32_t index, uint32_t* ndim,
+                         uint32_t* shape, uint32_t cap) {
+  Gil gil;
+  PyObject* tup = Call("pred_output_shape",
+                       Py_BuildValue("(OI)", static_cast<PyObject*>(h),
+                                     index));
+  if (!tup) return -1;
+  int rc = FillShape(tup, ndim, shape, cap);
+  Py_DECREF(tup);
+  return rc;
+}
+
+int MXPredGetOutput(PredictorHandle h, uint32_t index, float* data,
+                    size_t size) {
+  Gil gil;
+  return CallRC("pred_output_to",
+                Py_BuildValue("(OIN)", static_cast<PyObject*>(h), index,
+                              WritableView(data, size * sizeof(float))));
+}
+
 // ---- KVStore (c_api.cc:1199-1375 parity subset) --------------------
 int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
   Gil gil;

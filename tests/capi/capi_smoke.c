@@ -100,6 +100,39 @@ int main(void) {
     printf("forward: %u outputs, shape (%u,%u), row0 sum=%f\n",
            nout, oshape[0], oshape[1], rowsum);
     CHECK(MXExecutorFree(exec));
+
+    /* --- predict API (c_predict_api subset) --- */
+    const char* params = getenv("MXTPU_PARAMS_FILE");
+    if (params != NULL) {
+      /* re-read symbol json for the predictor */
+      FILE* f2 = fopen(path, "rb");
+      fseek(f2, 0, SEEK_END);
+      long n2 = ftell(f2);
+      fseek(f2, 0, SEEK_SET);
+      char* json2 = (char*)malloc(n2 + 1);
+      if (fread(json2, 1, n2, f2) != (size_t)n2) return 1;
+      json2[n2] = 0;
+      fclose(f2);
+      PredictorHandle pred;
+      CHECK(MXPredCreate(json2, params,
+                         "{\"data\": [2, 10], \"softmax_label\": [2]}",
+                         &pred));
+      free(json2);
+      float pin[20];
+      for (int i = 0; i < 20; ++i) pin[i] = 0.1f * i;
+      CHECK(MXPredSetInput(pred, "data", pin, 20));
+      CHECK(MXPredForward(pred));
+      uint32_t pndim, pshape[8];
+      CHECK(MXPredGetOutputShape(pred, 0, &pndim, pshape, 8));
+      float pout[4];
+      CHECK(MXPredGetOutput(pred, 0, pout, pshape[0] * pshape[1]));
+      if (pout[0] + pout[1] < 0.99f || pout[0] + pout[1] > 1.01f) {
+        fprintf(stderr, "FAIL predictor softmax\n");
+        return 1;
+      }
+      printf("predict: shape (%u,%u) OK\n", pshape[0], pshape[1]);
+      CHECK(MXPredFree(pred));
+    }
     CHECK(MXSymbolFree(sym));
   }
 

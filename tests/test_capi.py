@@ -23,14 +23,20 @@ def test_capi_smoke(tmp_path):
         pytest.skip("python headers unavailable")
     assert build.returncode == 0, build.stderr[-2000:]
 
-    # a symbol for the bind/forward leg
+    # a symbol + params for the bind/forward and predictor legs
     import mxnet_tpu as mx
     sym = mx.models.get_mlp(num_classes=2, hidden=(8,))
     sym_path = str(tmp_path / "mlp-symbol.json")
     sym.save(sym_path)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 10))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.save_checkpoint(str(tmp_path / "mlp"), 0)
 
     env = dict(os.environ)
     env["MXTPU_SYMBOL_JSON"] = sym_path
+    env["MXTPU_PARAMS_FILE"] = str(tmp_path / "mlp-0000.params")
     env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     # the embedded interpreter must skip the hanging accelerator plugin
@@ -43,3 +49,4 @@ def test_capi_smoke(tmp_path):
     assert proc.returncode == 0, (proc.stdout, proc.stderr[-1500:])
     assert "CAPI SMOKE OK" in proc.stdout
     assert "forward:" in proc.stdout
+    assert "predict:" in proc.stdout
