@@ -96,16 +96,9 @@ def pred_create(symbol_json, param_path, shapes_json):
     import json
     from .predictor import Predictor
     shapes = {k: tuple(v) for k, v in json.loads(shapes_json).items()}
-    import os
-    import tempfile
-    with tempfile.NamedTemporaryFile("w", suffix="-symbol.json",
-                                     delete=False) as f:
-        f.write(symbol_json)
-        spath = f.name
-    try:
-        return Predictor(spath, param_path, shapes)
-    finally:
-        os.unlink(spath)
+    # Predictor accepts raw JSON text directly (predictor.py routes
+    # non-path strings through load_json)
+    return Predictor(symbol_json, param_path, shapes)
 
 
 def pred_set_input(pred, name, buf):
@@ -116,22 +109,17 @@ def pred_set_input(pred, name, buf):
 
 
 def pred_forward(pred):
-    pred.forward()
+    # run without materializing outputs on host; the Get* calls copy
+    pred._exec.forward(is_train=False)
     return 0
 
 
 def pred_output_shape(pred, index):
-    return tuple(int(d) for d in pred.get_output(index).shape)
+    return tuple(int(d) for d in pred._exec.outputs[index].shape)
 
 
 def pred_output_to(pred, index, buf):
-    out = _np.frombuffer(buf, dtype=_np.float32)
-    arr = pred.get_output(index).astype(_np.float32).ravel()
-    if out.size != arr.size:
-        raise ValueError("buffer size %d != output size %d"
-                         % (out.size, arr.size))
-    out[:] = arr
-    return 0
+    return ndarray_copy_to(pred._exec.outputs[index], buf)
 
 
 def kvstore_create(kvtype):

@@ -106,6 +106,10 @@ int main(void) {
     if (params != NULL) {
       /* re-read symbol json for the predictor */
       FILE* f2 = fopen(path, "rb");
+      if (!f2) {
+        fprintf(stderr, "FAIL: cannot reopen %s\n", path);
+        return 1;
+      }
       fseek(f2, 0, SEEK_END);
       long n2 = ftell(f2);
       fseek(f2, 0, SEEK_SET);
@@ -124,6 +128,10 @@ int main(void) {
       CHECK(MXPredForward(pred));
       uint32_t pndim, pshape[8];
       CHECK(MXPredGetOutputShape(pred, 0, &pndim, pshape, 8));
+      if (pndim != 2 || pshape[0] * pshape[1] > 4) {
+        fprintf(stderr, "FAIL predictor output rank/size\n");
+        return 1;
+      }
       float pout[4];
       CHECK(MXPredGetOutput(pred, 0, pout, pshape[0] * pshape[1]));
       if (pout[0] + pout[1] < 0.99f || pout[0] + pout[1] > 1.01f) {
