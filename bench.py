@@ -231,7 +231,10 @@ def measure():
         return gbatch * n_steps / dt, dt / n_steps, trainer
 
     sweep = None
-    if os.environ.get("BENCH_AUTOTUNE"):
+    autotune = os.environ.get("BENCH_AUTOTUNE")
+    if autotune is None and on_tpu:
+        autotune = "1"      # default on-chip: find the MFU-best batch
+    if autotune and autotune != "0":
         # short sweep over per-device batch, then full run at the winner
         candidates = [int(x) for x in os.environ.get(
             "BENCH_AUTOTUNE_BATCHES", "64,128,256").split(",")]
