@@ -174,8 +174,17 @@ def orchestrate():
 
 def measure():
     """Child: the actual measurement.  May crash/hang — parent defends."""
+    # persistent XLA compile cache: a retried/repeated bench skips the
+    # ~40s ResNet-50 compiles
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/mxtpu_jax_cache")
     import numpy as np
     import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+    except Exception:
+        pass
     forced = os.environ.get("BENCH_FORCE_PLATFORM")
     if forced:
         jax.config.update("jax_platforms", forced)

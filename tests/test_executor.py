@@ -185,3 +185,26 @@ def test_ctx_group_model_parallel():
     for name in exe_plain.grad_dict:
         assert_almost_equal(exe_plain.grad_dict[name].asnumpy(),
                             exe_mp.grad_dict[name].asnumpy(), rtol=1e-4)
+
+
+def test_interpret_matches_compiled():
+    """check_consistency analog (reference test_operator_gpu.py): the
+    monitor's eager interpret path and the jitted path must produce
+    identical outputs for a conv/bn/pool net — the NaiveEngine-style
+    debugging mode is numerically the same program."""
+    net = mx.models.get_lenet(num_classes=4)
+    shapes = {"data": (2, 1, 28, 28), "softmax_label": (2,)}
+    exe = net.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name not in shapes:
+            arr[:] = rng.uniform(-0.1, 0.1, arr.shape).astype("float32")
+    exe.arg_dict["data"][:] = rng.rand(2, 1, 28, 28).astype("float32")
+
+    compiled = exe.forward(is_train=False)[0].asnumpy()
+    seen = []
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    interpreted = exe.forward(is_train=False)[0].asnumpy()
+    exe.set_monitor_callback(None)
+    assert seen, "monitor path did not run eagerly"
+    np.testing.assert_allclose(interpreted, compiled, rtol=2e-5, atol=2e-6)
