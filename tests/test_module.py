@@ -469,3 +469,40 @@ def test_fused_step_bf16_compute():
                    for v in args.values())        # f32 master weights
     finally:
         del os.environ["MXNET_COMPUTE_DTYPE"]
+
+
+def test_bucketing_on_sharded_mesh():
+    """BucketingModule over a device list: each bucket shares the sharded
+    mesh group (shared_group copies mesh state, VERDICT r2 review)."""
+    batch_size = 16
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, name="embed", input_dim=20,
+                                 output_dim=6)
+        pooled = mx.sym.sum_axis(embed, axis=1)
+        fc = mx.sym.FullyConnected(pooled, name="fc", num_hidden=4)
+        return (mx.sym.SoftmaxOutput(fc, label=label, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    ctxs = [mx.cpu(i) for i in range(8)]
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=12,
+                                 context=ctxs)
+    mod.bind(data_shapes=[("data", (batch_size, 12))],
+             label_shapes=[("softmax_label", (batch_size,))])
+    mod.init_params(initializer=mx.init.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for seq_len in (12, 8, 12, 8):
+        batch = mx.io.DataBatch(
+            data=[mx.nd.ones((batch_size, seq_len))],
+            label=[mx.nd.zeros((batch_size,))],
+            provide_data=[("data", (batch_size, seq_len))],
+            provide_label=[("softmax_label", (batch_size,))],
+            bucket_key=seq_len)
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert mod._curr_module._exec_group.sharded
+    assert mod.get_outputs()[0].shape == (batch_size, 4)
