@@ -232,3 +232,45 @@ def test_streaming_flat_rss_and_rate():
     floor = 3000 if big else 1000     # in-suite floor is conservative:
     # the CI box has one core and a cold page cache inflates variance
     assert rate >= floor, "only %.0f rec/s" % rate
+
+
+def test_streamed_training_on_sharded_mesh(tmp_path):
+    """Integration of the round's two big pieces: ImageRecordIter (raw
+    uint8 streaming) feeding a multi-device Module whose fused step runs
+    on the mesh with in-step all-reduce — the bench's chip path."""
+    import mxnet_tpu as mx
+    path = str(tmp_path / "train.rec")
+    rng = np.random.RandomState(0)
+    w = rio.MXRecordIO(path, "w")
+    # class = brightness of the raw image
+    for i in range(128):
+        k = i % 2
+        img = np.full((3, 16, 16), 60 if k == 0 else 190, np.uint8)
+        img += rng.randint(0, 40, img.shape).astype(np.uint8)
+        w.write(rio.pack(rio.IRHeader(0, float(k), i, 0), img.tobytes()))
+    w.close()
+
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                         batch_size=32, shuffle=True, dtype="uint8",
+                         preprocess_threads=2)
+    data = mx.sym.Variable("data")
+    # normalize ON DEVICE (uint8 in, f32 math) — the fused-step pattern
+    net = mx.sym.Cast(data, dtype="float32")
+    net = (net - 128.0) / 64.0
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=8)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    ctxs = [mx.cpu(i) for i in range(8)]
+    mod = mx.mod.Module(net, context=ctxs)
+    mod.fit(it, kvstore="device", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=3)
+    assert mod._exec_group.sharded
+    assert mod._exec_group.execs[0]._n_fused_step > 0
+    it.reset()
+    metric = mx.metric.Accuracy()
+    score = dict(mod.score(it, metric))
+    assert score["accuracy"] > 0.95, score
