@@ -16,6 +16,7 @@ from . import alexnet
 from . import vgg
 from . import googlenet
 from . import inception_bn
+from . import inception_v3
 from . import resnet
 from . import lstm
 
@@ -26,6 +27,7 @@ from .alexnet import get_symbol as get_alexnet
 from .vgg import get_symbol as get_vgg
 from .googlenet import get_symbol as get_googlenet
 from .inception_bn import get_symbol as get_inception_bn
+from .inception_v3 import get_symbol as get_inception_v3
 from .resnet import get_symbol as get_resnet
 
 __all__ = ["transformer", "mlp", "lenet", "alexnet", "vgg", "googlenet", "inception_bn",

@@ -239,6 +239,7 @@ def test_model_zoo_shapes():
         (mx.models.get_vgg(10, 11), (2, 3, 224, 224), 10),
         (mx.models.get_googlenet(10), (2, 3, 224, 224), 10),
         (mx.models.get_inception_bn(10), (2, 3, 224, 224), 10),
+        (mx.models.get_inception_v3(10), (2, 3, 299, 299), 10),
         (mx.models.get_resnet(10, 50), (2, 3, 224, 224), 10),
     ]
     for net, dshape, ncls in cases:
