@@ -1,7 +1,9 @@
-# Native components: threaded dependency engine + RecordIO fast path.
+# Native components: threaded dependency engine, RecordIO fast path,
+# libjpeg decode+augment kernel, and the flat MX* C ABI.
 # Parity: the reference's Makefile builds libmxnet.so from src/; here the
-# XLA path needs no native kernels, so the native library covers the
-# host-side runtime (src/engine.cc, src/recordio.cc).
+# XLA path needs no native device kernels, so the native library covers
+# the host-side runtime (src/engine.cc, src/recordio.cc, src/image.cc)
+# with the C ABI (src/c_api.cc) as a separate `make capi` library.
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -pthread
 
