@@ -141,16 +141,23 @@ class KVStore(object):
     def _allreduce(self, merged):
         """Cross-worker gradient sum for dist types.
 
-        With one process this is the identity; in a multi-host pod the sum
-        rides DCN via jax.make_array / process_allgather.  The *performant*
-        pod path never calls this: Module folds the psum into the compiled
-        step (update_on_kvstore=False ≡ in-step update, SURVEY §5 mapping).
+        With one process this is the identity; in a multi-host pod each
+        worker's tensor becomes one shard of a global array and a jitted
+        sum reduces it — XLA runs the actual all-reduce over ICI/DCN, so
+        no host ever materializes num_workers copies (the criticism of
+        the old process_allgather path).  The *performant* pod path never
+        calls this at all: Module folds the psum into the compiled step
+        (update_on_kvstore=False ≡ in-step update, SURVEY §5 mapping).
         """
-        if self.type.startswith("dist") and jax.process_count() > 1:
+        if not (self.type.startswith("dist") and jax.process_count() > 1):
+            return merged
+        try:
+            return _collective_sum(merged)
+        except Exception:
+            # conservative fallback (odd topologies, very old jax)
             from jax.experimental import multihost_utils
             gathered = multihost_utils.process_allgather(merged)
             return jnp.sum(gathered, axis=0)
-        return merged
 
     # -- updater / optimizer ----------------------------------------------
     def set_updater(self, updater):
@@ -264,6 +271,35 @@ def _states_from_host(states):
 
 _HB_PREFIX = "mxtpu_hb/"
 _HB_INTERVAL = 2.0
+
+_CSUM_CACHE = {}
+
+
+def _collective_sum(value):
+    """Sum ``value`` across processes with an XLA collective: each
+    process's tensor is one shard of a (n_proc, ...) global array; a
+    jitted sum over the worker axis lowers to an all-reduce."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import numpy as _onp
+
+    if "mesh" not in _CSUM_CACHE:
+        # one device per process carries its shard
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        devs = [per_proc[p] for p in sorted(per_proc)]
+        mesh = Mesh(_onp.asarray(devs), ("w",))
+        _CSUM_CACHE["mesh"] = mesh
+        _CSUM_CACHE["sum"] = jax.jit(
+            lambda x: jnp.sum(x, axis=0),
+            out_shardings=NamedSharding(mesh, P()))
+    mesh = _CSUM_CACHE["mesh"]
+    value = jnp.asarray(value)
+    sharding = NamedSharding(mesh, P("w", *([None] * value.ndim)))
+    garr = jax.make_array_from_process_local_data(sharding, value[None])
+    out = _CSUM_CACHE["sum"](garr)
+    # replicated over the mesh: this process's addressable copy
+    return jnp.asarray(out.addressable_data(0))
 
 
 def _dist_client():
