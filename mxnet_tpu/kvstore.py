@@ -151,13 +151,27 @@ class KVStore(object):
         """
         if not (self.type.startswith("dist") and jax.process_count() > 1):
             return merged
-        try:
+        # Pick the path ONCE per process (1-element probe at first use):
+        # falling back per-call could split workers between two different
+        # collectives and deadlock the pod.  Every worker runs the same
+        # probe at the same point (pushes are lockstep in SPMD programs).
+        enabled = _CSUM_CACHE.get("enabled")
+        if enabled is None:
+            try:
+                _collective_sum(jnp.zeros((1,), jnp.float32))
+                enabled = True
+            except Exception as exc:  # noqa: BLE001
+                import logging
+                logging.warning(
+                    "kvstore: XLA collective sum unavailable (%r); using "
+                    "the allgather fallback for this process", exc)
+                enabled = False
+            _CSUM_CACHE["enabled"] = enabled
+        if enabled:
             return _collective_sum(merged)
-        except Exception:
-            # conservative fallback (odd topologies, very old jax)
-            from jax.experimental import multihost_utils
-            gathered = multihost_utils.process_allgather(merged)
-            return jnp.sum(gathered, axis=0)
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(merged)
+        return jnp.sum(gathered, axis=0)
 
     # -- updater / optimizer ----------------------------------------------
     def set_updater(self, updater):
