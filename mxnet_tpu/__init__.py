@@ -58,5 +58,7 @@ from .predictor import Predictor
 from . import visualization
 from . import visualization as viz
 from . import models
+from . import rtc
+from . import test_utils
 
 __version__ = "0.1.0"
