@@ -1,0 +1,75 @@
+"""Dataframe iterator plugin (capability parity: plugin/sframe — the
+reference iterates Turi SFrames as training batches).
+
+Accepts anything dataframe-shaped: a Turi/pandas-like object with
+``.columns``/``__getitem__`` or a plain dict of column arrays.  Columns
+named by ``data_cols`` stack into the batch matrix; ``label_col``
+supplies labels — yielding standard DataBatches, so the rest of the
+framework (Module.fit etc.) is unchanged.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..io import DataIter, DataBatch, DataDesc
+from ..ndarray import array as nd_array
+
+__all__ = ["SFrameIter"]
+
+
+def _columns(frame):
+    cols = getattr(frame, "columns", None)
+    if cols is not None:
+        return list(cols)
+    if isinstance(frame, dict):
+        return list(frame)
+    raise TypeError("frame must expose .columns or be a dict of arrays")
+
+
+class SFrameIter(DataIter):
+    """Iterate a dataframe as (data, label) batches (plugin/sframe
+    iter parity, duck-typed instead of binding Turi's C++ API)."""
+
+    def __init__(self, frame, data_cols=None, label_col=None, batch_size=32,
+                 shuffle=False, seed=0, data_name="data",
+                 label_name="softmax_label"):
+        super().__init__()
+        cols = _columns(frame)
+        if data_cols is None:
+            data_cols = [c for c in cols if c != label_col]
+        mats = [_np.asarray(frame[c], dtype=_np.float32).reshape(len(frame[c]), -1)
+                for c in data_cols]
+        self._data = _np.concatenate(mats, axis=1)
+        if label_col is not None:
+            self._label = _np.asarray(frame[label_col], dtype=_np.float32)
+        else:
+            self._label = _np.zeros((len(self._data),), _np.float32)
+        if shuffle:
+            perm = _np.random.RandomState(seed).permutation(len(self._data))
+            self._data, self._label = self._data[perm], self._label[perm]
+        self.batch_size = batch_size
+        self.data_name, self.label_name = data_name, label_name
+        self._cursor = -batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size, self._data.shape[1]))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name, (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = -self.batch_size
+
+    def iter_next(self):
+        self._cursor += self.batch_size
+        return self._cursor + self.batch_size <= len(self._data)
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        sl = slice(self._cursor, self._cursor + self.batch_size)
+        return DataBatch([nd_array(self._data[sl])],
+                         [nd_array(self._label[sl])], pad=0)

@@ -153,3 +153,27 @@ def test_caffe_converter_lenet():
     assert out.shape == (2, 10)
     np.testing.assert_allclose(out.asnumpy().sum(axis=1), np.ones(2),
                                rtol=1e-5)
+
+
+def test_sframe_iter_plugin():
+    """plugin/sframe analog: dict-of-columns dataframe -> DataBatches ->
+    Module.fit."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.plugin.sframe import SFrameIter
+
+    rng = np.random.RandomState(0)
+    frame = {"f1": rng.randn(200), "f2": rng.randn(200),
+             "f3": rng.randn(200)}
+    frame["y"] = (frame["f1"] + frame["f2"] > 0).astype(np.float32)
+    it = SFrameIter(frame, data_cols=["f1", "f2", "f3"], label_col="y",
+                    batch_size=20, shuffle=True)
+    b = next(it)
+    assert b.data[0].shape == (20, 3) and b.label[0].shape == (20,)
+    it.reset()
+    mod = mx.mod.Module(mx.models.get_mlp(2, (8,)), context=mx.cpu())
+    mod.fit(it, optimizer="sgd", optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), num_epoch=6)
+    it.reset()
+    score = dict(mod.score(it, "acc"))
+    assert score["accuracy"] > 0.9, score
