@@ -388,11 +388,14 @@ class Executor:
         return wrt_names, jax.jit(step, donate_argnums=(3,))
 
     def _get_fused(self, optimizer):
-        """(wrt_names, jitted step) for this optimizer, cached by identity."""
-        if self._fused_cache is None or \
-                self._fused_cache[0] is not optimizer:
-            self._fused_cache = (optimizer,
-                                 self._build_fused_step(optimizer))
+        """(wrt_names, jitted step) for this optimizer, cached by
+        (optimizer identity, compute dtype) — an MXNET_COMPUTE_DTYPE
+        change between fits must not reuse a stale jit."""
+        import os
+        key = (id(optimizer), os.environ.get("MXNET_COMPUTE_DTYPE", ""))
+        if self._fused_cache is None or self._fused_cache[0] != key:
+            self._fused_cache = (key, self._build_fused_step(optimizer),
+                                 optimizer)
         return self._fused_cache[1]
 
     def fused_step(self, optimizer, states, num_update, **kwargs):
