@@ -1,24 +1,18 @@
 """CLI tools coverage (parity: the reference's tools/ family is exercised
 by its nightly scripts; here each tool gets a direct test)."""
-import json
 import os
-import subprocess
 import sys
 
 import numpy as np
 
 import mxnet_tpu as mx
-
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# shared hermetic-subprocess runner (strips the TPU plugin that would
+# hang worker init; see the rationale comment there)
+from test_examples import _run, REPO as ROOT
 
 
 def _run_tool(*argv, timeout=240):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = ROOT
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
-    return subprocess.run([sys.executable] + list(argv), cwd=ROOT, env=env,
-                          capture_output=True, text=True, timeout=timeout)
+    return _run(ROOT, *argv, timeout=timeout)
 
 
 def test_parse_log(tmp_path):
