@@ -507,3 +507,27 @@ def test_bucketing_on_sharded_mesh():
         mod.update()
     assert mod._curr_module._exec_group.sharded
     assert mod.get_outputs()[0].shape == (batch_size, 4)
+
+
+def test_checkpoint_cross_api_roundtrip(tmp_path):
+    """FeedForward.save -> Module.load and back: one checkpoint format
+    across both training APIs (reference model.py:308 contract)."""
+    X, y = _toy_problem(n=80)
+    model = mx.FeedForward(mx.models.get_mlp(2, (8,)), ctx=mx.cpu(),
+                           num_epoch=2, optimizer="sgd", learning_rate=0.3)
+    model.fit(X, y)
+    prefix = str(tmp_path / "xapi")
+    model.save(prefix, 2)
+
+    mod = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (16, 10))],
+             label_shapes=[("softmax_label", (16,))])
+    val = mx.io.NDArrayIter(X, y, batch_size=16)
+    acc_mod = dict(mod.score(val, "acc"))["accuracy"]
+    acc_ff = model.score(mx.io.NDArrayIter(X, y, batch_size=16))
+    assert abs(acc_mod - acc_ff) < 1e-9
+
+    mod.save_checkpoint(prefix + "2", 0)
+    back = mx.FeedForward.load(prefix + "2", 0, ctx=mx.cpu())
+    assert abs(back.score(mx.io.NDArrayIter(X, y, batch_size=16))
+               - acc_ff) < 1e-9
