@@ -274,3 +274,19 @@ def test_streamed_training_on_sharded_mesh(tmp_path):
     metric = mx.metric.Accuracy()
     score = dict(mod.score(it, metric))
     assert score["accuracy"] > 0.95, score
+
+
+def test_prefetching_iter_wraps_streaming_iter(jpeg_rec):
+    """The reference stacks PrefetcherIter on top of the record iterator;
+    the composition must preserve batches and reset cleanly."""
+    from mxnet_tpu.io import PrefetchingIter
+    base = ImageRecordIter(path_imgrec=jpeg_rec, data_shape=(3, 32, 32),
+                           batch_size=16, preprocess_threads=2)
+    it = PrefetchingIter(base)
+    n1 = 0
+    for b in it:
+        assert b.data[0].shape == (16, 3, 32, 32)
+        n1 += 1
+    it.reset()
+    n2 = sum(1 for _ in it)
+    assert n1 == n2 == 7
