@@ -531,3 +531,39 @@ def test_checkpoint_cross_api_roundtrip(tmp_path):
     back = mx.FeedForward.load(prefix + "2", 0, ctx=mx.cpu())
     assert abs(back.score(mx.io.NDArrayIter(X, y, batch_size=16))
                - acc_ff) < 1e-9
+
+
+def test_optimizer_states_roundtrip_fused(tmp_path):
+    """Momentum state saved mid-training resumes identically: two more
+    epochs after a save/load must equal two more epochs without it."""
+    X, y = _toy_problem(n=80)
+
+    def run(resume):
+        mx.random.seed(3)
+        train = mx.io.NDArrayIter(X, y, batch_size=20)
+        mod = mx.mod.Module(mx.models.get_mlp(2, (8,)), context=mx.cpu())
+        mod.fit(train, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+                initializer=mx.init.Uniform(0.1), num_epoch=2)
+        if resume:
+            prefix = str(tmp_path / "opt")
+            mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+            mod = mx.mod.Module.load(prefix, 2, load_optimizer_states=True,
+                                     context=mx.cpu())
+            mod.bind(data_shapes=train.provide_data,
+                     label_shapes=train.provide_label)
+            mod.init_optimizer(optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.2,
+                                                 "momentum": 0.9})
+        train.reset()
+        for _ in range(2):
+            for b in train:
+                mod.forward_backward(b)
+                mod.update()
+            train.reset()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    direct, resumed = run(False), run(True)
+    for k in direct:
+        np.testing.assert_allclose(resumed[k], direct[k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
