@@ -151,27 +151,65 @@ class KVStore(object):
         """
         if not (self.type.startswith("dist") and jax.process_count() > 1):
             return merged
-        # Pick the path ONCE per process (1-element probe at first use):
-        # falling back per-call could split workers between two different
-        # collectives and deadlock the pod.  Every worker runs the same
-        # probe at the same point (pushes are lockstep in SPMD programs).
+        # Pick the path ONCE, cluster-wide.  A per-process probe could
+        # split workers between two different collectives and deadlock the
+        # pod (probe failing on a subset), so rank 0 probes and publishes
+        # the verdict through the coordination-service KV (the same
+        # channel the heartbeats use); every other rank reads that single
+        # decision before its first allreduce.
         enabled = _CSUM_CACHE.get("enabled")
         if enabled is None:
-            try:
-                _collective_sum(jnp.zeros((1,), jnp.float32))
-                enabled = True
-            except Exception as exc:  # noqa: BLE001
-                import logging
-                logging.warning(
-                    "kvstore: XLA collective sum unavailable (%r); using "
-                    "the allgather fallback for this process", exc)
-                enabled = False
+            enabled = self._decide_csum_path()
             _CSUM_CACHE["enabled"] = enabled
         if enabled:
             return _collective_sum(merged)
         from jax.experimental import multihost_utils
         gathered = multihost_utils.process_allgather(merged)
         return jnp.sum(gathered, axis=0)
+
+    @staticmethod
+    def _decide_csum_path():
+        """Cluster-wide collective-vs-allgather decision: rank 0 probes the
+        XLA collective and publishes the verdict in the coordination KV;
+        every rank acts on that one answer (never a local probe that could
+        diverge across workers)."""
+        import logging
+        client = _dist_client()
+        key = "mxtpu_csum/enabled"
+        if client is not None and jax.process_index() != 0:
+            # retry the read, then fail LOUDLY: guessing here could put
+            # this rank in a different collective than the rest of the
+            # pod — a silent permanent hang, the exact bug this
+            # cluster-wide decision exists to eliminate
+            last_exc = None
+            for timeout_ms in (60_000, 240_000):
+                try:
+                    val = client.blocking_key_value_get(key, timeout_ms)
+                    return val == "1"
+                except Exception as exc:  # noqa: BLE001
+                    last_exc = exc
+            raise MXNetError(
+                "kvstore: could not read rank-0's collective-path verdict "
+                "(%r); refusing to guess (a wrong guess deadlocks the pod)"
+                % (last_exc,))
+        try:
+            # compile-only probe: executing the collective needs every
+            # rank, but lowering+compiling the program is local, and it is
+            # the compile step that surfaces backend/version asymmetry
+            _compile_collective_sum_probe()
+            enabled = True
+        except Exception as exc:  # noqa: BLE001
+            logging.warning(
+                "kvstore: XLA collective sum unavailable (%r); the cluster "
+                "will use the allgather fallback", exc)
+            enabled = False
+        if client is not None:
+            try:
+                client.key_value_set(key, "1" if enabled else "0",
+                                     allow_overwrite=True)
+            except Exception:
+                pass
+        return enabled
 
     # -- updater / optimizer ----------------------------------------------
     def set_updater(self, updater):
@@ -293,16 +331,10 @@ def _collective_sum(value):
     """Sum ``value`` across processes with an XLA collective: each
     process's tensor is one shard of a (n_proc, ...) global array; a
     jitted sum over the worker axis lowers to an all-reduce."""
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    import numpy as _onp
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     if "mesh" not in _CSUM_CACHE:
-        # one device per process carries its shard
-        per_proc = {}
-        for d in jax.devices():
-            per_proc.setdefault(d.process_index, d)
-        devs = [per_proc[p] for p in sorted(per_proc)]
-        mesh = Mesh(_onp.asarray(devs), ("w",))
+        mesh = _csum_mesh()
         _CSUM_CACHE["mesh"] = mesh
         _CSUM_CACHE["sum"] = jax.jit(
             lambda x: jnp.sum(x, axis=0),
@@ -314,6 +346,33 @@ def _collective_sum(value):
     out = _CSUM_CACHE["sum"](garr)
     # replicated over the mesh: this process's addressable copy
     return jnp.asarray(out.addressable_data(0))
+
+
+def _csum_mesh():
+    """One-device-per-process mesh used by the cross-worker sum."""
+    from jax.sharding import Mesh
+    import numpy as _onp
+
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    devs = [per_proc[p] for p in sorted(per_proc)]
+    return Mesh(_onp.asarray(devs), ("w",))
+
+
+def _compile_collective_sum_probe():
+    """AOT-compile (but do not run) the cross-worker sum program.  Raises
+    on any backend that cannot lower the collective; safe to call on one
+    rank because no execution happens."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _csum_mesh()
+    fn = jax.jit(lambda x: jnp.sum(x, axis=0),
+                 out_shardings=NamedSharding(mesh, P()))
+    shape = jax.ShapeDtypeStruct(
+        (len(mesh.devices), 1), jnp.float32,
+        sharding=NamedSharding(mesh, P("w", None)))
+    fn.lower(shape).compile()
 
 
 def _dist_client():

@@ -23,6 +23,7 @@ TPU-native reimplementation of the reference's NDArray
 from __future__ import annotations
 
 import struct
+import threading
 import weakref
 
 import numpy as _np
@@ -41,6 +42,10 @@ import jax.numpy as jnp
 # weak registry of this framework's arrays; waitall() blocks on these
 # instead of scanning the process-wide jax heap
 _LIVE = weakref.WeakSet()
+# Guards _LIVE snapshot/insert: background threads (PrefetchingIter
+# workers, async-checkpoint engine callbacks) create NDArrays while
+# waitall iterates, and WeakSet raises on concurrent mutation.
+_LIVE_LOCK = threading.Lock()
 
 
 def _ctx_device(ctx):
@@ -65,7 +70,8 @@ class NDArray:
 
     def __init__(self, data, ctx=None, writable=True, _parent=None,
                  _getter=None, _setter=None):
-        _LIVE.add(self)
+        with _LIVE_LOCK:
+            _LIVE.add(self)
         self._parent = _parent
         self._getter = _getter
         self._setter = _setter
@@ -411,7 +417,9 @@ def waitall():
     eng = _engine._ENGINE
     if eng is not None:
         eng.wait_for_all()
-    for arr in list(_LIVE):
+    with _LIVE_LOCK:
+        live = list(_LIVE)
+    for arr in live:
         data = arr._storage
         if data is not None and hasattr(data, "block_until_ready"):
             try:

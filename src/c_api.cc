@@ -13,6 +13,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <string>
 
 namespace {
@@ -45,18 +46,24 @@ void SetErrorFromPython() {
 class Gil {
  public:
   Gil() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      // drop the GIL acquired by initialization so Ensure below nests
-      owner_init_ = true;
-    }
+    // first MX* calls may race in from several plain-C threads: only one
+    // may initialize the interpreter
+    static std::once_flag init_once;
+    std::call_once(init_once, [] {
+      if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        // Py_InitializeEx leaves the calling thread holding the GIL;
+        // park it so Ensure below (and MX* calls from OTHER threads)
+        // can take it
+        PyEval_SaveThread();
+      }
+    });
     state_ = PyGILState_Ensure();
   }
   ~Gil() { PyGILState_Release(state_); }
 
  private:
   PyGILState_STATE state_;
-  bool owner_init_ = false;
 };
 
 int EnsureImpl() {

@@ -129,6 +129,19 @@ def test_feedforward_fit_score_predict(tmp_path):
     assert m2.score(val) > 0.9
 
 
+def test_feedforward_epoch_size_exact_multiple():
+    """epoch_size == batches-per-pass: each epoch drains the iterator
+    exactly, so epoch 2+ begins with it exhausted and the driver must
+    reset-and-retry instead of raising (reference do_reset semantics)."""
+    X, y = _toy_problem()
+    train = mx.io.NDArrayIter(X, y, batch_size=20)  # 10 batches/pass
+    model = mx.FeedForward(mx.models.get_mlp(2, (16,)), ctx=mx.cpu(),
+                           num_epoch=3, epoch_size=10, optimizer="sgd",
+                           learning_rate=0.5)
+    model.fit(train)
+    assert model.score(mx.io.NDArrayIter(X, y, batch_size=20)) > 0.7
+
+
 def test_feedforward_numpy_input():
     X, y = _toy_problem()
     model = mx.FeedForward(mx.models.get_mlp(2, (16,)), ctx=mx.cpu(),
