@@ -362,7 +362,7 @@ class _BatchNormParam(ParamStruct):
     use_global_stats = Field(bool, default=False)
 
 
-@register_op("BatchNorm")
+@register_op("BatchNorm", aliases=("CuDNNBatchNorm",))
 class BatchNorm(OperatorProperty):
     """batch_norm-inl.h. Aux moving_mean/moving_var updated functionally in
     train mode (the reference mutates them in Backward; same steady state)."""

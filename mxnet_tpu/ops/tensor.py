@@ -62,6 +62,36 @@ _make_binary("_Maximum", jnp.maximum, aliases=("broadcast_maximum",))
 _make_binary("_Minimum", jnp.minimum, aliases=("broadcast_minimum",))
 
 
+@register_op("element_mask")
+class ElementMask(OperatorProperty):
+    """broadcast_mask_op-inl.h:84 — rhs (1-D, len == lhs.shape[0]) masks
+    lhs row-wise: out[i, ...] = lhs[i, ...] * rhs[i].  The mask carries no
+    gradient (reference backward writes only lhs_grad), hence the
+    stop_gradient."""
+
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, in_shapes):
+        lhs, rhs = in_shapes
+        if lhs is None:
+            require_known(self.op_name, in_shapes, self.list_arguments())
+        if len(lhs) < 2:
+            raise MXNetError("element_mask: lhs must be 2-D or more, got %s"
+                             % (lhs,))
+        if rhs is not None and (len(rhs) != 1 or rhs[0] != lhs[0]):
+            raise MXNetError(
+                "element_mask: rhs must be 1-D of length lhs.shape[0]=%d, "
+                "got %s" % (lhs[0], rhs))
+        return [lhs, (lhs[0],)], [lhs], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        lhs, rhs = inputs
+        mask = jax.lax.stop_gradient(rhs).reshape(
+            (lhs.shape[0],) + (1,) * (lhs.ndim - 1))
+        return [lhs * mask.astype(lhs.dtype)], None
+
+
 # ----------------------------------------------------------------------
 # scalar variants (elementwise_scalar_op; reference keeps scalar in attrs)
 # ----------------------------------------------------------------------
@@ -69,8 +99,22 @@ class _ScalarParam(ParamStruct):
     scalar = Field(float, required=True, doc="scalar operand")
 
 
+def _snake(name):
+    """_DivScalar -> _div_scalar, _RDivScalar -> _rdiv_scalar (the
+    reference's imperative registration names)."""
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper():
+            if i > 1 and not name[i - 1].isupper():
+                out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
 def _make_scalar(op_name, fn):
-    @register_op(op_name)
+    @register_op(op_name, aliases=(_snake(op_name),))
     class _Scalar(OperatorProperty):
         param_cls = _ScalarParam
         hint = op_name.strip("_").lower()
@@ -127,6 +171,10 @@ _make_unary("floor", jnp.floor)
 _make_unary("square", jnp.square)
 _make_unary("negative", jnp.negative, aliases=("_Negative",))
 _make_unary("_copy", lambda x: x, aliases=("identity",))
+# cross_device_copy.cc: explicit ctx-boundary copy node.  Device motion is
+# XLA/sharding's job here, so the graph op itself is identity; the executor
+# places operands per ctx_group (see executor.py AssignContext analog).
+_make_unary("_CrossDeviceCopy", lambda x: x)
 
 
 class _SmoothL1Param(ParamStruct):

