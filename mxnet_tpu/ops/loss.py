@@ -252,18 +252,21 @@ class IdentityAttachKLSparseReg(OperatorProperty):
         avg = jnp.mean(x, axis=tuple(i for i in range(x.ndim) if i != 1))
         new_avg = p.momentum * aux[0] + (1 - p.momentum) * avg
 
+        # the moving average rides through the vjp as an ARGUMENT (closing
+        # over it from the outer trace leaks a tracer into the bwd rule)
         @jax.custom_vjp
-        def _kl(data):
+        def _kl(data, navg):
             return data
 
-        def _f(data):
-            return data, None
+        def _f(data, navg):
+            return data, navg
 
-        def _b(res, g):
-            a = lax.stop_gradient(new_avg).reshape((1, -1) + (1,) * (x.ndim - 2))
+        def _b(navg, g):
+            a = navg.reshape((1, -1) + (1,) * (x.ndim - 2))
             pen = p.penalty * (-p.sparseness_target / (a + 1e-8)
                                + (1.0 - p.sparseness_target) / (1.0 - a + 1e-8))
-            return (g + pen,)
+            return (g + pen, jnp.zeros_like(navg))
 
         _kl.defvjp(_f, _b)
-        return [_kl(x)], ([new_avg] if is_train else None)
+        return [_kl(x, lax.stop_gradient(new_avg))], \
+            ([new_avg] if is_train else None)
