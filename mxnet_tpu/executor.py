@@ -57,13 +57,41 @@ def _as_list(obj, names, what):
 class _Program:
     """Compiled form of a symbol graph: pure trace + jitted entries."""
 
-    __slots__ = ("trace", "jit_forward", "jit_fwd_bwd", "needs_rng")
+    __slots__ = ("trace", "jit_forward", "jit_fwd_bwd", "needs_rng",
+                 "_jit_forward_mon", "monitor_sink")
 
     def __init__(self, trace, jit_forward, jit_fwd_bwd, needs_rng):
         self.trace = trace
         self.jit_forward = jit_forward
         self.jit_fwd_bwd = jit_fwd_bwd
         self.needs_rng = needs_rng
+        self._jit_forward_mon = None
+        self.monitor_sink = None
+
+    def jit_forward_monitored(self):
+        """Compiled forward that streams every op output to the installed
+        monitor through ``jax.debug.callback`` — per-op stats come from the
+        SAME XLA computation that training runs, not an eager re-trace
+        (parity: graph_executor.cc:937-951 fires inside the real executor).
+        The sink is read through ``self`` at call time so one compiled
+        program serves every executor bound to this symbol."""
+        if self._jit_forward_mon is None:
+            import functools
+
+            def dispatch(name, value):
+                sink = self.monitor_sink
+                if sink is not None:
+                    sink(name, value)
+
+            def monitored(arg_values, aux_values, rng, is_train):
+                def mon(name, o):
+                    jax.debug.callback(functools.partial(dispatch, name), o)
+                return self.trace(arg_values, aux_values, rng, is_train,
+                                  monitor=mon)
+
+            self._jit_forward_mon = jax.jit(monitored,
+                                            static_argnames=("is_train",))
+        return self._jit_forward_mon
 
 
 def _build_program(symbol, group2ctx):
@@ -211,6 +239,7 @@ class Executor:
         self._n_forward = 0
         self._n_fwd_bwd = 0
         self._n_fused_step = 0
+        self._n_monitored_compiled = 0
         self._fused_cache = None  # (optimizer id, jitted step)
 
     @property
@@ -233,8 +262,23 @@ class Executor:
         aux_values = {n: a.data for n, a in self.aux_dict.items()}
         rng = _random.next_key() if self._needs_rng else _zero_key()
         if self._monitor_callback is not None:
-            outs, aux_out = self._trace(arg_values, aux_values, rng,
-                                        is_train, monitor=self._run_monitor)
+            import os as _os
+            if _os.environ.get("MXTPU_MONITOR_MODE", "compiled") == "interpret":
+                # eager op-by-op debugging path (NaiveEngine analog)
+                outs, aux_out = self._trace(arg_values, aux_values, rng,
+                                            is_train, monitor=self._run_monitor)
+            else:
+                prog = self._program
+                prog.monitor_sink = self._run_monitor
+                try:
+                    outs, aux_out = prog.jit_forward_monitored()(
+                        arg_values, aux_values, rng, is_train=bool(is_train))
+                    # debug callbacks are asynchronous: flush them so the
+                    # monitor queue is complete when toc() reads it
+                    jax.effects_barrier()
+                finally:
+                    prog.monitor_sink = None
+                self._n_monitored_compiled += 1
         else:
             outs, aux_out = self._jit_forward(arg_values, aux_values, rng,
                                               is_train=bool(is_train))
