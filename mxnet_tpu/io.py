@@ -517,6 +517,22 @@ class ImageRecordIter(DataIter):
                  dtype="float32", **kwargs):
         super().__init__()
         import os
+        from .stream import has_scheme
+        self._spool_path = None
+        if has_scheme(path_imgrec):
+            # remote record file (s3:// gs:// ...): spool locally once so
+            # the native chunked offset scan + decode pool work on a real
+            # fd.  Each worker spools its own copy; with num_parts sharding
+            # the byte-range split still applies to the spooled file.
+            import shutil
+            import tempfile
+            from .stream import open_uri
+            fd, self._spool_path = tempfile.mkstemp(suffix=".rec")
+            os.close(fd)
+            with open_uri(path_imgrec, "rb") as src, \
+                    open(self._spool_path, "wb") as dst:
+                shutil.copyfileobj(src, dst)
+            path_imgrec = self._spool_path
         self.path_imgrec = path_imgrec
         self.data_shape = tuple(data_shape)
         self.batch_size = batch_size
@@ -542,7 +558,7 @@ class ImageRecordIter(DataIter):
         self._mean_vec = None
         self._mean_full = None
         if mean_img is not None:
-            if not os.path.isfile(mean_img):
+            if not has_scheme(mean_img) and not os.path.isfile(mean_img):
                 raise MXNetError("mean_img %r does not exist" % mean_img)
             from .ndarray import load as nd_load
             loaded = nd_load(mean_img)
@@ -854,3 +870,10 @@ class ImageRecordIter(DataIter):
             self._gen += 1
         except Exception:
             pass
+        spool = getattr(self, "_spool_path", None)
+        if spool is not None:
+            try:
+                import os
+                os.unlink(spool)
+            except OSError:
+                pass

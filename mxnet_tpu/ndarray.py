@@ -703,7 +703,8 @@ def save(fname, data):
             arrays.append(data[k])
     else:
         arrays = list(data)
-    with open(fname, "wb") as fo:
+    from .stream import open_uri
+    with open_uri(fname, "wb") as fo:
         fo.write(struct.pack("<QQ", _MAGIC, _RESERVED))
         fo.write(struct.pack("<Q", len(arrays)))
         for arr in arrays:
@@ -714,7 +715,8 @@ def save(fname, data):
 
 
 def load(fname):
-    with open(fname, "rb") as fi:
+    from .stream import open_uri
+    with open_uri(fname, "rb") as fi:
         magic, _ = struct.unpack("<QQ", fi.read(16))
         if magic != _MAGIC:
             raise MXNetError("invalid NDArray file %s (bad magic)" % fname)

@@ -64,7 +64,14 @@ class MXRecordIO(object):
     """
 
     def __init__(self, uri, flag):
+        from .stream import has_scheme
         self.uri = uri
+        # remote URIs (s3:// gs:// memory:// ...) spool through a local
+        # temp file: the native reader/writer needs a real fd (dmlc::Stream
+        # parity — reference record files live on S3/HDFS transparently)
+        self._remote_uri = uri if has_scheme(uri) else None
+        self._spool = None
+        self._spooled_down = False
         self.flag = flag
         self.handle = None
         self._native = None
@@ -76,28 +83,46 @@ class MXRecordIO(object):
         from .libinfo import find_lib  # honors MXTPU_NO_NATIVE
         return find_lib()
 
+    def _local_uri(self):
+        """The path the (native) reader/writer actually opens."""
+        if self._remote_uri is None:
+            return self.uri
+        if self._spool is None:
+            import tempfile
+            fd, self._spool = tempfile.mkstemp(suffix=".rec")
+            os.close(fd)
+        if self.flag == "r" and not self._spooled_down:
+            import shutil
+            from .stream import open_uri
+            with open_uri(self._remote_uri, "rb") as src, \
+                    open(self._spool, "wb") as dst:
+                shutil.copyfileobj(src, dst)
+            self._spooled_down = True
+        return self._spool
+
     def open(self):
         lib = self._try_native()
+        path = self._local_uri()
         if self.flag == "w":
             self.writable = True
             if lib is not None:
-                h = lib.MXTPURecordIOWriterCreate(self.uri.encode())
+                h = lib.MXTPURecordIOWriterCreate(path.encode())
                 if h:
                     self._lib, self._native = lib, h
                 else:
                     raise IOError("cannot open %s for writing" % self.uri)
             else:
-                self.handle = open(self.uri, "wb")
+                self.handle = open(path, "wb")
         elif self.flag == "r":
             self.writable = False
             if lib is not None:
-                h = lib.MXTPURecordIOReaderCreate(self.uri.encode(), 0, -1)
+                h = lib.MXTPURecordIOReaderCreate(path.encode(), 0, -1)
                 if h:
                     self._lib, self._native = lib, h
                 else:
                     raise IOError("cannot open %s for reading" % self.uri)
             else:
-                self.handle = open(self.uri, "rb")
+                self.handle = open(path, "rb")
         else:
             raise ValueError("Invalid flag %s" % self.flag)
         self.is_open = True
@@ -119,12 +144,25 @@ class MXRecordIO(object):
                 self.handle.close()
                 self.handle = None
             self.is_open = False
+            if self._remote_uri is not None and self.writable:
+                # push the finished spool to the remote object
+                import shutil
+                from .stream import open_uri
+                with open(self._spool, "rb") as src, \
+                        open_uri(self._remote_uri, "wb") as dst:
+                    shutil.copyfileobj(src, dst)
 
     def __del__(self):
         try:
             self.close()
         except Exception:
             pass
+        if self._spool is not None:
+            try:
+                os.unlink(self._spool)
+            except OSError:
+                pass
+            self._spool = None
 
     def reset(self):
         self.close()
@@ -216,13 +254,27 @@ class MXIndexedRecordIO(MXRecordIO):
         self.key_type = key_type
         super().__init__(uri, flag)
 
+    def _idx_exists(self):
+        from .stream import has_scheme
+        if not has_scheme(self.idx_path):
+            return os.path.isfile(self.idx_path)
+        try:
+            import fsspec
+            fs, _, paths = fsspec.get_fs_token_paths(self.idx_path)
+            return fs.exists(paths[0])
+        except Exception:
+            return False
+
     def open(self):
         super().open()
         self.idx = {}
         self.keys = []
-        if not self.writable and os.path.isfile(self.idx_path):
-            with open(self.idx_path) as fin:
+        if not self.writable and self._idx_exists():
+            from .stream import open_uri
+            with open_uri(self.idx_path, "r") as fin:
                 for line in fin:
+                    if isinstance(line, bytes):
+                        line = line.decode()
                     line = line.strip().split("\t")
                     key = self.key_type(line[0])
                     self.idx[key] = int(line[1])
@@ -230,7 +282,8 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def close(self):
         if self.is_open and self.writable:
-            with open(self.idx_path, "w") as fout:
+            from .stream import open_uri
+            with open_uri(self.idx_path, "w") as fout:
                 for key in self.keys:
                     fout.write("%s\t%d\n" % (str(key), self.idx[key]))
         super().close()

@@ -354,7 +354,8 @@ class Symbol:
                            "heads": heads}, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as fo:
+        from .stream import open_uri
+        with open_uri(fname, "w") as fo:
             fo.write(self.tojson())
 
     def debug_str(self):
@@ -415,7 +416,8 @@ def load_json(json_str):
 
 
 def load(fname):
-    with open(fname) as fi:
+    from .stream import open_uri
+    with open_uri(fname, "r") as fi:
         return load_json(fi.read())
 
 

@@ -1,0 +1,104 @@
+"""Remote stream URIs (dmlc::Stream parity, VERDICT r3 #6).
+
+Every persistence path — NDArray save/load, Symbol save/load,
+checkpoints, RecordIO, ImageRecordIter — must accept scheme URIs the way
+the reference's dmlc::Stream makes S3/HDFS paths work everywhere
+(docs/how_to/cloud.md:84).  fsspec's ``memory://`` filesystem is the
+in-process fake remote."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio as rio
+from mxnet_tpu.test_utils import assert_almost_equal
+
+pytest.importorskip("fsspec")
+
+rng = np.random.RandomState(0)
+
+
+def _uri(name):
+    return "memory://mxtpu-test/%s" % name
+
+
+def test_ndarray_save_load_memory_uri():
+    arrs = {"w": mx.nd.array(rng.rand(3, 4).astype(np.float32)),
+            "b": mx.nd.array(rng.rand(4).astype(np.float32))}
+    uri = _uri("nd.params")
+    mx.nd.save(uri, arrs)
+    back = mx.nd.load(uri)
+    assert sorted(back) == ["b", "w"]
+    for k in arrs:
+        assert_almost_equal(back[k].asnumpy(), arrs[k].asnumpy())
+
+
+def test_symbol_save_load_memory_uri():
+    net = mx.models.get_mlp(2, (8,))
+    uri = _uri("net-symbol.json")
+    net.save(uri)
+    back = mx.sym.load(uri)
+    assert back.list_arguments() == net.list_arguments()
+
+
+def test_checkpoint_roundtrip_memory_uri():
+    net = mx.models.get_mlp(2, (8,))
+    arg_shapes, _, aux_shapes = net.infer_shape(data=(4, 10))
+    args = {n: mx.nd.array(rng.rand(*s).astype(np.float32))
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    prefix = _uri("ckpt/model")
+    mx.model.save_checkpoint(prefix, 3, net, args, {})
+    sym2, args2, aux2 = mx.model.load_checkpoint(prefix, 3)
+    assert sym2.list_arguments() == net.list_arguments()
+    for k in args:
+        assert_almost_equal(args2[k].asnumpy(), args[k].asnumpy())
+
+
+def test_recordio_roundtrip_memory_uri():
+    uri = _uri("data.rec")
+    w = rio.MXRecordIO(uri, "w")
+    payloads = [b"rec-%d" % i * (i + 1) for i in range(20)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    r = rio.MXRecordIO(uri, "r")
+    got = []
+    while True:
+        item = r.read()
+        if item is None:
+            break
+        got.append(item)
+    r.close()
+    assert got == payloads
+
+
+def test_indexed_recordio_memory_uri():
+    rec = _uri("idx_data.rec")
+    idx = _uri("idx_data.idx")
+    w = rio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(10):
+        w.write_idx(i, b"payload-%03d" % i)
+    w.close()
+
+    r = rio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"payload-007"
+    assert r.read_idx(2) == b"payload-002"
+    r.close()
+
+
+def test_image_record_iter_memory_uri():
+    uri = _uri("images.rec")
+    w = rio.MXRecordIO(uri, "w")
+    img = rng.randint(0, 255, (3, 8, 8), np.uint8)
+    for i in range(16):
+        w.write(rio.pack(rio.IRHeader(0, float(i % 4), i, 0), img.tobytes()))
+    w.close()
+
+    it = mx.io.ImageRecordIter(path_imgrec=uri, data_shape=(3, 8, 8),
+                               batch_size=4, dtype="uint8",
+                               preprocess_threads=1, prefetch_buffer=2)
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (4, 3, 8, 8)
