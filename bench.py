@@ -429,18 +429,48 @@ def _measure_module_path(jax, platform):
 
 def _measure_allreduce(jax):
     """Allreduce bandwidth over every visible device (the kvstore
-    push/pull -> psum secondary metric, BASELINE.md)."""
-    sys.path.insert(0, os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "tools", "bandwidth"))
-    import measure as bw
+    push/pull -> psum secondary metric, BASELINE.md).
+
+    With >1 real device the measurement runs in-process over ICI (the
+    armed TPU-pod path).  On a single-chip/host box a 1-device psum moves
+    zero bytes, so the metric instead comes from a subprocess running the
+    same measurement over 8 virtual CPU devices — always a >1-device
+    number to judge (VERDICT r3 #3)."""
     size = int(os.environ.get("BENCH_ALLREDUCE_BYTES", str(64 << 20)))
-    n, results = bw.measure_psum([size], repeat=5)
-    _size, dt, gbps = results[0]
+    if len(jax.devices()) > 1:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools", "bandwidth"))
+        import measure as bw
+        n, results = bw.measure_psum([size], repeat=5)
+        _size, dt, gbps = results[0]
+        platform = jax.devices()[0].platform
+    else:
+        size = min(size, 16 << 20)  # host-RAM-friendly
+        code = (
+            "import jax, sys, json\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "sys.path.insert(0, %r)\n"
+            "import measure as bw\n"
+            "n, res = bw.measure_psum([%d], repeat=3)\n"
+            "print(json.dumps({'n': n, 'dt': res[0][1], 'gbps': res[0][2]}))\n"
+            % (os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "bandwidth"), size))
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8")
+        env.pop("JAX_PLATFORMS", None)
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              timeout=300, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        n, dt, gbps = payload["n"], payload["dt"], payload["gbps"]
+        platform = "cpu-virtual"
     return {
         "allreduce_bytes": size,
         "allreduce_time_ms": round(dt * 1e3, 3),
         "allreduce_gbps": round(gbps, 2),
         "allreduce_devices": n,
+        "allreduce_platform": platform,
     }
 
 

@@ -1,0 +1,63 @@
+"""Cross-process allreduce bandwidth (multi-process on one box).
+
+The BASELINE secondary metric (kvstore push/pull -> allreduce bandwidth,
+reference tools/bandwidth/measure.py:16-40) measured across REAL process
+boundaries: each launch.py worker holds one shard of a global array on
+its own device and a jitted sum over the worker axis runs the collective.
+
+Prints one line per size:
+    ALLREDUCE size=<bytes> devices=<n> time_ms=<t> busbw_gbps=<bw>
+and asserts the bandwidth is a real number > 0.
+
+Run directly:
+    python tools/launch.py -n 2 --launcher local \
+        python tests/nightly/dist_allreduce_bench.py
+"""
+import sys
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx  # noqa: F401  (boots jax.distributed via kvstore)
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    nw = kv.num_workers
+    assert nw > 1, "launch with -n >= 2"
+
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.kvstore import _csum_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _csum_mesh()
+    summed = jax.jit(lambda x: jnp.sum(x, axis=0),
+                     out_shardings=NamedSharding(mesh, P()))
+    for size in (1 << 20, 16 << 20):
+        elems = size // 4
+        local = jnp.ones((1, elems), jnp.float32)
+        sharding = NamedSharding(mesh, P("w", None))
+        garr = jax.make_array_from_process_local_data(sharding, local)
+        summed(garr).block_until_ready()       # compile
+        kv.barrier()
+        repeat = 8
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            out = summed(garr)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / repeat
+        moved = 2 * (nw - 1) / nw * size
+        bw = moved / dt / 1e9
+        assert np.isfinite(bw) and bw > 0, bw
+        if kv.rank == 0:
+            print("ALLREDUCE size=%d devices=%d time_ms=%.3f "
+                  "busbw_gbps=%.3f" % (size, nw, dt * 1e3, bw))
+    kv.barrier()
+    if kv.rank == 0:
+        print("OK allreduce bench")
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)
