@@ -31,7 +31,11 @@ $(LIBDIR)/capi_smoke: tests/capi/capi_smoke.c $(LIBDIR)/libmxtpu_capi.so
 	$(CC) -O2 -Wall -Iinclude $< -o $@ -L$(LIBDIR) -lmxtpu_capi \
 	    -Wl,-rpath,'$$ORIGIN'
 
-test-capi: $(LIBDIR)/capi_smoke
+$(LIBDIR)/capi_threads: tests/capi/capi_threads.c $(LIBDIR)/libmxtpu_capi.so
+	$(CC) -O2 -Wall -Iinclude $< -o $@ -L$(LIBDIR) -lmxtpu_capi \
+	    -lpthread -Wl,-rpath,'$$ORIGIN'
+
+test-capi: $(LIBDIR)/capi_smoke $(LIBDIR)/capi_threads
 	python -m pytest tests/test_capi.py -q
 
 $(LIBDIR):

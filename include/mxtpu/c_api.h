@@ -68,6 +68,77 @@ int MXKVStoreInit(KVStoreHandle h, int key, NDArrayHandle val);
 int MXKVStorePush(KVStoreHandle h, int key, NDArrayHandle val);
 int MXKVStorePull(KVStoreHandle h, int key, NDArrayHandle out);
 
+/* -- function registry listing (c_api.cc:366-445 parity): enumerate
+ * every registered operator with docstrings through C — the machinery
+ * foreign bindings are built on.  Handles and returned strings live for
+ * the process. */
+typedef void* FunctionHandle;
+int MXListFunctions(uint32_t* out_size, FunctionHandle** out_array);
+int MXFuncGetInfo(FunctionHandle fn, const char** name,
+                  const char** description, uint32_t* num_args,
+                  const char*** arg_names, const char*** arg_types,
+                  const char*** arg_descriptions);
+
+/* -- symbol compose / attrs through C (c_api.cc:447-937 parity).
+ * kwargs_json carries op params ({"num_hidden": 4, "kernel": [3, 3]});
+ * MXSymbolCompose returns the composed symbol through *out instead of
+ * mutating in place (documented divergence). */
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out);
+int MXSymbolCreateAtomicSymbol(const char* op_name, const char* kwargs_json,
+                               const char* name, SymbolHandle* out);
+int MXSymbolCompose(SymbolHandle sym, uint32_t num_args, const char** keys,
+                    SymbolHandle* args, SymbolHandle* out);
+int MXSymbolGetAttr(SymbolHandle h, const char* key, char* buf, size_t cap,
+                    int* success);
+int MXSymbolSetAttr(SymbolHandle h, const char* key, const char* value);
+int MXSymbolGetNumOutputs(SymbolHandle h, uint32_t* out);
+int MXSymbolGetOutput(SymbolHandle h, uint32_t index, char* buf,
+                      size_t cap);
+/* *out_json / infer results point at thread-local storage valid until
+ * this thread's next MXSymbol*JSON call (the reference's ret_buf
+ * convention). */
+int MXSymbolSaveToJSON(SymbolHandle h, const char** out_json);
+int MXSymbolInferShapeJSON(SymbolHandle h, const char* in_json,
+                           const char** out_json);
+
+/* -- data iterators through C (c_api.cc:1101-1197 parity) */
+typedef void* DataIterHandle;
+int MXListDataIters(uint32_t* out_size, FunctionHandle** out_array);
+int MXDataIterGetIterInfo(FunctionHandle creator, const char** name,
+                          const char** description);
+int MXDataIterCreateIter(const char* name, const char* kwargs_json,
+                         DataIterHandle* out);
+int MXDataIterFree(DataIterHandle h);
+int MXDataIterNext(DataIterHandle h, int* out);
+int MXDataIterBeforeFirst(DataIterHandle h);
+int MXDataIterGetData(DataIterHandle h, NDArrayHandle* out);
+int MXDataIterGetLabel(DataIterHandle h, NDArrayHandle* out);
+int MXDataIterGetPadNum(DataIterHandle h, int* out);
+
+/* -- RecordIO through C (c_api.cc:1377-1454 parity) */
+typedef void* RecordIOHandle;
+int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out);
+int MXRecordIOWriterFree(RecordIOHandle h);
+int MXRecordIOWriterWriteRecord(RecordIOHandle h, const char* buf,
+                                size_t size);
+int MXRecordIOWriterTell(RecordIOHandle h, size_t* pos);
+int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out);
+int MXRecordIOReaderFree(RecordIOHandle h);
+/* *out is owned by the reader, valid until the next read/free; EOF is
+ * rc 0 with *out NULL. */
+int MXRecordIOReaderReadRecord(RecordIOHandle h, const char** out,
+                               size_t* size);
+int MXRecordIOReaderSeek(RecordIOHandle h, size_t pos);
+
+/* -- optimizer through C (c_api.cc:1525-1556 parity); lr/wd < 0 keep
+ * the optimizer's configured values */
+typedef void* OptimizerHandle;
+int MXOptimizerCreateOptimizer(const char* name, const char* kwargs_json,
+                               OptimizerHandle* out);
+int MXOptimizerFree(OptimizerHandle h);
+int MXOptimizerUpdate(OptimizerHandle h, int index, NDArrayHandle weight,
+                      NDArrayHandle grad, float lr, float wd);
+
 #ifdef __cplusplus
 }
 #endif

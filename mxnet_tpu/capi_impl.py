@@ -140,3 +140,228 @@ def kvstore_push(kv, key, nd):
 def kvstore_pull(kv, key, nd):
     kv.pull(int(key), nd)
     return 0
+
+
+# ----------------------------------------------------------------------
+# function-registry listing (c_api.cc:366-445 parity): what makes foreign
+# bindings possible — enumerate every op with docs through C
+# ----------------------------------------------------------------------
+def registry_list_ops():
+    from .ops.registry import OP_REGISTRY
+    seen = set()
+    names = []
+    for name, cls in OP_REGISTRY._entries.values():
+        if cls in seen:
+            continue
+        seen.add(cls)
+        names.append(name)
+    return names
+
+
+def registry_op_info(name):
+    """(name, description, [arg names], [arg type descs], [arg docs])."""
+    from .ops.registry import OP_REGISTRY
+    disp, cls = OP_REGISTRY._entries[name.lower()]
+    desc = (cls.__doc__ or "").strip()
+    args, types, docs = [], [], []
+    pc = getattr(cls, "param_cls", None)
+    if pc is not None:
+        for fname, field in pc._fields.items():
+            args.append(fname)
+            t = getattr(field.typ, "__name__", str(field.typ))
+            types.append("%s, %s" % (t, "required" if field.required
+                                     else "optional"))
+            docs.append(field.doc or "")
+    return (disp, desc, args, types, docs)
+
+
+# ----------------------------------------------------------------------
+# symbol compose / attrs through C (c_api.cc:447-937 parity)
+# ----------------------------------------------------------------------
+def symbol_create_variable(name):
+    from . import symbol as sym_mod
+    return sym_mod.Variable(name)
+
+
+def _coerce_json_value(v):
+    return tuple(v) if isinstance(v, list) else v
+
+
+def symbol_create_atomic(op_name, kwargs_json, name):
+    """An un-composed atomic symbol: an opaque staging record the later
+    symbol_compose call turns into a real Symbol (the reference stages the
+    same way: CreateAtomicSymbol holds op+params until Compose wires
+    inputs)."""
+    import json
+    kwargs = {k: _coerce_json_value(v)
+              for k, v in (json.loads(kwargs_json) if kwargs_json else {}).items()}
+    return ["atomic", op_name, kwargs, name or None]
+
+
+def symbol_compose(staged, keys, args):
+    """Wire inputs into a staged atomic symbol -> composed Symbol.
+    keys empty = positional; else keyword composition."""
+    from . import symbol as sym_mod
+    kind, op_name, kwargs, name = staged
+    if kind != "atomic":
+        raise ValueError("compose target is not an atomic symbol")
+    builder = getattr(sym_mod, op_name)
+    kw = dict(kwargs)
+    if name:
+        kw["name"] = name
+    if keys:
+        kw.update(zip(keys, args))
+        return builder(**kw)
+    return builder(*args, **kw)
+
+
+def symbol_get_attr(sym, key):
+    return sym.attr(key)
+
+
+def symbol_set_attr(sym, key, value):
+    sym._set_attr(**{key: value})
+    return 0
+
+
+def symbol_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_tojson(sym):
+    return sym.tojson()
+
+
+def symbol_infer_shape_json(sym, in_json):
+    import json
+    shapes = {k: tuple(v) for k, v in json.loads(in_json).items()}
+    arg, out, aux = sym.infer_shape(**shapes)
+    def _ser(lst):
+        return None if lst is None else [list(s) for s in lst]
+    return json.dumps({"arg_shapes": _ser(arg), "out_shapes": _ser(out),
+                       "aux_shapes": _ser(aux)})
+
+
+# ----------------------------------------------------------------------
+# data iterators through C (c_api.cc:1101-1197 parity)
+# ----------------------------------------------------------------------
+_CAPI_ITERS = ("MNISTIter", "ImageRecordIter", "CSVIter")
+
+
+def dataiter_list():
+    return list(_CAPI_ITERS)
+
+
+def dataiter_create(name, kwargs_json):
+    import json
+    from . import io
+    if name not in _CAPI_ITERS:
+        raise ValueError("unknown data iterator %r (have %s)"
+                         % (name, ", ".join(_CAPI_ITERS)))
+    kwargs = {k: _coerce_json_value(v)
+              for k, v in (json.loads(kwargs_json) if kwargs_json else {}).items()}
+    return getattr(io, name)(**kwargs)
+
+
+def dataiter_next(it):
+    try:
+        it._capi_batch = next(it)
+        return 1
+    except StopIteration:
+        it._capi_batch = None
+        return 0
+
+
+def dataiter_before_first(it):
+    it.reset()
+    return 0
+
+
+def dataiter_get_data(it):
+    return it._capi_batch.data[0]
+
+
+def dataiter_get_label(it):
+    return it._capi_batch.label[0]
+
+
+def dataiter_get_pad(it):
+    return int(it._capi_batch.pad or 0)
+
+
+# ----------------------------------------------------------------------
+# RecordIO through C (c_api.cc:1377-1454 parity)
+# ----------------------------------------------------------------------
+def recordio_writer_create(uri):
+    from . import recordio as rio
+    return rio.MXRecordIO(uri, "w")
+
+
+def recordio_writer_write(w, buf):
+    w.write(bytes(buf))
+    return 0
+
+
+def recordio_writer_tell(w):
+    return int(w.tell())
+
+
+def recordio_writer_free(w):
+    w.close()
+    return 0
+
+
+def recordio_reader_create(uri):
+    from . import recordio as rio
+    return rio.MXRecordIO(uri, "r")
+
+
+def recordio_reader_read(r):
+    """Returns the record bytes (kept alive on the reader until the next
+    read/close so the C pointer stays valid), or None at EOF."""
+    data = r.read()
+    r._capi_last = data
+    return data
+
+
+def recordio_reader_seek(r, pos):
+    r._seek_to(int(pos))
+    return 0
+
+
+def recordio_reader_free(r):
+    r._capi_last = None
+    r.close()
+    return 0
+
+
+# ----------------------------------------------------------------------
+# optimizer create/update through C (c_api.cc:1525-1556 parity)
+# ----------------------------------------------------------------------
+def optimizer_create(name, kwargs_json):
+    import json
+    from . import optimizer
+    kwargs = {k: _coerce_json_value(v)
+              for k, v in (json.loads(kwargs_json) if kwargs_json else {}).items()}
+    opt = optimizer.create(name, **kwargs)
+    opt._capi_states = {}
+    return opt
+
+
+def optimizer_update(opt, index, weight, grad, lr, wd):
+    """Parity: MXOptimizerUpdate(handle, index, weight, grad, lr, wd) —
+    the caller-supplied lr/wd override the optimizer's for this call
+    (negative = keep the optimizer's own)."""
+    index = int(index)
+    old_lr, old_wd = opt.lr, opt.wd
+    try:
+        if lr >= 0:
+            opt.lr = float(lr)
+        if wd >= 0:
+            opt.wd = float(wd)
+        if index not in opt._capi_states:
+            opt._capi_states[index] = opt.create_state(index, weight)
+        opt.update(index, weight, grad, opt._capi_states[index])
+    finally:
+        opt.lr, opt.wd = old_lr, old_wd
+    return 0

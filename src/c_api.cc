@@ -15,6 +15,7 @@
 #include <cstring>
 #include <mutex>
 #include <string>
+#include <vector>
 
 namespace {
 
@@ -369,6 +370,432 @@ int MXKVStorePull(KVStoreHandle h, int key, NDArrayHandle out) {
   return CallRC("kvstore_pull",
                 Py_BuildValue("(OiO)", static_cast<PyObject*>(h), key,
                               static_cast<PyObject*>(out)));
+}
+
+// ---- function registry listing (c_api.cc:366-445 parity) -----------
+// Handles are pointers into a process-lifetime cache (the reference's
+// registry entries are equally static).
+namespace {
+
+struct FuncInfo {
+  std::string name;
+  std::string description;
+  std::vector<std::string> arg_names, arg_types, arg_descs;
+  std::vector<const char*> pnames, ptypes, pdescs;  // C views
+};
+
+std::vector<FuncInfo*>* g_functions = nullptr;  // leaked on purpose
+
+int EnsureFunctions() {
+  if (g_functions) return 0;
+  PyObject* lst = Call("registry_list_ops", PyTuple_New(0));
+  if (!lst) return -1;
+  auto* fns = new std::vector<FuncInfo*>();
+  for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i) {
+    const char* nm = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+    auto* fi = new FuncInfo();
+    fi->name = nm ? nm : "";
+    fns->push_back(fi);
+  }
+  Py_DECREF(lst);
+  g_functions = fns;
+  return 0;
+}
+
+int FillInfo(FuncInfo* fi) {
+  if (!fi->description.empty() || !fi->arg_names.empty()) return 0;
+  PyObject* tup = Call("registry_op_info",
+                       Py_BuildValue("(s)", fi->name.c_str()));
+  if (!tup) return -1;
+  const char* desc = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 1));
+  fi->description = desc ? desc : "";
+  PyObject* lists[3] = {PyTuple_GetItem(tup, 2), PyTuple_GetItem(tup, 3),
+                        PyTuple_GetItem(tup, 4)};
+  std::vector<std::string>* dsts[3] = {&fi->arg_names, &fi->arg_types,
+                                       &fi->arg_descs};
+  for (int k = 0; k < 3; ++k) {
+    for (Py_ssize_t i = 0; i < PyList_Size(lists[k]); ++i) {
+      const char* s = PyUnicode_AsUTF8(PyList_GetItem(lists[k], i));
+      dsts[k]->push_back(s ? s : "");
+    }
+  }
+  Py_DECREF(tup);
+  for (auto& s : fi->arg_names) fi->pnames.push_back(s.c_str());
+  for (auto& s : fi->arg_types) fi->ptypes.push_back(s.c_str());
+  for (auto& s : fi->arg_descs) fi->pdescs.push_back(s.c_str());
+  return 0;
+}
+
+}  // namespace
+
+typedef void* FunctionHandle;
+
+int MXListFunctions(uint32_t* out_size, FunctionHandle** out_array) {
+  Gil gil;
+  if (EnsureFunctions() != 0) return -1;
+  *out_size = static_cast<uint32_t>(g_functions->size());
+  *out_array = reinterpret_cast<FunctionHandle*>(g_functions->data());
+  return 0;
+}
+
+int MXFuncGetInfo(FunctionHandle fn, const char** name,
+                  const char** description, uint32_t* num_args,
+                  const char*** arg_names, const char*** arg_types,
+                  const char*** arg_descriptions) {
+  Gil gil;
+  auto* fi = static_cast<FuncInfo*>(fn);
+  if (!fi) { SetError("null function handle"); return -1; }
+  if (FillInfo(fi) != 0) return -1;
+  if (name) *name = fi->name.c_str();
+  if (description) *description = fi->description.c_str();
+  if (num_args) *num_args = static_cast<uint32_t>(fi->arg_names.size());
+  if (arg_names) *arg_names = fi->pnames.data();
+  if (arg_types) *arg_types = fi->ptypes.data();
+  if (arg_descriptions) *arg_descriptions = fi->pdescs.data();
+  return 0;
+}
+
+// ---- symbol compose / attrs (c_api.cc:447-937 parity) --------------
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  Gil gil;
+  PyObject* sym = Call("symbol_create_variable", Py_BuildValue("(s)", name));
+  if (!sym) return -1;
+  *out = sym;
+  return 0;
+}
+
+// kwargs_json: {"num_hidden": 4, "kernel": [3, 3]} (the reference passes
+// key/value string arrays; JSON is this ABI's established convention)
+int MXSymbolCreateAtomicSymbol(const char* op_name, const char* kwargs_json,
+                               const char* name, SymbolHandle* out) {
+  Gil gil;
+  PyObject* staged = Call("symbol_create_atomic",
+                          Py_BuildValue("(sss)", op_name,
+                                        kwargs_json ? kwargs_json : "",
+                                        name ? name : ""));
+  if (!staged) return -1;
+  *out = staged;
+  return 0;
+}
+
+// Unlike the reference (which mutates sym in place), composition returns
+// the composed symbol through *out; the staged atomic handle stays valid
+// and must still be freed.
+int MXSymbolCompose(SymbolHandle sym, uint32_t num_args, const char** keys,
+                    SymbolHandle* args, SymbolHandle* out) {
+  Gil gil;
+  PyObject* pykeys = PyList_New(0);
+  if (keys) {
+    for (uint32_t i = 0; i < num_args; ++i) {
+      PyObject* s = PyUnicode_FromString(keys[i]);
+      PyList_Append(pykeys, s);
+      Py_DECREF(s);
+    }
+  }
+  PyObject* pyargs = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyObject* a = static_cast<PyObject*>(args[i]);
+    Py_INCREF(a);
+    PyList_SetItem(pyargs, i, a);
+  }
+  PyObject* composed = Call("symbol_compose",
+                            Py_BuildValue("(ONN)",
+                                          static_cast<PyObject*>(sym),
+                                          pykeys, pyargs));
+  if (!composed) return -1;
+  *out = composed;
+  return 0;
+}
+
+int MXSymbolGetAttr(SymbolHandle h, const char* key, char* buf, size_t cap,
+                    int* success) {
+  Gil gil;
+  PyObject* val = Call("symbol_get_attr",
+                       Py_BuildValue("(Os)", static_cast<PyObject*>(h),
+                                     key));
+  if (!val) return -1;
+  if (val == Py_None) {
+    if (success) *success = 0;
+    if (cap) buf[0] = '\0';
+  } else {
+    const char* s = PyUnicode_AsUTF8(val);
+    snprintf(buf, cap, "%s", s ? s : "");
+    if (success) *success = 1;
+  }
+  Py_DECREF(val);
+  return 0;
+}
+
+int MXSymbolSetAttr(SymbolHandle h, const char* key, const char* value) {
+  Gil gil;
+  return CallRC("symbol_set_attr",
+                Py_BuildValue("(Oss)", static_cast<PyObject*>(h), key,
+                              value));
+}
+
+int MXSymbolGetNumOutputs(SymbolHandle h, uint32_t* out) {
+  Gil gil;
+  PyObject* lst = Call("symbol_outputs",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!lst) return -1;
+  *out = static_cast<uint32_t>(PyList_Size(lst));
+  Py_DECREF(lst);
+  return 0;
+}
+
+int MXSymbolGetOutput(SymbolHandle h, uint32_t index, char* buf,
+                      size_t cap) {
+  Gil gil;
+  PyObject* lst = Call("symbol_outputs",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!lst) return -1;
+  if (index >= static_cast<uint32_t>(PyList_Size(lst))) {
+    Py_DECREF(lst);
+    SetError("output index out of range");
+    return -1;
+  }
+  const char* name = PyUnicode_AsUTF8(PyList_GetItem(lst, index));
+  snprintf(buf, cap, "%s", name ? name : "");
+  Py_DECREF(lst);
+  return 0;
+}
+
+// *out_json points at thread-local storage valid until this thread's
+// next MXSymbol*JSON call (the reference's ret_buf convention).
+int MXSymbolSaveToJSON(SymbolHandle h, const char** out_json) {
+  Gil gil;
+  PyObject* s = Call("symbol_tojson",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!s) return -1;
+  thread_local std::string ret;
+  const char* c = PyUnicode_AsUTF8(s);
+  ret = c ? c : "";
+  Py_DECREF(s);
+  *out_json = ret.c_str();
+  return 0;
+}
+
+// in_json: {"data": [4, 10]}; out_json: {"arg_shapes": ..., "out_shapes":
+// ..., "aux_shapes": ...}
+int MXSymbolInferShapeJSON(SymbolHandle h, const char* in_json,
+                           const char** out_json) {
+  Gil gil;
+  PyObject* s = Call("symbol_infer_shape_json",
+                     Py_BuildValue("(Os)", static_cast<PyObject*>(h),
+                                   in_json));
+  if (!s) return -1;
+  thread_local std::string ret;
+  const char* c = PyUnicode_AsUTF8(s);
+  ret = c ? c : "";
+  Py_DECREF(s);
+  *out_json = ret.c_str();
+  return 0;
+}
+
+// ---- data iterators (c_api.cc:1101-1197 parity) --------------------
+typedef void* DataIterHandle;
+
+int MXListDataIters(uint32_t* out_size, FunctionHandle** out_array) {
+  Gil gil;
+  static std::vector<FuncInfo*>* iters = nullptr;  // leaked on purpose
+  if (!iters) {
+    PyObject* lst = Call("dataiter_list", PyTuple_New(0));
+    if (!lst) return -1;
+    iters = new std::vector<FuncInfo*>();
+    for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i) {
+      auto* fi = new FuncInfo();
+      const char* nm = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+      fi->name = nm ? nm : "";
+      fi->description = "data iterator";  // listing only; no Field walk
+      iters->push_back(fi);
+    }
+    Py_DECREF(lst);
+  }
+  *out_size = static_cast<uint32_t>(iters->size());
+  *out_array = reinterpret_cast<FunctionHandle*>(iters->data());
+  return 0;
+}
+
+int MXDataIterGetIterInfo(FunctionHandle creator, const char** name,
+                          const char** description) {
+  Gil gil;
+  auto* fi = static_cast<FuncInfo*>(creator);
+  if (!fi) { SetError("null iterator handle"); return -1; }
+  if (name) *name = fi->name.c_str();
+  if (description) *description = fi->description.c_str();
+  return 0;
+}
+
+int MXDataIterCreateIter(const char* name, const char* kwargs_json,
+                         DataIterHandle* out) {
+  Gil gil;
+  PyObject* it = Call("dataiter_create",
+                      Py_BuildValue("(ss)", name,
+                                    kwargs_json ? kwargs_json : ""));
+  if (!it) return -1;
+  *out = it;
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle h) { return MXNDArrayFree(h); }
+
+int MXDataIterNext(DataIterHandle h, int* out) {
+  Gil gil;
+  PyObject* n = Call("dataiter_next",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!n) return -1;
+  if (out) *out = static_cast<int>(PyLong_AsLong(n));
+  Py_DECREF(n);
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle h) {
+  Gil gil;
+  return CallRC("dataiter_before_first",
+                PyTuple_Pack(1, static_cast<PyObject*>(h)));
+}
+
+int MXDataIterGetData(DataIterHandle h, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* nd = Call("dataiter_get_data",
+                      PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!nd) return -1;
+  *out = nd;
+  return 0;
+}
+
+int MXDataIterGetLabel(DataIterHandle h, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* nd = Call("dataiter_get_label",
+                      PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!nd) return -1;
+  *out = nd;
+  return 0;
+}
+
+int MXDataIterGetPadNum(DataIterHandle h, int* out) {
+  Gil gil;
+  PyObject* n = Call("dataiter_get_pad",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!n) return -1;
+  if (out) *out = static_cast<int>(PyLong_AsLong(n));
+  Py_DECREF(n);
+  return 0;
+}
+
+// ---- RecordIO (c_api.cc:1377-1454 parity) --------------------------
+typedef void* RecordIOHandle;
+
+int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out) {
+  Gil gil;
+  PyObject* w = Call("recordio_writer_create", Py_BuildValue("(s)", uri));
+  if (!w) return -1;
+  *out = w;
+  return 0;
+}
+
+int MXRecordIOWriterFree(RecordIOHandle h) {
+  Gil gil;
+  int rc = CallRC("recordio_writer_free",
+                  PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  Py_XDECREF(static_cast<PyObject*>(h));
+  return rc;
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle h, const char* buf,
+                                size_t size) {
+  Gil gil;
+  return CallRC("recordio_writer_write",
+                Py_BuildValue("(ON)", static_cast<PyObject*>(h),
+                              ReadView(buf, size)));
+}
+
+int MXRecordIOWriterTell(RecordIOHandle h, size_t* pos) {
+  Gil gil;
+  PyObject* n = Call("recordio_writer_tell",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!n) return -1;
+  if (pos) *pos = static_cast<size_t>(PyLong_AsSize_t(n));
+  Py_DECREF(n);
+  return 0;
+}
+
+int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out) {
+  Gil gil;
+  PyObject* r = Call("recordio_reader_create", Py_BuildValue("(s)", uri));
+  if (!r) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXRecordIOReaderFree(RecordIOHandle h) {
+  Gil gil;
+  int rc = CallRC("recordio_reader_free",
+                  PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  Py_XDECREF(static_cast<PyObject*>(h));
+  return rc;
+}
+
+// *out points at memory owned by the reader, valid until the next
+// ReadRecord/Free on this handle.  EOF: rc 0, *out null, *size 0.
+int MXRecordIOReaderReadRecord(RecordIOHandle h, const char** out,
+                               size_t* size) {
+  Gil gil;
+  PyObject* data = Call("recordio_reader_read",
+                        PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!data) return -1;
+  if (data == Py_None) {
+    *out = nullptr;
+    *size = 0;
+  } else {
+    char* p = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(data, &p, &n) != 0) {
+      SetErrorFromPython();
+      Py_DECREF(data);
+      return -1;
+    }
+    // the impl stashed its own reference on the reader (_capi_last), so
+    // the pointer outlives this borrowed object
+    *out = p;
+    *size = static_cast<size_t>(n);
+  }
+  Py_DECREF(data);
+  return 0;
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle h, size_t pos) {
+  Gil gil;
+  return CallRC("recordio_reader_seek",
+                Py_BuildValue("(On)", static_cast<PyObject*>(h),
+                              static_cast<Py_ssize_t>(pos)));
+}
+
+// ---- optimizer (c_api.cc:1525-1556 parity) -------------------------
+typedef void* OptimizerHandle;
+
+int MXOptimizerCreateOptimizer(const char* name, const char* kwargs_json,
+                               OptimizerHandle* out) {
+  Gil gil;
+  PyObject* opt = Call("optimizer_create",
+                       Py_BuildValue("(ss)", name,
+                                     kwargs_json ? kwargs_json : ""));
+  if (!opt) return -1;
+  *out = opt;
+  return 0;
+}
+
+int MXOptimizerFree(OptimizerHandle h) { return MXNDArrayFree(h); }
+
+// lr/wd < 0 keep the optimizer's own values (reference passes both
+// explicitly on every update)
+int MXOptimizerUpdate(OptimizerHandle h, int index, NDArrayHandle weight,
+                      NDArrayHandle grad, float lr, float wd) {
+  Gil gil;
+  return CallRC("optimizer_update",
+                Py_BuildValue("(OiOOff)", static_cast<PyObject*>(h), index,
+                              static_cast<PyObject*>(weight),
+                              static_cast<PyObject*>(grad), lr, wd));
 }
 
 }  // extern "C"

@@ -144,6 +144,217 @@ int main(void) {
     CHECK(MXSymbolFree(sym));
   }
 
+  /* --- function-registry listing with docs --- */
+  uint32_t nfn = 0;
+  FunctionHandle* fns = NULL;
+  CHECK(MXListFunctions(&nfn, &fns));
+  if (nfn < 80) {
+    fprintf(stderr, "FAIL: registry lists only %u ops\n", nfn);
+    return 1;
+  }
+  int saw_conv = 0;
+  for (uint32_t i = 0; i < nfn; ++i) {
+    const char *fname, *fdesc;
+    uint32_t na;
+    const char **anames, **atypes, **adescs;
+    CHECK(MXFuncGetInfo(fns[i], &fname, &fdesc, &na, &anames, &atypes,
+                        &adescs));
+    if (strcmp(fname, "Convolution") == 0) {
+      saw_conv = 1;
+      if (strlen(fdesc) == 0 || na == 0) {
+        fprintf(stderr, "FAIL: Convolution info empty\n");
+        return 1;
+      }
+      printf("registry: %u ops; Convolution has %u params, first=%s (%s)\n",
+             nfn, na, anames[0], atypes[0]);
+    }
+  }
+  if (!saw_conv) {
+    fprintf(stderr, "FAIL: Convolution not listed\n");
+    return 1;
+  }
+
+  /* --- compose a symbol entirely through C --- */
+  SymbolHandle var, fc_atomic, fc, sm_atomic, net;
+  CHECK(MXSymbolCreateVariable("cdata", &var));
+  CHECK(MXSymbolCreateAtomicSymbol("FullyConnected",
+                                   "{\"num_hidden\": 4}", "cfc",
+                                   &fc_atomic));
+  const char* ckeys[1] = {"data"};
+  SymbolHandle cargs[1] = {var};
+  CHECK(MXSymbolCompose(fc_atomic, 1, ckeys, cargs, &fc));
+  CHECK(MXSymbolCreateAtomicSymbol("SoftmaxOutput", "", "csm", &sm_atomic));
+  SymbolHandle cargs2[1] = {fc};
+  CHECK(MXSymbolCompose(sm_atomic, 1, ckeys, cargs2, &net));
+  uint32_t cnargs = 0, cnout = 0;
+  CHECK(MXSymbolGetNumArguments(net, &cnargs));
+  CHECK(MXSymbolGetNumOutputs(net, &cnout));
+  char outname[64];
+  CHECK(MXSymbolGetOutput(net, 0, outname, sizeof(outname)));
+  CHECK(MXSymbolSetAttr(fc, "ctx_group", "stage1"));
+  char attr[32];
+  int ok = 0;
+  CHECK(MXSymbolGetAttr(fc, "ctx_group", attr, sizeof(attr), &ok));
+  if (!ok || strcmp(attr, "stage1") != 0) {
+    fprintf(stderr, "FAIL attr roundtrip: %d %s\n", ok, attr);
+    return 1;
+  }
+  const char* netjson = NULL;
+  CHECK(MXSymbolSaveToJSON(net, &netjson));
+  const char* shapes = NULL;
+  CHECK(MXSymbolInferShapeJSON(net, "{\"cdata\": [2, 8]}", &shapes));
+  if (strstr(shapes, "out_shapes") == NULL) {
+    fprintf(stderr, "FAIL infer_shape json: %s\n", shapes);
+    return 1;
+  }
+  printf("compose: %u args, %u outputs, head=%s, json %zu B\n",
+         cnargs, cnout, outname, strlen(netjson));
+  CHECK(MXSymbolFree(var));
+  CHECK(MXSymbolFree(fc_atomic));
+  CHECK(MXSymbolFree(fc));
+  CHECK(MXSymbolFree(sm_atomic));
+  CHECK(MXSymbolFree(net));
+
+  /* --- RecordIO through C --- */
+  const char* rec_path = "/tmp/mxtpu_capi_smoke.rec";
+  RecordIOHandle w;
+  CHECK(MXRecordIOWriterCreate(rec_path, &w));
+  CHECK(MXRecordIOWriterWriteRecord(w, "hello", 5));
+  CHECK(MXRecordIOWriterWriteRecord(w, "worlds", 6));
+  size_t wpos = 0;
+  CHECK(MXRecordIOWriterTell(w, &wpos));
+  CHECK(MXRecordIOWriterFree(w));
+  RecordIOHandle r;
+  CHECK(MXRecordIOReaderCreate(rec_path, &r));
+  const char* rbuf = NULL;
+  size_t rlen = 0;
+  CHECK(MXRecordIOReaderReadRecord(r, &rbuf, &rlen));
+  if (rlen != 5 || memcmp(rbuf, "hello", 5) != 0) {
+    fprintf(stderr, "FAIL recordio read 1 (%zu)\n", rlen);
+    return 1;
+  }
+  CHECK(MXRecordIOReaderReadRecord(r, &rbuf, &rlen));
+  if (rlen != 6 || memcmp(rbuf, "worlds", 6) != 0) {
+    fprintf(stderr, "FAIL recordio read 2\n");
+    return 1;
+  }
+  CHECK(MXRecordIOReaderReadRecord(r, &rbuf, &rlen));
+  if (rbuf != NULL || rlen != 0) {
+    fprintf(stderr, "FAIL recordio EOF\n");
+    return 1;
+  }
+  CHECK(MXRecordIOReaderFree(r));
+  remove(rec_path);
+  printf("recordio: wrote %zu bytes, read back OK\n", wpos);
+
+  /* --- optimizer through C --- */
+  OptimizerHandle opt;
+  CHECK(MXOptimizerCreateOptimizer(
+      "sgd", "{\"learning_rate\": 0.5, \"momentum\": 0.0}", &opt));
+  NDArrayHandle wgt, grd;
+  uint32_t oshp[1] = {4};
+  CHECK(MXNDArrayCreate(oshp, 1, &wgt));
+  CHECK(MXNDArrayCreate(oshp, 1, &grd));
+  float ones[4] = {1, 1, 1, 1};
+  CHECK(MXNDArraySyncCopyFromCPU(wgt, ones, 4));
+  CHECK(MXNDArraySyncCopyFromCPU(grd, ones, 4));
+  CHECK(MXOptimizerUpdate(opt, 0, wgt, grd, -1.0f, 0.0f));
+  float wout[4];
+  CHECK(MXNDArraySyncCopyToCPU(wgt, wout, 4));
+  if (wout[0] > 0.51f || wout[0] < 0.49f) {
+    fprintf(stderr, "FAIL optimizer update: %f\n", wout[0]);
+    return 1;
+  }
+  printf("optimizer: sgd step 1.0 -> %f\n", wout[0]);
+  CHECK(MXOptimizerFree(opt));
+  CHECK(MXNDArrayFree(wgt));
+  CHECK(MXNDArrayFree(grd));
+
+  /* --- data iterator through C (CSVIter) --- */
+  {
+    FILE* csv = fopen("/tmp/mxtpu_capi_smoke.csv", "w");
+    if (!csv) return 1;
+    for (int i = 0; i < 8; ++i)
+      fprintf(csv, "%d,%d,%d\n", i, i + 1, i + 2);
+    fclose(csv);
+    uint32_t nit = 0;
+    FunctionHandle* iters = NULL;
+    CHECK(MXListDataIters(&nit, &iters));
+    if (nit < 3) {
+      fprintf(stderr, "FAIL: %u data iters listed\n", nit);
+      return 1;
+    }
+    const char* itname = NULL;
+    CHECK(MXDataIterGetIterInfo(iters[0], &itname, NULL));
+    DataIterHandle it;
+    CHECK(MXDataIterCreateIter(
+        "CSVIter",
+        "{\"data_csv\": \"/tmp/mxtpu_capi_smoke.csv\", "
+        "\"data_shape\": [3], \"batch_size\": 4}", &it));
+    int more = 0, batches = 0;
+    CHECK(MXDataIterNext(it, &more));
+    while (more) {
+      NDArrayHandle d;
+      CHECK(MXDataIterGetData(it, &d));
+      uint32_t dn, ds[4];
+      CHECK(MXNDArrayGetShape(d, &dn, ds, 4));
+      if (dn != 2 || ds[0] != 4 || ds[1] != 3) {
+        fprintf(stderr, "FAIL iter batch shape\n");
+        return 1;
+      }
+      CHECK(MXNDArrayFree(d));
+      ++batches;
+      CHECK(MXDataIterNext(it, &more));
+    }
+    if (batches != 2) {
+      fprintf(stderr, "FAIL iter batches %d\n", batches);
+      return 1;
+    }
+    CHECK(MXDataIterBeforeFirst(it));
+    CHECK(MXDataIterNext(it, &more));
+    if (!more) {
+      fprintf(stderr, "FAIL iter reset\n");
+      return 1;
+    }
+    CHECK(MXDataIterFree(it));
+    remove("/tmp/mxtpu_capi_smoke.csv");
+    printf("dataiter: %u listed (first=%s), CSVIter 2 batches OK\n",
+           nit, itname);
+  }
+
+  /* --- deliberate failures: the last-error contract --- */
+  SymbolHandle bad = NULL;
+  if (MXSymbolCreateAtomicSymbol("NoSuchOperator", "", "x", &bad) == 0) {
+    /* staging is lazy; composing must fail */
+    SymbolHandle out2 = NULL;
+    if (MXSymbolCompose(bad, 0, NULL, NULL, &out2) == 0) {
+      fprintf(stderr, "FAIL: composing unknown op succeeded\n");
+      return 1;
+    }
+    MXSymbolFree(bad);
+  }
+  if (strlen(MXGetLastError()) == 0) {
+    fprintf(stderr, "FAIL: empty last error after failure\n");
+    return 1;
+  }
+  RecordIOHandle nor;
+  if (MXRecordIOReaderCreate("/nonexistent/dir/x.rec", &nor) == 0) {
+    fprintf(stderr, "FAIL: opening nonexistent rec succeeded\n");
+    return 1;
+  }
+  if (strstr(MXGetLastError(), "x.rec") == NULL &&
+      strlen(MXGetLastError()) == 0) {
+    fprintf(stderr, "FAIL: useless error message: %s\n", MXGetLastError());
+    return 1;
+  }
+  /* the failed call must not poison the next one */
+  NDArrayHandle after;
+  uint32_t ashp[1] = {2};
+  CHECK(MXNDArrayCreate(ashp, 1, &after));
+  CHECK(MXNDArrayFree(after));
+  printf("error-path: rc -1, message=\"%.40s...\", recovery OK\n",
+         MXGetLastError());
+
   CHECK(MXNDArrayFree(a));
   CHECK(MXNDArrayFree(pulled));
   CHECK(MXKVStoreFree(kv));

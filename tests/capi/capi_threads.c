@@ -1,0 +1,62 @@
+/* Thread contract of the C ABI: a second plain-C thread's MX* call must
+ * not deadlock after the first thread initialized the embedded
+ * interpreter (the Gil class parks the startup GIL), and per-thread
+ * last-error stays isolated (TLS, c_api_error.h semantics). */
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+static void* worker(void* arg) {
+  (void)arg;
+  /* a failing call on THIS thread ... */
+  RecordIOHandle r;
+  if (MXRecordIOReaderCreate("/nonexistent/worker.rec", &r) == 0) {
+    fprintf(stderr, "FAIL: worker expected open failure\n");
+    return (void*)1;
+  }
+  if (strlen(MXGetLastError()) == 0) {
+    fprintf(stderr, "FAIL: worker last-error empty\n");
+    return (void*)1;
+  }
+  /* ... and a successful one (would deadlock before the GIL fix) */
+  NDArrayHandle h;
+  uint32_t shape[2] = {2, 3};
+  if (MXNDArrayCreate(shape, 2, &h) != 0) {
+    fprintf(stderr, "FAIL worker create: %s\n", MXGetLastError());
+    return (void*)1;
+  }
+  MXNDArrayFree(h);
+  printf("worker thread MX* calls: OK\n");
+  return NULL;
+}
+
+int main(void) {
+  NDArrayHandle h;
+  uint32_t shape[2] = {4, 4};
+  if (MXNDArrayCreate(shape, 2, &h) != 0) {
+    fprintf(stderr, "FAIL main create: %s\n", MXGetLastError());
+    return 1;
+  }
+  MXNDArrayFree(h);
+  const char* main_err_before = MXGetLastError();
+  if (strlen(main_err_before) != 0) {
+    fprintf(stderr, "FAIL: main has stale error\n");
+    return 1;
+  }
+  pthread_t t;
+  pthread_create(&t, NULL, worker, NULL);
+  void* rc = NULL;
+  pthread_join(t, &rc);
+  if (rc != NULL) return 1;
+  /* worker's failure must NOT leak into main's TLS error slot */
+  if (strlen(MXGetLastError()) != 0) {
+    fprintf(stderr, "FAIL: worker error leaked to main: %s\n",
+            MXGetLastError());
+    return 1;
+  }
+  printf("CAPI THREADS OK\n");
+  return 0;
+}

@@ -50,3 +50,28 @@ def test_capi_smoke(tmp_path):
     assert "CAPI SMOKE OK" in proc.stdout
     assert "forward:" in proc.stdout
     assert "predict:" in proc.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None or
+                    shutil.which("make") is None,
+                    reason="no native toolchain")
+def test_capi_threads():
+    """Second-thread MX* calls must not deadlock (the embedded
+    interpreter's startup GIL is parked) and per-thread last-error stays
+    isolated (TLS contract)."""
+    build = subprocess.run(["make", "-s", "lib/capi_threads"], cwd=_ROOT,
+                           capture_output=True, text=True, timeout=300)
+    if build.returncode != 0 and "Python.h" in (build.stderr or ""):
+        pytest.skip("python headers unavailable")
+    assert build.returncode == 0, build.stderr[-2000:]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env["PYTHONPATH"].split(os.pathsep)
+        if p and not os.path.isfile(os.path.join(p, "sitecustomize.py")))
+    proc = subprocess.run([os.path.join(_ROOT, "lib", "capi_threads")],
+                          env=env, capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-1500:])
+    assert "CAPI THREADS OK" in proc.stdout
