@@ -1,0 +1,283 @@
+"""Run caffe-defined layers as graph operators.
+
+Parity: plugin/caffe — ``CaffeOp`` (caffe_op.cc:46) embeds one
+caffe-described layer as a graph op with learnable weights;
+``CaffeLoss`` (caffe_loss.cc:46) embeds a caffe loss layer with the
+reference loss-layer backward contract (grad·grad_scale, head gradient
+ignored).  The reference links libcaffe and runs the real kernels; here
+the layer's prototxt is parsed (same text format the converter reads)
+and its math lowers to this framework's own operators — so the caffe
+layer trains at XLA speed and its weights live in the graph exactly like
+the reference's CaffeOp blobs.
+
+    import mxnet_tpu.plugin.caffe as caffe
+    fc = caffe.CaffeOp(data, prototxt='layer { type: "InnerProduct" '
+                       'inner_product_param { num_output: 10 } }',
+                       name="cfc")
+    loss = caffe.CaffeLoss(fc, label, prototxt='layer { type: '
+                           '"SoftmaxWithLoss" }')
+
+Also home of the prototxt text-format parser shared with
+tools/caffe_converter.
+"""
+from __future__ import annotations
+
+import re
+
+from ..base import MXNetError
+from ..ops.registry import (OperatorProperty, register_op, create_operator,
+                            require_known)
+
+__all__ = ["CaffeOp", "CaffeLoss", "parse_prototxt"]
+
+
+# ----------------------------------------------------------------------
+# prototxt (protobuf text format) parser -> nested dict/list structure
+# ----------------------------------------------------------------------
+_TOKEN = re.compile(r"""
+    (?P<brace>[{}])
+  | (?P<name>[A-Za-z_][A-Za-z0-9_]*)\s*(?P<colon>:)?
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<number>-?\d+(?:\.\d*)?(?:[eE][+-]?\d+)?)
+""", re.VERBOSE)
+
+
+def _tokenize(text):
+    text = re.sub(r"#.*", "", text)
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m:
+            if text[pos].isspace():
+                pos += 1
+                continue
+            raise ValueError("prototxt parse error at %r" % text[pos:pos + 20])
+        pos = m.end()
+        if m.group("brace"):
+            yield ("brace", m.group("brace"))
+        elif m.group("name"):
+            yield ("key" if m.group("colon") else "ident", m.group("name"))
+        elif m.group("string"):
+            yield ("value", m.group("string")[1:-1])
+        else:
+            num = m.group("number")
+            yield ("value", float(num) if "." in num or "e" in num.lower()
+                   else int(num))
+
+
+def _parse_block(tokens):
+    """Parse until the matching '}'; repeated fields become lists."""
+    out = {}
+
+    def put(key, value):
+        if key in out:
+            if not isinstance(out[key], list):
+                out[key] = [out[key]]
+            out[key].append(value)
+        else:
+            out[key] = value
+
+    for kind, tok in tokens:
+        if kind == "brace" and tok == "}":
+            return out
+        if kind == "key":                      # key: value
+            k2, v2 = next(tokens)
+            if k2 == "brace" and v2 == "{":    # "key: {" style
+                put(tok, _parse_block(tokens))
+            else:
+                put(tok, v2)
+        elif kind == "ident":                  # key { ... }
+            k2, v2 = next(tokens)
+            assert k2 == "brace" and v2 == "{", (tok, k2, v2)
+            put(tok, _parse_block(tokens))
+    return out
+
+
+def parse_prototxt(text):
+    tokens = iter(list(_tokenize(text)) + [("brace", "}")])
+    return _parse_block(tokens)
+
+
+def _pair(param, key, default=0):
+    """Caffe's kernel_size/stride/pad may be scalar or (h, w) fields."""
+    v = param.get(key)
+    if v is None:
+        h = param.get(key + "_h", default)
+        w = param.get(key + "_w", default)
+        return (int(h), int(w))
+    if isinstance(v, list):
+        v = v[0]
+    return (int(v), int(v))
+
+
+def _layer_of(prototxt):
+    net = parse_prototxt(prototxt)
+    layer = net.get("layer") or net.get("layers") or net
+    if isinstance(layer, list):
+        layer = layer[0]
+    ltype = str(layer.get("type", "")).strip('"').upper()
+    if not ltype:
+        raise MXNetError("CaffeOp: prototxt has no layer type: %r"
+                         % prototxt)
+    return ltype, layer
+
+
+def _delegate_of(prototxt):
+    """Map the caffe layer to (inner op instance, weight arg names)."""
+    ltype, layer = _layer_of(prototxt)
+    if ltype == "INNERPRODUCT":
+        p = layer.get("inner_product_param", {})
+        no_bias = not bool(p.get("bias_term", 1))
+        inner = create_operator("FullyConnected",
+                                num_hidden=int(p.get("num_output")),
+                                no_bias=no_bias)
+        return inner, (["weight"] if no_bias else ["weight", "bias"])
+    if ltype == "CONVOLUTION":
+        p = layer.get("convolution_param", {})
+        no_bias = not bool(p.get("bias_term", 1))
+        inner = create_operator("Convolution",
+                                num_filter=int(p.get("num_output")),
+                                kernel=_pair(p, "kernel_size"),
+                                stride=_pair(p, "stride", 1),
+                                pad=_pair(p, "pad", 0), no_bias=no_bias)
+        return inner, (["weight"] if no_bias else ["weight", "bias"])
+    if ltype == "POOLING":
+        p = layer.get("pooling_param", {})
+        pool = "avg" if str(p.get("pool", "MAX")).upper() in ("1", "AVE") \
+            else "max"
+        if p.get("global_pooling"):
+            inner = create_operator("Pooling", kernel=(1, 1),
+                                    global_pool=True, pool_type=pool)
+        else:
+            inner = create_operator("Pooling", kernel=_pair(p, "kernel_size"),
+                                    stride=_pair(p, "stride", 1),
+                                    pad=_pair(p, "pad", 0), pool_type=pool)
+        return inner, []
+    if ltype in ("RELU", "SIGMOID", "TANH"):
+        act = {"RELU": "relu", "SIGMOID": "sigmoid", "TANH": "tanh"}[ltype]
+        return create_operator("Activation", act_type=act), []
+    raise MXNetError("CaffeOp: unsupported layer type %r (supported: "
+                     "InnerProduct, Convolution, Pooling, ReLU, Sigmoid, "
+                     "TanH)" % ltype)
+
+
+@register_op("CaffeOp")
+class CaffeOpProp(OperatorProperty):
+    """caffe_op.cc:46 — one caffe layer as a graph op; its weights are
+    regular graph arguments (learnable, checkpointable)."""
+    param_cls = None
+    hint = "caffe"
+    accepts_any_attrs = True
+
+    def __init__(self, **attrs):
+        self.attrs = {k: str(v) for k, v in attrs.items()}
+        prototxt = self.attrs.get("prototxt")
+        if not prototxt:
+            raise MXNetError("CaffeOp requires a prototxt attr")
+        self._inner, self._weights = _delegate_of(prototxt)
+        self.param = None
+
+    def list_arguments(self):
+        return ["data"] + list(self._weights)
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known("CaffeOp", in_shapes[:1], ["data"])
+        # caffe InnerProduct flattens trailing dims implicitly
+        if type(self._inner).__name__.endswith("FullyConnected") \
+                and len(data) > 2:
+            data = (data[0], int(_prod(data[1:])))
+        shapes, outs, aux = self._inner.infer_shape(
+            [data] + list(in_shapes[1:]))
+        return [in_shapes[0] or data] + shapes[1:], outs, aux
+
+    def forward(self, inputs, aux, is_train, rng):
+        x = inputs[0]
+        if len(self._weights) and type(self._inner).__name__.endswith(
+                "FullyConnected") and x.ndim > 2:
+            x = x.reshape((x.shape[0], -1))
+        return self._inner.forward([x] + list(inputs[1:]), aux, is_train,
+                                   rng)
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+@register_op("CaffeLoss")
+class CaffeLossProp(OperatorProperty):
+    """caffe_loss.cc:46 — caffe loss layer with the reference loss-layer
+    backward (grad·grad_scale, head gradient ignored, no label grad)."""
+    param_cls = None
+    hint = "caffeloss"
+    accepts_any_attrs = True
+
+    def __init__(self, **attrs):
+        self.attrs = {k: str(v) for k, v in attrs.items()}
+        prototxt = self.attrs.get("prototxt")
+        if not prototxt:
+            raise MXNetError("CaffeLoss requires a prototxt attr")
+        self._ltype, _ = _layer_of(prototxt)
+        if self._ltype not in ("SOFTMAXWITHLOSS", "EUCLIDEANLOSS"):
+            raise MXNetError("CaffeLoss: unsupported loss %r (supported: "
+                             "SoftmaxWithLoss, EuclideanLoss)" % self._ltype)
+        self.grad_scale = float(self.attrs.get("grad_scale", 1.0))
+        self.param = None
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known("CaffeLoss", in_shapes[:1], ["data"])
+        if self._ltype == "SOFTMAXWITHLOSS":
+            return [data, (data[0],)], [data], []
+        return [data, data], [(1,)], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        import jax
+        import jax.numpy as jnp
+        scale = self.grad_scale
+        data, label = inputs
+
+        if self._ltype == "SOFTMAXWITHLOSS":
+            # delegate to the native loss layer: identical contract
+            inner = create_operator("SoftmaxOutput", grad_scale=scale)
+            return inner.forward(inputs, aux, is_train, rng)
+
+        # EuclideanLoss: fwd = 1/(2N)·||data-label||²; bwd = (d-l)/N·scale
+        @jax.custom_vjp
+        def _euclid(d, l):
+            return (jnp.sum(jnp.square(d - l))
+                    / (2.0 * d.shape[0])).reshape(1)
+
+        def _f(d, l):
+            return _euclid(d, l), (d, l)
+
+        def _b(res, g):
+            d, l = res
+            return ((d - l) / d.shape[0] * scale, jnp.zeros_like(l))
+
+        _euclid.defvjp(_f, _b)
+        return [_euclid(data, label)], None
+
+
+def CaffeOp(*args, **kwargs):
+    """Symbol factory (reference: mx.symbol.CaffeOp)."""
+    from .. import symbol as _sym
+    return _sym._create("CaffeOp", *args, **kwargs)
+
+
+def CaffeLoss(*args, **kwargs):
+    """Symbol factory (reference: mx.symbol.CaffeLoss)."""
+    from .. import symbol as _sym
+    return _sym._create("CaffeLoss", *args, **kwargs)
+
+
+from .. import symbol as _symbol  # noqa: E402
+_symbol._init_symbol_module()

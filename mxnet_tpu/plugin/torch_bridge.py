@@ -111,5 +111,79 @@ class _TorchModule(OperatorProperty):
         return outs, None
 
 
+def torch_criterion(criterion, data, label, grad_scale=1.0, **kwargs):
+    """Wrap a torch criterion (e.g. ``nn.MSELoss()``) as a loss-layer op.
+
+    Parity: plugin/torch/torch_criterion.cc:24 — forward emits the scalar
+    loss; backward emits d(loss)/d(data)·grad_scale and IGNORES the head
+    gradient (the reference loss-layer contract), with no gradient to the
+    label."""
+    from .. import symbol as _sym
+    token = "_torch_criterion_%d" % _NEXT[0]
+    _NEXT[0] += 1
+    _MODULES[token] = criterion
+    return _sym._create("_TorchCriterion", data, label, info=token,
+                        grad_scale=str(grad_scale), **kwargs)
+
+
+@register_op("_TorchCriterion")
+class _TorchCriterion(OperatorProperty):
+    param_cls = None
+    hint = "torchcrit"
+    accepts_any_attrs = True
+
+    def __init__(self, **attrs):
+        self.attrs = {k: str(v) for k, v in attrs.items()}
+        token = self.attrs.get("info")
+        if token not in _MODULES:
+            raise MXNetError("_TorchCriterion: unknown criterion token %r"
+                             % token)
+        self.criterion = _MODULES[token]
+        self.grad_scale = float(self.attrs.get("grad_scale", 1.0))
+        self.param = None
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known("_TorchCriterion", in_shapes[:1], ["data"])
+        label = in_shapes[1] if in_shapes[1] is not None else data
+        return [data, label], [(1,)], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        criterion = self.criterion
+        scale = self.grad_scale
+        data, label = inputs
+        in_shapes = [tuple(int(d) for d in x.shape) for x in inputs]
+        dtypes = [np.dtype(x.dtype) for x in inputs]
+        import torch
+
+        def host_forward(train_flag, in_data, aux_data):
+            d = torch.from_numpy(np.ascontiguousarray(in_data[0]))
+            l = torch.from_numpy(np.ascontiguousarray(in_data[1]))
+            with torch.no_grad():
+                loss = criterion(d, l)
+            return [np.asarray(loss.numpy(), dtype=dtypes[0]).reshape(1)], \
+                aux_data
+
+        def host_backward(out_grad, in_data, out_data, aux_data):
+            # reference loss layers ignore the incoming head gradient
+            d = torch.from_numpy(
+                np.ascontiguousarray(in_data[0])).requires_grad_(True)
+            l = torch.from_numpy(np.ascontiguousarray(in_data[1]))
+            loss = criterion(d, l)
+            loss.backward()
+            return [d.grad.numpy().astype(dtypes[0]) * scale,
+                    np.zeros(in_shapes[1], dtypes[1])]
+
+        from ..operator import _run_host_op
+        outs, _ = _run_host_op(host_forward, host_backward, inputs, aux,
+                               is_train, in_shapes, dtypes,
+                               [(1,)], [dtypes[0]])
+        return outs, None
+
+
 from .. import symbol as _symbol  # noqa: E402
 _symbol._init_symbol_module()

@@ -177,3 +177,91 @@ def test_sframe_iter_plugin():
     it.reset()
     score = dict(mod.score(it, "acc"))
     assert score["accuracy"] > 0.9, score
+
+
+def test_caffe_op_forward_backward():
+    """CaffeOp (caffe_op.cc:46 analog): a prototxt-described InnerProduct
+    runs as a graph op with learnable weight/bias arguments."""
+    from mxnet_tpu.plugin import caffe
+    rng = np.random.RandomState(3)
+    data = mx.sym.Variable("data")
+    fc = caffe.CaffeOp(data, prototxt='layer { type: "InnerProduct" '
+                       'inner_product_param { num_output: 4 } }',
+                       name="cfc")
+    assert fc.list_arguments() == ["data", "cfc_weight", "cfc_bias"]
+    x = rng.rand(2, 3, 2).astype(np.float32)     # caffe IP flattens
+    w = rng.rand(4, 6).astype(np.float32)
+    b = rng.rand(4).astype(np.float32)
+    arg_shapes, out_shapes, _ = fc.infer_shape(data=(2, 3, 2))
+    assert arg_shapes[1] == (4, 6) and out_shapes[0] == (2, 4)
+    from mxnet_tpu.test_utils import (check_symbolic_forward,
+                                      check_numeric_gradient)
+    want = x.reshape(2, 6).dot(w.T) + b
+    check_symbolic_forward(fc, [x, w, b], [want], rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(fc, {"data": x.astype(np.float64),
+                                "cfc_weight": w.astype(np.float64),
+                                "cfc_bias": b.astype(np.float64)},
+                           rtol=2e-2, atol=2e-3)
+    # activation layer with zero weights
+    relu = caffe.CaffeOp(data, prototxt='layer { type: "ReLU" }', name="cr")
+    assert relu.list_arguments() == ["data"]
+    xa = rng.rand(3, 4).astype(np.float32) - 0.5
+    check_symbolic_forward(relu, [xa], [np.maximum(xa, 0)])
+
+
+def test_caffe_loss_forward_backward():
+    """CaffeLoss (caffe_loss.cc:46 analog): loss-layer contract — head
+    gradient ignored, grad_scale applied, no label gradient."""
+    from mxnet_tpu.plugin import caffe
+    from mxnet_tpu.test_utils import (check_symbolic_forward,
+                                      check_symbolic_backward)
+    rng = np.random.RandomState(4)
+    data = mx.sym.Variable("x")
+    label = mx.sym.Variable("l")
+
+    # SoftmaxWithLoss delegates to the SoftmaxOutput contract
+    sm = caffe.CaffeLoss(data, label, prototxt='layer '
+                         '{ type: "SoftmaxWithLoss" }', name="cl")
+    d = rng.rand(3, 5).astype(np.float32)
+    lab = np.array([0, 2, 4], np.float32)
+    e = np.exp(d - d.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    check_symbolic_forward(sm, [d, lab], [p], rtol=1e-3)
+    onehot = np.eye(5, dtype=np.float32)[lab.astype(int)]
+    og = np.full_like(d, 7.0)     # must be ignored
+    check_symbolic_backward(sm, [d, lab], [og], {"x": p - onehot},
+                            rtol=1e-3)
+
+    # EuclideanLoss: fwd 1/(2N)||d-l||^2, bwd (d-l)/N * grad_scale
+    eu = caffe.CaffeLoss(data, label, prototxt='layer '
+                         '{ type: "EuclideanLoss" }', grad_scale=2.0,
+                         name="ce")
+    l2 = rng.rand(3, 5).astype(np.float32)
+    want = np.array([np.sum((d - l2) ** 2) / 6.0], np.float32)
+    check_symbolic_forward(eu, [d, l2], [want], rtol=1e-3)
+    check_symbolic_backward(eu, [d, l2], [np.ones((1,), np.float32) * 9],
+                            {"x": (d - l2) / 3.0 * 2.0,
+                             "l": np.zeros_like(l2)}, rtol=1e-3)
+
+
+def test_torch_criterion_forward_backward():
+    """TorchCriterion (torch_criterion.cc:24 analog): torch loss as a
+    loss-layer op; backward = d(loss)/d(data)*scale, head grad ignored."""
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu.plugin import torch_bridge
+    from mxnet_tpu.test_utils import (check_symbolic_forward,
+                                      check_symbolic_backward)
+    rng = np.random.RandomState(5)
+    crit = torch.nn.MSELoss()
+    data = mx.sym.Variable("x")
+    label = mx.sym.Variable("l")
+    s = torch_bridge.torch_criterion(crit, data, label, grad_scale=3.0,
+                                     name="tc")
+    d = rng.rand(4, 3).astype(np.float32)
+    lab = rng.rand(4, 3).astype(np.float32)
+    want = np.array([np.mean((d - lab) ** 2)], np.float32)
+    check_symbolic_forward(s, [d, lab], [want], rtol=1e-4)
+    # MSE grad: 2*(d-l)/numel, scaled by 3; head grad 5 must be ignored
+    check_symbolic_backward(s, [d, lab], [np.full((1,), 5.0, np.float32)],
+                            {"x": 2.0 * (d - lab) / d.size * 3.0,
+                             "l": np.zeros_like(lab)}, rtol=1e-3)

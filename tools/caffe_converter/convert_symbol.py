@@ -22,71 +22,9 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
-# ----------------------------------------------------------------------
-# prototxt (protobuf text format) parser -> nested dict/list structure
-# ----------------------------------------------------------------------
-_TOKEN = re.compile(r"""
-    (?P<brace>[{}])
-  | (?P<name>[A-Za-z_][A-Za-z0-9_]*)\s*(?P<colon>:)?
-  | (?P<string>"(?:[^"\\]|\\.)*")
-  | (?P<number>-?\d+(?:\.\d*)?(?:[eE][+-]?\d+)?)
-""", re.VERBOSE)
-
-
-def _tokenize(text):
-    text = re.sub(r"#.*", "", text)
-    pos = 0
-    while pos < len(text):
-        m = _TOKEN.match(text, pos)
-        if not m:
-            if text[pos].isspace():
-                pos += 1
-                continue
-            raise ValueError("prototxt parse error at %r" % text[pos:pos + 20])
-        pos = m.end()
-        if m.group("brace"):
-            yield ("brace", m.group("brace"))
-        elif m.group("name"):
-            yield ("key" if m.group("colon") else "ident", m.group("name"))
-        elif m.group("string"):
-            yield ("value", m.group("string")[1:-1])
-        else:
-            num = m.group("number")
-            yield ("value", float(num) if "." in num or "e" in num.lower()
-                   else int(num))
-
-
-def _parse_block(tokens):
-    """Parse until the matching '}'; repeated fields become lists."""
-    out = {}
-
-    def put(key, value):
-        if key in out:
-            if not isinstance(out[key], list):
-                out[key] = [out[key]]
-            out[key].append(value)
-        else:
-            out[key] = value
-
-    for kind, tok in tokens:
-        if kind == "brace" and tok == "}":
-            return out
-        if kind == "key":                      # key: value
-            k2, v2 = next(tokens)
-            if k2 == "brace" and v2 == "{":    # "key: {" style
-                put(tok, _parse_block(tokens))
-            else:
-                put(tok, v2)
-        elif kind == "ident":                  # key { ... }
-            k2, v2 = next(tokens)
-            assert k2 == "brace" and v2 == "{", (tok, k2, v2)
-            put(tok, _parse_block(tokens))
-    return out
-
-
-def parse_prototxt(text):
-    tokens = iter(list(_tokenize(text)) + [("brace", "}")])
-    return _parse_block(tokens)
+# the prototxt text-format parser lives in the runtime plugin (shared
+# with CaffeOp/CaffeLoss, mxnet_tpu/plugin/caffe.py)
+from mxnet_tpu.plugin.caffe import parse_prototxt, _pair  # noqa: E402
 
 
 # ----------------------------------------------------------------------
@@ -96,18 +34,6 @@ def _aslist(v):
     if v is None:
         return []
     return v if isinstance(v, list) else [v]
-
-
-def _pair(param, key, default=0):
-    """Caffe's kernel_size/stride/pad may be scalar or (h, w) fields."""
-    v = param.get(key)
-    if v is None:
-        h = param.get(key + "_h", default)
-        w = param.get(key + "_w", default)
-        return (int(h), int(w))
-    if isinstance(v, list):
-        v = v[0]
-    return (int(v), int(v))
 
 
 def convert(prototxt_text, input_name="data"):
