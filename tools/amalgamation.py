@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Build a self-contained predict-only artifact from a checkpoint.
+
+Parity: amalgamation/ (mxnet_predict0.cc + amalgamation.py) — the
+reference concatenates a predict-only build into one translation unit so
+a model deploys with no framework checkout.  The TPU-native analog
+exports the bound inference computation as serialized StableHLO
+(jax.export) and packs everything a standalone consumer needs into one
+directory:
+
+    model.stablehlo   the compiled-forward program, portable across
+                      machines/versions per StableHLO guarantees
+    params.npz        flat parameter arrays (graph inputs of the export)
+    meta.json         input names/shapes/dtypes + output count
+    predict.py        standalone consumer: needs ONLY jax + numpy,
+                      never imports mxnet_tpu
+    <name>-symbol.json / <name>-0000.params
+                      the original checkpoint, so MXPred*/Predictor
+                      consumers load the same artifact
+
+Usage:
+    python tools/amalgamation.py prefix epoch \
+        --shapes '{"data": [1, 3, 224, 224]}' --out artifact_dir
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_PREDICT_PY = '''\
+#!/usr/bin/env python
+"""Standalone predictor over a mxnet_tpu amalgamation artifact.
+
+Needs only jax + numpy.  Usage:
+    python predict.py input.npy [more_inputs.npy ...]   # positional, in
+                                                        # meta.json order
+prints each output array (numpy repr) to stdout.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import jax
+from jax import export
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def load():
+    with open(os.path.join(_HERE, "meta.json")) as f:
+        meta = json.load(f)
+    with open(os.path.join(_HERE, "model.stablehlo"), "rb") as f:
+        exported = export.deserialize(bytearray(f.read()))
+    params = dict(np.load(os.path.join(_HERE, "params.npz")))
+    return meta, exported, params
+
+
+def predict(inputs):
+    meta, exported, params = load()
+    if len(inputs) != len(meta["input_names"]):
+        raise SystemExit("expected %d inputs %s, got %d" % (
+            len(meta["input_names"]), meta["input_names"], len(inputs)))
+    feed = dict(params)
+    for name, arr in zip(meta["input_names"], inputs):
+        feed[name] = np.asarray(arr, dtype=np.dtype(
+            meta["input_dtypes"][name])).reshape(meta["input_shapes"][name])
+    args = [feed[k] for k in meta["arg_order"]]
+    return exported.call(*args)
+
+
+if __name__ == "__main__":
+    ins = [np.load(p) for p in sys.argv[1:]]
+    for i, out in enumerate(predict(ins)):
+        print("output[%d] shape=%s" % (i, tuple(out.shape)))
+        print(np.asarray(out))
+'''
+
+
+def build(prefix, epoch, input_shapes, out_dir):
+    """Export checkpoint (prefix, epoch) bound at input_shapes into a
+    standalone artifact at out_dir.  Returns the artifact path."""
+    import numpy as np
+    import jax
+    from jax import export as jexport
+    import mxnet_tpu as mx
+    from mxnet_tpu import ndarray as nd_mod
+
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(prefix, epoch)
+    arg_names = symbol.list_arguments()
+    input_names = list(input_shapes)
+    missing = [n for n in arg_names
+               if n not in input_shapes and n not in arg_params]
+    # label-style inputs a predict graph never feeds get zeros
+    label_like = {n: (input_shapes[input_names[0]][0],) for n in missing}
+
+    exe = symbol.bind(mx.cpu(), dict(
+        {n: mx.nd.zeros(tuple(input_shapes[n])) for n in input_names},
+        **{n: arg_params[n] for n in arg_names if n in arg_params},
+        **{n: mx.nd.zeros(s) for n, s in label_like.items()}))
+
+    prog = exe._program
+    aux_names = symbol.list_auxiliary_states()
+    aux_values = {n: a.data for n, a in exe.aux_dict.items()}
+    arg_values = {n: a.data for n, a in exe.arg_dict.items()}
+    rng = jax.random.PRNGKey(0)
+
+    arg_order = sorted(arg_values)
+
+    def fwd(*flat):
+        values = dict(zip(arg_order, flat))
+        outs, _aux = prog.trace(values, aux_values, rng, False)
+        return tuple(outs)
+
+    specs = [jax.ShapeDtypeStruct(tuple(arg_values[k].shape),
+                                  arg_values[k].dtype) for k in arg_order]
+    exported = jexport.export(jax.jit(fwd))(*specs)
+
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "model.stablehlo"), "wb") as f:
+        f.write(exported.serialize())
+
+    params_np = {k: np.asarray(v) for k, v in arg_values.items()
+                 if k not in input_names}
+    np.savez(os.path.join(out_dir, "params.npz"), **params_np)
+
+    meta = {
+        "input_names": input_names,
+        "input_shapes": {n: list(input_shapes[n]) for n in input_names},
+        "input_dtypes": {n: str(np.dtype(arg_values[n].dtype))
+                         for n in input_names},
+        "arg_order": arg_order,
+        "num_outputs": len(exe.outputs),
+        "aux_names": aux_names,
+    }
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+    with open(os.path.join(out_dir, "predict.py"), "w") as f:
+        f.write(_PREDICT_PY)
+
+    # the classic checkpoint rides along for MXPred consumers
+    name = os.path.basename(prefix)
+    symbol.save(os.path.join(out_dir, "%s-symbol.json" % name))
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    nd_mod.save(os.path.join(out_dir, "%s-%04d.params" % (name, epoch)),
+                save_dict)
+    return out_dir
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("epoch", type=int)
+    ap.add_argument("--shapes", required=True,
+                    help='{"data": [1, 3, 224, 224]}')
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+    shapes = {k: tuple(v) for k, v in json.loads(args.shapes).items()}
+    out = build(args.prefix, args.epoch, shapes, args.out)
+    total = sum(os.path.getsize(os.path.join(out, f))
+                for f in os.listdir(out))
+    print("amalgamation: %s (%d files, %.1f KB)"
+          % (out, len(os.listdir(out)), total / 1024.0))
+
+
+if __name__ == "__main__":
+    main()
