@@ -246,7 +246,7 @@ def measure():
     if autotune and autotune != "0":
         # short sweep over per-device batch, then full run at the winner
         candidates = [int(x) for x in os.environ.get(
-            "BENCH_AUTOTUNE_BATCHES", "64,128,256").split(",")]
+            "BENCH_AUTOTUNE_BATCHES", "64,128,256,512").split(",")]
         sweep = {}
         for cand in candidates:
             try:
@@ -260,7 +260,15 @@ def measure():
             per_dev_batch = max(survivors)[1]
             global_batch = per_dev_batch * n_dev
 
-    images_per_sec, step_time, trainer = run_once(per_dev_batch, steps)
+    # BENCH_PROFILE=<dir>: capture a jax profiler trace of the timed loop
+    # (the layout/fusion audit the MFU gap analysis needs, VERDICT r3 #1)
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        with jax.profiler.trace(profile_dir):
+            images_per_sec, step_time, trainer = run_once(per_dev_batch,
+                                                          steps)
+    else:
+        images_per_sec, step_time, trainer = run_once(per_dev_batch, steps)
 
     # MFU = model FLOPs per step / step time / total peak FLOPs.
     # Model FLOPs from XLA's own cost analysis of the compiled step
