@@ -62,21 +62,24 @@ def _emit(payload):
     sys.stdout.flush()
 
 
+def _last_json_line(text):
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
 def _run_child(extra_env, timeout):
     env = dict(os.environ)
     env.update(extra_env)
     here = os.path.dirname(os.path.abspath(__file__))
     env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
     env["MXTPU_BENCH_CHILD"] = "1"
-    def _last_json(text):
-        for line in reversed((text or "").strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    return json.loads(line)
-                except ValueError:
-                    continue
-        return None
+    _last_json = _last_json_line
 
     try:
         proc = subprocess.run(
@@ -470,7 +473,11 @@ def _measure_allreduce(jax):
         proc = subprocess.run([sys.executable, "-c", code], env=env,
                               timeout=300, stdout=subprocess.PIPE,
                               stderr=subprocess.PIPE, text=True)
-        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        payload = _last_json_line(proc.stdout)
+        if payload is None:
+            tail = (proc.stderr or "").strip().splitlines()[-3:]
+            raise RuntimeError("allreduce child rc=%s: %s"
+                               % (proc.returncode, " | ".join(tail)))
         n, dt, gbps = payload["n"], payload["dt"], payload["gbps"]
         platform = "cpu-virtual"
     return {

@@ -85,6 +85,8 @@ def _parse_block(tokens):
             if k2 == "brace" and v2 == "{":    # "key: {" style
                 put(tok, _parse_block(tokens))
             else:
+                if k2 == "ident" and v2 in ("true", "false"):
+                    v2 = v2 == "true"          # protobuf bool literals
                 put(tok, v2)
         elif kind == "ident":                  # key { ... }
             k2, v2 = next(tokens)

@@ -4,24 +4,19 @@ In the reference every file path is a ``dmlc::Stream`` URI, which is what
 makes data and checkpoints cloud-capable (docs/how_to/cloud.md:84 trains
 straight off S3).  Here any plain path keeps using builtin ``open``;
 paths carrying a scheme (``s3://``, ``gs://``, ``hdfs://``, ``memory://``,
-...) route through fsspec.  Two entry points:
-
-- :func:`open_uri` — file-like handle for streaming read/write.
-- :func:`local_path` — a REAL local filesystem path for consumers that
-  need one (the native RecordIO reader, mmap users): remote objects are
-  spooled to a temp file on read and uploaded on close for write.
+...) route through fsspec via :func:`open_uri`.  Consumers that need a
+real local fd (the native RecordIO reader, ImageRecordIter's chunked
+scan) spool remote objects through a temp file themselves — their spool
+lifetimes outlive any ``with`` block (spools survive ``reset()`` and
+upload on ``close()``), so no context-manager helper is offered here.
 
 ``file://`` is normalized to a plain local path.
 """
 from __future__ import annotations
 
-import contextlib
-import os
 import re
-import shutil
-import tempfile
 
-__all__ = ["has_scheme", "open_uri", "local_path"]
+__all__ = ["has_scheme", "open_uri"]
 
 _SCHEME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.\-]*://")
 
@@ -47,34 +42,3 @@ def open_uri(uri, mode="rb"):
     if not has_scheme(uri):
         return open(uri, mode)
     return _fs_open(uri, mode)
-
-
-@contextlib.contextmanager
-def local_path(uri, mode="r"):
-    """Yield a local filesystem path standing in for ``uri``.
-
-    mode "r": remote objects are downloaded to a spool file (deleted on
-    exit).  mode "w": a spool file is yielded and uploaded to ``uri`` on
-    clean exit.  Local paths are yielded unchanged either way.
-    """
-    uri = _strip_file(str(uri))
-    if not has_scheme(uri):
-        yield uri
-        return
-    suffix = os.path.splitext(uri)[1]
-    fd, tmp = tempfile.mkstemp(suffix=suffix)
-    os.close(fd)
-    try:
-        if mode == "r":
-            with _fs_open(uri, "rb") as src, open(tmp, "wb") as dst:
-                shutil.copyfileobj(src, dst)
-            yield tmp
-        elif mode == "w":
-            yield tmp
-            with open(tmp, "rb") as src, _fs_open(uri, "wb") as dst:
-                shutil.copyfileobj(src, dst)
-        else:
-            raise ValueError("local_path mode must be 'r' or 'w', got %r"
-                             % mode)
-    finally:
-        os.unlink(tmp)

@@ -265,3 +265,23 @@ def test_torch_criterion_forward_backward():
     check_symbolic_backward(s, [d, lab], [np.full((1,), 5.0, np.float32)],
                             {"x": 2.0 * (d - lab) / d.size * 3.0,
                              "l": np.zeros_like(lab)}, rtol=1e-3)
+
+
+def test_prototxt_bool_literals():
+    """protobuf text-format booleans must parse as bools: bias_term: false
+    means NO bias (review regression — truthy-string inversion)."""
+    from mxnet_tpu.plugin import caffe
+    parsed = caffe.parse_prototxt(
+        'layer { type: "InnerProduct" inner_product_param '
+        '{ num_output: 3 bias_term: false } }')
+    assert parsed["layer"]["inner_product_param"]["bias_term"] is False
+    data = mx.sym.Variable("data")
+    fc = caffe.CaffeOp(data, prototxt='layer { type: "InnerProduct" '
+                       'inner_product_param { num_output: 3 '
+                       'bias_term: false } }', name="nb")
+    assert fc.list_arguments() == ["data", "nb_weight"]
+    # enum-style bare idents stay strings
+    parsed2 = caffe.parse_prototxt(
+        'layer { type: "Pooling" pooling_param { pool: MAX '
+        'kernel_size: 2 } }')
+    assert parsed2["layer"]["pooling_param"]["pool"] == "MAX"
