@@ -24,7 +24,24 @@ __all__ = ["Initializer", "Uniform", "Normal", "Orthogonal", "Xavier",
 
 
 class Initializer(object):
-    """Base: dispatch on parameter name (parity: initializer.py:15 __call__)."""
+    """Base: dispatch on parameter name (role: initializer.py:15 __call__).
+
+    The parameter's name suffix selects the handler; the first matching
+    suffix in ``_SUFFIX_RULES`` wins (``moving_inv_var`` must be listed
+    before ``moving_var`` would ever match it, hence ordered rules rather
+    than a dict).
+    """
+
+    _SUFFIX_RULES = (
+        ("bias", "_init_bias"),
+        ("gamma", "_init_gamma"),
+        ("beta", "_init_beta"),
+        ("weight", "_init_weight"),
+        ("moving_mean", "_init_zero"),
+        ("moving_inv_var", "_init_zero"),
+        ("moving_var", "_init_one"),
+        ("moving_avg", "_init_zero"),
+    )
 
     def __call__(self, name, arr):
         if not isinstance(name, str):
@@ -33,24 +50,12 @@ class Initializer(object):
             raise TypeError("arr must be NDArray")
         if name.startswith("upsampling"):
             self._init_bilinear(name, arr)
-        elif name.endswith("bias"):
-            self._init_bias(name, arr)
-        elif name.endswith("gamma"):
-            self._init_gamma(name, arr)
-        elif name.endswith("beta"):
-            self._init_beta(name, arr)
-        elif name.endswith("weight"):
-            self._init_weight(name, arr)
-        elif name.endswith("moving_mean"):
-            self._init_zero(name, arr)
-        elif name.endswith("moving_var"):
-            self._init_one(name, arr)
-        elif name.endswith("moving_inv_var"):
-            self._init_zero(name, arr)
-        elif name.endswith("moving_avg"):
-            self._init_zero(name, arr)
-        else:
-            self._init_default(name, arr)
+            return
+        for suffix, handler in self._SUFFIX_RULES:
+            if name.endswith(suffix):
+                getattr(self, handler)(name, arr)
+                return
+        self._init_default(name, arr)
 
     def _init_bilinear(self, _, arr):
         shape = arr.shape
@@ -218,20 +223,19 @@ class Xavier(Initializer):
         self.factor_type = factor_type
         self.magnitude = float(magnitude)
 
+    _FACTORS = {"avg": lambda fi, fo: (fi + fo) / 2.0,
+                "in": lambda fi, fo: fi,
+                "out": lambda fi, fo: fo}
+
     def _init_weight(self, name, arr):
         shape = arr.shape
-        hw_scale = 1.0
-        if len(shape) > 2:
-            hw_scale = _np.prod(shape[2:])
-        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        if self.factor_type == "avg":
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == "in":
-            factor = fan_in
-        elif self.factor_type == "out":
-            factor = fan_out
-        else:
-            raise ValueError("Incorrect factor type")
+        receptive = _np.prod(shape[2:]) if len(shape) > 2 else 1.0
+        fan_in = shape[1] * receptive
+        fan_out = shape[0] * receptive
+        if self.factor_type not in self._FACTORS:
+            raise ValueError("Xavier factor_type must be one of %s, got %r"
+                             % (sorted(self._FACTORS), self.factor_type))
+        factor = self._FACTORS[self.factor_type](fan_in, fan_out)
         scale = _np.sqrt(self.magnitude / factor)
         key = _random.next_key()
         if self.rnd_type == "uniform":
@@ -239,7 +243,8 @@ class Xavier(Initializer):
         elif self.rnd_type == "gaussian":
             val = jax.random.normal(key, shape) * scale
         else:
-            raise ValueError("Unknown random type")
+            raise ValueError("Xavier rnd_type must be uniform or gaussian, "
+                             "got %r" % (self.rnd_type,))
         arr._set_data(val.astype(arr.dtype))
 
 

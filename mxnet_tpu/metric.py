@@ -81,44 +81,34 @@ class EvalMetric(object):
 
 
 class CompositeEvalMetric(EvalMetric):
-    """Manage multiple metrics at once (parity: metric.py CompositeEvalMetric)."""
+    """A bundle of child metrics driven through one EvalMetric interface
+    (role: metric.py CompositeEvalMetric)."""
 
     def __init__(self, **kwargs):
         super().__init__("composite")
-        try:
-            self.metrics = kwargs["metrics"]
-        except KeyError:
-            self.metrics = []
+        self.metrics = kwargs.get("metrics", [])
 
     def add(self, metric):
         self.metrics.append(metric)
 
     def get_metric(self, index):
-        try:
-            return self.metrics[index]
-        except IndexError:
-            raise ValueError("Metric index {} is out of range 0 and {}".format(
-                index, len(self.metrics)))
+        if not 0 <= index < len(self.metrics):
+            raise ValueError("no child metric at index %d (have %d)"
+                             % (index, len(self.metrics)))
+        return self.metrics[index]
 
     def update(self, labels, preds):
         for metric in self.metrics:
             metric.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        # base __init__ calls reset() before self.metrics exists
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
 
     def get(self):
-        names = []
-        results = []
-        for metric in self.metrics:
-            result = metric.get()
-            names.append(result[0])
-            results.append(result[1])
-        return (names, results)
+        pairs = [metric.get() for metric in self.metrics]
+        return ([name for name, _ in pairs], [value for _, value in pairs])
 
 
 class Accuracy(EvalMetric):
@@ -147,11 +137,8 @@ class TopKAccuracy(EvalMetric):
 
     def __init__(self, **kwargs):
         super().__init__("top_k_accuracy")
-        try:
-            self.top_k = kwargs["top_k"]
-        except KeyError:
-            self.top_k = 1
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        self.top_k = kwargs.get("top_k", 1)
+        assert self.top_k > 1, "top_k must exceed 1 (use Accuracy for top-1)"
         self.name += "_%d" % self.top_k
 
     def update(self, labels, preds):
@@ -160,21 +147,17 @@ class TopKAccuracy(EvalMetric):
             pred_label = _asnumpy(pred_label)
             label = _asnumpy(label)
             assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_label = numpy.argsort(pred_label.astype("float32"), axis=1)
-            label = label.astype("int32")
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.flatten() == label.flatten()).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred_label[:, num_classes - 1 - j].flatten()
-                        == label.flatten()).sum()
-            self.num_inst += num_samples
+            label = label.astype("int32").ravel()
+            if pred_label.ndim == 1:
+                self.sum_metric += int((pred_label == label).sum())
+            else:
+                k = min(self.top_k, pred_label.shape[1])
+                # membership of the true class among the k best scores
+                ranked = numpy.argsort(pred_label.astype("float32"), axis=1)
+                topk = ranked[:, -k:]
+                self.sum_metric += int(
+                    (topk == label[:, None]).any(axis=1).sum())
+            self.num_inst += label.shape[0]
 
 
 class F1(EvalMetric):
@@ -187,32 +170,18 @@ class F1(EvalMetric):
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
             pred = _asnumpy(pred)
-            label = _asnumpy(label).astype("int32")
-            pred_label = numpy.argmax(pred, axis=1)
-            check_label_shapes(label, pred)
-            if len(numpy.unique(label)) > 2:
-                raise ValueError("F1 currently only supports binary classification.")
-            true_positives, false_positives, false_negatives = 0., 0., 0.
-            for y_pred, y_true in zip(pred_label, label):
-                if y_pred == 1 and y_true == 1:
-                    true_positives += 1.
-                elif y_pred == 1 and y_true == 0:
-                    false_positives += 1.
-                elif y_pred == 0 and y_true == 1:
-                    false_negatives += 1.
-            if true_positives + false_positives > 0:
-                precision = true_positives / (true_positives + false_positives)
-            else:
-                precision = 0.
-            if true_positives + false_negatives > 0:
-                recall = true_positives / (true_positives + false_negatives)
-            else:
-                recall = 0.
-            if precision + recall > 0:
-                f1_score = 2 * precision * recall / (precision + recall)
-            else:
-                f1_score = 0.
-            self.sum_metric += f1_score
+            label = _asnumpy(label).astype("int32").ravel()
+            if numpy.unique(label).size > 2:
+                raise ValueError("F1 is defined here for binary labels only")
+            hat = numpy.argmax(pred, axis=1)
+            tp = float(numpy.sum((hat == 1) & (label == 1)))
+            fp = float(numpy.sum((hat == 1) & (label == 0)))
+            fn = float(numpy.sum((hat == 0) & (label == 1)))
+            precision = tp / (tp + fp) if tp + fp else 0.0
+            recall = tp / (tp + fn) if tp + fn else 0.0
+            score = (2.0 * precision * recall / (precision + recall)
+                     if precision + recall else 0.0)
+            self.sum_metric += score
             self.num_inst += 1
 
 
