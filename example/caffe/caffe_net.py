@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Caffe interop (reference example/caffe role): both directions.
+
+1. Train a net whose hidden layer is a ``CaffeOp`` — a layer DEFINED by
+   caffe prototxt, run as a native graph op with learnable weights, with
+   a ``CaffeLoss`` head.
+2. Convert a full multi-layer prototxt to a Symbol with
+   tools/caffe_converter and train that.
+
+Run: python caffe_net.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.plugin import caffe
+
+
+def toy_problem(n=256, rng=None):
+    rng = rng or np.random.RandomState(0)
+    X = rng.randn(n, 10).astype(np.float32)
+    y = (X[:, :5].sum(axis=1) > X[:, 5:].sum(axis=1)).astype(np.float32)
+    return X, y
+
+
+def train_caffe_op_net(epochs=10):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    hid = caffe.CaffeOp(data, prototxt='layer { type: "InnerProduct" '
+                        'inner_product_param { num_output: 32 } }',
+                        name="cfc1")
+    hid = caffe.CaffeOp(hid, prototxt='layer { type: "TanH" }', name="ct")
+    out = caffe.CaffeOp(hid, prototxt='layer { type: "InnerProduct" '
+                        'inner_product_param { num_output: 2 } }',
+                        name="cfc2")
+    net = caffe.CaffeLoss(out, label,
+                          prototxt='layer { type: "SoftmaxWithLoss" }',
+                          name="softmax")
+
+    X, y = toy_problem()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2})
+    score = dict(mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc"))
+    print("CaffeOp net accuracy: %.3f" % score["accuracy"])
+    return score["accuracy"]
+
+
+PROTOTXT = """
+name: "tiny"
+input: "data"
+layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+        inner_product_param { num_output: 16 } }
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "relu1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "relu1" top: "ip2"
+        inner_product_param { num_output: 2 } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" top: "loss" }
+"""
+
+
+def train_converted_net(epochs=10):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "tools", "caffe_converter"))
+    try:
+        from convert_symbol import convert
+    finally:
+        sys.path.pop(0)
+    net, inputs = convert(PROTOTXT)
+    X, y = toy_problem(rng=np.random.RandomState(1))
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2})
+    score = dict(mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc"))
+    print("converted prototxt accuracy: %.3f" % score["accuracy"])
+    return score["accuracy"]
+
+
+if __name__ == "__main__":
+    a1 = train_caffe_op_net()
+    a2 = train_converted_net()
+    assert a1 > 0.8 and a2 > 0.8, (a1, a2)
+    print("OK caffe example")

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Stochastic depth (Huang et al. 2016) via module composition.
+
+Capability parity: example/stochastic-depth/sd_module.py + sd_mnist.py —
+the reference gates each residual block at the MODULE level: a
+StochasticDepthModule wraps the block's Module and, per training batch,
+a coin flip either runs the block (y = x + f(x)) or passes the input
+through untouched; at inference the block always runs.  Chained with
+SequentialModule.
+
+Run: python sd_mnist.py  (synthetic data; a few seconds on CPU)
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataBatch
+
+
+class RandomNumberQueue(object):
+    """Pre-drawn uniforms (the reference's trick to keep the training
+    loop's host-side RNG cost trivial)."""
+
+    def __init__(self, pool_size=1000, seed=0):
+        self._rng = np.random.RandomState(seed)
+        self._pool = self._rng.rand(pool_size)
+        self._index = 0
+
+    def get_sample(self):
+        if self._index >= len(self._pool):
+            self._pool = self._rng.rand(len(self._pool))
+            self._index = 0
+        self._index += 1
+        return self._pool[self._index - 1]
+
+
+class StochasticDepthModule(mx.mod.BaseModule):
+    """Run the wrapped residual-block module with probability
+    1 - death_rate during training (always at inference); when the block
+    is "dead", inputs pass through unchanged and gradients flow straight
+    back (identity skip)."""
+
+    def __init__(self, symbol_compute, data_names=("data",),
+                 label_names=None, death_rate=0.0, context=None,
+                 rng=None, logger=logging):
+        super().__init__(logger=logger)
+        self._module = mx.mod.Module(symbol_compute,
+                                     data_names=list(data_names),
+                                     label_names=list(label_names or []),
+                                     context=context or mx.cpu(),
+                                     logger=logger)
+        self._death_rate = death_rate
+        self._rng = rng or RandomNumberQueue()
+        self._gate_open = True
+        self._passthrough_data = None
+
+    # -- delegation boilerplate ----------------------------------------
+    @property
+    def data_names(self):
+        return self._module.data_names
+
+    @property
+    def output_names(self):
+        return self._module.output_names
+
+    @property
+    def data_shapes(self):
+        return self._module.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._module.label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._module.output_shapes
+
+    def get_params(self):
+        return self._module.get_params()
+
+    def init_params(self, *args, **kwargs):
+        self._module.init_params(*args, **kwargs)
+        self.params_initialized = True
+
+    def bind(self, *args, **kwargs):
+        self._module.bind(*args, **kwargs)
+        self.binded = True
+        self.inputs_need_grad = self._module.inputs_need_grad
+
+    def init_optimizer(self, *args, **kwargs):
+        self._module.init_optimizer(*args, **kwargs)
+        self.optimizer_initialized = True
+
+    def install_monitor(self, mon):
+        self._module.install_monitor(mon)
+
+    def update_metric(self, eval_metric, labels):
+        if self._gate_open:
+            self._module.update_metric(eval_metric, labels)
+
+    # -- the stochastic gate -------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        self._gate_open = not (is_train and
+                               self._rng.get_sample() < self._death_rate)
+        if self._gate_open:
+            self._module.forward(data_batch, is_train=is_train)
+        else:
+            self._passthrough_data = data_batch.data
+
+    def get_outputs(self, merge_multi_context=True):
+        if self._gate_open:
+            return self._module.get_outputs(merge_multi_context)
+        return self._passthrough_data
+
+    def backward(self, out_grads=None):
+        if self._gate_open:
+            self._module.backward(out_grads=out_grads)
+        else:
+            self._passthrough_grads = out_grads
+
+    def get_input_grads(self, merge_multi_context=True):
+        if self._gate_open:
+            return self._module.get_input_grads(merge_multi_context)
+        return self._passthrough_grads
+
+    def update(self):
+        if self._gate_open:
+            self._module.update()
+
+
+def residual_block(hidden, prefix):
+    """y = x + f(x): shape-preserving compute branch."""
+    data = mx.sym.Variable("data")
+    f = mx.sym.FullyConnected(data, num_hidden=hidden,
+                              name="%s_fc" % prefix)
+    f = mx.sym.Activation(f, act_type="relu", name="%s_relu" % prefix)
+    return data + f
+
+
+def build_net(hidden=64, n_blocks=3, death_rate=0.5, ctx=None):
+    rng = RandomNumberQueue(seed=7)
+    seq = mx.mod.SequentialModule()
+    entry = mx.sym.Variable("data")
+    entry = mx.sym.FullyConnected(entry, num_hidden=hidden, name="entry_fc")
+    entry = mx.sym.Activation(entry, act_type="relu", name="entry_relu")
+    seq.add(mx.mod.Module(entry, label_names=[], context=ctx or mx.cpu()),
+            auto_wiring=True)
+    for i in range(n_blocks):
+        seq.add(StochasticDepthModule(
+            residual_block(hidden, "block%d" % i), death_rate=death_rate,
+            context=ctx, rng=rng), auto_wiring=True)
+    head = mx.sym.Variable("data")
+    head = mx.sym.FullyConnected(head, num_hidden=2, name="head_fc")
+    head = mx.sym.SoftmaxOutput(head, name="softmax")
+    seq.add(mx.mod.Module(head, context=ctx or mx.cpu()),
+            take_labels=True, auto_wiring=True)
+    return seq
+
+
+def main(epochs=6, batch=32, n=512):
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, 16).astype(np.float32)
+    y = (X[:, :8].sum(axis=1) > X[:, 8:].sum(axis=1)).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=True)
+
+    net = build_net(hidden=32, n_blocks=3, death_rate=0.5)
+    net.bind(data_shapes=[("data", (batch, 16))],
+             label_shapes=[("softmax_label", (batch,))])
+    net.init_params(mx.init.Xavier())
+    net.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    metric = mx.metric.create("acc")
+    for epoch in range(epochs):
+        train.reset()
+        metric.reset()
+        for b in train:
+            net.forward(b, is_train=True)
+            net.backward()
+            net.update()
+            net.update_metric(metric, b.label)
+        print("epoch %d train-acc %.3f" % (epoch, metric.get()[1]))
+
+    # inference: every block active
+    train.reset()
+    metric.reset()
+    for b in train:
+        net.forward(b, is_train=False)
+        net.update_metric(metric, b.label)
+    acc = metric.get()[1]
+    print("final eval-acc %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    args = ap.parse_args()
+    acc = main(epochs=args.epochs)
+    assert acc > 0.8, "stochastic-depth net failed to learn (%.3f)" % acc

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""SVM output layer training (reference example/svm_mnist role): an MLP
+whose head is ``SVMOutput`` — scores trained with the multiclass hinge
+loss (L2 by default, use_linear for L1) instead of softmax cross
+entropy.
+
+Run: python svm_mnist.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def main(epochs=12, batch=32, n=512, classes=4):
+    rng = np.random.RandomState(0)
+    centers = rng.randn(classes, 12) * 3.0
+    y = rng.randint(0, classes, size=n)
+    X = (centers[y] + rng.randn(n, 12)).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    net = mx.sym.SVMOutput(net, name="svm", margin=1.0,
+                           regularization_coefficient=1.0)
+
+    train = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=batch,
+                              shuffle=True, label_name="svm_label")
+    mod = mx.mod.Module(net, context=mx.cpu(), label_names=["svm_label"])
+    mod.fit(train, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.02, "momentum": 0.9})
+
+    val = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=batch,
+                            label_name="svm_label")
+    score = dict(mod.score(val, "acc"))
+    print("SVM head accuracy: %.3f" % score["accuracy"])
+    return score["accuracy"]
+
+
+if __name__ == "__main__":
+    acc = main()
+    assert acc > 0.9, acc
+    print("OK svm example")

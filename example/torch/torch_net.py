@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Torch interop (reference example/torch role): a torch.nn module as a
+hidden layer (TorchModule op) and a torch criterion as the loss head
+(TorchCriterion op), embedded in a graph whose OTHER layers are native
+ops trained by the framework optimizer.
+
+Run: python torch_net.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.plugin import torch_bridge
+
+
+def main(steps=120):
+    import torch
+
+    rng = np.random.RandomState(0)
+    n, din = 128, 8
+    X = rng.randn(n, din).astype(np.float32)
+    W_true = rng.randn(din, 1).astype(np.float32)
+    Y = X @ W_true + 0.05 * rng.randn(n, 1).astype(np.float32)
+
+    # torch-owned hidden block (its weights update via torch)
+    tnet = torch.nn.Sequential(torch.nn.Linear(din, 16), torch.nn.Tanh())
+    topt = torch.optim.SGD(tnet.parameters(), lr=0.05)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    hid = torch_bridge.torch_module(tnet, data, name="t0")
+    out = mx.sym.FullyConnected(hid, num_hidden=1, name="fc_out")
+    loss = torch_bridge.torch_criterion(torch.nn.MSELoss(), out, label,
+                                        name="crit")
+
+    exe = loss.simple_bind(mx.cpu(0), data=(n, din), label=(n, 1),
+                           grad_req="write")
+    init = mx.init.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "label"):
+            init(name, arr)
+    exe.arg_dict["data"][:] = X
+    exe.arg_dict["label"][:] = Y
+    opt = mx.optimizer.create("sgd", learning_rate=0.05)
+    updater = mx.optimizer.get_updater(opt)
+
+    first = None
+    for step in range(steps):
+        exe.forward(is_train=True)
+        mse = float(exe.outputs[0].asnumpy()[0])
+        if first is None:
+            first = mse
+        exe.backward()
+        # native params update via the framework optimizer...
+        for i, name in enumerate(exe._arg_names):
+            if name in ("data", "label"):
+                continue
+            updater(i, exe.grad_dict[name], exe.arg_dict[name])
+        # ...torch params via the torch optimizer (grads were produced by
+        # the bridged backward replay)
+        topt.step()
+        topt.zero_grad()
+    print("mse %.4f -> %.4f after %d steps" % (first, mse, steps))
+    return first, mse
+
+
+if __name__ == "__main__":
+    first, last = main()
+    assert last < first * 0.2, (first, last)
+    print("OK torch example")

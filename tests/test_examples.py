@@ -113,3 +113,42 @@ def test_neural_style_example_descends():
     ns = _load_example("neural-style/neural_style.py", "ns_example")
     hist = ns.run(steps=40)
     assert hist[-1] < hist[0] * 0.5, (hist[0], hist[-1])
+
+
+def test_stochastic_depth_example():
+    """Module-level residual gating (reference example/stochastic-depth):
+    SequentialModule of StochasticDepthModules learns, and eval runs with
+    every block active."""
+    r = _run(os.path.join(REPO, "example/stochastic-depth"),
+             "sd_mnist.py", "--epochs", "6")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "final eval-acc" in r.stdout
+
+
+def test_warpctc_example():
+    """CTC training (reference example/warpctc): loss descends and greedy
+    decode recovers the labels exactly."""
+    r = _run(os.path.join(REPO, "example/warpctc"), "lstm_ocr.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK warpctc example" in r.stdout
+
+
+def test_caffe_example():
+    """CaffeOp/CaffeLoss net + converted prototxt net both train."""
+    r = _run(os.path.join(REPO, "example/caffe"), "caffe_net.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK caffe example" in r.stdout
+
+
+def test_torch_example():
+    """torch module + criterion embedded in a native graph co-train."""
+    r = _run(os.path.join(REPO, "example/torch"), "torch_net.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK torch example" in r.stdout
+
+
+def test_svm_example():
+    """SVMOutput hinge-loss head trains (reference example/svm_mnist)."""
+    r = _run(os.path.join(REPO, "example/svm_mnist"), "svm_mnist.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK svm example" in r.stdout
