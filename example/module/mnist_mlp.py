@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Module API walkthrough (reference example/module role): the
+intermediate-level interface — explicit bind / init_params /
+init_optimizer / forward / backward / update — plus the high-level
+``fit``, checkpointing mid-training, and resuming from a saved epoch.
+
+Run: python mnist_mlp.py
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def toy(n=512, rng=None):
+    rng = rng or np.random.RandomState(0)
+    X = rng.randn(n, 20).astype(np.float32)
+    y = (X[:, :10].sum(axis=1) > X[:, 10:].sum(axis=1)).astype(np.float32)
+    return X, y
+
+
+def low_level_loop(epochs=6, batch=32):
+    """The explicit step loop fit() wraps."""
+    X, y = toy()
+    train = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=True)
+    mod = mx.mod.Module(mx.models.get_mlp(2, (32,)), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.2})
+    metric = mx.metric.create("acc")
+    for epoch in range(epochs):
+        train.reset()
+        metric.reset()
+        for batch_data in train:
+            mod.forward(batch_data, is_train=True)
+            mod.update_metric(metric, batch_data.label)
+            mod.backward()
+            mod.update()
+        print("epoch %d train-acc %.3f" % (epoch, metric.get()[1]))
+    return metric.get()[1]
+
+
+def fit_checkpoint_resume(epochs=4, batch=32):
+    """High-level fit with a checkpoint every epoch, then resume."""
+    X, y = toy(rng=np.random.RandomState(1))
+    train = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=True)
+    prefix = os.path.join(tempfile.mkdtemp(), "mlp")
+
+    mod = mx.mod.Module(mx.models.get_mlp(2, (32,)), context=mx.cpu())
+    mod.fit(train, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2},
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+
+    sym, args, aux = mx.model.load_checkpoint(prefix, epochs)
+    mod2 = mx.mod.Module(sym, context=mx.cpu())
+    train.reset()
+    mod2.fit(train, num_epoch=epochs + 2, begin_epoch=epochs,
+             arg_params=args, aux_params=aux, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.1})
+    acc = dict(mod2.score(mx.io.NDArrayIter(X, y, batch_size=batch),
+                          "acc"))["accuracy"]
+    print("resumed accuracy %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    a1 = low_level_loop()
+    a2 = fit_checkpoint_resume()
+    assert a1 > 0.9 and a2 > 0.9, (a1, a2)
+    print("OK module example")

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Multi-task training (reference example/multi-task role): one trunk,
+two loss heads (class label + parity of the label) grouped into a single
+symbol; a custom metric scores each head.
+
+Run: python multitask_mlp.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def build_net(classes=4):
+    data = mx.sym.Variable("data")
+    trunk = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    trunk = mx.sym.Activation(trunk, act_type="relu", name="relu1")
+    cls = mx.sym.FullyConnected(trunk, num_hidden=classes, name="fc_cls")
+    cls = mx.sym.SoftmaxOutput(cls, name="softmax")
+    par = mx.sym.FullyConnected(trunk, num_hidden=2, name="fc_par")
+    par = mx.sym.SoftmaxOutput(par, name="parity")
+    return mx.sym.Group([cls, par])
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Per-head accuracy (the reference example's Multi_Accuracy)."""
+
+    def __init__(self, num=2):
+        super().__init__("multi-accuracy", num=num)
+
+    def update(self, labels, preds):
+        for i, (label, pred) in enumerate(zip(labels, preds)):
+            hat = pred.asnumpy().argmax(axis=1)
+            lab = label.asnumpy().astype(int).ravel()
+            self.sum_metric[i] += int((hat == lab).sum())
+            self.num_inst[i] += lab.shape[0]
+
+
+def main(epochs=10, batch=32, n=512, classes=4):
+    rng = np.random.RandomState(0)
+    centers = rng.randn(classes, 12) * 3.0
+    y = rng.randint(0, classes, size=n)
+    X = (centers[y] + rng.randn(n, 12)).astype(np.float32)
+    y_parity = (y % 2).astype(np.float32)
+
+    train = mx.io.NDArrayIter(
+        X, {"softmax_label": y.astype(np.float32),
+            "parity_label": y_parity}, batch_size=batch, shuffle=True)
+    mod = mx.mod.Module(build_net(classes), context=mx.cpu(),
+                        label_names=["softmax_label", "parity_label"])
+    metric = MultiAccuracy()
+    mod.fit(train, num_epoch=epochs, eval_metric=metric, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+
+    val = mx.io.NDArrayIter(
+        X, {"softmax_label": y.astype(np.float32),
+            "parity_label": y_parity}, batch_size=batch)
+    accs = dict(mod.score(val, MultiAccuracy()))
+    print("per-head accuracy:", {k: round(v, 3) for k, v in accs.items()})
+    return list(accs.values())
+
+
+if __name__ == "__main__":
+    accs = main()
+    assert all(a > 0.85 for a in accs), accs
+    print("OK multi-task example")

@@ -152,3 +152,18 @@ def test_svm_example():
     r = _run(os.path.join(REPO, "example/svm_mnist"), "svm_mnist.py")
     assert r.returncode == 0, r.stderr[-1500:]
     assert "OK svm example" in r.stdout
+
+
+def test_multitask_example():
+    """Two loss heads via sym.Group + per-head metric."""
+    r = _run(os.path.join(REPO, "example/multi-task"), "multitask_mlp.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK multi-task example" in r.stdout
+
+
+def test_module_example():
+    """Explicit bind/forward/backward/update loop + fit with checkpoint
+    and resume (reference example/module)."""
+    r = _run(os.path.join(REPO, "example/module"), "mnist_mlp.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK module example" in r.stdout
