@@ -1,0 +1,95 @@
+"""tools/accnn: low-rank model acceleration (reference tools/accnn).
+
+Train a small convnet, compress it with automatic rank selection, and
+check the accelerated checkpoint loads and keeps accuracy; also check
+the pure-SVD single-layer paths preserve outputs at full rank."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools", "accnn"))
+
+import utils            # noqa: E402
+import acc_fc           # noqa: E402
+import acc_conv         # noqa: E402
+import rank_selection   # noqa: E402
+import accnn as accnn_mod  # noqa: E402
+
+rng = np.random.RandomState(0)
+
+
+def _toy_conv_model(tmp_path, epochs=10):
+    n, classes = 256, 3
+    patterns = rng.randn(classes, 8, 6, 6).astype(np.float32) * 1.5
+    y = rng.randint(0, classes, size=n)
+    X = (patterns[y] + rng.randn(n, 8, 6, 6)).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16,
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    it = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=32,
+                           shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 0.005})
+    val = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=32)
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    prefix = str(tmp_path / "net")
+    mod.save_checkpoint(prefix, 0)
+    return prefix, X, y, acc
+
+
+def _score(prefix, epoch, X, y):
+    sym, args, aux = mx.model.load_checkpoint(prefix, epoch)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", X.shape[:1] and (32,) + X.shape[1:])],
+             label_shapes=[("softmax_label", (32,))])
+    mod.set_params(args, aux)
+    val = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=32)
+    return dict(mod.score(val, "acc"))["accuracy"]
+
+
+def test_full_rank_decomposition_preserves_outputs(tmp_path):
+    """At full rank the SVD factors reproduce the original layer, so the
+    surgery itself must be numerically transparent — this isolates graph
+    splicing from approximation error."""
+    prefix, X, y, _ = _toy_conv_model(tmp_path, epochs=2)
+    model = utils.load_model(prefix, 0)
+
+    m_fc = acc_fc.fc_decomposition(model, "fc1", K=10**9)
+    m_cv = acc_conv.conv_vh_decomposition(model, "conv1", K=10**9)
+    for m2 in (m_fc, m_cv):
+        assert "softmax_label" in m2["symbol"].list_arguments()
+        utils.save_model(m2, str(tmp_path / "t"), 0)
+        a_orig = _score(prefix, 0, X, y)
+        a_new = _score(str(tmp_path / "t"), 0, X, y)
+        assert abs(a_orig - a_new) < 0.02, (a_orig, a_new)
+
+
+def test_accnn_whole_model(tmp_path):
+    """Ratio-driven acceleration: fewer params, model still loads, runs,
+    and keeps accuracy near the original (min_energy floor active)."""
+    prefix, X, y, acc0 = _toy_conv_model(tmp_path)
+    assert acc0 > 0.9, "toy model failed to train (%.2f)" % acc0
+    model = utils.load_model(prefix, 0)
+    cfg = rank_selection.get_ranksel(model, ratio=2.0, min_energy=0.97)
+    assert cfg, "rank selection chose nothing"
+    m2 = accnn_mod.accelerate(model, cfg)
+    p0, p1 = accnn_mod.param_count(model), accnn_mod.param_count(m2)
+    assert p1 < p0, (p0, p1)
+    utils.save_model(m2, str(tmp_path / "fast"), 0)
+    acc1 = _score(str(tmp_path / "fast"), 0, X, y)
+    assert acc1 > acc0 - 0.1, (acc0, acc1)

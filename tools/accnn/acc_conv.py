@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Separable (V-H) conv decomposition (parity: tools/accnn/acc_conv.py).
+
+A (N, C, y, x) conv ≈ a (K, C, y, 1) vertical conv followed by a
+(N, K, 1, x) horizontal conv, ranks chosen by SVD of the unfolded
+kernel — the ACDC/Jaderberg-style test-time speedup.
+"""
+import argparse
+
+import numpy as np
+
+import utils
+import mxnet_tpu as mx
+
+
+def conv_vh_decomposition(model, layer, K):
+    W = model["arg_params"][layer + "_weight"].asnumpy()
+    N, C, y, x = W.shape
+    has_bias = (layer + "_bias") in model["arg_params"]
+    b = model["arg_params"][layer + "_bias"].asnumpy() if has_bias else None
+    node = utils.node_of(model["symbol"], layer)
+    attr = node.get("attr", {})
+    pad = eval(attr.get("pad", "(0, 0)"))
+    stride = eval(attr.get("stride", "(1, 1)"))
+
+    M = W.transpose((1, 2, 0, 3)).reshape((C * y, N * x))
+    U, D, Qt = np.linalg.svd(M, full_matrices=False)
+    K = int(min(K, D.size))
+    sd = np.sqrt(D[:K])
+    V = (U[:, :K] * sd).T.reshape(K, C, y, 1)                  # vertical
+    H = (Qt[:K, :].T * sd).reshape(N, x, 1, K).transpose((0, 3, 2, 1))
+
+    name1, name2 = layer + "_v", layer + "_h"
+    data = mx.sym.Variable("data")
+    sub = mx.sym.Convolution(data, kernel=(y, 1), pad=(pad[0], 0),
+                             stride=(stride[0], 1), num_filter=K,
+                             no_bias=True, name=name1)
+    sub = mx.sym.Convolution(sub, kernel=(1, x), pad=(0, pad[1]),
+                             stride=(1, stride[1]), num_filter=N,
+                             no_bias=not has_bias, name=name2)
+
+    new_sym = utils.replace_layer(model["symbol"], layer, sub)
+    args = dict(model["arg_params"])
+    args[name1 + "_weight"] = mx.nd.array(V.astype(np.float32))
+    args[name2 + "_weight"] = mx.nd.array(H.astype(np.float32))
+    if has_bias:
+        args[name2 + "_bias"] = mx.nd.array(b.astype(np.float32))
+    return {"symbol": new_sym,
+            "arg_params": utils.prune_params(new_sym, args),
+            "aux_params": model["aux_params"]}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-m", "--model", required=True, help="prefix")
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("-l", "--layer", required=True)
+    ap.add_argument("-K", type=int, required=True, help="rank")
+    ap.add_argument("--save-model", required=True)
+    args = ap.parse_args()
+    model = utils.load_model(args.model, args.epoch)
+    new_model = conv_vh_decomposition(model, args.layer, args.K)
+    utils.save_model(new_model, args.save_model)
+    print("saved %s (rank %d V-H decomposition of %s)"
+          % (args.save_model, args.K, args.layer))
+
+
+if __name__ == "__main__":
+    main()

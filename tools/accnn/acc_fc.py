@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Low-rank FC decomposition (parity: tools/accnn/acc_fc.py).
+
+W (n_out, n_in) ≈ P·Q with rank K: the layer becomes
+FC(no_bias, K, weight=Q) → FC(n_out, weight=P, bias=b).
+Parameter count drops from n_out·n_in to K·(n_out + n_in).
+"""
+import argparse
+import sys
+
+import numpy as np
+
+import utils
+import mxnet_tpu as mx
+
+
+def fc_decomposition(model, layer, K):
+    W = model["arg_params"][layer + "_weight"].asnumpy()
+    has_bias = (layer + "_bias") in model["arg_params"]
+    W2d = W.reshape((W.shape[0], -1))
+    u, s, vt = np.linalg.svd(W2d, full_matrices=False)
+    K = int(min(K, s.size))
+    P = u[:, :K] * s[:K]          # (n_out, K)
+    Q = vt[:K, :]                 # (K, n_in)
+
+    name1, name2 = layer + "_red", layer + "_rec"
+    data = mx.sym.Variable("data")
+    sub = mx.sym.FullyConnected(data, num_hidden=K, no_bias=True,
+                                name=name1)
+    sub = mx.sym.FullyConnected(sub, num_hidden=W2d.shape[0],
+                                no_bias=not has_bias, name=name2)
+
+    new_sym = utils.replace_layer(model["symbol"], layer, sub)
+    args = dict(model["arg_params"])
+    args[name1 + "_weight"] = mx.nd.array(Q.astype(np.float32))
+    args[name2 + "_weight"] = mx.nd.array(P.astype(np.float32))
+    if has_bias:
+        args[name2 + "_bias"] = args[layer + "_bias"]
+    return {"symbol": new_sym,
+            "arg_params": utils.prune_params(new_sym, args),
+            "aux_params": model["aux_params"]}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-m", "--model", required=True, help="prefix")
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("-l", "--layer", required=True)
+    ap.add_argument("-K", type=int, required=True, help="rank")
+    ap.add_argument("--save-model", required=True)
+    args = ap.parse_args()
+    model = utils.load_model(args.model, args.epoch)
+    new_model = fc_decomposition(model, args.layer, args.K)
+    utils.save_model(new_model, args.save_model)
+    print("saved %s (rank %d decomposition of %s)"
+          % (args.save_model, args.K, args.layer))
+
+
+if __name__ == "__main__":
+    main()

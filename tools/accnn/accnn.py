@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Whole-model low-rank acceleration (parity: tools/accnn/accnn.py).
+
+Decompose every eligible Convolution (V-H separable) and FullyConnected
+(SVD two-layer) in a checkpoint, ranks chosen automatically by
+rank_selection (or supplied via --config json {layer: rank}), and save
+the accelerated model.
+
+    python accnn.py -m model-prefix --epoch 5 --save-model fast-model \
+        --ratio 2
+"""
+import argparse
+import json
+
+import acc_conv
+import acc_fc
+import rank_selection
+import utils
+
+
+def accelerate(model, config):
+    for layer, K in config.items():
+        node = utils.node_of(model["symbol"], layer)
+        if node["op"] == "Convolution":
+            model = acc_conv.conv_vh_decomposition(model, layer, K)
+        elif node["op"] == "FullyConnected":
+            model = acc_fc.fc_decomposition(model, layer, K)
+    return model
+
+
+def param_count(model):
+    return sum(int(v.asnumpy().size)
+               for v in model["arg_params"].values())
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-m", "--model", required=True, help="prefix")
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--save-model", required=True)
+    ap.add_argument("--ratio", type=float, default=2.0)
+    ap.add_argument("--config", help="json file {layer: rank}")
+    args = ap.parse_args()
+
+    model = utils.load_model(args.model, args.epoch)
+    before = param_count(model)
+    if args.config:
+        with open(args.config) as f:
+            config = {k: int(v) for k, v in json.load(f).items()}
+    else:
+        config = rank_selection.get_ranksel(model, args.ratio)
+        with open("config.json", "w") as f:
+            json.dump(config, f, indent=2)
+    model = accelerate(model, config)
+    after = param_count(model)
+    utils.save_model(model, args.save_model)
+    print("accelerated %d layers: %d -> %d params (%.2fx); saved %s"
+          % (len(config), before, after, before / max(after, 1),
+             args.save_model))
+
+
+if __name__ == "__main__":
+    main()

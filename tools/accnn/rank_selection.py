@@ -1,0 +1,66 @@
+"""Automatic rank selection (parity: tools/accnn/rank_selection.py).
+
+The reference solves a DP over per-layer eigen-energy to hit a global
+speedup ratio; this implementation uses the same signal (singular-value
+energy of the unfolded kernel) with a direct allocation: every
+decomposable layer gets the largest rank whose decomposed cost stays
+within cost/ratio (the reference's per-layer budget), optionally raised
+to retain ``min_energy`` of the spectrum (0 = pure ratio-driven, as the
+reference, which relies on fine-tuning to recover accuracy).
+"""
+import json
+
+import numpy as np
+
+import utils
+
+
+def _spectrum(model, layer, op):
+    W = model["arg_params"][layer + "_weight"].asnumpy()
+    if op == "Convolution":
+        C, y = W.shape[1], W.shape[2]
+        M = W.transpose((1, 2, 0, 3)).reshape((C * y, -1))
+    else:
+        M = W.reshape((W.shape[0], -1))
+    return np.linalg.svd(M, compute_uv=False), W
+
+
+def _cost(op, W, K=None):
+    """Relative parameter/FLOP cost of the layer (K=None: original)."""
+    if op == "Convolution":
+        N, C, y, x = W.shape
+        return (K * (C * y + N * x)) if K else N * C * y * x
+    n_out, n_in = W.shape[0], int(np.prod(W.shape[1:]))
+    return (K * (n_out + n_in)) if K else n_out * n_in
+
+
+def get_ranksel(model, ratio, min_energy=0.0):
+    """layer -> rank for every decomposable Convolution/FullyConnected.
+
+    Layers where even the budget rank yields no saving (tiny layers) are
+    skipped and stay dense."""
+    graph = json.loads(model["symbol"].tojson())
+    sel = {}
+    for node in graph["nodes"]:
+        op = node["op"]
+        if op not in ("Convolution", "FullyConnected"):
+            continue
+        name = node["name"]
+        if name + "_weight" not in model["arg_params"]:
+            continue
+        if op == "Convolution":
+            kernel = eval(node.get("attr", {}).get("kernel", "(1, 1)"))
+            if len(kernel) != 2 or (kernel[0] == 1 and kernel[1] == 1):
+                continue            # 1x1 convs gain nothing from V-H
+        D, W = _spectrum(model, name, op)
+        budget = _cost(op, W) / float(ratio)
+        k_budget = max(1, int(budget // _cost(op, W, 1)))
+        K = k_budget
+        if min_energy > 0:
+            energy = np.cumsum(D ** 2) / np.sum(D ** 2)
+            K = max(K, int(np.searchsorted(energy, min_energy) + 1))
+        K = int(min(K, D.size))
+        if _cost(op, W, K) >= _cost(op, W):
+            continue            # decomposition saves nothing here
+        sel[name] = K
+    return sel

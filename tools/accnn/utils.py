@@ -1,0 +1,135 @@
+"""accnn graph-surgery helpers.
+
+Capability parity: tools/accnn/utils.py — load a checkpoint, splice a
+replacement subgraph in place of one layer, save the new model.  The
+splice operates on the symbol's JSON form: the target node is replaced
+by the nodes of a donor sub-symbol (built against a placeholder "data"
+variable), with the donor's placeholder wired to the target's data input
+and its parameter variables appended as new arg nodes.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def load_model(prefix, epoch):
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, epoch)
+    return {"symbol": sym, "arg_params": arg_params,
+            "aux_params": aux_params}
+
+
+def save_model(model, prefix, epoch=0):
+    mx.model.save_checkpoint(prefix, epoch, model["symbol"],
+                             model["arg_params"], model["aux_params"])
+
+
+def node_of(symbol, layer_name):
+    """The JSON node dict of ``layer_name`` (op attrs as strings)."""
+    graph = json.loads(symbol.tojson())
+    for node in graph["nodes"]:
+        if node["name"] == layer_name and node["op"] != "null":
+            return node
+    raise ValueError("layer %r not found" % layer_name)
+
+
+def replace_layer(symbol, layer_name, sub_symbol):
+    """Return a new Symbol with ``layer_name``'s node replaced by
+    ``sub_symbol`` (a symbol over one Variable named "data").
+
+    The old layer's parameter variables become dangling and are dropped;
+    the donor's parameter variables join the graph under their own names
+    (caller seeds them in arg_params).
+    """
+    graph = json.loads(symbol.tojson())
+    nodes = graph["nodes"]
+    target = None
+    for i, node in enumerate(nodes):
+        if node["name"] == layer_name and node["op"] != "null":
+            target = i
+            break
+    if target is None:
+        raise ValueError("layer %r not found" % layer_name)
+    data_input = nodes[target]["inputs"][0]  # [idx, out_idx] of the data arg
+
+    donor = json.loads(sub_symbol.tojson())
+    donor_nodes = donor["nodes"]
+
+    # Donor nodes are spliced IN PLACE of the target so the node list
+    # stays topologically ordered (nodes before the target keep their
+    # indices; downstream nodes shift by the donor size).
+    def copy_node(node):
+        return {"op": node["op"], "name": node["name"],
+                "attr": dict(node.get("attr", {})),
+                "inputs": [list(p) for p in node["inputs"]]}
+
+    new_nodes = [copy_node(n) for n in nodes[:target]]
+
+    donor2new = {}
+    spliced_out = None
+    for j, node in enumerate(donor_nodes):
+        if node["op"] == "null" and node["name"] == "data":
+            donor2new[j] = data_input[0]     # target's upstream node
+            continue
+        donor2new[j] = len(new_nodes)
+        spliced_out = len(new_nodes)
+        new_nodes.append(copy_node(node))
+        new_nodes[-1]["inputs"] = None       # filled below
+    # downstream indices shift by (donor nodes added - the 1 removed)
+    shift = len(new_nodes) - target - 1
+
+    for j, node in enumerate(donor_nodes):
+        k = donor2new[j]
+        if node["op"] == "null" and node["name"] == "data":
+            continue
+        new_nodes[k]["inputs"] = [[donor2new[r[0]], r[1]]
+                                  for r in node["inputs"]]
+
+    def map_old(ref):
+        idx, out = ref
+        if idx == target:
+            return [spliced_out, out]
+        return [idx + shift, out] if idx > target else [idx, out]
+
+    for node in nodes[target + 1:]:
+        cp = copy_node(node)
+        cp["inputs"] = [map_old(r) for r in cp["inputs"]]
+        new_nodes.append(cp)
+
+    heads = [map_old(h) for h in graph["heads"]]
+
+    # prune nodes no longer reachable from the heads (the replaced
+    # layer's old weight/bias variables)
+    reachable = set()
+    stack = [h[0] for h in heads]
+    while stack:
+        i = stack.pop()
+        if i in reachable:
+            continue
+        reachable.add(i)
+        stack.extend(ref[0] for ref in new_nodes[i]["inputs"])
+    keep = sorted(reachable)
+    remap = {old: new for new, old in enumerate(keep)}
+    pruned = []
+    for i in keep:
+        node = new_nodes[i]
+        pruned.append({"op": node["op"], "name": node["name"],
+                       "attr": node["attr"],
+                       "inputs": [[remap[r[0]], r[1]]
+                                  for r in node["inputs"]]})
+
+    graph_out = {
+        "nodes": pruned,
+        "arg_nodes": [i for i, n in enumerate(pruned) if n["op"] == "null"],
+        "heads": [[remap[h[0]], h[1]] for h in heads],
+    }
+    return mx.sym.load_json(json.dumps(graph_out))
+
+
+def prune_params(symbol, arg_params):
+    """Keep only params the new symbol still references."""
+    wanted = set(symbol.list_arguments())
+    return {k: v for k, v in arg_params.items() if k in wanted}
