@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""CNN text classification (reference example/cnn_text_classification,
+Kim 2014): embedding -> parallel convolutions with several filter
+widths over the token sequence -> max-over-time pooling -> concat ->
+softmax.
+
+Synthetic task: a sentence is positive iff it contains the bigram
+(7, 3) — exactly the pattern a width-2 filter learns.
+
+Run: python text_cnn.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+SEQ, VOCAB, EMBED, BATCH = 16, 20, 24, 32
+FILTERS, NUM_FILTER = (2, 3), 16
+
+
+def make_data(n, rng):
+    xs = rng.randint(0, VOCAB, size=(n, SEQ))
+    ys = np.zeros(n)
+    half = n // 2
+    # plant the bigram in half the sentences, scrub it from the rest
+    for i in range(half):
+        pos = rng.randint(0, SEQ - 1)
+        xs[i, pos], xs[i, pos + 1] = 7, 3
+        ys[i] = 1
+    for i in range(half, n):
+        for t in range(SEQ - 1):
+            if xs[i, t] == 7 and xs[i, t + 1] == 3:
+                xs[i, t + 1] = 4
+    perm = rng.permutation(n)
+    return xs[perm].astype(np.float32), ys[perm].astype(np.float32)
+
+
+def build_net():
+    data = mx.sym.Variable("data")                     # (N, SEQ)
+    emb = mx.sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                           name="embed")               # (N, SEQ, EMBED)
+    # conv wants NCHW: 1 input channel, height=SEQ, width=EMBED
+    x = mx.sym.Reshape(emb, shape=(-1, 1, SEQ, EMBED), name="img")
+    pooled = []
+    for width in FILTERS:
+        c = mx.sym.Convolution(x, kernel=(width, EMBED),
+                               num_filter=NUM_FILTER,
+                               name="conv%d" % width)  # (N, F, SEQ-w+1, 1)
+        c = mx.sym.Activation(c, act_type="relu")
+        p = mx.sym.Pooling(c, kernel=(1, 1), global_pool=True,
+                           pool_type="max",
+                           name="pool%d" % width)      # max over time
+        pooled.append(mx.sym.Flatten(p))
+    h = mx.sym.Concat(*pooled, dim=1, name="features")
+    h = mx.sym.Dropout(h, p=0.25, name="drop")
+    out = mx.sym.FullyConnected(h, num_hidden=2, name="cls")
+    return mx.sym.SoftmaxOutput(out, name="softmax")
+
+
+def main(epochs=8, n=512):
+    rng = np.random.RandomState(0)
+    X, y = make_data(n, rng)
+    train = mx.io.NDArrayIter(X, y, batch_size=BATCH, shuffle=True)
+    mod = mx.mod.Module(build_net(), context=mx.cpu())
+    mod.fit(train, num_epoch=epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 0.005})
+    val = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    print("text-cnn accuracy: %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    acc = main()
+    assert acc > 0.9, acc
+    print("OK text-cnn example")

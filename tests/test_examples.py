@@ -167,3 +167,25 @@ def test_module_example():
     r = _run(os.path.join(REPO, "example/module"), "mnist_mlp.py")
     assert r.returncode == 0, r.stderr[-1500:]
     assert "OK module example" in r.stdout
+
+
+def test_bilstm_sort_example():
+    """Bidirectional RNN learns to sort (reference example/bi-lstm-sort)."""
+    r = _run(os.path.join(REPO, "example/bi-lstm-sort"), "sort_io.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK bi-lstm-sort example" in r.stdout
+
+
+def test_sgld_example():
+    """SGLD posterior sampling: mean near truth, nonzero spread."""
+    r = _run(os.path.join(REPO, "example/bayesian-methods"), "sgld_demo.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK sgld example" in r.stdout
+
+
+def test_text_cnn_example():
+    """Kim-CNN text classifier (reference example/cnn_text_classification)."""
+    r = _run(os.path.join(REPO, "example/cnn_text_classification"),
+             "text_cnn.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK text-cnn example" in r.stdout
