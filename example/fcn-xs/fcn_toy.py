@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Fully-convolutional segmentation (reference example/fcn-xs role):
+conv downsampling -> 1x1 score layer -> Deconvolution upsampling (
+bilinear-initialized) -> Crop back to input size -> per-pixel softmax
+(multi_output SoftmaxOutput), trained end-to-end.
+
+Synthetic task: segment bright square blobs from background.
+
+Run: python fcn_toy.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+H = W = 16
+BATCH, CLASSES = 16, 2
+
+
+def make_data(n, rng):
+    X = rng.rand(n, 1, H, W).astype(np.float32) * 0.3
+    Y = np.zeros((n, H, W), np.float32)
+    for i in range(n):
+        y0, x0 = rng.randint(0, H - 6), rng.randint(0, W - 6)
+        s = rng.randint(3, 7)
+        X[i, 0, y0:y0 + s, x0:x0 + s] += 0.7
+        Y[i, y0:y0 + s, x0:x0 + s] = 1
+    return X, Y
+
+
+def build_net():
+    data = mx.sym.Variable("data")
+    # encoder: stride-2 conv halves the resolution
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                            pad=(1, 1), name="conv1")
+    c1 = mx.sym.Activation(c1, act_type="relu")
+    c2 = mx.sym.Convolution(c1, kernel=(3, 3), num_filter=16,
+                            pad=(1, 1), stride=(2, 2), name="conv2")
+    c2 = mx.sym.Activation(c2, act_type="relu")
+    # per-class scores at coarse resolution
+    score = mx.sym.Convolution(c2, kernel=(1, 1), num_filter=CLASSES,
+                               name="score")
+    # learnable 2x upsample back to input resolution (fcn-xs pattern:
+    # Deconvolution with bilinear-friendly kernel, then Crop to input)
+    up = mx.sym.Deconvolution(score, kernel=(4, 4), stride=(2, 2),
+                              pad=(1, 1), num_filter=CLASSES,
+                              no_bias=True, name="upsample_score")
+    up = mx.sym.Crop(up, data, num_args=2, name="crop_score")
+    return mx.sym.SoftmaxOutput(up, multi_output=True, name="softmax")
+
+
+def main(epochs=10, n=256):
+    rng = np.random.RandomState(0)
+    X, Y = make_data(n, rng)
+    train = mx.io.NDArrayIter(X, Y, batch_size=BATCH, shuffle=True)
+    mod = mx.mod.Module(build_net(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    # bilinear init for the deconv filter, xavier for the rest
+    mod.init_params(mx.init.Xavier())
+    args, aux = mod.get_params()
+    bilinear = mx.nd.zeros(args["upsample_score_weight"].shape)
+    mx.init.Bilinear()("upsample_score_weight", bilinear)
+    mod.set_params(dict(args, upsample_score_weight=bilinear), aux)
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    metric = mx.metric.create("acc")
+    for epoch in range(epochs):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        if (epoch + 1) % 5 == 0:
+            print("epoch %d pixel-acc %.3f" % (epoch + 1,
+                                               metric.get()[1]))
+    return metric.get()[1]
+
+
+if __name__ == "__main__":
+    acc = main()
+    assert acc > 0.9, "segmentation failed to learn (%.3f)" % acc
+    print("OK fcn example")

@@ -189,3 +189,11 @@ def test_text_cnn_example():
              "text_cnn.py")
     assert r.returncode == 0, r.stderr[-1500:]
     assert "OK text-cnn example" in r.stdout
+
+
+def test_fcn_example():
+    """FCN segmentation: Deconvolution (bilinear-init) + Crop +
+    multi_output softmax trained end-to-end (reference example/fcn-xs)."""
+    r = _run(os.path.join(REPO, "example/fcn-xs"), "fcn_toy.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK fcn example" in r.stdout
