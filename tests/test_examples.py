@@ -197,3 +197,11 @@ def test_fcn_example():
     r = _run(os.path.join(REPO, "example/fcn-xs"), "fcn_toy.py")
     assert r.returncode == 0, r.stderr[-1500:]
     assert "OK fcn example" in r.stdout
+
+
+def test_nce_example():
+    """NCE: true class outscores sampled noise via per-candidate logistic
+    losses over Embedding + batch_dot (reference example/nce-loss)."""
+    r = _run(os.path.join(REPO, "example/nce-loss"), "nce_demo.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK nce example" in r.stdout
