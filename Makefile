@@ -29,7 +29,7 @@ $(LIBDIR)/libmxtpu_capi.so: src/c_api.cc | $(LIBDIR)
 
 $(LIBDIR)/capi_smoke: tests/capi/capi_smoke.c $(LIBDIR)/libmxtpu_capi.so
 	$(CC) -O2 -Wall -Iinclude $< -o $@ -L$(LIBDIR) -lmxtpu_capi \
-	    -Wl,-rpath,'$$ORIGIN'
+	    -lm -Wl,-rpath,'$$ORIGIN'
 
 $(LIBDIR)/capi_threads: tests/capi/capi_threads.c $(LIBDIR)/libmxtpu_capi.so
 	$(CC) -O2 -Wall -Iinclude $< -o $@ -L$(LIBDIR) -lmxtpu_capi \

@@ -130,6 +130,47 @@ int MXRecordIOReaderReadRecord(RecordIOHandle h, const char** out,
                                size_t* size);
 int MXRecordIOReaderSeek(RecordIOHandle h, size_t pos);
 
+/* -- NDArray save/load (checkpoint format), slice/reshape/dtype.
+ * MXNDArrayLoad's out arrays live until this thread's next load. */
+int MXNDArraySave(const char* fname, uint32_t num, NDArrayHandle* handles,
+                  const char** keys);
+int MXNDArrayLoad(const char* fname, uint32_t* out_size,
+                  NDArrayHandle** out_arr, uint32_t* out_name_size,
+                  const char*** out_names);
+int MXNDArrayGetDType(NDArrayHandle h, int* out);
+int MXNDArraySlice(NDArrayHandle h, uint32_t begin, uint32_t end,
+                   NDArrayHandle* out);
+int MXNDArrayReshape(NDArrayHandle h, uint32_t ndim, const uint32_t* shape,
+                     NDArrayHandle* out);
+
+/* -- executor training surface: grad_req=write bind, backward, and
+ * handles to the executor's BOUND arg/grad arrays (imperative updates
+ * through them are seen by the next forward) — enough for a C program
+ * to run the full train loop with MXOptimizerUpdate. */
+int MXExecutorSimpleBindTrain(SymbolHandle sym, const char* shapes_json,
+                              ExecutorHandle* out);
+int MXExecutorBackward(ExecutorHandle h);
+int MXExecutorArgHandle(ExecutorHandle h, const char* name,
+                        NDArrayHandle* out);
+int MXExecutorGradHandle(ExecutorHandle h, const char* name,
+                         NDArrayHandle* out);
+int MXExecutorNumArgs(ExecutorHandle h, uint32_t* out);
+int MXExecutorArgName(ExecutorHandle h, uint32_t index, char* buf,
+                      size_t cap);
+
+/* -- kvstore cluster queries + barrier */
+int MXKVStoreGetRank(KVStoreHandle h, int* out);
+int MXKVStoreGetGroupSize(KVStoreHandle h, int* out);
+/* *out valid until this thread's next MXKVStoreGetType */
+int MXKVStoreGetType(KVStoreHandle h, const char** out);
+int MXKVStoreBarrier(KVStoreHandle h);
+
+/* -- misc */
+int MXRandomSeed(int seed);
+int MXGetVersion(int* out);   /* MAJOR*10000 + MINOR*100 + PATCH */
+int MXSymbolGetNumAuxiliaryStates(SymbolHandle h, uint32_t* out);
+int MXSymbolGetName(SymbolHandle h, char* buf, size_t cap);
+
 /* -- optimizer through C (c_api.cc:1525-1556 parity); lr/wd < 0 keep
  * the optimizer's configured values */
 typedef void* OptimizerHandle;

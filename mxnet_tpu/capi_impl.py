@@ -365,3 +365,110 @@ def optimizer_update(opt, index, weight, grad, lr, wd):
     finally:
         opt.lr, opt.wd = old_lr, old_wd
     return 0
+
+
+# ----------------------------------------------------------------------
+# NDArray extras (save/load/slice/reshape/dtype through C)
+# ----------------------------------------------------------------------
+def ndarray_save(fname, nds, names):
+    from .ndarray import save
+    if names:
+        save(fname, dict(zip(names, nds)))
+    else:
+        save(fname, list(nds))
+    return 0
+
+
+def ndarray_load(fname):
+    """-> (names list (may be empty), arrays list)."""
+    from .ndarray import load
+    data = load(fname)
+    if isinstance(data, dict):
+        names = sorted(data)
+        return names, [data[n] for n in names]
+    return [], list(data)
+
+
+def ndarray_dtype(nd):
+    from .base import dtype_np_to_mx
+    return int(dtype_np_to_mx(nd.dtype))
+
+
+def ndarray_slice(nd, begin, end):
+    return nd[int(begin):int(end)]
+
+
+def ndarray_reshape(nd, shape):
+    return nd.reshape(tuple(int(d) for d in shape))
+
+
+# ----------------------------------------------------------------------
+# executor training surface (backward + bound-array handles through C)
+# ----------------------------------------------------------------------
+def executor_bind_train(sym, shapes_json):
+    import json
+    from .context import current_context
+    shapes = {k: tuple(v) for k, v in json.loads(shapes_json).items()}
+    return sym.simple_bind(current_context(), grad_req="write", **shapes)
+
+
+def executor_backward(exec_):
+    exec_.backward()
+    return 0
+
+
+def executor_arg_handle(exec_, name):
+    return exec_.arg_dict[name]
+
+
+def executor_grad_handle(exec_, name):
+    g = exec_.grad_dict.get(name)
+    if g is None:
+        raise KeyError("no gradient bound for %r" % name)
+    return g
+
+
+def executor_arg_names(exec_):
+    return list(exec_._arg_names)
+
+
+# ----------------------------------------------------------------------
+# kvstore cluster queries
+# ----------------------------------------------------------------------
+def kvstore_rank(kv):
+    return int(kv.rank)
+
+
+def kvstore_num_workers(kv):
+    return int(kv.num_workers)
+
+
+def kvstore_type(kv):
+    return str(kv.type)
+
+
+def kvstore_barrier(kv):
+    kv.barrier()
+    return 0
+
+
+# ----------------------------------------------------------------------
+# misc (random seed, version, symbol aux/name)
+# ----------------------------------------------------------------------
+def random_seed(seed):
+    from . import random
+    random.seed(int(seed))
+    return 0
+
+
+def get_version():
+    import mxnet_tpu
+    return str(getattr(mxnet_tpu, "__version__", "0.0.0"))
+
+
+def symbol_aux_states(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def symbol_name(sym):
+    return str(getattr(sym, "name", "") or "")

@@ -771,6 +771,247 @@ int MXRecordIOReaderSeek(RecordIOHandle h, size_t pos) {
                               static_cast<Py_ssize_t>(pos)));
 }
 
+// ---- NDArray save/load/slice/reshape (c_api.cc:198-363 parity) -----
+int MXNDArraySave(const char* fname, uint32_t num, NDArrayHandle* handles,
+                  const char** keys) {
+  Gil gil;
+  PyObject* nds = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyObject* a = static_cast<PyObject*>(handles[i]);
+    Py_INCREF(a);
+    PyList_SetItem(nds, i, a);
+  }
+  PyObject* names = PyList_New(0);
+  if (keys) {
+    for (uint32_t i = 0; i < num; ++i) {
+      PyObject* s = PyUnicode_FromString(keys[i]);
+      PyList_Append(names, s);
+      Py_DECREF(s);
+    }
+  }
+  return CallRC("ndarray_save",
+                Py_BuildValue("(sNN)", fname, nds, names));
+}
+
+// out arrays live until this thread's next MXNDArrayLoad (ret_buf style)
+int MXNDArrayLoad(const char* fname, uint32_t* out_size,
+                  NDArrayHandle** out_arr, uint32_t* out_name_size,
+                  const char*** out_names) {
+  Gil gil;
+  PyObject* tup = Call("ndarray_load", Py_BuildValue("(s)", fname));
+  if (!tup) return -1;
+  PyObject* names = PyTuple_GetItem(tup, 0);
+  PyObject* nds = PyTuple_GetItem(tup, 1);
+  thread_local std::vector<PyObject*> arrs;
+  thread_local std::vector<std::string> name_store;
+  thread_local std::vector<const char*> name_ptrs;
+  for (PyObject* old : arrs) Py_XDECREF(old);
+  arrs.clear();
+  name_store.clear();
+  name_ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(nds); ++i) {
+    PyObject* a = PyList_GetItem(nds, i);
+    Py_INCREF(a);
+    arrs.push_back(a);
+  }
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+    name_store.push_back(s ? s : "");
+  }
+  for (auto& s : name_store) name_ptrs.push_back(s.c_str());
+  Py_DECREF(tup);
+  *out_size = static_cast<uint32_t>(arrs.size());
+  *out_arr = reinterpret_cast<NDArrayHandle*>(arrs.data());
+  *out_name_size = static_cast<uint32_t>(name_ptrs.size());
+  *out_names = name_ptrs.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle h, int* out) {
+  Gil gil;
+  PyObject* n = Call("ndarray_dtype",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!n) return -1;
+  *out = static_cast<int>(PyLong_AsLong(n));
+  Py_DECREF(n);
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle h, uint32_t begin, uint32_t end,
+                   NDArrayHandle* out) {
+  Gil gil;
+  PyObject* nd = Call("ndarray_slice",
+                      Py_BuildValue("(OII)", static_cast<PyObject*>(h),
+                                    begin, end));
+  if (!nd) return -1;
+  *out = nd;
+  return 0;
+}
+
+int MXNDArrayReshape(NDArrayHandle h, uint32_t ndim, const uint32_t* shape,
+                     NDArrayHandle* out) {
+  Gil gil;
+  PyObject* dims = PyTuple_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyTuple_SetItem(dims, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* nd = Call("ndarray_reshape",
+                      Py_BuildValue("(ON)", static_cast<PyObject*>(h),
+                                    dims));
+  if (!nd) return -1;
+  *out = nd;
+  return 0;
+}
+
+// ---- executor training surface (c_api.cc:939-1099 parity) ----------
+int MXExecutorSimpleBindTrain(SymbolHandle sym, const char* shapes_json,
+                              ExecutorHandle* out) {
+  Gil gil;
+  PyObject* exec_ = Call("executor_bind_train",
+                         Py_BuildValue("(Os)",
+                                       static_cast<PyObject*>(sym),
+                                       shapes_json));
+  if (!exec_) return -1;
+  *out = exec_;
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle h) {
+  Gil gil;
+  return CallRC("executor_backward",
+                PyTuple_Pack(1, static_cast<PyObject*>(h)));
+}
+
+// Handles to the executor's BOUND arrays (imperative updates through
+// them are seen by the next forward — the reference's arg/grad arrays).
+int MXExecutorArgHandle(ExecutorHandle h, const char* name,
+                        NDArrayHandle* out) {
+  Gil gil;
+  PyObject* nd = Call("executor_arg_handle",
+                      Py_BuildValue("(Os)", static_cast<PyObject*>(h),
+                                    name));
+  if (!nd) return -1;
+  *out = nd;
+  return 0;
+}
+
+int MXExecutorGradHandle(ExecutorHandle h, const char* name,
+                         NDArrayHandle* out) {
+  Gil gil;
+  PyObject* nd = Call("executor_grad_handle",
+                      Py_BuildValue("(Os)", static_cast<PyObject*>(h),
+                                    name));
+  if (!nd) return -1;
+  *out = nd;
+  return 0;
+}
+
+int MXExecutorNumArgs(ExecutorHandle h, uint32_t* out) {
+  Gil gil;
+  PyObject* lst = Call("executor_arg_names",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!lst) return -1;
+  *out = static_cast<uint32_t>(PyList_Size(lst));
+  Py_DECREF(lst);
+  return 0;
+}
+
+int MXExecutorArgName(ExecutorHandle h, uint32_t index, char* buf,
+                      size_t cap) {
+  Gil gil;
+  PyObject* lst = Call("executor_arg_names",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!lst) return -1;
+  if (index >= static_cast<uint32_t>(PyList_Size(lst))) {
+    Py_DECREF(lst);
+    SetError("arg index out of range");
+    return -1;
+  }
+  const char* name = PyUnicode_AsUTF8(PyList_GetItem(lst, index));
+  snprintf(buf, cap, "%s", name ? name : "");
+  Py_DECREF(lst);
+  return 0;
+}
+
+// ---- kvstore cluster queries (c_api.cc:1199-1375 parity) -----------
+int MXKVStoreGetRank(KVStoreHandle h, int* out) {
+  Gil gil;
+  PyObject* n = Call("kvstore_rank",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!n) return -1;
+  *out = static_cast<int>(PyLong_AsLong(n));
+  Py_DECREF(n);
+  return 0;
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle h, int* out) {
+  Gil gil;
+  PyObject* n = Call("kvstore_num_workers",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!n) return -1;
+  *out = static_cast<int>(PyLong_AsLong(n));
+  Py_DECREF(n);
+  return 0;
+}
+
+int MXKVStoreGetType(KVStoreHandle h, const char** out) {
+  Gil gil;
+  PyObject* s = Call("kvstore_type",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!s) return -1;
+  thread_local std::string ret;
+  const char* c = PyUnicode_AsUTF8(s);
+  ret = c ? c : "";
+  Py_DECREF(s);
+  *out = ret.c_str();
+  return 0;
+}
+
+int MXKVStoreBarrier(KVStoreHandle h) {
+  Gil gil;
+  return CallRC("kvstore_barrier",
+                PyTuple_Pack(1, static_cast<PyObject*>(h)));
+}
+
+// ---- misc ----------------------------------------------------------
+int MXRandomSeed(int seed) {
+  Gil gil;
+  return CallRC("random_seed", Py_BuildValue("(i)", seed));
+}
+
+int MXGetVersion(int* out) {
+  Gil gil;
+  PyObject* s = Call("get_version", PyTuple_New(0));
+  if (!s) return -1;
+  // "MAJOR.MINOR.PATCH" -> MAJOR*10000 + MINOR*100 + PATCH
+  const char* c = PyUnicode_AsUTF8(s);
+  int maj = 0, min = 0, pat = 0;
+  if (c) sscanf(c, "%d.%d.%d", &maj, &min, &pat);
+  *out = maj * 10000 + min * 100 + pat;
+  Py_DECREF(s);
+  return 0;
+}
+
+int MXSymbolGetNumAuxiliaryStates(SymbolHandle h, uint32_t* out) {
+  Gil gil;
+  PyObject* lst = Call("symbol_aux_states",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!lst) return -1;
+  *out = static_cast<uint32_t>(PyList_Size(lst));
+  Py_DECREF(lst);
+  return 0;
+}
+
+int MXSymbolGetName(SymbolHandle h, char* buf, size_t cap) {
+  Gil gil;
+  PyObject* s = Call("symbol_name",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!s) return -1;
+  const char* c = PyUnicode_AsUTF8(s);
+  snprintf(buf, cap, "%s", c ? c : "");
+  Py_DECREF(s);
+  return 0;
+}
+
 // ---- optimizer (c_api.cc:1525-1556 parity) -------------------------
 typedef void* OptimizerHandle;
 

@@ -4,6 +4,7 @@
  *
  * Built and run by `make test-capi`; expects MXTPU_SYMBOL_JSON to point
  * at a saved -symbol.json (the pytest wrapper generates one). */
+#include <math.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -320,6 +321,139 @@ int main(void) {
     remove("/tmp/mxtpu_capi_smoke.csv");
     printf("dataiter: %u listed (first=%s), CSVIter 2 batches OK\n",
            nit, itname);
+  }
+
+  /* --- a FULL training loop driven from C ---
+   * compose net -> bind grad_req=write -> loop { set data/label,
+   * forward(train), backward, MXOptimizerUpdate over bound arg/grad
+   * handles } -> cross-entropy must drop. */
+  {
+    int version = 0;
+    CHECK(MXGetVersion(&version));
+    CHECK(MXRandomSeed(42));
+    SymbolHandle v, fca, fcs, sma, tnet;
+    CHECK(MXSymbolCreateVariable("data", &v));
+    CHECK(MXSymbolCreateAtomicSymbol("FullyConnected",
+                                     "{\"num_hidden\": 2}", "tfc", &fca));
+    const char* tk[1] = {"data"};
+    SymbolHandle ta[1] = {v};
+    CHECK(MXSymbolCompose(fca, 1, tk, ta, &fcs));
+    CHECK(MXSymbolCreateAtomicSymbol("SoftmaxOutput", "", "softmax",
+                                     &sma));
+    SymbolHandle ta2[1] = {fcs};
+    CHECK(MXSymbolCompose(sma, 1, tk, ta2, &tnet));
+
+    ExecutorHandle tex;
+    CHECK(MXExecutorSimpleBindTrain(
+        tnet, "{\"data\": [8, 4], \"softmax_label\": [8]}", &tex));
+    /* init weights from C */
+    float w0[2 * 4], b0[2] = {0, 0};
+    for (int i = 0; i < 8; ++i) w0[i] = 0.05f * (i % 5) - 0.1f;
+    CHECK(MXExecutorSetArg(tex, "tfc_weight", w0, 8));
+    CHECK(MXExecutorSetArg(tex, "tfc_bias", b0, 2));
+    /* separable toy data: class = (x0 + x1 > x2 + x3) */
+    float data_t[8 * 4], label_t[8];
+    for (int i = 0; i < 8; ++i) {
+      for (int j = 0; j < 4; ++j)
+        data_t[i * 4 + j] = ((i * 7 + j * 13) % 11) / 11.0f - 0.5f;
+      label_t[i] = (data_t[i * 4] + data_t[i * 4 + 1] >
+                    data_t[i * 4 + 2] + data_t[i * 4 + 3]) ? 1.f : 0.f;
+    }
+    CHECK(MXExecutorSetArg(tex, "data", data_t, 32));
+    CHECK(MXExecutorSetArg(tex, "softmax_label", label_t, 8));
+
+    OptimizerHandle topt;
+    CHECK(MXOptimizerCreateOptimizer(
+        "sgd", "{\"learning_rate\": 0.5, \"momentum\": 0.9}", &topt));
+    NDArrayHandle warg, wgrad, barg, bgrad;
+    CHECK(MXExecutorArgHandle(tex, "tfc_weight", &warg));
+    CHECK(MXExecutorGradHandle(tex, "tfc_weight", &wgrad));
+    CHECK(MXExecutorArgHandle(tex, "tfc_bias", &barg));
+    CHECK(MXExecutorGradHandle(tex, "tfc_bias", &bgrad));
+
+    float first_loss = -1.f, loss = 0.f;
+    for (int step = 0; step < 40; ++step) {
+      uint32_t nout = 0;
+      CHECK(MXExecutorForward(tex, 1, &nout));
+      float probs[16];
+      CHECK(MXExecutorOutputCopy(tex, 0, probs, 16));
+      loss = 0.f;
+      for (int i = 0; i < 8; ++i) {
+        float p = probs[i * 2 + (int)label_t[i]];
+        loss += -(float)log(p > 1e-6f ? p : 1e-6f);
+      }
+      if (first_loss < 0) first_loss = loss;
+      CHECK(MXExecutorBackward(tex));
+      CHECK(MXOptimizerUpdate(topt, 0, warg, wgrad, -1.f, 0.f));
+      CHECK(MXOptimizerUpdate(topt, 1, barg, bgrad, -1.f, 0.f));
+    }
+    if (!(loss < first_loss * 0.5f)) {
+      fprintf(stderr, "FAIL C training loop: loss %f -> %f\n",
+              first_loss, loss);
+      return 1;
+    }
+    printf("train-from-C: loss %.3f -> %.3f over 40 steps (version %d)\n",
+           first_loss, loss, version);
+
+    /* checkpoint the trained weights through C and load them back */
+    NDArrayHandle saved[2] = {warg, barg};
+    const char* names[2] = {"arg:tfc_weight", "arg:tfc_bias"};
+    CHECK(MXNDArraySave("/tmp/mxtpu_capi_train.params", 2, saved, names));
+    uint32_t ln = 0, lnames_n = 0;
+    NDArrayHandle* larr = NULL;
+    const char** lnames = NULL;
+    CHECK(MXNDArrayLoad("/tmp/mxtpu_capi_train.params", &ln, &larr,
+                        &lnames_n, &lnames));
+    if (ln != 2 || lnames_n != 2 ||
+        strcmp(lnames[0], "arg:tfc_bias") != 0) {
+      fprintf(stderr, "FAIL save/load roundtrip (%u, %u)\n", ln, lnames_n);
+      return 1;
+    }
+    int dtype = -1;
+    CHECK(MXNDArrayGetDType(larr[0], &dtype));
+    NDArrayHandle resh;
+    uint32_t rshape[1] = {8};
+    CHECK(MXNDArrayReshape(larr[1], 1, rshape, &resh));
+    NDArrayHandle slc;
+    CHECK(MXNDArraySlice(resh, 2, 6, &slc));
+    uint32_t sn, ss[4];
+    CHECK(MXNDArrayGetShape(slc, &sn, ss, 4));
+    if (sn != 1 || ss[0] != 4) {
+      fprintf(stderr, "FAIL slice shape\n");
+      return 1;
+    }
+    remove("/tmp/mxtpu_capi_train.params");
+    printf("checkpoint-from-C: 2 arrays, dtype %d, reshape+slice OK\n",
+           dtype);
+    CHECK(MXNDArrayFree(resh));
+    CHECK(MXNDArrayFree(slc));
+    CHECK(MXNDArrayFree(warg));
+    CHECK(MXNDArrayFree(wgrad));
+    CHECK(MXNDArrayFree(barg));
+    CHECK(MXNDArrayFree(bgrad));
+    CHECK(MXOptimizerFree(topt));
+    CHECK(MXExecutorFree(tex));
+    CHECK(MXSymbolFree(v));
+    CHECK(MXSymbolFree(fca));
+    CHECK(MXSymbolFree(fcs));
+    CHECK(MXSymbolFree(sma));
+    CHECK(MXSymbolFree(tnet));
+  }
+
+  /* --- kvstore cluster queries --- */
+  {
+    int rank = -1, size = -1;
+    const char* ktype = NULL;
+    CHECK(MXKVStoreGetRank(kv, &rank));
+    CHECK(MXKVStoreGetGroupSize(kv, &size));
+    CHECK(MXKVStoreGetType(kv, &ktype));
+    CHECK(MXKVStoreBarrier(kv));
+    if (rank != 0 || size != 1 || strcmp(ktype, "local") != 0) {
+      fprintf(stderr, "FAIL kvstore queries: %d %d %s\n", rank, size,
+              ktype);
+      return 1;
+    }
+    printf("kvstore queries: rank %d/%d type %s\n", rank, size, ktype);
   }
 
   /* --- deliberate failures: the last-error contract --- */
