@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Deep Embedded Clustering (reference example/dec, Xie et al. 2016).
+
+Pipeline: (1) pretrain an autoencoder; (2) initialize cluster centroids
+(k-means-style from the embeddings); (3) refine encoder + centroids by
+minimizing KL(P || Q) where Q is the Student-t soft assignment of each
+embedding to each centroid and P is the sharpened target distribution.
+
+The KL-refinement gradient (DEC eq. 4) is computed host-side and fed
+into ``Executor.backward(out_grads)`` as the embedding cotangent — the
+same pattern the reference's dec.py uses (python-computed gradient into
+the solver), exercising the external-cotangent backward path.
+
+Run: python dec_toy.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+D_IN, D_HID, D_EMB, K, BATCH = 16, 32, 2, 3, 64
+
+
+def make_data(n, rng):
+    """Three gaussian clusters in a 16-d ambient space."""
+    centers = rng.randn(K, D_IN) * 4.0
+    y = rng.randint(0, K, size=n)
+    X = (centers[y] + rng.randn(n, D_IN)).astype(np.float32)
+    return X, y
+
+
+def autoencoder_symbols():
+    data = mx.sym.Variable("data")
+    enc = mx.sym.FullyConnected(data, num_hidden=D_HID, name="enc1")
+    enc = mx.sym.Activation(enc, act_type="relu", name="enc1a")
+    emb = mx.sym.FullyConnected(enc, num_hidden=D_EMB, name="emb")
+    dec = mx.sym.FullyConnected(emb, num_hidden=D_HID, name="dec1")
+    dec = mx.sym.Activation(dec, act_type="relu", name="dec1a")
+    rec = mx.sym.FullyConnected(dec, num_hidden=D_IN, name="rec")
+    loss = mx.sym.LinearRegressionOutput(rec, mx.sym.Variable("label"),
+                                         name="mse")
+    return loss, emb
+
+
+def soft_assign(z, mu):
+    """Student-t kernel Q (DEC eq. 1)."""
+    d2 = ((z[:, None, :] - mu[None, :, :]) ** 2).sum(axis=2)
+    q = 1.0 / (1.0 + d2)
+    return q / q.sum(axis=1, keepdims=True)
+
+
+def target_dist(q):
+    """Sharpened targets P (DEC eq. 3)."""
+    w = q ** 2 / q.sum(axis=0)
+    return w / w.sum(axis=1, keepdims=True)
+
+
+def kmeans(z, k, rng, iters=20):
+    mu = z[rng.choice(len(z), k, replace=False)]
+    for _ in range(iters):
+        a = ((z[:, None] - mu[None]) ** 2).sum(axis=2).argmin(axis=1)
+        for j in range(k):
+            if (a == j).any():
+                mu[j] = z[a == j].mean(axis=0)
+    return mu
+
+
+def main(pretrain_epochs=20, refine_steps=60):
+    rng = np.random.RandomState(0)
+    X, y_true = make_data(512, rng)
+
+    # (1) autoencoder pretraining (reconstruction)
+    ae, _ = autoencoder_symbols()
+    it = mx.io.NDArrayIter(X, X, batch_size=BATCH, shuffle=True,
+                           label_name="label")
+    ae_mod = mx.mod.Module(ae, context=mx.cpu(), label_names=["label"])
+    ae_mod.fit(it, num_epoch=pretrain_epochs, optimizer="adam",
+               optimizer_params={"learning_rate": 0.003},
+               eval_metric="mse")
+    args, _aux = ae_mod.get_params()
+
+    # (2) embed everything, init centroids
+    _, emb_sym = autoencoder_symbols()
+    enc_exe = emb_sym.simple_bind(mx.cpu(0), data=(len(X), D_IN),
+                                  grad_req="write")
+    enc_exe.copy_params_from(
+        {k: v for k, v in args.items() if k in enc_exe.arg_dict},
+        allow_extra_params=True)
+    Z = enc_exe.forward(data=X)[0].asnumpy()
+    mu = kmeans(Z.copy(), K, rng)
+
+    def cluster_acc(assign):
+        """Best-map accuracy over the K! label permutations (K=3)."""
+        from itertools import permutations
+        return max(np.mean(np.array([p[a] for a in assign]) == y_true)
+                   for p in permutations(range(K)))
+
+    q0 = soft_assign(Z, mu)
+    acc0 = cluster_acc(q0.argmax(axis=1))
+
+    # (3) KL refinement (DEC eq. 4/5, alpha=1):
+    #   dL/dz_i  =  2 sum_j (1+|z_i-mu_j|^2)^-1 (p_ij-q_ij)(z_i-mu_j)
+    #   dL/dmu_j = -2 sum_i (1+|z_i-mu_j|^2)^-1 (p_ij-q_ij)(z_i-mu_j)
+    opt = mx.optimizer.create("adam", learning_rate=0.003)
+    updater = mx.optimizer.get_updater(opt)
+    for step in range(refine_steps):
+        Z = enc_exe.forward(is_train=True, data=X)[0].asnumpy()
+        q = soft_assign(Z, mu)
+        p = target_dist(q)
+        diff = Z[:, None, :] - mu[None, :, :]
+        w = (p - q) / (1.0 + (diff ** 2).sum(axis=2))
+        dz = 2.0 * (w[:, :, None] * diff).sum(axis=1) / len(Z)
+        enc_exe.backward([mx.nd.array(dz.astype(np.float32))])
+        for i, name in enumerate(enc_exe._arg_names):
+            if name == "data":
+                continue
+            updater(i, enc_exe.grad_dict[name], enc_exe.arg_dict[name])
+        dmu = -2.0 * (w[:, :, None] * diff).sum(axis=0) / len(Z)
+        mu -= 0.1 * dmu
+
+    Z = enc_exe.forward(data=X)[0].asnumpy()
+    acc1 = cluster_acc(soft_assign(Z, mu).argmax(axis=1))
+    print("cluster accuracy: %.3f (init) -> %.3f (refined)" % (acc0, acc1))
+    return acc0, acc1
+
+
+if __name__ == "__main__":
+    acc0, acc1 = main()
+    assert acc1 > 0.9 and acc1 >= acc0 - 0.02, (acc0, acc1)
+    print("OK dec example")

@@ -205,3 +205,11 @@ def test_nce_example():
     r = _run(os.path.join(REPO, "example/nce-loss"), "nce_demo.py")
     assert r.returncode == 0, r.stderr[-1500:]
     assert "OK nce example" in r.stdout
+
+
+def test_dec_example():
+    """Deep Embedded Clustering: AE pretrain + KL refinement with an
+    external cotangent improves cluster accuracy (reference example/dec)."""
+    r = _run(os.path.join(REPO, "example/dec"), "dec_toy.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK dec example" in r.stdout
