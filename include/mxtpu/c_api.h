@@ -78,6 +78,11 @@ int MXFuncGetInfo(FunctionHandle fn, const char** name,
                   const char** description, uint32_t* num_args,
                   const char*** arg_names, const char*** arg_types,
                   const char*** arg_descriptions);
+/* imperative invoke on NDArrays (outputs are new handles; cap = size of
+ * the caller's out array) */
+int MXFuncInvoke(FunctionHandle fn, uint32_t num_in, NDArrayHandle* in,
+                 const char* kwargs_json, uint32_t* num_out,
+                 NDArrayHandle* out, uint32_t cap);
 
 /* -- symbol compose / attrs through C (c_api.cc:447-937 parity).
  * kwargs_json carries op params ({"num_hidden": 4, "kernel": [3, 3]});

@@ -472,3 +472,24 @@ def symbol_aux_states(sym):
 
 def symbol_name(sym):
     return str(getattr(sym, "name", "") or "")
+
+
+def func_invoke(name, kwargs_json, nd_args):
+    """Imperative registered-function call (MXFuncInvoke parity): run op
+    ``name`` eagerly on NDArray inputs, return the output list."""
+    import json
+    from .ndarray import NDArray
+    from .ops.registry import create_operator
+    kwargs = {k: _coerce_json_value(v)
+              for k, v in (json.loads(kwargs_json) if kwargs_json else {}).items()}
+    op = create_operator(name, **kwargs)
+    n_aux = len(op.list_auxiliary_states())
+    if n_aux:
+        raise ValueError("func_invoke: %r needs aux state; bind it in a "
+                         "graph instead" % name)
+    rng = None
+    if getattr(op, "need_rng", False):
+        from . import random as _random
+        rng = _random.next_key()
+    outs, _aux = op.forward([nd.data for nd in nd_args], [], False, rng)
+    return [NDArray(o) for o in outs]

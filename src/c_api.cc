@@ -455,6 +455,42 @@ int MXFuncGetInfo(FunctionHandle fn, const char** name,
   return 0;
 }
 
+// Imperative invoke of a registered function on NDArrays (MXFuncInvoke
+// parity, c_api.cc:410).  fn must come from MXListFunctions; outputs are
+// new handles written to out[0..*num_out-1] (cap = caller array size).
+int MXFuncInvoke(FunctionHandle fn, uint32_t num_in, NDArrayHandle* in,
+                 const char* kwargs_json, uint32_t* num_out,
+                 NDArrayHandle* out, uint32_t cap) {
+  Gil gil;
+  auto* fi = static_cast<FuncInfo*>(fn);
+  if (!fi) { SetError("null function handle"); return -1; }
+  PyObject* args = PyList_New(num_in);
+  for (uint32_t i = 0; i < num_in; ++i) {
+    PyObject* a = static_cast<PyObject*>(in[i]);
+    Py_INCREF(a);
+    PyList_SetItem(args, i, a);
+  }
+  PyObject* outs = Call("func_invoke",
+                        Py_BuildValue("(ssN)", fi->name.c_str(),
+                                      kwargs_json ? kwargs_json : "",
+                                      args));
+  if (!outs) return -1;
+  uint32_t n = static_cast<uint32_t>(PyList_Size(outs));
+  if (n > cap) {
+    Py_DECREF(outs);
+    SetError("output count exceeds caller buffer");
+    return -1;
+  }
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(outs, i);
+    Py_INCREF(o);
+    out[i] = o;
+  }
+  if (num_out) *num_out = n;
+  Py_DECREF(outs);
+  return 0;
+}
+
 // ---- symbol compose / attrs (c_api.cc:447-937 parity) --------------
 int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
   Gil gil;

@@ -174,6 +174,40 @@ int main(void) {
     fprintf(stderr, "FAIL: Convolution not listed\n");
     return 1;
   }
+  /* imperative invoke through the registry: dot((2,3),(3,2)) */
+  {
+    FunctionHandle dot_fn = NULL;
+    for (uint32_t i = 0; i < nfn; ++i) {
+      const char* fname;
+      CHECK(MXFuncGetInfo(fns[i], &fname, NULL, NULL, NULL, NULL, NULL));
+      if (strcmp(fname, "dot") == 0) dot_fn = fns[i];
+    }
+    if (!dot_fn) {
+      fprintf(stderr, "FAIL: dot not in registry\n");
+      return 1;
+    }
+    uint32_t s23[2] = {2, 3}, s32[2] = {3, 2};
+    NDArrayHandle da, db, douts[4];
+    CHECK(MXNDArrayCreate(s23, 2, &da));
+    CHECK(MXNDArrayCreate(s32, 2, &db));
+    float fa[6] = {1, 2, 3, 4, 5, 6}, fb[6] = {1, 0, 0, 1, 1, 1};
+    CHECK(MXNDArraySyncCopyFromCPU(da, fa, 6));
+    CHECK(MXNDArraySyncCopyFromCPU(db, fb, 6));
+    uint32_t ndout = 0;
+    NDArrayHandle din[2] = {da, db};
+    CHECK(MXFuncInvoke(dot_fn, 2, din, "", &ndout, douts, 4));
+    float dres[4];
+    CHECK(MXNDArraySyncCopyToCPU(douts[0], dres, 4));
+    /* [[1,2,3],[4,5,6]] x [[1,0],[0,1],[1,1]] = [[4,5],[10,11]] */
+    if (ndout != 1 || dres[0] != 4.f || dres[3] != 11.f) {
+      fprintf(stderr, "FAIL MXFuncInvoke dot: %f %f\n", dres[0], dres[3]);
+      return 1;
+    }
+    printf("func-invoke: dot through the registry OK\n");
+    CHECK(MXNDArrayFree(da));
+    CHECK(MXNDArrayFree(db));
+    CHECK(MXNDArrayFree(douts[0]));
+  }
 
   /* --- compose a symbol entirely through C --- */
   SymbolHandle var, fc_atomic, fc, sm_atomic, net;
