@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Generalized linear regression (reference example/GLRegression role):
+the three regression output layers — linear (identity link), logistic
+(sigmoid link), MAE (robust L1) — fit with FeedForward.
+
+Run: python glregression.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def fit(head, X, Y, label_name, epochs=20, lr=0.1):
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=1, name="w")
+    net = head(out, mx.sym.Variable(label_name), name="out")
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True,
+                           label_name=label_name)
+    model = mx.FeedForward(net, ctx=mx.cpu(), num_epoch=epochs,
+                           optimizer="sgd", learning_rate=lr)
+    model.fit(it, eval_metric="mse")
+    return model
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n, d = 512, 5
+    X = rng.randn(n, d).astype(np.float32)
+    w_true = rng.randn(d).astype(np.float32)
+
+    # linear: y = Xw + noise
+    y_lin = (X @ w_true + 0.1 * rng.randn(n)).astype(np.float32)
+    m = fit(mx.sym.LinearRegressionOutput, X, y_lin[:, None], "out_label")
+    w_hat = m.arg_params["w_weight"].asnumpy().ravel()
+    err_lin = np.abs(w_hat - w_true).max()
+    print("linear: max |w_hat - w| = %.3f" % err_lin)
+
+    # logistic: p = sigmoid(Xw)
+    y_log = (1 / (1 + np.exp(-(X @ w_true))) >
+             rng.rand(n)).astype(np.float32)
+    m = fit(mx.sym.LogisticRegressionOutput, X, y_log[:, None],
+            "out_label", epochs=30, lr=0.3)
+    p = m.predict(mx.io.NDArrayIter(X, y_log[:, None], batch_size=32,
+                                    label_name="out_label")).ravel()
+    acc = ((p > 0.5) == y_log).mean()
+    # labels are sampled from sigmoid(Xw): compare against the accuracy
+    # the TRUE weights achieve (the Bayes ceiling), not an absolute bar
+    bayes = (((X @ w_true) > 0) == y_log).mean()
+    print("logistic: accuracy %.3f (true-w ceiling %.3f)" % (acc, bayes))
+    acc_gap = bayes - acc
+
+    # MAE: heavy-tailed noise, L1 regression stays robust
+    y_mae = (X @ w_true + np.where(rng.rand(n) < 0.1,
+                                   20 * rng.randn(n),
+                                   0.1 * rng.randn(n))).astype(np.float32)
+    m = fit(mx.sym.MAERegressionOutput, X, y_mae[:, None], "out_label",
+            epochs=40, lr=0.05)
+    w_hat = m.arg_params["w_weight"].asnumpy().ravel()
+    err_mae = np.abs(w_hat - w_true).max()
+    print("MAE (10%% outliers): max |w_hat - w| = %.3f" % err_mae)
+    return err_lin, acc_gap, err_mae
+
+
+if __name__ == "__main__":
+    err_lin, acc_gap, err_mae = main()
+    assert err_lin < 0.1 and acc_gap < 0.05 and err_mae < 0.5, \
+        (err_lin, acc_gap, err_mae)
+    print("OK glregression example")

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Metric-learning loss via MakeLoss (reference example/MLLoss role):
+a contrastive embedding loss written as symbol arithmetic and turned
+into a training objective with ``MakeLoss`` — same-class pairs pulled
+together, different-class pairs pushed beyond a margin.
+
+Run: python metric_loss.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+EMB, MARGIN, BATCH = 8, 2.0, 32
+
+
+def build_net():
+    """Paired inputs: (a, b) with pair label 1=same class, 0=different."""
+    a = mx.sym.Variable("data_a")
+    b = mx.sym.Variable("data_b")
+    same = mx.sym.Variable("same")
+
+    def embed(x):
+        # shared weights: same names on both towers (siamese pattern)
+        h = mx.sym.FullyConnected(x, num_hidden=16, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu", name="fc1a")
+        return mx.sym.FullyConnected(h, num_hidden=EMB, name="fc2")
+
+    ea, eb = embed(a), embed(b)
+    d2 = mx.sym.sum(mx.sym.square(ea - eb), axis=(1,))
+    d = mx.sym.sqrt(d2 + 1e-8)
+    # contrastive: same -> d^2 ; different -> max(0, margin - d)^2
+    push = mx.sym._MaximumScalar(MARGIN - d, scalar=0.0)
+    loss = same * d2 + (1.0 - same) * mx.sym.square(push)
+    return mx.sym.MakeLoss(loss, normalization="batch", name="mlloss")
+
+
+def make_pairs(X, y, n_pairs, rng):
+    idx_a = rng.randint(0, len(X), n_pairs)
+    idx_b = rng.randint(0, len(X), n_pairs)
+    return (X[idx_a], X[idx_b],
+            (y[idx_a] == y[idx_b]).astype(np.float32))
+
+
+def main(steps=300):
+    rng = np.random.RandomState(0)
+    classes = 4
+    centers = rng.randn(classes, 12) * 2.0
+    y = rng.randint(0, classes, size=512)
+    X = (centers[y] + 0.5 * rng.randn(512, 12)).astype(np.float32)
+
+    net = build_net()
+    exe = net.simple_bind(mx.cpu(0), data_a=(BATCH, 12),
+                          data_b=(BATCH, 12), same=(BATCH,),
+                          grad_req="write")
+    init = mx.init.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data_a", "data_b", "same"):
+            init(name, arr)
+    opt = mx.optimizer.create("adam", learning_rate=0.01)
+    states = exe.init_fused_states(opt)
+
+    for step in range(1, steps + 1):
+        A, B, same = make_pairs(X, y, BATCH, rng)
+        states = exe.fused_step(opt, states, step, data_a=A, data_b=B,
+                                same=same)
+
+    # evaluate: distance separates same/different pairs
+    A, B, same = make_pairs(X, y, 512, rng)
+    exe2 = net.simple_bind(mx.cpu(0), data_a=(512, 12),
+                           data_b=(512, 12), same=(512,))
+    exe2.copy_params_from({k: v for k, v in exe.arg_dict.items()
+                           if k not in ("data_a", "data_b", "same")},
+                          allow_extra_params=True)
+    # the loss symbol's value IS per-pair loss; recompute distances from
+    # a fresh embed-only bind for the report
+    loss = exe2.forward(is_train=False, data_a=A, data_b=B,
+                        same=same)[0].asnumpy()
+    same_loss = loss[same == 1].mean()
+    diff_loss = loss[same == 0].mean()
+    print("mean loss: same-pairs %.3f, diff-pairs %.3f" % (same_loss,
+                                                           diff_loss))
+    return same_loss, diff_loss
+
+
+if __name__ == "__main__":
+    same_loss, diff_loss = main()
+    assert same_loss < 0.3 and diff_loss < 0.5, (same_loss, diff_loss)
+    print("OK mlloss example")

@@ -213,3 +213,18 @@ def test_dec_example():
     r = _run(os.path.join(REPO, "example/dec"), "dec_toy.py")
     assert r.returncode == 0, r.stderr[-1500:]
     assert "OK dec example" in r.stdout
+
+
+def test_glregression_example():
+    """Linear/logistic/MAE regression heads (reference example/GLRegression)."""
+    r = _run(os.path.join(REPO, "example/GLRegression"), "glregression.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK glregression example" in r.stdout
+
+
+def test_mlloss_example():
+    """Contrastive metric loss via MakeLoss + siamese shared weights
+    (reference example/MLLoss)."""
+    r = _run(os.path.join(REPO, "example/MLLoss"), "metric_loss.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK mlloss example" in r.stdout
