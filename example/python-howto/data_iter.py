@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""How-to: write a custom DataIter (reference
+example/python-howto/data_iter.py)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataBatch, DataIter
+
+
+class SimpleIter(DataIter):
+    """A DataIter is: provide_data/provide_label descriptors + next()
+    raising StopIteration + reset()."""
+
+    def __init__(self, batches=10, batch_size=16):
+        super().__init__()
+        self.batches = batches
+        self.batch_size = batch_size
+        self.cur = 0
+        self.rng = np.random.RandomState(0)
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size, 4))]
+
+    @property
+    def provide_label(self):
+        return [("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        if self.cur >= self.batches:
+            raise StopIteration
+        self.cur += 1
+        X = self.rng.rand(self.batch_size, 4).astype(np.float32)
+        y = (X.sum(axis=1) > 2).astype(np.float32)
+        return DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)])
+
+
+if __name__ == "__main__":
+    it = SimpleIter()
+    mod = mx.mod.Module(mx.models.get_mlp(2, (16,)), context=mx.cpu())
+    mod.fit(it, num_epoch=25, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    it.reset()
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    print("custom-iter accuracy %.3f" % acc)
+    assert acc > 0.9
+    print("OK data_iter howto")

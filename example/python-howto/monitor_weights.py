@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""How-to: watch per-op statistics during training with Monitor
+(reference example/python-howto/monitor_weights.py).  Stats stream from
+the COMPILED program via jax.debug.callback — see docs/env_vars.md
+MXTPU_MONITOR_MODE."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+if __name__ == "__main__":
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 10).astype(np.float32)
+    y = (X.sum(axis=1) > 5).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+
+    mod = mx.mod.Module(mx.models.get_mlp(2, (8,)), context=mx.cpu())
+    mon = mx.Monitor(interval=1, pattern=".*output")   # regex filter
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.install_monitor(mon)
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.2})
+
+    seen = set()
+    for batch in train:
+        mon.tic()
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        for _step, name, stat in mon.toc():   # stat is a tab-joined str
+            seen.add(name)
+            print("%-24s |x|/size = %s" % (name, stat.strip()))
+        break
+    assert any("output" in n for n in seen), seen
+    print("OK monitor howto")

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""How-to: multi-output symbols with Group and reading internals
+(reference example/python-howto/multiple_outputs.py)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+if __name__ == "__main__":
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    out = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    # 1) Group: expose an internal alongside the head
+    grouped = mx.sym.Group([out, act])
+    print("grouped outputs:", grouped.list_outputs())
+
+    exe = grouped.simple_bind(mx.cpu(0), data=(4, 10))
+    init = mx.init.Uniform(0.2)
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            init(name, arr)
+    probs, hidden = exe.forward(
+        is_train=False, data=np.random.rand(4, 10).astype(np.float32))
+    assert probs.shape == (4, 2) and hidden.shape == (4, 8)
+
+    # 2) get_internals: fish out any intermediate after the fact
+    internals = out.get_internals()
+    print("internals:", internals.list_outputs()[:6], "...")
+    sub = internals["relu1_output"]
+    assert sub.list_arguments()[:1] == ["data"]
+    print("OK multiple_outputs howto")

@@ -228,3 +228,16 @@ def test_mlloss_example():
     r = _run(os.path.join(REPO, "example/MLLoss"), "metric_loss.py")
     assert r.returncode == 0, r.stderr[-1500:]
     assert "OK mlloss example" in r.stdout
+
+
+def test_python_howto_scripts():
+    """The three how-to walkthroughs run clean (reference
+    example/python-howto): custom DataIter, Monitor stats, multi-output
+    symbols + get_internals."""
+    for script, marker in [("data_iter.py", "OK data_iter howto"),
+                           ("monitor_weights.py", "OK monitor howto"),
+                           ("multiple_outputs.py",
+                            "OK multiple_outputs howto")]:
+        r = _run(os.path.join(REPO, "example/python-howto"), script)
+        assert r.returncode == 0, (script, r.stderr[-1200:])
+        assert marker in r.stdout, script
