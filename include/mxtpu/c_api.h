@@ -163,6 +163,10 @@ int MXExecutorNumArgs(ExecutorHandle h, uint32_t* out);
 int MXExecutorArgName(ExecutorHandle h, uint32_t index, char* buf,
                       size_t cap);
 
+/* execution-plan dump + symbol attributes (thread-local ret storage) */
+int MXExecutorPrint(ExecutorHandle h, const char** out);
+int MXSymbolListAttrJSON(SymbolHandle h, const char** out);
+
 /* -- kvstore cluster queries + barrier */
 int MXKVStoreGetRank(KVStoreHandle h, int* out);
 int MXKVStoreGetGroupSize(KVStoreHandle h, int* out);

@@ -493,3 +493,14 @@ def func_invoke(name, kwargs_json, nd_args):
         rng = _random.next_key()
     outs, _aux = op.forward([nd.data for nd in nd_args], [], False, rng)
     return [NDArray(o) for o in outs]
+
+
+def executor_print(exec_):
+    """Execution-plan dump (MXExecutorPrint / GraphExecutor::Print)."""
+    return exec_.debug_str()
+
+
+def symbol_attr_json(sym):
+    """All attributes as JSON (MXSymbolListAttr parity)."""
+    import json
+    return json.dumps(sym.attr_dict())

@@ -968,6 +968,36 @@ int MXExecutorArgName(ExecutorHandle h, uint32_t index, char* buf,
   return 0;
 }
 
+// Execution-plan dump (MXExecutorPrint / GraphExecutor::Print parity,
+// graph_executor.cc:955).  *out valid until this thread's next call.
+int MXExecutorPrint(ExecutorHandle h, const char** out) {
+  Gil gil;
+  PyObject* s = Call("executor_print",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!s) return -1;
+  thread_local std::string ret;
+  const char* c = PyUnicode_AsUTF8(s);
+  ret = c ? c : "";
+  Py_DECREF(s);
+  *out = ret.c_str();
+  return 0;
+}
+
+// All symbol attributes as JSON (MXSymbolListAttr parity); *out valid
+// until this thread's next call.
+int MXSymbolListAttrJSON(SymbolHandle h, const char** out) {
+  Gil gil;
+  PyObject* s = Call("symbol_attr_json",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!s) return -1;
+  thread_local std::string ret;
+  const char* c = PyUnicode_AsUTF8(s);
+  ret = c ? c : "";
+  Py_DECREF(s);
+  *out = ret.c_str();
+  return 0;
+}
+
 // ---- kvstore cluster queries (c_api.cc:1199-1375 parity) -----------
 int MXKVStoreGetRank(KVStoreHandle h, int* out) {
   Gil gil;

@@ -474,6 +474,37 @@ int main(void) {
     CHECK(MXSymbolFree(tnet));
   }
 
+  /* --- executor plan dump + symbol attrs through C --- */
+  {
+    SymbolHandle pv, pfa, pnet;
+    CHECK(MXSymbolCreateVariable("data", &pv));
+    CHECK(MXSymbolCreateAtomicSymbol("FullyConnected",
+                                     "{\"num_hidden\": 2}", "pfc", &pfa));
+    const char* pk[1] = {"data"};
+    SymbolHandle pa[1] = {pv};
+    CHECK(MXSymbolCompose(pfa, 1, pk, pa, &pnet));
+    CHECK(MXSymbolSetAttr(pnet, "lr_mult", "2.0"));
+    const char* attrs_json = NULL;
+    CHECK(MXSymbolListAttrJSON(pnet, &attrs_json));
+    if (strstr(attrs_json, "lr_mult") == NULL) {
+      fprintf(stderr, "FAIL attr json: %s\n", attrs_json);
+      return 1;
+    }
+    ExecutorHandle pex;
+    CHECK(MXExecutorSimpleBind(pnet, "{\"data\": [2, 3]}", &pex));
+    const char* plan = NULL;
+    CHECK(MXExecutorPrint(pex, &plan));
+    if (strstr(plan, "pfc") == NULL) {
+      fprintf(stderr, "FAIL executor print lacks op: %.120s\n", plan);
+      return 1;
+    }
+    printf("plan-dump: %zu chars, attrs json OK\n", strlen(plan));
+    CHECK(MXExecutorFree(pex));
+    CHECK(MXSymbolFree(pv));
+    CHECK(MXSymbolFree(pfa));
+    CHECK(MXSymbolFree(pnet));
+  }
+
   /* --- kvstore cluster queries --- */
   {
     int rank = -1, size = -1;
