@@ -241,3 +241,10 @@ def test_python_howto_scripts():
         r = _run(os.path.join(REPO, "example/python-howto"), script)
         assert r.returncode == 0, (script, r.stderr[-1200:])
         assert marker in r.stdout, script
+
+
+def test_rtc_example():
+    """Runtime-compiled Pallas / traceable kernels on NDArrays."""
+    r = _run(os.path.join(REPO, "example/rtc"), "pallas_kernel.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK rtc example" in r.stdout
