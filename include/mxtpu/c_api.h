@@ -136,7 +136,9 @@ int MXRecordIOReaderReadRecord(RecordIOHandle h, const char** out,
 int MXRecordIOReaderSeek(RecordIOHandle h, size_t pos);
 
 /* -- NDArray save/load (checkpoint format), slice/reshape/dtype.
- * MXNDArrayLoad's out arrays live until this thread's next load. */
+ * MXNDArrayLoad: the handle/name ARRAYS are valid until this thread's
+ * next load; each loaded handle is owned by the caller (MXNDArrayFree
+ * it like any other NDArrayHandle). */
 int MXNDArraySave(const char* fname, uint32_t num, NDArrayHandle* handles,
                   const char** keys);
 int MXNDArrayLoad(const char* fname, uint32_t* out_size,

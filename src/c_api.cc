@@ -829,7 +829,9 @@ int MXNDArraySave(const char* fname, uint32_t num, NDArrayHandle* handles,
                 Py_BuildValue("(sNN)", fname, nds, names));
 }
 
-// out arrays live until this thread's next MXNDArrayLoad (ret_buf style)
+// The handle ARRAY and name strings live until this thread's next
+// MXNDArrayLoad; each handle itself is owned by the CALLER (free with
+// MXNDArrayFree, like every other NDArrayHandle in this ABI).
 int MXNDArrayLoad(const char* fname, uint32_t* out_size,
                   NDArrayHandle** out_arr, uint32_t* out_name_size,
                   const char*** out_names) {
@@ -841,13 +843,12 @@ int MXNDArrayLoad(const char* fname, uint32_t* out_size,
   thread_local std::vector<PyObject*> arrs;
   thread_local std::vector<std::string> name_store;
   thread_local std::vector<const char*> name_ptrs;
-  for (PyObject* old : arrs) Py_XDECREF(old);
-  arrs.clear();
+  arrs.clear();          // pointer storage only: caller owns the refs
   name_store.clear();
   name_ptrs.clear();
   for (Py_ssize_t i = 0; i < PyList_Size(nds); ++i) {
     PyObject* a = PyList_GetItem(nds, i);
-    Py_INCREF(a);
+    Py_INCREF(a);        // transferred to the caller
     arrs.push_back(a);
   }
   for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {

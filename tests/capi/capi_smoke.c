@@ -459,6 +459,7 @@ int main(void) {
     remove("/tmp/mxtpu_capi_train.params");
     printf("checkpoint-from-C: 2 arrays, dtype %d, reshape+slice OK\n",
            dtype);
+    for (uint32_t i = 0; i < ln; ++i) CHECK(MXNDArrayFree(larr[i]));
     CHECK(MXNDArrayFree(resh));
     CHECK(MXNDArrayFree(slc));
     CHECK(MXNDArrayFree(warg));

@@ -13,13 +13,17 @@ import pytest
 import mxnet_tpu as mx
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# import the tool modules, then drop the path again: generic names like
+# "utils" must not shadow other tests' imports for the session
 sys.path.insert(0, os.path.join(_ROOT, "tools", "accnn"))
-
-import utils            # noqa: E402
-import acc_fc           # noqa: E402
-import acc_conv         # noqa: E402
-import rank_selection   # noqa: E402
-import accnn as accnn_mod  # noqa: E402
+try:
+    import utils            # noqa: E402
+    import acc_fc           # noqa: E402
+    import acc_conv         # noqa: E402
+    import rank_selection   # noqa: E402
+    import accnn as accnn_mod  # noqa: E402
+finally:
+    sys.path.pop(0)
 
 rng = np.random.RandomState(0)
 
@@ -93,3 +97,28 @@ def test_accnn_whole_model(tmp_path):
     utils.save_model(m2, str(tmp_path / "fast"), 0)
     acc1 = _score(str(tmp_path / "fast"), 0, X, y)
     assert acc1 > acc0 - 0.1, (acc0, acc1)
+
+
+def test_replace_layer_preserves_producer_output_index(tmp_path):
+    """The decomposed layer may consume a NON-FIRST output of its
+    producer (review regression): splice must keep that output index."""
+    data = mx.sym.Variable("data")
+    halves = mx.sym.SliceChannel(data, num_outputs=2, name="split")
+    fc = mx.sym.FullyConnected(halves[1], num_hidden=4, name="fc1")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+
+    x = rng.rand(2, 6).astype(np.float32)
+    w = rng.rand(4, 3).astype(np.float32)
+    b = rng.rand(4).astype(np.float32)
+    args = {"fc1_weight": mx.nd.array(w), "fc1_bias": mx.nd.array(b)}
+
+    def run(sym, params):
+        exe = sym.simple_bind(mx.cpu(0), data=(2, 6))
+        exe.copy_params_from(params, allow_extra_params=True)
+        return exe.forward(data=x)[0].asnumpy()
+
+    want = run(net, args)
+    model = {"symbol": net, "arg_params": dict(args), "aux_params": {}}
+    m2 = acc_fc.fc_decomposition(model, "fc1", K=10**9)  # full rank
+    got = run(m2["symbol"], m2["arg_params"])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

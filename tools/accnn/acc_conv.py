@@ -22,6 +22,13 @@ def conv_vh_decomposition(model, layer, K):
     attr = node.get("attr", {})
     pad = eval(attr.get("pad", "(0, 0)"))
     stride = eval(attr.get("stride", "(1, 1)"))
+    dilate = eval(attr.get("dilate", "(1, 1)"))
+    groups = int(attr.get("num_group", 1))
+    if tuple(dilate) != (1, 1) or groups != 1:
+        raise ValueError(
+            "conv_vh_decomposition: %r has dilate=%s num_group=%d — the "
+            "V-H factorization only covers dense non-dilated convs"
+            % (layer, tuple(dilate), groups))
 
     M = W.transpose((1, 2, 0, 3)).reshape((C * y, N * x))
     U, D, Qt = np.linalg.svd(M, full_matrices=False)

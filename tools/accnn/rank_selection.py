@@ -49,17 +49,27 @@ def get_ranksel(model, ratio, min_energy=0.0):
         if name + "_weight" not in model["arg_params"]:
             continue
         if op == "Convolution":
-            kernel = eval(node.get("attr", {}).get("kernel", "(1, 1)"))
+            attr = node.get("attr", {})
+            kernel = eval(attr.get("kernel", "(1, 1)"))
             if len(kernel) != 2 or (kernel[0] == 1 and kernel[1] == 1):
                 continue            # 1x1 convs gain nothing from V-H
-        D, W = _spectrum(model, name, op)
+            if eval(attr.get("dilate", "(1, 1)")) != (1, 1) or \
+                    int(attr.get("num_group", 1)) != 1:
+                continue            # V-H covers dense non-dilated only
+        W = model["arg_params"][name + "_weight"].asnumpy()
         budget = _cost(op, W) / float(ratio)
         k_budget = max(1, int(budget // _cost(op, W, 1)))
         K = k_budget
-        if min_energy > 0:
+        if op == "Convolution":
+            max_rank = min(W.shape[1] * W.shape[2],
+                           W.shape[0] * W.shape[3])
+        else:
+            max_rank = min(W.shape[0], int(np.prod(W.shape[1:])))
+        if min_energy > 0:          # spectrum only when actually needed
+            D, _ = _spectrum(model, name, op)
             energy = np.cumsum(D ** 2) / np.sum(D ** 2)
             K = max(K, int(np.searchsorted(energy, min_energy) + 1))
-        K = int(min(K, D.size))
+        K = int(min(K, max_rank))
         if _cost(op, W, K) >= _cost(op, W):
             continue            # decomposition saves nothing here
         sel[name] = K

@@ -69,10 +69,12 @@ def replace_layer(symbol, layer_name, sub_symbol):
     new_nodes = [copy_node(n) for n in nodes[:target]]
 
     donor2new = {}
+    placeholder = set()
     spliced_out = None
     for j, node in enumerate(donor_nodes):
         if node["op"] == "null" and node["name"] == "data":
             donor2new[j] = data_input[0]     # target's upstream node
+            placeholder.add(j)
             continue
         donor2new[j] = len(new_nodes)
         spliced_out = len(new_nodes)
@@ -82,11 +84,14 @@ def replace_layer(symbol, layer_name, sub_symbol):
     shift = len(new_nodes) - target - 1
 
     for j, node in enumerate(donor_nodes):
-        k = donor2new[j]
-        if node["op"] == "null" and node["name"] == "data":
+        if j in placeholder:
             continue
-        new_nodes[k]["inputs"] = [[donor2new[r[0]], r[1]]
-                                  for r in node["inputs"]]
+        # refs to the placeholder keep the PRODUCER's output index (the
+        # replaced layer may have consumed a non-first output)
+        new_nodes[donor2new[j]]["inputs"] = [
+            [donor2new[r[0]],
+             data_input[1] if r[0] in placeholder else r[1]]
+            for r in node["inputs"]]
 
     def map_old(ref):
         idx, out = ref
