@@ -30,9 +30,15 @@ __all__ = ["ShardedTrainer"]
 
 
 def _abstractify(a):
-    """ShapeDtypeStruct (with sharding when present) for jit.lower()."""
-    if hasattr(a, "sharding"):
-        return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+    """ShapeDtypeStruct (with sharding when present) for jit.lower().
+
+    Single-device shardings (the uncommitted rng key, host scalars) are
+    dropped: baking them in would make lower() reject the mix with
+    mesh-sharded arguments that the real dispatch accepts."""
+    from jax.sharding import SingleDeviceSharding
+    sh = getattr(a, "sharding", None)
+    if sh is not None and not isinstance(sh, SingleDeviceSharding):
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
     a = jnp.asarray(a)
     return jax.ShapeDtypeStruct(a.shape, a.dtype)
 
@@ -54,7 +60,7 @@ class ShardedTrainer(object):
     def __init__(self, symbol, optimizer, mesh, data_names=("data",),
                  label_names=("softmax_label",), rules=None, seq_axis=None,
                  donate=True, compute_dtype=None, remat=False,
-                 cast_exempt=()):
+                 cast_exempt=(), zero1=False):
         self.symbol = symbol
         self.optimizer = optimizer
         self.mesh = mesh
@@ -71,6 +77,14 @@ class ShardedTrainer(object):
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype is not None else None)
         self.remat = bool(remat)
+        # ZeRO-1 (beyond-reference): shard OPTIMIZER STATE over the dp
+        # axis — each dp rank keeps 1/dp of momentum/adam state, the
+        # update computes sharded, and XLA all-gathers the new params
+        # (the scaling-book optimizer-state-sharding recipe).  Parameters
+        # themselves stay replicated (unlike ZeRO-3), so fwd/bwd is
+        # untouched; only the update's layout changes.
+        self.zero1 = bool(zero1) and "dp" in mesh.shape \
+            and mesh.shape["dp"] > 1
 
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
@@ -143,6 +157,17 @@ class ShardedTrainer(object):
                 g = preprocess(grads[name])
                 w, s = opt_update(params[name], g, opt_state.get(name),
                                   lr, wd, t)
+                if self.zero1:
+                    # pin layouts: state stays dp-sharded, weights come
+                    # back replicated (XLA inserts the all-gather) — the
+                    # ZeRO-1 contract
+                    w = jax.lax.with_sharding_constraint(
+                        w, self.param_sharding(name, w.shape))
+                    if s is not None:
+                        s = jax.tree_util.tree_map(
+                            lambda a: jax.lax.with_sharding_constraint(
+                                a, self.opt_state_sharding(name, a.shape)),
+                            s)
                 new_params[name] = w
                 if s is not None:
                     new_opt_state[name] = s
@@ -171,6 +196,15 @@ class ShardedTrainer(object):
     def batch_sharding(self, shape):
         return NamedSharding(self.mesh,
                              batch_pspec(shape, self.mesh, self.seq_axis))
+
+    def opt_state_sharding(self, name, shape):
+        """ZeRO-1 placement for one optimizer-state array: axis 0 sharded
+        over dp when divisible, else the parameter's own sharding."""
+        if self.zero1 and shape and \
+                shape[0] % self.mesh.shape["dp"] == 0:
+            return NamedSharding(
+                self.mesh, P("dp", *([None] * (len(shape) - 1))))
+        return self.param_sharding(name, shape)
 
     def _replicated(self):
         return NamedSharding(self.mesh, P())
@@ -208,9 +242,9 @@ class ShardedTrainer(object):
         for name in self.param_names:
             s = self.optimizer.create_state_arrays(shape_map[name], dtype)
             if s is not None:
-                sharding = self.param_sharding(name, shape_map[name])
                 opt_state[name] = jax.tree_util.tree_map(
-                    lambda a: jax.device_put(a, sharding), s)
+                    lambda a, _n=name: jax.device_put(
+                        a, self.opt_state_sharding(_n, a.shape)), s)
         aux = {}
         for name in self._aux_names:
             init_val = jnp.ones(aux_map[name], dtype=dtype) \
