@@ -60,7 +60,7 @@ class ShardedTrainer(object):
     def __init__(self, symbol, optimizer, mesh, data_names=("data",),
                  label_names=("softmax_label",), rules=None, seq_axis=None,
                  donate=True, compute_dtype=None, remat=False,
-                 cast_exempt=(), zero1=False):
+                 cast_exempt=(), zero1=False, fsdp=False):
         self.symbol = symbol
         self.optimizer = optimizer
         self.mesh = mesh
@@ -84,6 +84,13 @@ class ShardedTrainer(object):
         # themselves stay replicated (unlike ZeRO-3), so fwd/bwd is
         # untouched; only the update's layout changes.
         self.zero1 = bool(zero1) and "dp" in mesh.shape \
+            and mesh.shape["dp"] > 1
+        # FSDP / ZeRO-3 (beyond-reference): PARAMETERS live dp-sharded
+        # too; GSPMD all-gathers each weight where the forward needs it
+        # and reduce-scatters its gradient — memory scales 1/dp for
+        # params+grads+state at the cost of per-layer gather traffic.
+        # Optimizer state follows the parameter sharding automatically.
+        self.fsdp = bool(fsdp) and "dp" in mesh.shape \
             and mesh.shape["dp"] > 1
 
         self._arg_names = symbol.list_arguments()
@@ -190,6 +197,14 @@ class ShardedTrainer(object):
     # shardings
     # ------------------------------------------------------------------
     def param_sharding(self, name, shape):
+        if self.fsdp:
+            spec = param_pspec(name, shape, self.mesh, self.rules)
+            if all(ax is None for ax in spec) and shape and \
+                    shape[0] % self.mesh.shape["dp"] == 0:
+                # otherwise-replicated param: shard axis 0 over dp
+                return NamedSharding(
+                    self.mesh, P("dp", *([None] * (len(shape) - 1))))
+            return NamedSharding(self.mesh, spec)
         return NamedSharding(self.mesh,
                              param_pspec(name, shape, self.mesh, self.rules))
 
