@@ -8,3 +8,4 @@ from . import sequence  # noqa: F401
 from . import rnn     # noqa: F401
 from . import vision  # noqa: F401
 from . import attention  # noqa: F401
+from . import moe     # noqa: F401

@@ -1,0 +1,77 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+Beyond-reference (SURVEY's parallelism table lists expert parallelism as
+absent from the reference): a Switch-style top-1 routed FFN whose expert
+weights carry a leading ``num_experts`` axis — shard that axis over an
+``ep`` mesh dimension (parallel.param_pspec does it by name) and GSPMD
+partitions the expert einsums across ranks, inserting the combine
+collective where the routed outputs merge.
+
+The dispatch is the dense einsum formulation (every expert computes every
+token, the routing mask selects): no dynamic shapes, no sorting — the
+XLA-friendly form for moderate expert counts.  Gate gradients flow
+through the top-1 probability scaling (Switch Transformer's trick);
+the op also returns the load-balance auxiliary loss as a second output
+(fraction·probability dot product, Switch eq. 4) so trainers can add it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..dparam import Field, ParamStruct
+from .registry import OperatorProperty, register_op, require_known
+
+
+class _MoEParam(ParamStruct):
+    num_experts = Field(int, required=True, lower=2)
+    hidden_size = Field(int, required=True, lower=1)
+
+
+@register_op("MoE", aliases=("SwitchFFN",))
+class MoE(OperatorProperty):
+    """data (..., E) -> (..., E); outputs [y, aux_loss(1,)]."""
+    param_cls = _MoEParam
+
+    def list_arguments(self):
+        return ["data", "gate_weight", "expert_fc1_weight",
+                "expert_fc1_bias", "expert_fc2_weight",
+                "expert_fc2_bias"]
+
+    def list_outputs(self):
+        return ["output", "aux_loss"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known("MoE", in_shapes[:1], ["data"])
+        if len(data) < 2:
+            raise MXNetError("MoE: data must be (..., embed)")
+        E = data[-1]
+        K, H = self.param.num_experts, self.param.hidden_size
+        return ([data, (K, E), (K, H, E), (K, H), (K, E, H), (K, E)],
+                [data, (1,)], [])
+
+    def forward(self, inputs, aux, is_train, rng):
+        x, wg, w1, b1, w2, b2 = inputs
+        K = self.param.num_experts
+        shape = x.shape
+        t = x.reshape(-1, shape[-1])                    # (T, E)
+        logits = t @ wg.T                               # (T, K)
+        probs = jax.nn.softmax(logits, axis=-1)
+        top1 = jnp.argmax(probs, axis=-1)               # (T,)
+        mask = jax.nn.one_hot(top1, K, dtype=t.dtype)   # (T, K)
+        # switch gating: scale by the (differentiable) top-1 probability
+        gate = jnp.sum(mask * probs, axis=-1)           # (T,)
+
+        h = jnp.einsum("te,khe->tkh", t, w1) + b1[None]
+        h = jax.nn.relu(h)
+        y = jnp.einsum("tkh,keh->tke", h, w2) + b2[None]
+        out = jnp.einsum("tke,tk->te", y, mask) * gate[:, None]
+
+        # load-balance aux (Switch eq. 4): K * <fraction, mean prob>
+        frac = jnp.mean(mask, axis=0)
+        mean_p = jnp.mean(probs, axis=0)
+        aux_loss = (K * jnp.sum(frac * mean_p)).reshape(1)
+        return [out.reshape(shape), aux_loss], None

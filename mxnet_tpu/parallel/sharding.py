@@ -35,6 +35,10 @@ def param_pspec(name, shape, mesh, rules=None):
         spec = rules.match(name, shape)
         if spec is not None:
             return spec
+    if "ep" in mesh.shape and mesh.shape["ep"] > 1 and shape \
+            and "expert" in name and shape[0] % mesh.shape["ep"] == 0:
+        # MoE expert stacks: leading num_experts axis over 'ep'
+        return P("ep", *([None] * (len(shape) - 1)))
     if "tp" in mesh.shape and mesh.shape["tp"] > 1 and shape:
         # shard the widest shardable axis over tp: prefer axis 0 (out-features
         # / vocab) — column parallel; fall back to axis 1 (row parallel)

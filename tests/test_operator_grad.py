@@ -221,6 +221,18 @@ GRAD_SPECS = {
          "rn_state": np.zeros((1, 2, 3)),
          "rn_state_cell": np.zeros((1, 2, 3))},
         {"grad_nodes": ["a", "rn_parameters"], "rtol": 5e-2, "atol": 5e-2}),
+    "MoE": lambda: (
+        sym.MoE(V("a"), num_experts=2, hidden_size=4, name="mo"),
+        # gate logits get a wide margin (scaled gate weights on
+        # well-spread tokens) so routing never flips inside the
+        # numeric-diff epsilon and the top-1 mask stays constant
+        {"a": _distinct64(6, 4) * 2.0,
+         "mo_gate_weight": np.array([[3.0, 0, 0, 0], [0, 3.0, 0, 0]]),
+         "mo_expert_fc1_weight": _f64(2, 4, 4) * 0.4,
+         "mo_expert_fc1_bias": _f64(2, 4) * 0.1 + 0.5,
+         "mo_expert_fc2_weight": _f64(2, 4, 4) * 0.4,
+         "mo_expert_fc2_bias": _f64(2, 4) * 0.1},
+        {"rtol": 5e-2, "atol": 5e-3}),
     "MultiHeadAttention": lambda: (
         sym.MultiHeadAttention(V("a"), num_heads=2, use_flash=False,
                                name="mh"),
