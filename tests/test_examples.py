@@ -248,3 +248,10 @@ def test_rtc_example():
     r = _run(os.path.join(REPO, "example/rtc"), "pallas_kernel.py")
     assert r.returncode == 0, r.stderr[-1500:]
     assert "OK rtc example" in r.stdout
+
+
+def test_moe_example():
+    """Expert-parallel MoE training over a dp x ep mesh."""
+    r = _run(os.path.join(REPO, "example/moe"), "moe_ep.py")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "OK moe example" in r.stdout
