@@ -170,6 +170,12 @@ int MXExecutorPrint(ExecutorHandle h, const char** out);
 int MXSymbolListAttrJSON(SymbolHandle h, const char** out);
 
 /* -- kvstore cluster queries + barrier */
+/* a C function as the kvstore's merge-update rule (handles borrowed for
+ * the duration of each callback) */
+typedef void (MXKVStoreUpdaterCB)(int key, NDArrayHandle recv,
+                                  NDArrayHandle local, void* user);
+int MXKVStoreSetUpdater(KVStoreHandle h, MXKVStoreUpdaterCB* updater,
+                        void* user);
 int MXKVStoreGetRank(KVStoreHandle h, int* out);
 int MXKVStoreGetGroupSize(KVStoreHandle h, int* out);
 /* *out valid until this thread's next MXKVStoreGetType */

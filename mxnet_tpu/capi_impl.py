@@ -504,3 +504,25 @@ def symbol_attr_json(sym):
     """All attributes as JSON (MXSymbolListAttr parity)."""
     import json
     return json.dumps(sym.attr_dict())
+
+
+def kvstore_set_c_updater(kv, fn_addr, user_handle_addr):
+    """Install a C function pointer as the kvstore updater
+    (MXKVStoreSetUpdater parity).  The C callback receives
+    (int key, NDArrayHandle recv, NDArrayHandle local, void* user) with
+    the handles valid for the duration of the call."""
+    import ctypes
+    cb_type = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                               ctypes.c_void_p, ctypes.c_void_p)
+    cb = cb_type(fn_addr)
+    user = ctypes.c_void_p(user_handle_addr or 0)
+
+    def _updater(key, recv, local):
+        # id() of a live PyObject IS its address (CPython): the C side
+        # gets real NDArrayHandles, borrowed for the call
+        cb(int(key), ctypes.c_void_p(id(recv)), ctypes.c_void_p(id(local)),
+           user)
+
+    _updater._capi_refs = (cb, user)   # keep the ctypes thunk alive
+    kv.set_updater(_updater)
+    return 0

@@ -1039,6 +1039,21 @@ int MXKVStoreBarrier(KVStoreHandle h) {
                 PyTuple_Pack(1, static_cast<PyObject*>(h)));
 }
 
+// Reference MXKVStoreSetUpdater: a C function becomes the kvstore's
+// merge-update rule (the "optimizer runs on the server" hook).  The
+// handles passed to the callback are borrowed for the call.
+typedef void (MXKVStoreUpdaterCB)(int key, NDArrayHandle recv,
+                                  NDArrayHandle local, void* user);
+
+int MXKVStoreSetUpdater(KVStoreHandle h, MXKVStoreUpdaterCB* updater,
+                        void* user) {
+  Gil gil;
+  return CallRC("kvstore_set_c_updater",
+                Py_BuildValue("(Onn)", static_cast<PyObject*>(h),
+                              reinterpret_cast<Py_ssize_t>(updater),
+                              reinterpret_cast<Py_ssize_t>(user)));
+}
+
 // ---- misc ----------------------------------------------------------
 int MXRandomSeed(int seed) {
   Gil gil;

@@ -18,6 +18,20 @@
       return 1; \
     } } while (0)
 
+/* kvstore updater written in C: local -= 0.5 * recv */
+static void c_updater(int key, NDArrayHandle recv, NDArrayHandle local,
+                      void* user) {
+  (void)key;
+  float r[16], l[16];
+  uint32_t nd, shp[4];
+  if (MXNDArrayGetShape(recv, &nd, shp, 4) != 0 || nd != 1) return;
+  if (MXNDArraySyncCopyToCPU(recv, r, shp[0]) != 0) return;
+  if (MXNDArraySyncCopyToCPU(local, l, shp[0]) != 0) return;
+  for (uint32_t i = 0; i < shp[0]; ++i) l[i] -= 0.5f * r[i];
+  if (MXNDArraySyncCopyFromCPU(local, l, shp[0]) != 0) return;
+  ++*(int*)user;
+}
+
 int main(void) {
   /* --- ndarray round trip --- */
   uint32_t shape[2] = {2, 3};
@@ -473,6 +487,40 @@ int main(void) {
     CHECK(MXSymbolFree(fcs));
     CHECK(MXSymbolFree(sma));
     CHECK(MXSymbolFree(tnet));
+  }
+
+  /* --- a C function as the kvstore updater --- */
+  {
+    KVStoreHandle ukv;
+    CHECK(MXKVStoreCreate("local_update_cpu", &ukv));
+    uint32_t ushp[1] = {4};
+    NDArrayHandle uw, ug;
+    CHECK(MXNDArrayCreate(ushp, 1, &uw));
+    CHECK(MXNDArrayCreate(ushp, 1, &ug));
+    float wv[4] = {10, 10, 10, 10}, gv[4] = {1, 2, 3, 4};
+    CHECK(MXNDArraySyncCopyFromCPU(uw, wv, 4));
+    CHECK(MXNDArraySyncCopyFromCPU(ug, gv, 4));
+    CHECK(MXKVStoreInit(ukv, 5, uw));
+    int hits = 0;
+    CHECK(MXKVStoreSetUpdater(ukv, c_updater, &hits));
+    CHECK(MXKVStorePush(ukv, 5, ug));
+    NDArrayHandle upulled;
+    CHECK(MXNDArrayCreate(ushp, 1, &upulled));
+    CHECK(MXKVStorePull(ukv, 5, upulled));
+    float got_u[4];
+    CHECK(MXNDArraySyncCopyToCPU(upulled, got_u, 4));
+    /* updater: local -= 0.5 * recv  ->  10 - 0.5*g */
+    if (hits != 1 || got_u[0] != 9.5f || got_u[3] != 8.0f) {
+      fprintf(stderr, "FAIL C updater: hits=%d %f %f\n", hits, got_u[0],
+              got_u[3]);
+      return 1;
+    }
+    printf("kvstore C updater: key 5, %d call, local -= 0.5*recv OK\n",
+           hits);
+    CHECK(MXNDArrayFree(uw));
+    CHECK(MXNDArrayFree(ug));
+    CHECK(MXNDArrayFree(upulled));
+    CHECK(MXKVStoreFree(ukv));
   }
 
   /* --- executor plan dump + symbol attrs through C --- */
