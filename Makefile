@@ -35,7 +35,11 @@ $(LIBDIR)/capi_threads: tests/capi/capi_threads.c $(LIBDIR)/libmxtpu_capi.so
 	$(CC) -O2 -Wall -Iinclude $< -o $@ -L$(LIBDIR) -lmxtpu_capi \
 	    -lpthread -Wl,-rpath,'$$ORIGIN'
 
-test-capi: $(LIBDIR)/capi_smoke $(LIBDIR)/capi_threads
+$(LIBDIR)/capi_parity: tests/capi/capi_parity.c $(LIBDIR)/libmxtpu_capi.so
+	$(CC) -O2 -Wall -Iinclude $< -o $@ -L$(LIBDIR) -lmxtpu_capi \
+	    -lm -Wl,-rpath,'$$ORIGIN'
+
+test-capi: $(LIBDIR)/capi_smoke $(LIBDIR)/capi_threads $(LIBDIR)/capi_parity
 	python -m pytest tests/test_capi.py -q
 
 $(LIBDIR):

@@ -7,6 +7,9 @@
 
 #include <stddef.h>
 #include <stdint.h>
+#ifndef __cplusplus
+#include <stdbool.h>   /* custom-op callback structs use bool */
+#endif
 
 #ifdef __cplusplus
 extern "C" {
@@ -196,6 +199,242 @@ int MXOptimizerCreateOptimizer(const char* name, const char* kwargs_json,
 int MXOptimizerFree(OptimizerHandle h);
 int MXOptimizerUpdate(OptimizerHandle h, int index, NDArrayHandle weight,
                       NDArrayHandle grad, float lr, float wd);
+
+/* ==================================================================
+ * Reference-surface completion: the remaining MX* names of the
+ * reference c_api.h (~109 functions).  Same conventions throughout:
+ * 0/-1 return codes, MXGetLastError, thread-local ret storage for
+ * string/array outputs, caller-owned NDArrayHandles.
+ * ================================================================== */
+
+/* -- NDArray extras */
+int MXNDArrayCreateNone(NDArrayHandle* out);
+int MXNDArrayCreateEx(const uint32_t* shape, uint32_t ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out);
+int MXNDArrayAt(NDArrayHandle h, uint32_t idx, NDArrayHandle* out);
+int MXNDArrayGetContext(NDArrayHandle h, int* out_dev_type,
+                        int* out_dev_id);
+/* *out_pdata: synced float32 host snapshot owned by the handle, valid
+ * until the next GetData on it (XLA buffers are not host-addressable) */
+int MXNDArrayGetData(NDArrayHandle h, float** out_pdata);
+int MXNDArrayWaitToRead(NDArrayHandle h);
+int MXNDArrayWaitToWrite(NDArrayHandle h);
+/* single-array raw serialization (reference per-array layout,
+ * ndarray.cc:637-687); *out_buf thread-local until the next call */
+int MXNDArraySaveRawBytes(NDArrayHandle h, size_t* out_size,
+                          const char** out_buf);
+int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                              NDArrayHandle* out);
+int MXNotifyShutdown(void);
+
+/* -- Symbol completion */
+int MXSymbolCopy(SymbolHandle h, SymbolHandle* out);
+int MXSymbolCreateGroup(uint32_t num_symbols, SymbolHandle* symbols,
+                        SymbolHandle* out);
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out);
+int MXSymbolSaveToFile(SymbolHandle h, const char* fname);
+int MXSymbolGetInternals(SymbolHandle h, SymbolHandle* out);
+/* gradient symbol: args = base args + <headnode>_<idx>_grad head-grad
+ * inputs; outputs = d(outputs)/d(wrt) (Symbol::Grad, symbol.cc:569) */
+int MXSymbolGrad(SymbolHandle h, uint32_t num_wrt, const char** wrt,
+                 SymbolHandle* out);
+/* string arrays are thread-local until this thread's next listing call */
+int MXSymbolListArguments(SymbolHandle h, uint32_t* out_size,
+                          const char*** out_str_array);
+int MXSymbolListOutputs(SymbolHandle h, uint32_t* out_size,
+                        const char*** out_str_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle h, uint32_t* out_size,
+                                const char*** out_str_array);
+/* attr listings return (key, value) PAIRS: *out has 2 * *out_size
+ * entries.  ListAttr walks every node (keys "<node>$<key>");
+ * ListAttrShallow lists the head node only. */
+int MXSymbolListAttr(SymbolHandle h, uint32_t* out_size,
+                     const char*** out);
+int MXSymbolListAttrShallow(SymbolHandle h, uint32_t* out_size,
+                            const char*** out);
+int MXSymbolPrint(SymbolHandle h, const char** out_str);
+/* CSR-packed shape inference (reference layout): arg_ind_ptr has
+ * num_args+1 entries indexing into arg_shape_data; keys NULL means
+ * positional by argument order.  Out arrays thread-local per call. */
+int MXSymbolInferShape(SymbolHandle h, uint32_t num_args, const char** keys,
+                       const uint32_t* arg_ind_ptr,
+                       const uint32_t* arg_shape_data,
+                       uint32_t* in_shape_size,
+                       const uint32_t** in_shape_ndim,
+                       const uint32_t*** in_shape_data,
+                       uint32_t* out_shape_size,
+                       const uint32_t** out_shape_ndim,
+                       const uint32_t*** out_shape_data,
+                       uint32_t* aux_shape_size,
+                       const uint32_t** aux_shape_ndim,
+                       const uint32_t*** aux_shape_data, int* complete);
+int MXSymbolInferShapePartial(SymbolHandle h, uint32_t num_args,
+                              const char** keys,
+                              const uint32_t* arg_ind_ptr,
+                              const uint32_t* arg_shape_data,
+                              uint32_t* in_shape_size,
+                              const uint32_t** in_shape_ndim,
+                              const uint32_t*** in_shape_data,
+                              uint32_t* out_shape_size,
+                              const uint32_t** out_shape_ndim,
+                              const uint32_t*** out_shape_data,
+                              uint32_t* aux_shape_size,
+                              const uint32_t** aux_shape_ndim,
+                              const uint32_t*** aux_shape_data,
+                              int* complete);
+/* dtype flags use the reference numbering (f32=0 f64=1 f16=2 u8=3 i32=4);
+ * -1 = unknown */
+int MXSymbolInferType(SymbolHandle h, uint32_t num_args, const char** keys,
+                      const int* arg_type_data, uint32_t* in_type_size,
+                      const int** in_type_data, uint32_t* out_type_size,
+                      const int** out_type_data, uint32_t* aux_type_size,
+                      const int** aux_type_data, int* complete);
+
+/* -- atomic symbol creators (what language bindings enumerate) */
+typedef void* AtomicSymbolCreator;
+int MXSymbolListAtomicSymbolCreators(uint32_t* out_size,
+                                     AtomicSymbolCreator** out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char** name);
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char** name, const char** description,
+                                uint32_t* num_args, const char*** arg_names,
+                                const char*** arg_type_infos,
+                                const char*** arg_descriptions,
+                                const char** key_var_num_args);
+
+/* -- function registry completion */
+int MXGetFunction(const char* name, FunctionHandle* out);
+/* type_mask: 1 = NDArray args before scalars, 4 = accept empty mutate
+ * targets (this ABI's functions allocate their outputs) */
+int MXFuncDescribe(FunctionHandle fn, uint32_t* num_use_vars,
+                   uint32_t* num_scalars, uint32_t* num_mutate_vars,
+                   int* type_mask);
+/* key/value-array invoke; results written INTO mutate_vars */
+int MXFuncInvokeEx(FunctionHandle fn, NDArrayHandle* use_vars,
+                   float* scalar_args, NDArrayHandle* mutate_vars,
+                   int num_params, char** param_keys, char** param_vals);
+
+/* -- executor completion: reference Bind signatures over caller-provided
+ * NDArray handles.  grad_req codes: 0 null, 1 write, 2 inplace, 3 add. */
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id, uint32_t len,
+                   NDArrayHandle* in_args, NDArrayHandle* arg_grad_store,
+                   uint32_t* grad_req_type, uint32_t aux_states_len,
+                   NDArrayHandle* aux_states, ExecutorHandle* out);
+int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                    uint32_t num_map_keys, const char** map_keys,
+                    const int* map_dev_types, const int* map_dev_ids,
+                    uint32_t len, NDArrayHandle* in_args,
+                    NDArrayHandle* arg_grad_store, uint32_t* grad_req_type,
+                    uint32_t aux_states_len, NDArrayHandle* aux_states,
+                    ExecutorHandle* out);
+int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                     uint32_t num_map_keys, const char** map_keys,
+                     const int* map_dev_types, const int* map_dev_ids,
+                     uint32_t len, NDArrayHandle* in_args,
+                     NDArrayHandle* arg_grad_store, uint32_t* grad_req_type,
+                     uint32_t aux_states_len, NDArrayHandle* aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle* out);
+/* handle array thread-local until the next call; handles caller-owned */
+int MXExecutorOutputs(ExecutorHandle h, uint32_t* out_size,
+                      NDArrayHandle** out);
+/* per-op monitor fired from the compiled program (handle borrowed for
+ * the duration of each callback) */
+typedef void (*ExecutorMonitorCallback)(const char* name, NDArrayHandle arr,
+                                        void* user);
+int MXExecutorSetMonitorCallback(ExecutorHandle h,
+                                 ExecutorMonitorCallback callback,
+                                 void* callback_handle);
+
+/* -- kvstore completion */
+int MXInitPSEnv(uint32_t num_vars, const char** keys, const char** vals);
+int MXKVStoreIsWorkerNode(int* ret);
+int MXKVStoreIsServerNode(int* ret);
+int MXKVStoreIsSchedulerNode(int* ret);
+int MXKVStoreGetNumDeadNode(KVStoreHandle h, const int node_id, int* number,
+                            const int timeout_sec);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle h,
+                                  const int barrier_before_exit);
+/* (sic) the reference's triple-m name is part of its ABI.  Commands are
+ * queued on the handle; a same-process RunServer drains them through the
+ * controller (head 0 = kStopServer ends the loop). */
+int MXKVStoreSendCommmandToServers(KVStoreHandle h, int cmd_id,
+                                   const char* cmd_body);
+typedef void (MXKVStoreServerController)(int head, const char* body,
+                                         void* controller_handle);
+int MXKVStoreRunServer(KVStoreHandle h, MXKVStoreServerController controller,
+                       void* controller_handle);
+
+/* -- data iter index of the current batch (thread-local array) */
+int MXDataIterGetIndex(DataIterHandle h, uint64_t** out_index,
+                       uint64_t* out_size);
+
+/* -- optimizer creator lookup; the returned handle is consumed by
+ * MXOptimizerCreateOptimizer's name argument story (free with
+ * MXNDArrayFree) */
+typedef void* OptimizerCreator;
+int MXOptimizerFindCreator(const char* key, OptimizerCreator* out);
+
+/* -- Rtc: runtime-compiled kernels.  The reference compiles CUDA C via
+ * NVRTC; the TPU-native kernel language is Pallas/jax, so `kernel` is
+ * Python source defining a function named `name` — a Pallas body of
+ * (num_input + num_output) refs, or a jax function of num_input arrays
+ * returning the outputs.  grid/block dims accepted for signature parity
+ * (Pallas owns its grid). */
+typedef void* RtcHandle;
+int MXRtcCreate(char* name, uint32_t num_input, uint32_t num_output,
+                char** input_names, char** output_names,
+                NDArrayHandle* inputs, NDArrayHandle* outputs, char* kernel,
+                RtcHandle* out);
+int MXRtcPush(RtcHandle h, uint32_t num_input, uint32_t num_output,
+              NDArrayHandle* inputs, NDArrayHandle* outputs,
+              uint32_t gridDimX, uint32_t gridDimY, uint32_t gridDimZ,
+              uint32_t blockDimX, uint32_t blockDimY, uint32_t blockDimZ);
+int MXRtcFree(RtcHandle h);
+
+/* -- custom ops from C: the reference's callback-struct protocol
+ * (CustomOpPropCreator fills CustomOpPropInfo; its create_operator
+ * fills CustomOpInfo).  Compute callbacks receive NDArrayHandle ptrs
+ * with tags in_data=0 out_data=1 in_grad=2 out_grad=3 aux=4
+ * (custom.cc:47-135) and may use any MXNDArray* function on them. */
+struct MXCustomOpInfo {
+  bool (*forward)(int size, void** ptrs, int* tags, const int* reqs,
+                  const bool is_train, void* state);
+  bool (*backward)(int size, void** ptrs, int* tags, const int* reqs,
+                   const bool is_train, void* state);
+  bool (*del)(void* state);
+  void* p_forward;
+  void* p_backward;
+  void* p_del;
+};
+struct MXCustomOpPropInfo {
+  bool (*list_arguments)(char*** args, void* state);
+  bool (*list_outputs)(char*** outputs, void* state);
+  bool (*infer_shape)(int num_total, int* ndims, unsigned** shapes,
+                      void* state);
+  bool (*declare_backward_dependency)(const int* out_grad,
+                                      const int* in_data,
+                                      const int* out_data, int* num_deps,
+                                      int** rdeps, void* state);
+  bool (*create_operator)(const char* ctx, int num_inputs,
+                          unsigned** shapes, int* ndims, int* dtypes,
+                          struct MXCustomOpInfo* ret, void* state);
+  bool (*list_auxiliary_states)(char*** aux, void* state);
+  bool (*del)(void* state);
+  void* p_list_arguments;
+  void* p_list_outputs;
+  void* p_infer_shape;
+  void* p_declare_backward_dependency;
+  void* p_create_operator;
+  void* p_list_auxiliary_states;
+  void* p_del;
+};
+typedef bool (*CustomOpPropCreator)(const char* op_type,
+                                    const int num_kwargs, const char** keys,
+                                    const char** values,
+                                    struct MXCustomOpPropInfo* ret);
+int MXCustomOpRegister(const char* op_type, CustomOpPropCreator creator);
 
 #ifdef __cplusplus
 }

@@ -266,14 +266,21 @@ def dataiter_create(name, kwargs_json):
 def dataiter_next(it):
     try:
         it._capi_batch = next(it)
-        return 1
     except StopIteration:
         it._capi_batch = None
         return 0
+    # positional index of the batch's records (for iterators that don't
+    # track indices themselves — MXDataIterGetIndex falls back to this)
+    n = int(it._capi_batch.data[0].shape[0])
+    start = getattr(it, "_capi_pos", 0)
+    it._capi_range = list(range(start, start + n))
+    it._capi_pos = start + n
+    return 1
 
 
 def dataiter_before_first(it):
     it.reset()
+    it._capi_pos = 0
     return 0
 
 
@@ -504,6 +511,654 @@ def symbol_attr_json(sym):
     """All attributes as JSON (MXSymbolListAttr parity)."""
     import json
     return json.dumps(sym.attr_dict())
+
+
+# ----------------------------------------------------------------------
+# NDArray extras: the remaining reference creation/sync/raw-bytes surface
+# (c_api.cc:116-363)
+# ----------------------------------------------------------------------
+def ndarray_create_none():
+    """MXNDArrayCreateNone parity: a placeholder array (the reference's
+    delayed-alloc default NDArray) — scalar zero until written."""
+    from .ndarray import zeros
+    return zeros(())
+
+
+def ndarray_create_ex(shape, dev_type, dev_id, delay_alloc, dtype_flag):
+    """MXNDArrayCreateEx parity.  delay_alloc is accepted and ignored:
+    XLA owns buffer lifetime (executor.py:10-13)."""
+    from .base import dtype_mx_to_np
+    from .context import Context
+    from .ndarray import zeros
+    ctx = Context(Context.devtype2str[int(dev_type)], int(dev_id))
+    return zeros(tuple(int(d) for d in shape), ctx=ctx,
+                 dtype=dtype_mx_to_np(int(dtype_flag)))
+
+
+def ndarray_at(nd, idx):
+    return nd.at(int(idx))
+
+
+def ndarray_context(nd):
+    ctx = nd.context
+    return (int(ctx.device_typeid), int(ctx.device_id))
+
+
+_CAPI_DATA = None   # NDArray -> host snapshot (created lazily: weakref)
+
+
+def ndarray_data_addr(nd):
+    """MXNDArrayGetData parity: address of the array's host float32 data.
+    XLA buffers are not host-addressable, so this is a synced host
+    snapshot, kept alive as long as the handle — valid until the next
+    GetData call on the same handle (the reference's pointer is live CPU
+    memory; callers that mutate through it are out of contract there
+    too)."""
+    global _CAPI_DATA
+    if _CAPI_DATA is None:
+        import weakref
+        _CAPI_DATA = weakref.WeakKeyDictionary()
+    host = _np.ascontiguousarray(nd.asnumpy().astype(_np.float32))
+    _CAPI_DATA[nd] = host
+    return int(host.ctypes.data)
+
+
+def ndarray_wait_read(nd):
+    nd.wait_to_read()
+    return 0
+
+
+def ndarray_wait_write(nd):
+    nd.wait_to_write()
+    return 0
+
+
+def ndarray_save_raw(nd):
+    """MXNDArraySaveRawBytes parity: one array in the reference's
+    per-array layout (shape + context + type flag + raw data,
+    ndarray.cc:637-687)."""
+    import io as _io
+    from .ndarray import _save_one
+    bio = _io.BytesIO()
+    _save_one(bio, nd)
+    return bio.getvalue()
+
+
+def ndarray_load_raw(buf):
+    import io as _io
+    from .ndarray import _load_one
+    return _load_one(_io.BytesIO(bytes(buf)))
+
+
+def notify_shutdown():
+    """MXNotifyShutdown parity: drain pending work (engine + arrays)."""
+    from .ndarray import waitall
+    waitall()
+    try:
+        from .engine import Engine
+        Engine.get().wait_for_all()
+    except Exception:
+        pass
+    return 0
+
+
+# ----------------------------------------------------------------------
+# Symbol: copy/group/file/internals/listing/print (c_api.cc:447-937)
+# ----------------------------------------------------------------------
+def symbol_copy(sym):
+    return symbol_from_json(sym.tojson())
+
+
+def symbol_group(syms):
+    from . import symbol as sym_mod
+    return sym_mod.Group(list(syms))
+
+
+def symbol_from_file(fname):
+    from . import symbol as sym_mod
+    return sym_mod.load(fname)
+
+
+def symbol_save_file(sym, fname):
+    sym.save(fname)
+    return 0
+
+
+def symbol_get_internals(sym):
+    return sym.get_internals()
+
+
+def symbol_attr_pairs(sym, deep):
+    """Flat [k0, v0, k1, v1, ...] attribute listing.  Deep walks every
+    node with ``<node>$<key>`` keys (MXSymbolListAttr); shallow lists the
+    head node only (MXSymbolListAttrShallow)."""
+    pairs = []
+    if deep:
+        for node_name, attrs in sorted(sym.attr_dict().items()):
+            for k in sorted(attrs):
+                pairs.extend(["%s$%s" % (node_name, k), str(attrs[k])])
+    else:
+        for k, v in sorted(sym.list_attr().items()):
+            pairs.extend([k, str(v)])
+    return pairs
+
+
+def symbol_print(sym):
+    return sym.debug_str()
+
+
+def symbol_grad(sym, wrt):
+    return sym.grad(list(wrt))
+
+
+def symbol_infer_shape_arrays(sym, keys, shapes, partial):
+    """MXSymbolInferShape parity (CSR in, three shape lists out).
+    keys empty => positional by argument order.
+    -> (arg_shapes, out_shapes, aux_shapes, complete)"""
+    args = sym.list_arguments()
+    # reference CSR convention: a 0-dim entry means UNKNOWN, not scalar
+    if keys:
+        known = {k: tuple(s) for k, s in zip(keys, shapes) if len(s)}
+    else:
+        known = {a: tuple(s) for a, s in zip(args, shapes) if len(s)}
+    fn = sym.infer_shape_partial if partial else sym.infer_shape
+    arg, out, aux = fn(**known)
+    complete = (arg is not None and out is not None
+                and all(s is not None for s in (arg + out + (aux or []))))
+
+    def _ser(lst, n):
+        if lst is None:
+            return [()] * n
+        return [tuple(s) if s is not None else () for s in lst]
+
+    return (_ser(arg, len(args)), _ser(out, len(sym.list_outputs())),
+            _ser(aux, len(sym.list_auxiliary_states())), int(complete))
+
+
+def symbol_infer_type_arrays(sym, keys, type_flags):
+    """MXSymbolInferType parity: int dtype flags in/out."""
+    from .base import dtype_mx_to_np, dtype_np_to_mx
+    args = sym.list_arguments()
+    names = list(keys) if keys else args[:len(type_flags)]
+    known = {n: dtype_mx_to_np(int(t)) for n, t in zip(names, type_flags)
+             if int(t) != -1}
+    arg, out, aux = sym.infer_type(**known)
+
+    def _flags(lst):
+        return [(-1 if t is None else int(dtype_np_to_mx(_np.dtype(t))))
+                for t in (lst or [])]
+
+    complete = all(t is not None for t in (arg or []) + (out or []))
+    return (_flags(arg), _flags(out), _flags(aux), int(complete))
+
+
+# ----------------------------------------------------------------------
+# function registry extras (describe + invoke-ex + atomic symbol info)
+# ----------------------------------------------------------------------
+def registry_op_describe(name):
+    """MXFuncDescribe parity -> (num_use_vars, num_scalars,
+    num_mutate_vars, type_mask).  Ops with a ``scalar`` param take one
+    scalar arg (the reference's scalar-op convention); everything else
+    takes NDArray inputs only.  Outputs are fresh (accept-empty-mutate
+    calling style: type_mask kAcceptEmptyMutateTarget |
+    kNDArrayArgBeforeScalar)."""
+    from .ops.registry import OP_REGISTRY
+    cls = OP_REGISTRY.get(name)
+    pc = getattr(cls, "param_cls", None)
+    n_scalar = 1 if (pc is not None and "scalar" in pc._fields) else 0
+    try:
+        op = cls(**({"scalar": 0.0} if n_scalar else {}))
+        n_in = len(op.list_arguments())
+        n_out = len(op.list_outputs())
+    except Exception:
+        n_in, n_out = 1, 1      # required params: signature unknowable
+    return (n_in, n_scalar, n_out, 1 | 4)
+
+
+def func_invoke_into(name, param_keys, param_vals, use_vars, scalars,
+                     mutate_vars):
+    """MXFuncInvokeEx parity: run op ``name`` on ``use_vars`` and write
+    results into ``mutate_vars``.  ``param_keys``/``param_vals`` are the
+    reference's string arrays (no JSON on this path — values coerce
+    through the dparam Field layer); a scalar arg fills the op's
+    ``scalar`` param when it has one and the params didn't set it."""
+    import json
+    kwargs = dict(zip([str(k) for k in param_keys],
+                      [str(v) for v in param_vals]))
+    if scalars:
+        from .ops.registry import OP_REGISTRY
+        pc = getattr(OP_REGISTRY.get(name), "param_cls", None)
+        if pc is not None and "scalar" in pc._fields and "scalar" not in kwargs:
+            kwargs["scalar"] = float(scalars[0])
+    outs = func_invoke(name, json.dumps(kwargs), list(use_vars))
+    if len(outs) != len(mutate_vars):
+        raise ValueError("op %r produced %d outputs for %d mutate vars"
+                         % (name, len(outs), len(mutate_vars)))
+    for dst, src in zip(mutate_vars, outs):
+        dst._set_data(src.data)
+    return 0
+
+
+def registry_symbol_op_info(name):
+    """MXSymbolGetAtomicSymbolInfo parity: registry_op_info plus the
+    key_var_num_args marker (ops taking a variable input list declare a
+    ``num_args`` param — Concat/ElementWiseSum, operator.h:295-306)."""
+    from .ops.registry import OP_REGISTRY
+    disp, desc, args, types, docs = registry_op_info(name)
+    pc = getattr(OP_REGISTRY.get(name), "param_cls", None)
+    key_var = "num_args" if (pc is not None and "num_args" in pc._fields) else ""
+    return (disp, desc, args, types, docs, key_var)
+
+
+# ----------------------------------------------------------------------
+# executor: full Bind with caller arrays + outputs + monitor callback
+# (c_api.cc:939-1099)
+# ----------------------------------------------------------------------
+# code 2 (kWriteInplace) binds as write: in-place sharing is the
+# reference's memory optimization; XLA donation plays that role here
+_GRAD_REQ = {0: "null", 1: "write", 2: "write", 3: "add"}
+
+
+def executor_bind_full(sym, dev_type, dev_id, in_args, arg_grads, grad_reqs,
+                       aux_states, map_keys, map_dev_types, map_dev_ids,
+                       shared_exec):
+    """MXExecutorBind/BindX/BindEX parity: bind with caller-provided
+    NDArray handles, per-arg grad_req codes, and optional group2ctx."""
+    from .context import Context
+    ctx = Context(Context.devtype2str[int(dev_type)], int(dev_id))
+    group2ctx = None
+    if map_keys:
+        group2ctx = {k: Context(Context.devtype2str[int(t)], int(i))
+                     for k, t, i in zip(map_keys, map_dev_types, map_dev_ids)}
+    reqs = [_GRAD_REQ[int(r)] for r in grad_reqs]
+    grads = list(arg_grads) if arg_grads else None
+    return sym.bind(ctx, list(in_args), args_grad=grads, grad_req=reqs,
+                    aux_states=list(aux_states) if aux_states else None,
+                    group2ctx=group2ctx, shared_exec=shared_exec)
+
+
+def executor_outputs(exec_):
+    return list(exec_.outputs)
+
+
+def executor_set_monitor_c(exec_, fn_addr, user_addr):
+    """MXExecutorSetMonitorCallback parity: a C function pointer receives
+    (name, NDArrayHandle, user) per monitored op output; the handle is
+    borrowed for the call (reference graph_executor.cc:937-951)."""
+    import ctypes
+    from .ndarray import NDArray
+    cb_type = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                               ctypes.c_void_p)
+    cb = cb_type(fn_addr)
+    user = ctypes.c_void_p(user_addr or 0)
+
+    def _monitor(name, arr):
+        nd = arr if isinstance(arr, NDArray) else NDArray(arr)
+        cb(str(name).encode(), ctypes.c_void_p(id(nd)), user)
+
+    _monitor._capi_refs = (cb, user)
+    exec_.set_monitor_callback(_monitor)
+    return 0
+
+
+# ----------------------------------------------------------------------
+# kvstore: roles, fault queries, server loop (c_api.cc:1199-1375)
+# ----------------------------------------------------------------------
+def init_ps_env(keys, vals):
+    """MXInitPSEnv parity: stash DMLC_*/PS_* launcher variables into the
+    environment before kvstore creation (ps::Environment analog)."""
+    import os
+    for k, v in zip(keys, vals):
+        os.environ[str(k)] = str(v)
+    return 0
+
+
+def _role():
+    import os
+    return os.environ.get("DMLC_ROLE", "worker").lower()
+
+
+def kvstore_is_worker():
+    return int(_role() == "worker")
+
+
+def kvstore_is_server():
+    return int(_role() == "server")
+
+
+def kvstore_is_scheduler():
+    return int(_role() == "scheduler")
+
+
+def kvstore_num_dead(kv, node_id, timeout_sec):
+    return int(kv.num_dead_nodes(node_id=int(node_id),
+                                 timeout=int(timeout_sec)))
+
+
+def kvstore_set_barrier_before_exit(kv, flag):
+    kv._barrier_before_exit = bool(flag)
+    return 0
+
+
+def kvstore_send_command(kv, head, body):
+    """MXKVStoreSendCommmandToServers parity.  Commands are queued on the
+    handle; a same-process RunServer drains them (single-process analog
+    of the reference's worker->server command RPC,
+    kvstore_dist_server.h:28-85)."""
+    queue = getattr(kv, "_capi_commands", None)
+    if queue is None:
+        queue = kv._capi_commands = []
+    queue.append((int(head), str(body)))
+    kv._send_command_to_servers(int(head), str(body))
+    return 0
+
+
+def kvstore_run_server_c(kv, fn_addr, user_addr):
+    """MXKVStoreRunServer parity: the C controller receives each queued
+    command (head, body, user).  head 0 is kStopServer
+    (kvstore_dist_server.h:22) and ends the loop; with no stop command the
+    loop ends when the queue drains (single-process semantics — the
+    reference blocks on a remote socket instead)."""
+    import ctypes
+    ctrl_type = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_void_p)
+    ctrl = ctrl_type(fn_addr)
+    user = ctypes.c_void_p(user_addr or 0)
+    queue = getattr(kv, "_capi_commands", None) or []
+    while queue:
+        head, body = queue.pop(0)
+        if head == 0:           # kStopServer
+            break
+        ctrl(int(head), str(body).encode(), user)
+    return 0
+
+
+# ----------------------------------------------------------------------
+# data iter index + optimizer creator lookup
+# ----------------------------------------------------------------------
+def dataiter_get_index(it):
+    batch = it._capi_batch
+    idx = getattr(batch, "index", None)
+    if idx is None:
+        # sequential iterators (CSV/MNIST): index == record position
+        idx = getattr(it, "_capi_range", [])
+    return [int(i) for i in idx]
+
+
+def optimizer_find_creator(name):
+    """MXOptimizerFindCreator parity: resolve the registered optimizer
+    name; the returned handle is the canonical-name string the create
+    call consumes."""
+    from .optimizer import Optimizer
+    key = str(name).lower()
+    if key not in Optimizer.opt_registry:
+        raise ValueError("optimizer %r is not registered (have %s)"
+                         % (name, sorted(Optimizer.opt_registry)))
+    return key
+
+
+# ----------------------------------------------------------------------
+# MXCustomOpRegister: the reference's C custom-op protocol
+# (c_api.h CustomOpPropCreator / CustomOpPropInfo / CustomOpInfo;
+# consumed by src/operator/custom-inl.h:62-210).  A C creator fills a
+# struct of callbacks; the op then runs as a regular graph op with the
+# compute dispatched to the C callbacks via host callback, NDArray
+# handles + tags exactly as custom.cc:47-135 passes them
+# (in_data=0, out_data=1, in_grad=2, out_grad=3, aux=4).
+# ----------------------------------------------------------------------
+def _custom_ctypes():
+    import ctypes as ct
+
+    class CustomOpInfo(ct.Structure):
+        _compute_t = ct.CFUNCTYPE(ct.c_bool, ct.c_int,
+                                  ct.POINTER(ct.c_void_p),
+                                  ct.POINTER(ct.c_int),
+                                  ct.POINTER(ct.c_int), ct.c_bool,
+                                  ct.c_void_p)
+        _del_t = ct.CFUNCTYPE(ct.c_bool, ct.c_void_p)
+        _fields_ = [
+            ("forward", _compute_t),
+            ("backward", _compute_t),
+            ("del_", _del_t),
+            ("p_forward", ct.c_void_p),
+            ("p_backward", ct.c_void_p),
+            ("p_del", ct.c_void_p),
+        ]
+
+    class CustomOpPropInfo(ct.Structure):
+        _strlist_t = ct.CFUNCTYPE(ct.c_bool,
+                                  ct.POINTER(ct.POINTER(ct.c_char_p)),
+                                  ct.c_void_p)
+        _ishape_t = ct.CFUNCTYPE(ct.c_bool, ct.c_int, ct.POINTER(ct.c_int),
+                                 ct.POINTER(ct.POINTER(ct.c_uint)),
+                                 ct.c_void_p)
+        _bwddep_t = ct.CFUNCTYPE(ct.c_bool, ct.POINTER(ct.c_int),
+                                 ct.POINTER(ct.c_int), ct.POINTER(ct.c_int),
+                                 ct.POINTER(ct.c_int),
+                                 ct.POINTER(ct.POINTER(ct.c_int)),
+                                 ct.c_void_p)
+        _createop_t = ct.CFUNCTYPE(ct.c_bool, ct.c_char_p, ct.c_int,
+                                   ct.POINTER(ct.POINTER(ct.c_uint)),
+                                   ct.POINTER(ct.c_int),
+                                   ct.POINTER(ct.c_int),
+                                   ct.POINTER(CustomOpInfo), ct.c_void_p)
+        _del_t = ct.CFUNCTYPE(ct.c_bool, ct.c_void_p)
+        _fields_ = [
+            ("list_arguments", _strlist_t),
+            ("list_outputs", _strlist_t),
+            ("infer_shape", _ishape_t),
+            ("declare_backward_dependency", _bwddep_t),
+            ("create_operator", _createop_t),
+            ("list_auxiliary_states", _strlist_t),
+            ("del_", _del_t),
+            ("p_list_arguments", ct.c_void_p),
+            ("p_list_outputs", ct.c_void_p),
+            ("p_infer_shape", ct.c_void_p),
+            ("p_declare_backward_dependency", ct.c_void_p),
+            ("p_create_operator", ct.c_void_p),
+            ("p_list_auxiliary_states", ct.c_void_p),
+            ("p_del", ct.c_void_p),
+        ]
+
+    creator_t = ct.CFUNCTYPE(ct.c_bool, ct.c_char_p, ct.c_int,
+                             ct.POINTER(ct.c_char_p),
+                             ct.POINTER(ct.c_char_p),
+                             ct.POINTER(CustomOpPropInfo))
+    return ct, CustomOpInfo, CustomOpPropInfo, creator_t
+
+
+_REQ_CODE = {"null": 0, "write": 1, "inplace": 2, "add": 3}
+
+
+def custom_op_register_c(op_type, creator_addr):
+    """Register a C-implemented custom op under ``op_type`` so
+    ``mx.sym.Custom(op_type=...)`` (and the C symbol ABI) can use it."""
+    ct, CustomOpInfo, CustomOpPropInfo, creator_t = _custom_ctypes()
+    from . import operator as op_mod
+    from .base import MXNetError
+    from .ndarray import NDArray
+    creator = creator_t(creator_addr)
+    op_type = str(op_type)
+
+    def _strlist(fn, payload):
+        out = ct.POINTER(ct.c_char_p)()
+        if not fn(ct.byref(out), payload):
+            raise MXNetError("custom op %r: string-list callback failed"
+                             % op_type)
+        res = []
+        i = 0
+        while out[i]:
+            res.append(out[i].decode())
+            i += 1
+        return res
+
+    class _CCustomOp(op_mod.CustomOp):
+        def __init__(self, info):
+            self._info = info
+
+        def _dispatch(self, fn, payload, groups, reqs, train):
+            """groups: list of (arrays, tag); arrays are the numpy host
+            views — wrapped as NDArray handles for the C side, results
+            copied back after the call (custom.cc ptr/tag protocol)."""
+            ptrs, tags, keep = [], [], []
+            for arrays, tag in groups:
+                for a in arrays:
+                    nd = NDArray(_np.asarray(a))
+                    keep.append((nd, a))
+                    ptrs.append(id(nd))
+                    tags.append(tag)
+            n = len(ptrs)
+            c_ptrs = (ct.c_void_p * n)(*ptrs)
+            c_tags = (ct.c_int * n)(*tags)
+            c_reqs = (ct.c_int * len(reqs))(
+                *[_REQ_CODE.get(r, 1) for r in reqs])
+            if not fn(n, c_ptrs, c_tags, c_reqs, bool(train), payload):
+                raise MXNetError("custom op %r: compute callback failed"
+                                 % op_type)
+            for nd, a in keep:
+                host = nd.asnumpy()
+                a_np = _np.asarray(a)
+                if host.shape == a_np.shape and a_np.flags.writeable:
+                    a_np[...] = host.astype(a_np.dtype)
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self._dispatch(self._info.forward, self._info.p_forward,
+                           [(in_data, 0), (out_data, 1), (aux, 4)],
+                           req, is_train)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            # custom.cc:97-135 order: in_data, out_data, in_grad, aux,
+            # out_grad (tags 0, 1, 2, 4, 3)
+            self._dispatch(self._info.backward, self._info.p_backward,
+                           [(in_data, 0), (out_data, 1), (in_grad, 2),
+                            (aux, 4), (out_grad, 3)],
+                           req, True)
+
+    class _CCustomOpProp(op_mod.CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=True)
+            keys = sorted(kwargs)
+            c_keys = (ct.c_char_p * len(keys))(
+                *[k.encode() for k in keys])
+            c_vals = (ct.c_char_p * len(keys))(
+                *[str(kwargs[k]).encode() for k in keys])
+            self._info = CustomOpPropInfo()
+            if not creator(op_type.encode(), len(keys), c_keys, c_vals,
+                           ct.byref(self._info)):
+                raise MXNetError("custom op %r: creator failed" % op_type)
+
+        def list_arguments(self):
+            return _strlist(self._info.list_arguments,
+                            self._info.p_list_arguments)
+
+        def list_outputs(self):
+            return _strlist(self._info.list_outputs,
+                            self._info.p_list_outputs)
+
+        def list_auxiliary_states(self):
+            return _strlist(self._info.list_auxiliary_states,
+                            self._info.p_list_auxiliary_states)
+
+        def infer_shape(self, in_shape):
+            n_in = len(self.list_arguments())
+            n_out = len(self.list_outputs())
+            n_aux = len(self.list_auxiliary_states())
+            total = n_in + n_out + n_aux
+            ndims = (ct.c_int * total)()
+            shapes = (ct.POINTER(ct.c_uint) * total)()
+            keep = []
+            for i, s in enumerate(in_shape):
+                arr = (ct.c_uint * len(s))(*[int(d) for d in s])
+                keep.append(arr)
+                ndims[i] = len(s)
+                shapes[i] = ct.cast(arr, ct.POINTER(ct.c_uint))
+            if not self._info.infer_shape(total, ndims, shapes,
+                                          self._info.p_infer_shape):
+                raise MXNetError("custom op %r: infer_shape failed"
+                                 % op_type)
+
+            def _get(i):
+                return tuple(int(shapes[i][d]) for d in range(ndims[i]))
+
+            return ([_get(i) for i in range(n_in)],
+                    [_get(i) for i in range(n_in, n_in + n_out)],
+                    [_get(i) for i in range(n_in + n_out, total)])
+
+        def declare_backward_dependency(self, out_grad, in_data, out_data):
+            c_og = (ct.c_int * len(out_grad))(*out_grad)
+            c_id = (ct.c_int * len(in_data))(*in_data)
+            c_od = (ct.c_int * len(out_data))(*out_data)
+            num = ct.c_int(0)
+            rdeps = ct.POINTER(ct.c_int)()
+            if not self._info.declare_backward_dependency(
+                    c_og, c_id, c_od, ct.byref(num), ct.byref(rdeps),
+                    self._info.p_declare_backward_dependency):
+                raise MXNetError("custom op %r: backward-dependency "
+                                 "callback failed" % op_type)
+            return [int(rdeps[i]) for i in range(num.value)]
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            from .base import dtype_np_to_mx
+            n = len(in_shapes)
+            ndims = (ct.c_int * n)()
+            shapes = (ct.POINTER(ct.c_uint) * n)()
+            keep = []
+            for i, s in enumerate(in_shapes):
+                arr = (ct.c_uint * len(s))(*[int(d) for d in s])
+                keep.append(arr)
+                ndims[i] = len(s)
+                shapes[i] = ct.cast(arr, ct.POINTER(ct.c_uint))
+            dtypes = (ct.c_int * n)(
+                *[int(dtype_np_to_mx(_np.dtype(t))) for t in in_dtypes])
+            op_info = CustomOpInfo()
+            if not self._info.create_operator(
+                    str(ctx or "cpu").encode(), n, shapes, ndims, dtypes,
+                    ct.byref(op_info), self._info.p_create_operator):
+                raise MXNetError("custom op %r: create_operator failed"
+                                 % op_type)
+            op = _CCustomOp(op_info)
+            op._keep = keep
+            return op
+
+    _CCustomOpProp.__name__ = "CCustomOpProp_%s" % op_type
+    _CCustomOpProp._capi_creator = creator   # keep the thunk alive
+    op_mod.register(op_type)(_CCustomOpProp)
+    return 0
+
+
+# ----------------------------------------------------------------------
+# Rtc through C (MXRtcCreate/Push/Free): runtime kernels from source
+# ----------------------------------------------------------------------
+def rtc_create(name, input_names, output_names, inputs, outputs, kernel_src):
+    """MXRtcCreate parity.  The reference compiles CUDA C through NVRTC;
+    the TPU-native kernel language is Pallas/jax, so ``kernel_src`` is
+    Python source defining a function called ``name`` — either a Pallas
+    body taking (n_in + n_out) refs, or a jax function of n_in arrays
+    returning the outputs (rtc.py picks by arity).  Example NDArrays give
+    the output shapes/dtypes, as in the reference signature."""
+    from .rtc import Rtc
+    ns = {}
+    exec(compile(kernel_src, "<mxrtc:%s>" % name, "exec"), ns)
+    if name not in ns:
+        raise ValueError("kernel source does not define %r" % name)
+    fn = ns[name]
+    import inspect
+    arity = len(inspect.signature(fn).parameters)
+    n_in, n_out = len(inputs), len(outputs)
+    pallas = arity == n_in + n_out
+    out_shapes = [tuple(int(d) for d in o.shape) for o in outputs]
+    out_dtypes = [o.dtype for o in outputs]
+    rtc = Rtc(fn, n_outputs=n_out, pallas=pallas, out_shapes=out_shapes,
+              out_dtypes=out_dtypes)
+    rtc._capi_names = (list(input_names), list(output_names))
+    return rtc
+
+
+def rtc_push(rtc, inputs, outputs, grid_dims, block_dims):
+    outs = rtc.push(list(inputs), grid_dims=grid_dims, block_dims=block_dims)
+    for dst, src in zip(outputs, outs):
+        dst._set_data(src.data)
+    return 0
 
 
 def kvstore_set_c_updater(kv, fn_addr, user_handle_addr):

@@ -217,7 +217,21 @@ class Executor:
         self.aux_arrays = aux_list
         self.aux_dict = dict(zip(self._aux_names, aux_list))
 
-        self.outputs = [None] * len(self._out_names)
+        # outputs are allocated AT BIND and updated in place by forward:
+        # a handle taken once (MXExecutorOutputs, reference c_api.cc
+        # MXExecutorOutputs contract) stays aliased to the executor's
+        # live outputs across forwards
+        out_shapes = None
+        try:
+            _, out_shapes, _ = symbol.infer_shape(
+                **{n: a.shape for n, a in self.arg_dict.items()})
+        except Exception:
+            pass
+        if out_shapes is not None:
+            self.outputs = [NDArray(jnp.zeros(s), ctx=self._ctx)
+                            for s in out_shapes]
+        else:
+            self.outputs = [None] * len(self._out_names)
 
         # The traced program is a pure function of (symbol, group2ctx) — NOT
         # of this executor — and is cached on the symbol so every executor
@@ -241,6 +255,20 @@ class Executor:
         self._n_fused_step = 0
         self._n_monitored_compiled = 0
         self._fused_cache = None  # (optimizer id, jitted step)
+
+    def _publish_output(self, i, value):
+        """Update output slot i IN PLACE: the NDArray object is stable for
+        the life of the executor (MXExecutorOutputs handles stay aliased,
+        reference c_api.cc MXExecutorOutputs), only its buffer moves.
+        Dtype/shape may legitimately differ from the bind-time allocation
+        (Cast outputs, reshape) — rebind storage directly then."""
+        nd = self.outputs[i]
+        if nd is None:
+            self.outputs[i] = NDArray(value, ctx=self._ctx)
+        elif nd.dtype == value.dtype and nd.shape == value.shape:
+            nd._set_data(value)
+        else:
+            nd._storage = value
 
     @property
     def _trace(self):
@@ -283,7 +311,7 @@ class Executor:
             outs, aux_out = self._jit_forward(arg_values, aux_values, rng,
                                               is_train=bool(is_train))
         for i, o in enumerate(outs):
-            self.outputs[i] = NDArray(o, ctx=self._ctx)
+            self._publish_output(i, o)
         if is_train:
             for n, a in self.aux_dict.items():
                 if aux_out[n] is not aux_values[n]:
@@ -338,7 +366,7 @@ class Executor:
         outs, aux_out, grads = self._jit_fwd_bwd(arg_values, aux_values, rng,
                                                  ograds, wrt)
         for i, o in enumerate(outs):
-            self.outputs[i] = NDArray(o, ctx=self._ctx)
+            self._publish_output(i, o)
         for n, a in self.aux_dict.items():
             a._set_data(aux_out[n])
         for n in wrt_names:
@@ -466,7 +494,7 @@ class Executor:
             jnp.float32(lr), jnp.float32(optimizer.wd),
             jnp.int32(num_update))
         for i, o in enumerate(outs):
-            self.outputs[i] = NDArray(o, ctx=self._ctx)
+            self._publish_output(i, o)
         for n, a in self.aux_dict.items():
             a._set_data(aux_out[n])
         for n in wrt_names:

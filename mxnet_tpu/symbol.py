@@ -332,9 +332,32 @@ class Symbol:
 
     # -- grad (Symbol::Grad symbol.cc:569) ---------------------------------
     def grad(self, wrt):
-        raise MXNetError("Symbol.grad is not supported; bind with args_grad "
-                         "and call backward (autograd runs inside the jitted "
-                         "executor)")
+        """Gradient symbol (``Symbol::Grad`` parity, reference
+        symbol.cc:569).
+
+        Returns a new symbol whose arguments are this symbol's arguments
+        plus one head-gradient variable per output — named
+        ``<headnode>_<index>_grad`` exactly as the reference's backward
+        pass names them (static_graph.cc:448-452) — and whose outputs are
+        the gradients w.r.t. ``wrt`` (in order).  Where the reference
+        splices explicit Backward nodes into the graph, here the whole
+        subgraph runs under ``jax.vjp`` inside one traceable op: one XLA
+        computation, no per-node backward dispatch.
+        """
+        if isinstance(wrt, str):
+            wrt = [wrt]
+        wrt = list(wrt)
+        args = self.list_arguments()
+        missing = [w for w in wrt if w not in args]
+        if missing:
+            raise MXNetError("Symbol.grad: %s not in arguments %s"
+                             % (missing, args))
+        op = _GradProp(self, wrt)
+        name = NameManager.current().get(None, op.hint)
+        attrs = dict(AttrScope.current().get(None))
+        entries = [Variable(a)._heads[0] for a in op.list_arguments()]
+        node = _Node(op, name, entries, attrs)
+        return Symbol([(node, i) for i in range(op.num_outputs)])
 
     # -- serialization (reference JSON layout) -----------------------------
     def tojson(self):
@@ -367,6 +390,95 @@ class Symbol:
                 ins = ", ".join("%s[%d]" % (c.name, ci) for c, ci in n.inputs)
                 lines.append("%s(%s) -> %s" % (n.op.op_name, ins, n.name))
         return "\n".join(lines)
+
+
+class _GradProp:
+    """Operator backing ``Symbol.grad`` (reference Symbol::Grad,
+    symbol.cc:569 + MakeBackwardPass static_graph.cc:395).
+
+    Holds the base symbol; ``forward`` evaluates the base graph's trace
+    under ``jax.vjp`` and returns the cotangents of the requested
+    arguments.  Arguments = base args + head-gradient inputs (reference
+    naming ``<headnode>_<index>_grad``).  Not registered in OP_REGISTRY —
+    a grad symbol is constructed, bound, and executed, not re-parsed from
+    JSON (the reference's Grad symbols carry un-serializable
+    backward_source_node pointers too).
+    """
+
+    param_cls = None
+    op_name = "_Grad"
+    hint = "grad"
+
+    def __init__(self, base, wrt):
+        from .executor import _build_program
+        self.attrs = {}
+        self.param = None
+        self._base = base
+        self._wrt = list(wrt)
+        self._base_args = base.list_arguments()
+        self._aux_names = base.list_auxiliary_states()
+        self._head_names = ["%s_%d_grad" % (node.name, index)
+                            for node, index in base._heads]
+        prog = _build_program(base, {})
+        self._trace = prog.trace
+        self.need_rng = prog.needs_rng
+
+    # -- metadata ---------------------------------------------------------
+    def list_arguments(self):
+        return list(self._base_args) + list(self._head_names)
+
+    def list_outputs(self):
+        return ["%s_grad" % w for w in self._wrt]
+
+    def list_auxiliary_states(self):
+        return list(self._aux_names)
+
+    @property
+    def num_outputs(self):
+        return len(self._wrt)
+
+    # -- inference --------------------------------------------------------
+    def infer_shape(self, in_shapes):
+        n = len(self._base_args)
+        known = {k: v for k, v in zip(self._base_args, in_shapes[:n])
+                 if v is not None}
+        barg, bout, baux = self._base.infer_shape(**known)
+        full_in = list(barg) + list(bout)   # head grads shaped like outputs
+        out_shapes = [barg[self._base_args.index(w)] for w in self._wrt]
+        return full_in, out_shapes, list(baux)
+
+    def infer_type(self, in_types):
+        known = [t for t in in_types if t is not None]
+        base = known[0] if known else None
+        return ([base] * len(self.list_arguments()),
+                [base] * self.num_outputs,
+                [base] * len(self._aux_names))
+
+    # -- compute ----------------------------------------------------------
+    def forward(self, inputs, aux, is_train, rng):
+        import jax
+        import jax.numpy as jnp
+        from .executor import _zero_key
+        n = len(self._base_args)
+        arg_vals = dict(zip(self._base_args, inputs[:n]))
+        head_grads = list(inputs[n:])
+        aux_vals = dict(zip(self._aux_names, aux))
+        key = rng if rng is not None else _zero_key()
+
+        # the reference's backward pass differentiates the TRAINING
+        # computation (BatchNorm batch stats, Dropout active) regardless
+        # of the grad executor's own is_train flag
+        def f(wrt_vals):
+            merged = dict(arg_vals)
+            merged.update(wrt_vals)
+            return self._trace(merged, aux_vals, key, True)
+
+        wrt_in = {w: arg_vals[w] for w in self._wrt}
+        (outs, aux_out), vjp_fn = jax.vjp(f, wrt_in)
+        cot = ([jnp.asarray(h, o.dtype) for h, o in zip(head_grads, outs)],
+               jax.tree_util.tree_map(jnp.zeros_like, aux_out))
+        grads = vjp_fn(cot)[0]
+        return [grads[w] for w in self._wrt], None
 
 
 def Variable(name, attr=None, shape=None, **kwargs):

@@ -380,6 +380,7 @@ namespace {
 struct FuncInfo {
   std::string name;
   std::string description;
+  std::string key_var;  // key_var_num_args (atomic-symbol info only)
   std::vector<std::string> arg_names, arg_types, arg_descs;
   std::vector<const char*> pnames, ptypes, pdescs;  // C views
 };
@@ -1119,6 +1120,934 @@ int MXOptimizerUpdate(OptimizerHandle h, int index, NDArrayHandle weight,
                 Py_BuildValue("(OiOOff)", static_cast<PyObject*>(h), index,
                               static_cast<PyObject*>(weight),
                               static_cast<PyObject*>(grad), lr, wd));
+}
+
+// ====================================================================
+// Reference-surface completion: the remaining MX* names of the
+// reference's ~109-function ABI (c_api.h), same JSON/handle conventions
+// as above.
+// ====================================================================
+
+// ---- NDArray extras (c_api.cc:116-363) -----------------------------
+int MXNDArrayCreateNone(NDArrayHandle* out) {
+  Gil gil;
+  PyObject* nd = Call("ndarray_create_none", PyTuple_New(0));
+  if (!nd) return -1;
+  *out = nd;
+  return 0;
+}
+
+int MXNDArrayCreateEx(const uint32_t* shape, uint32_t ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out) {
+  Gil gil;
+  PyObject* dims = PyTuple_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyTuple_SetItem(dims, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* nd = Call("ndarray_create_ex",
+                      Py_BuildValue("(Niiii)", dims, dev_type, dev_id,
+                                    delay_alloc, dtype));
+  if (!nd) return -1;
+  *out = nd;
+  return 0;
+}
+
+int MXNDArrayAt(NDArrayHandle h, uint32_t idx, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* nd = Call("ndarray_at",
+                      Py_BuildValue("(OI)", static_cast<PyObject*>(h), idx));
+  if (!nd) return -1;
+  *out = nd;
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle h, int* out_dev_type,
+                        int* out_dev_id) {
+  Gil gil;
+  PyObject* tup = Call("ndarray_context",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!tup) return -1;
+  if (out_dev_type)
+    *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(tup, 0)));
+  if (out_dev_id)
+    *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(tup, 1)));
+  Py_DECREF(tup);
+  return 0;
+}
+
+// *out_pdata is a synced float32 host snapshot owned by the handle,
+// valid until the next MXNDArrayGetData on it (XLA buffers are not
+// host-addressable; see capi_impl.ndarray_data_addr).
+int MXNDArrayGetData(NDArrayHandle h, float** out_pdata) {
+  Gil gil;
+  PyObject* addr = Call("ndarray_data_addr",
+                        PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!addr) return -1;
+  *out_pdata = reinterpret_cast<float*>(PyLong_AsSize_t(addr));
+  Py_DECREF(addr);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle h) {
+  Gil gil;
+  return CallRC("ndarray_wait_read",
+                PyTuple_Pack(1, static_cast<PyObject*>(h)));
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle h) {
+  Gil gil;
+  return CallRC("ndarray_wait_write",
+                PyTuple_Pack(1, static_cast<PyObject*>(h)));
+}
+
+// *out_buf thread-local, valid until this thread's next SaveRawBytes.
+int MXNDArraySaveRawBytes(NDArrayHandle h, size_t* out_size,
+                          const char** out_buf) {
+  Gil gil;
+  PyObject* b = Call("ndarray_save_raw",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!b) return -1;
+  char* p = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(b, &p, &n) != 0) {
+    SetErrorFromPython();
+    Py_DECREF(b);
+    return -1;
+  }
+  thread_local std::string ret;
+  ret.assign(p, static_cast<size_t>(n));
+  Py_DECREF(b);
+  *out_size = ret.size();
+  *out_buf = ret.data();
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                              NDArrayHandle* out) {
+  Gil gil;
+  PyObject* nd = Call("ndarray_load_raw",
+                      Py_BuildValue("(N)", ReadView(buf, size)));
+  if (!nd) return -1;
+  *out = nd;
+  return 0;
+}
+
+int MXNotifyShutdown() {
+  Gil gil;
+  return CallRC("notify_shutdown", PyTuple_New(0));
+}
+
+// ---- Symbol completion (c_api.cc:447-937) --------------------------
+int MXSymbolCopy(SymbolHandle h, SymbolHandle* out) {
+  Gil gil;
+  PyObject* sym = Call("symbol_copy",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!sym) return -1;
+  *out = sym;
+  return 0;
+}
+
+int MXSymbolCreateGroup(uint32_t num_symbols, SymbolHandle* symbols,
+                        SymbolHandle* out) {
+  Gil gil;
+  PyObject* lst = PyList_New(num_symbols);
+  for (uint32_t i = 0; i < num_symbols; ++i) {
+    PyObject* s = static_cast<PyObject*>(symbols[i]);
+    Py_INCREF(s);
+    PyList_SetItem(lst, i, s);
+  }
+  PyObject* sym = Call("symbol_group", Py_BuildValue("(N)", lst));
+  if (!sym) return -1;
+  *out = sym;
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  Gil gil;
+  PyObject* sym = Call("symbol_from_file", Py_BuildValue("(s)", fname));
+  if (!sym) return -1;
+  *out = sym;
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle h, const char* fname) {
+  Gil gil;
+  return CallRC("symbol_save_file",
+                Py_BuildValue("(Os)", static_cast<PyObject*>(h), fname));
+}
+
+int MXSymbolGetInternals(SymbolHandle h, SymbolHandle* out) {
+  Gil gil;
+  PyObject* sym = Call("symbol_get_internals",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!sym) return -1;
+  *out = sym;
+  return 0;
+}
+
+int MXSymbolGrad(SymbolHandle h, uint32_t num_wrt, const char** wrt,
+                 SymbolHandle* out) {
+  Gil gil;
+  PyObject* names = PyList_New(num_wrt);
+  for (uint32_t i = 0; i < num_wrt; ++i)
+    PyList_SetItem(names, i, PyUnicode_FromString(wrt[i]));
+  PyObject* sym = Call("symbol_grad",
+                       Py_BuildValue("(ON)", static_cast<PyObject*>(h),
+                                     names));
+  if (!sym) return -1;
+  *out = sym;
+  return 0;
+}
+
+namespace {
+
+// string-array return helper (the reference's per-thread ret_vec_charp):
+// copies a python list[str] into thread-local storage and exposes it as
+// a const char** valid until this thread's next call through here.
+int FillStrArray(PyObject* lst, uint32_t* out_size,
+                 const char*** out_array) {
+  thread_local std::vector<std::string> store;
+  thread_local std::vector<const char*> ptrs;
+  store.clear();
+  ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+    store.push_back(s ? s : "");
+  }
+  for (auto& s : store) ptrs.push_back(s.c_str());
+  *out_size = static_cast<uint32_t>(ptrs.size());
+  *out_array = ptrs.data();
+  return 0;
+}
+
+int ListThrough(const char* impl_fn, PyObject* h, uint32_t* out_size,
+                const char*** out_array) {
+  PyObject* lst = Call(impl_fn, PyTuple_Pack(1, h));
+  if (!lst) return -1;
+  int rc = FillStrArray(lst, out_size, out_array);
+  Py_DECREF(lst);
+  return rc;
+}
+
+}  // namespace
+
+int MXSymbolListArguments(SymbolHandle h, uint32_t* out_size,
+                          const char*** out_str_array) {
+  Gil gil;
+  return ListThrough("symbol_arguments", static_cast<PyObject*>(h),
+                     out_size, out_str_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle h, uint32_t* out_size,
+                        const char*** out_str_array) {
+  Gil gil;
+  return ListThrough("symbol_outputs", static_cast<PyObject*>(h),
+                     out_size, out_str_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle h, uint32_t* out_size,
+                                const char*** out_str_array) {
+  Gil gil;
+  return ListThrough("symbol_aux_states", static_cast<PyObject*>(h),
+                     out_size, out_str_array);
+}
+
+namespace {
+
+int ListAttrPairs(PyObject* h, int deep, uint32_t* out_size,
+                  const char*** out) {
+  PyObject* lst = Call("symbol_attr_pairs",
+                       Py_BuildValue("(Oi)", h, deep));
+  if (!lst) return -1;
+  uint32_t n = 0;
+  int rc = FillStrArray(lst, &n, out);
+  Py_DECREF(lst);
+  *out_size = n / 2;  // reference convention: count of (key, value) PAIRS
+  return rc;
+}
+
+}  // namespace
+
+int MXSymbolListAttr(SymbolHandle h, uint32_t* out_size,
+                     const char*** out) {
+  Gil gil;
+  return ListAttrPairs(static_cast<PyObject*>(h), 1, out_size, out);
+}
+
+int MXSymbolListAttrShallow(SymbolHandle h, uint32_t* out_size,
+                            const char*** out) {
+  Gil gil;
+  return ListAttrPairs(static_cast<PyObject*>(h), 0, out_size, out);
+}
+
+int MXSymbolPrint(SymbolHandle h, const char** out_str) {
+  Gil gil;
+  PyObject* s = Call("symbol_print",
+                     PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!s) return -1;
+  thread_local std::string ret;
+  const char* c = PyUnicode_AsUTF8(s);
+  ret = c ? c : "";
+  Py_DECREF(s);
+  *out_str = ret.c_str();
+  return 0;
+}
+
+// ---- array-convention shape/type inference (reference CSR layout) --
+namespace {
+
+struct ShapeTriple {
+  // storage for the three shape lists (arg/out/aux) of one infer call
+  std::vector<std::vector<uint32_t>> shapes[3];
+  std::vector<uint32_t> ndims[3];
+  std::vector<const uint32_t*> data[3];
+};
+
+thread_local ShapeTriple g_infer_shapes;
+
+int UnpackShapeList(PyObject* lst, int slot, uint32_t* size,
+                    const uint32_t** ndim_out, const uint32_t*** data_out) {
+  auto& st = g_infer_shapes;
+  st.shapes[slot].clear();
+  st.ndims[slot].clear();
+  st.data[slot].clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i) {
+    PyObject* tup = PyList_GetItem(lst, i);
+    std::vector<uint32_t> dims;
+    for (Py_ssize_t d = 0; d < PyTuple_Size(tup); ++d)
+      dims.push_back(static_cast<uint32_t>(
+          PyLong_AsUnsignedLong(PyTuple_GetItem(tup, d))));
+    st.shapes[slot].push_back(std::move(dims));
+  }
+  for (auto& dims : st.shapes[slot]) {
+    st.ndims[slot].push_back(static_cast<uint32_t>(dims.size()));
+    st.data[slot].push_back(dims.data());
+  }
+  *size = static_cast<uint32_t>(st.shapes[slot].size());
+  *ndim_out = st.ndims[slot].data();
+  *data_out = st.data[slot].data();
+  return 0;
+}
+
+int InferShapeImpl(SymbolHandle h, uint32_t num_args, const char** keys,
+                   const uint32_t* arg_ind_ptr,
+                   const uint32_t* arg_shape_data, uint32_t* in_shape_size,
+                   const uint32_t** in_shape_ndim,
+                   const uint32_t*** in_shape_data,
+                   uint32_t* out_shape_size,
+                   const uint32_t** out_shape_ndim,
+                   const uint32_t*** out_shape_data,
+                   uint32_t* aux_shape_size,
+                   const uint32_t** aux_shape_ndim,
+                   const uint32_t*** aux_shape_data, int* complete,
+                   int partial) {
+  PyObject* pykeys = PyList_New(0);
+  if (keys) {
+    for (uint32_t i = 0; i < num_args; ++i) {
+      PyObject* s = PyUnicode_FromString(keys[i]);
+      PyList_Append(pykeys, s);
+      Py_DECREF(s);
+    }
+  }
+  PyObject* pyshapes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    uint32_t lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject* tup = PyTuple_New(hi - lo);
+    for (uint32_t d = lo; d < hi; ++d)
+      PyTuple_SetItem(tup, d - lo,
+                      PyLong_FromUnsignedLong(arg_shape_data[d]));
+    PyList_SetItem(pyshapes, i, tup);
+  }
+  PyObject* res = Call("symbol_infer_shape_arrays",
+                       Py_BuildValue("(ONNi)", static_cast<PyObject*>(h),
+                                     pykeys, pyshapes, partial));
+  if (!res) return -1;
+  UnpackShapeList(PyTuple_GetItem(res, 0), 0, in_shape_size, in_shape_ndim,
+                  in_shape_data);
+  UnpackShapeList(PyTuple_GetItem(res, 1), 1, out_shape_size,
+                  out_shape_ndim, out_shape_data);
+  UnpackShapeList(PyTuple_GetItem(res, 2), 2, aux_shape_size,
+                  aux_shape_ndim, aux_shape_data);
+  if (complete)
+    *complete = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 3)));
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+int MXSymbolInferShape(SymbolHandle h, uint32_t num_args, const char** keys,
+                       const uint32_t* arg_ind_ptr,
+                       const uint32_t* arg_shape_data,
+                       uint32_t* in_shape_size,
+                       const uint32_t** in_shape_ndim,
+                       const uint32_t*** in_shape_data,
+                       uint32_t* out_shape_size,
+                       const uint32_t** out_shape_ndim,
+                       const uint32_t*** out_shape_data,
+                       uint32_t* aux_shape_size,
+                       const uint32_t** aux_shape_ndim,
+                       const uint32_t*** aux_shape_data, int* complete) {
+  Gil gil;
+  return InferShapeImpl(h, num_args, keys, arg_ind_ptr, arg_shape_data,
+                        in_shape_size, in_shape_ndim, in_shape_data,
+                        out_shape_size, out_shape_ndim, out_shape_data,
+                        aux_shape_size, aux_shape_ndim, aux_shape_data,
+                        complete, 0);
+}
+
+int MXSymbolInferShapePartial(SymbolHandle h, uint32_t num_args,
+                              const char** keys,
+                              const uint32_t* arg_ind_ptr,
+                              const uint32_t* arg_shape_data,
+                              uint32_t* in_shape_size,
+                              const uint32_t** in_shape_ndim,
+                              const uint32_t*** in_shape_data,
+                              uint32_t* out_shape_size,
+                              const uint32_t** out_shape_ndim,
+                              const uint32_t*** out_shape_data,
+                              uint32_t* aux_shape_size,
+                              const uint32_t** aux_shape_ndim,
+                              const uint32_t*** aux_shape_data,
+                              int* complete) {
+  Gil gil;
+  return InferShapeImpl(h, num_args, keys, arg_ind_ptr, arg_shape_data,
+                        in_shape_size, in_shape_ndim, in_shape_data,
+                        out_shape_size, out_shape_ndim, out_shape_data,
+                        aux_shape_size, aux_shape_ndim, aux_shape_data,
+                        complete, 1);
+}
+
+int MXSymbolInferType(SymbolHandle h, uint32_t num_args, const char** keys,
+                      const int* arg_type_data, uint32_t* in_type_size,
+                      const int** in_type_data, uint32_t* out_type_size,
+                      const int** out_type_data, uint32_t* aux_type_size,
+                      const int** aux_type_data, int* complete) {
+  Gil gil;
+  PyObject* pykeys = PyList_New(0);
+  if (keys) {
+    for (uint32_t i = 0; i < num_args; ++i) {
+      PyObject* s = PyUnicode_FromString(keys[i]);
+      PyList_Append(pykeys, s);
+      Py_DECREF(s);
+    }
+  }
+  PyObject* pytypes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i)
+    PyList_SetItem(pytypes, i, PyLong_FromLong(arg_type_data[i]));
+  PyObject* res = Call("symbol_infer_type_arrays",
+                       Py_BuildValue("(ONN)", static_cast<PyObject*>(h),
+                                     pykeys, pytypes));
+  if (!res) return -1;
+  thread_local std::vector<int> store[3];
+  PyObject* lists[3] = {PyTuple_GetItem(res, 0), PyTuple_GetItem(res, 1),
+                        PyTuple_GetItem(res, 2)};
+  uint32_t* sizes[3] = {in_type_size, out_type_size, aux_type_size};
+  const int** datas[3] = {in_type_data, out_type_data, aux_type_data};
+  for (int k = 0; k < 3; ++k) {
+    store[k].clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(lists[k]); ++i)
+      store[k].push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GetItem(lists[k], i))));
+    if (sizes[k]) *sizes[k] = static_cast<uint32_t>(store[k].size());
+    if (datas[k]) *datas[k] = store[k].data();
+  }
+  if (complete)
+    *complete = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 3)));
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---- atomic symbol creators (c_api.cc:447-530) ---------------------
+typedef void* AtomicSymbolCreator;
+
+namespace {
+
+std::vector<FuncInfo*>* g_atomic_creators = nullptr;  // leaked on purpose
+
+int EnsureAtomicCreators() {
+  if (g_atomic_creators) return 0;
+  PyObject* lst = Call("registry_list_ops", PyTuple_New(0));
+  if (!lst) return -1;
+  auto* fns = new std::vector<FuncInfo*>();
+  for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i) {
+    const char* nm = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+    auto* fi = new FuncInfo();
+    fi->name = nm ? nm : "";
+    fns->push_back(fi);
+  }
+  Py_DECREF(lst);
+  g_atomic_creators = fns;
+  return 0;
+}
+
+}  // namespace
+
+int MXSymbolListAtomicSymbolCreators(uint32_t* out_size,
+                                     AtomicSymbolCreator** out_array) {
+  Gil gil;
+  if (EnsureAtomicCreators() != 0) return -1;
+  *out_size = static_cast<uint32_t>(g_atomic_creators->size());
+  *out_array =
+      reinterpret_cast<AtomicSymbolCreator*>(g_atomic_creators->data());
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char** name) {
+  Gil gil;
+  auto* fi = static_cast<FuncInfo*>(creator);
+  if (!fi) { SetError("null creator handle"); return -1; }
+  *name = fi->name.c_str();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char** name, const char** description,
+                                uint32_t* num_args, const char*** arg_names,
+                                const char*** arg_type_infos,
+                                const char*** arg_descriptions,
+                                const char** key_var_num_args) {
+  Gil gil;
+  auto* fi = static_cast<FuncInfo*>(creator);
+  if (!fi) { SetError("null creator handle"); return -1; }
+  if (fi->description.empty() && fi->arg_names.empty()) {
+    PyObject* tup = Call("registry_symbol_op_info",
+                         Py_BuildValue("(s)", fi->name.c_str()));
+    if (!tup) return -1;
+    const char* desc = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 1));
+    fi->description = desc ? desc : "";
+    PyObject* lists[3] = {PyTuple_GetItem(tup, 2), PyTuple_GetItem(tup, 3),
+                          PyTuple_GetItem(tup, 4)};
+    std::vector<std::string>* dsts[3] = {&fi->arg_names, &fi->arg_types,
+                                         &fi->arg_descs};
+    for (int k = 0; k < 3; ++k)
+      for (Py_ssize_t i = 0; i < PyList_Size(lists[k]); ++i) {
+        const char* s = PyUnicode_AsUTF8(PyList_GetItem(lists[k], i));
+        dsts[k]->push_back(s ? s : "");
+      }
+    const char* kv = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 5));
+    fi->key_var = kv ? kv : "";
+    Py_DECREF(tup);
+    for (auto& s : fi->arg_names) fi->pnames.push_back(s.c_str());
+    for (auto& s : fi->arg_types) fi->ptypes.push_back(s.c_str());
+    for (auto& s : fi->arg_descs) fi->pdescs.push_back(s.c_str());
+  }
+  if (name) *name = fi->name.c_str();
+  if (description) *description = fi->description.c_str();
+  if (num_args) *num_args = static_cast<uint32_t>(fi->arg_names.size());
+  if (arg_names) *arg_names = fi->pnames.data();
+  if (arg_type_infos) *arg_type_infos = fi->ptypes.data();
+  if (arg_descriptions) *arg_descriptions = fi->pdescs.data();
+  if (key_var_num_args) *key_var_num_args = fi->key_var.c_str();
+  return 0;
+}
+
+// ---- function registry completion ----------------------------------
+int MXGetFunction(const char* name, FunctionHandle* out) {
+  Gil gil;
+  if (EnsureFunctions() != 0) return -1;
+  for (auto* fi : *g_functions) {
+    if (fi->name == name) {
+      *out = fi;
+      return 0;
+    }
+  }
+  SetError("function not found");
+  return -1;
+}
+
+int MXFuncDescribe(FunctionHandle fn, uint32_t* num_use_vars,
+                   uint32_t* num_scalars, uint32_t* num_mutate_vars,
+                   int* type_mask) {
+  Gil gil;
+  auto* fi = static_cast<FuncInfo*>(fn);
+  if (!fi) { SetError("null function handle"); return -1; }
+  PyObject* tup = Call("registry_op_describe",
+                       Py_BuildValue("(s)", fi->name.c_str()));
+  if (!tup) return -1;
+  if (num_use_vars)
+    *num_use_vars = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(tup, 0)));
+  if (num_scalars)
+    *num_scalars = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(tup, 1)));
+  if (num_mutate_vars)
+    *num_mutate_vars = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(tup, 2)));
+  if (type_mask)
+    *type_mask = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(tup, 3)));
+  Py_DECREF(tup);
+  return 0;
+}
+
+// the reference's key/value-array invoke (vs MXFuncInvoke's JSON):
+// results are written INTO mutate_vars
+int MXFuncInvokeEx(FunctionHandle fn, NDArrayHandle* use_vars,
+                   float* scalar_args, NDArrayHandle* mutate_vars,
+                   int num_params, char** param_keys, char** param_vals) {
+  Gil gil;
+  auto* fi = static_cast<FuncInfo*>(fn);
+  if (!fi) { SetError("null function handle"); return -1; }
+  uint32_t n_use = 0, n_scalar = 0, n_mut = 0;
+  {
+    PyObject* tup = Call("registry_op_describe",
+                         Py_BuildValue("(s)", fi->name.c_str()));
+    if (!tup) return -1;
+    n_use = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(tup, 0)));
+    n_scalar = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(tup, 1)));
+    n_mut = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(tup, 2)));
+    Py_DECREF(tup);
+  }
+  // pass the param arrays straight through as python lists (no JSON
+  // round trip: arbitrary key/value strings stay intact)
+  PyObject* pkeys = PyList_New(num_params);
+  PyObject* pvals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SetItem(pkeys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SetItem(pvals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject* uses = PyList_New(n_use);
+  for (uint32_t i = 0; i < n_use; ++i) {
+    PyObject* a = static_cast<PyObject*>(use_vars[i]);
+    Py_INCREF(a);
+    PyList_SetItem(uses, i, a);
+  }
+  PyObject* scalars = PyList_New(n_scalar);
+  for (uint32_t i = 0; i < n_scalar; ++i)
+    PyList_SetItem(scalars, i,
+                   PyFloat_FromDouble(scalar_args ? scalar_args[i] : 0.0));
+  PyObject* muts = PyList_New(n_mut);
+  for (uint32_t i = 0; i < n_mut; ++i) {
+    PyObject* a = static_cast<PyObject*>(mutate_vars[i]);
+    Py_INCREF(a);
+    PyList_SetItem(muts, i, a);
+  }
+  return CallRC("func_invoke_into",
+                Py_BuildValue("(sNNNNN)", fi->name.c_str(), pkeys, pvals,
+                              uses, scalars, muts));
+}
+
+// ---- executor completion (c_api.cc:939-1099) -----------------------
+namespace {
+
+int BindImpl(SymbolHandle sym, int dev_type, int dev_id,
+             uint32_t num_map_keys, const char** map_keys,
+             const int* map_dev_types, const int* map_dev_ids, uint32_t len,
+             NDArrayHandle* in_args, NDArrayHandle* arg_grad_store,
+             uint32_t* grad_req_type, uint32_t aux_states_len,
+             NDArrayHandle* aux_states, ExecutorHandle shared_exec,
+             ExecutorHandle* out) {
+  PyObject* args = PyList_New(len);
+  for (uint32_t i = 0; i < len; ++i) {
+    PyObject* a = static_cast<PyObject*>(in_args[i]);
+    Py_INCREF(a);
+    PyList_SetItem(args, i, a);
+  }
+  PyObject* grads = PyList_New(len);
+  for (uint32_t i = 0; i < len; ++i) {
+    PyObject* g = arg_grad_store && arg_grad_store[i]
+                      ? static_cast<PyObject*>(arg_grad_store[i])
+                      : Py_None;
+    Py_INCREF(g);
+    PyList_SetItem(grads, i, g);
+  }
+  PyObject* reqs = PyList_New(len);
+  for (uint32_t i = 0; i < len; ++i)
+    PyList_SetItem(reqs, i,
+                   PyLong_FromUnsignedLong(grad_req_type ? grad_req_type[i]
+                                                         : 1));
+  PyObject* auxs = PyList_New(aux_states_len);
+  for (uint32_t i = 0; i < aux_states_len; ++i) {
+    PyObject* a = static_cast<PyObject*>(aux_states[i]);
+    Py_INCREF(a);
+    PyList_SetItem(auxs, i, a);
+  }
+  PyObject* mkeys = PyList_New(0);
+  PyObject* mtypes = PyList_New(0);
+  PyObject* mids = PyList_New(0);
+  for (uint32_t i = 0; i < num_map_keys; ++i) {
+    PyObject* s = PyUnicode_FromString(map_keys[i]);
+    PyList_Append(mkeys, s);
+    Py_DECREF(s);
+    PyObject* t = PyLong_FromLong(map_dev_types[i]);
+    PyList_Append(mtypes, t);
+    Py_DECREF(t);
+    PyObject* d = PyLong_FromLong(map_dev_ids[i]);
+    PyList_Append(mids, d);
+    Py_DECREF(d);
+  }
+  PyObject* shared = shared_exec ? static_cast<PyObject*>(shared_exec)
+                                 : Py_None;
+  PyObject* exec_ = Call(
+      "executor_bind_full",
+      Py_BuildValue("(OiiNNNNNNNO)", static_cast<PyObject*>(sym), dev_type,
+                    dev_id, args, grads, reqs, auxs, mkeys, mtypes, mids,
+                    shared));
+  if (!exec_) return -1;
+  *out = exec_;
+  return 0;
+}
+
+}  // namespace
+
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id, uint32_t len,
+                   NDArrayHandle* in_args, NDArrayHandle* arg_grad_store,
+                   uint32_t* grad_req_type, uint32_t aux_states_len,
+                   NDArrayHandle* aux_states, ExecutorHandle* out) {
+  Gil gil;
+  return BindImpl(sym, dev_type, dev_id, 0, nullptr, nullptr, nullptr, len,
+                  in_args, arg_grad_store, grad_req_type, aux_states_len,
+                  aux_states, nullptr, out);
+}
+
+int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                    uint32_t num_map_keys, const char** map_keys,
+                    const int* map_dev_types, const int* map_dev_ids,
+                    uint32_t len, NDArrayHandle* in_args,
+                    NDArrayHandle* arg_grad_store, uint32_t* grad_req_type,
+                    uint32_t aux_states_len, NDArrayHandle* aux_states,
+                    ExecutorHandle* out) {
+  Gil gil;
+  return BindImpl(sym, dev_type, dev_id, num_map_keys, map_keys,
+                  map_dev_types, map_dev_ids, len, in_args, arg_grad_store,
+                  grad_req_type, aux_states_len, aux_states, nullptr, out);
+}
+
+int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                     uint32_t num_map_keys, const char** map_keys,
+                     const int* map_dev_types, const int* map_dev_ids,
+                     uint32_t len, NDArrayHandle* in_args,
+                     NDArrayHandle* arg_grad_store, uint32_t* grad_req_type,
+                     uint32_t aux_states_len, NDArrayHandle* aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle* out) {
+  Gil gil;
+  return BindImpl(sym, dev_type, dev_id, num_map_keys, map_keys,
+                  map_dev_types, map_dev_ids, len, in_args, arg_grad_store,
+                  grad_req_type, aux_states_len, aux_states, shared_exec,
+                  out);
+}
+
+// handle ARRAY thread-local until the next call; each handle owned by
+// the caller (same convention as MXNDArrayLoad)
+int MXExecutorOutputs(ExecutorHandle h, uint32_t* out_size,
+                      NDArrayHandle** out) {
+  Gil gil;
+  PyObject* lst = Call("executor_outputs",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!lst) return -1;
+  thread_local std::vector<PyObject*> arrs;
+  arrs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i) {
+    PyObject* a = PyList_GetItem(lst, i);
+    Py_INCREF(a);  // transferred to the caller
+    arrs.push_back(a);
+  }
+  Py_DECREF(lst);
+  *out_size = static_cast<uint32_t>(arrs.size());
+  *out = reinterpret_cast<NDArrayHandle*>(arrs.data());
+  return 0;
+}
+
+typedef void (*ExecutorMonitorCallback)(const char*, NDArrayHandle, void*);
+
+int MXExecutorSetMonitorCallback(ExecutorHandle h,
+                                 ExecutorMonitorCallback callback,
+                                 void* callback_handle) {
+  Gil gil;
+  return CallRC("executor_set_monitor_c",
+                Py_BuildValue("(Onn)", static_cast<PyObject*>(h),
+                              reinterpret_cast<Py_ssize_t>(callback),
+                              reinterpret_cast<Py_ssize_t>(callback_handle)));
+}
+
+// ---- kvstore completion (c_api.cc:1199-1375) -----------------------
+int MXInitPSEnv(uint32_t num_vars, const char** keys, const char** vals) {
+  Gil gil;
+  PyObject* ks = PyList_New(num_vars);
+  PyObject* vs = PyList_New(num_vars);
+  for (uint32_t i = 0; i < num_vars; ++i) {
+    PyList_SetItem(ks, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(vs, i, PyUnicode_FromString(vals[i]));
+  }
+  return CallRC("init_ps_env", Py_BuildValue("(NN)", ks, vs));
+}
+
+namespace {
+
+int RoleQuery(const char* fn, int* ret) {
+  PyObject* n = Call(fn, PyTuple_New(0));
+  if (!n) return -1;
+  *ret = static_cast<int>(PyLong_AsLong(n));
+  Py_DECREF(n);
+  return 0;
+}
+
+}  // namespace
+
+int MXKVStoreIsWorkerNode(int* ret) {
+  Gil gil;
+  return RoleQuery("kvstore_is_worker", ret);
+}
+
+int MXKVStoreIsServerNode(int* ret) {
+  Gil gil;
+  return RoleQuery("kvstore_is_server", ret);
+}
+
+int MXKVStoreIsSchedulerNode(int* ret) {
+  Gil gil;
+  return RoleQuery("kvstore_is_scheduler", ret);
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle h, const int node_id, int* number,
+                            const int timeout_sec) {
+  Gil gil;
+  PyObject* n = Call("kvstore_num_dead",
+                     Py_BuildValue("(Oii)", static_cast<PyObject*>(h),
+                                   node_id, timeout_sec));
+  if (!n) return -1;
+  *number = static_cast<int>(PyLong_AsLong(n));
+  Py_DECREF(n);
+  return 0;
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle h,
+                                  const int barrier_before_exit) {
+  Gil gil;
+  return CallRC("kvstore_set_barrier_before_exit",
+                Py_BuildValue("(Oi)", static_cast<PyObject*>(h),
+                              barrier_before_exit));
+}
+
+// (sic) the reference's triple-m typo is part of its ABI
+int MXKVStoreSendCommmandToServers(KVStoreHandle h, int cmd_id,
+                                   const char* cmd_body) {
+  Gil gil;
+  return CallRC("kvstore_send_command",
+                Py_BuildValue("(Ois)", static_cast<PyObject*>(h), cmd_id,
+                              cmd_body ? cmd_body : ""));
+}
+
+typedef void (MXKVStoreServerController)(int head, const char* body,
+                                         void* controller_handle);
+
+int MXKVStoreRunServer(KVStoreHandle h, MXKVStoreServerController controller,
+                       void* controller_handle) {
+  Gil gil;
+  return CallRC("kvstore_run_server_c",
+                Py_BuildValue("(Onn)", static_cast<PyObject*>(h),
+                              reinterpret_cast<Py_ssize_t>(controller),
+                              reinterpret_cast<Py_ssize_t>(
+                                  controller_handle)));
+}
+
+// ---- data iter index ------------------------------------------------
+// *out_index thread-local until this thread's next call
+int MXDataIterGetIndex(DataIterHandle h, uint64_t** out_index,
+                       uint64_t* out_size) {
+  Gil gil;
+  PyObject* lst = Call("dataiter_get_index",
+                       PyTuple_Pack(1, static_cast<PyObject*>(h)));
+  if (!lst) return -1;
+  thread_local std::vector<uint64_t> idx;
+  idx.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i)
+    idx.push_back(static_cast<uint64_t>(
+        PyLong_AsUnsignedLongLong(PyList_GetItem(lst, i))));
+  Py_DECREF(lst);
+  *out_size = idx.size();
+  *out_index = idx.data();
+  return 0;
+}
+
+// ---- optimizer creator lookup ---------------------------------------
+typedef void* OptimizerCreator;
+
+int MXOptimizerFindCreator(const char* key, OptimizerCreator* out) {
+  Gil gil;
+  PyObject* name = Call("optimizer_find_creator", Py_BuildValue("(s)", key));
+  if (!name) return -1;
+  *out = name;  // canonical-name str object; consumed by CreateOptimizer
+  return 0;
+}
+
+// ---- Rtc: runtime kernels through C (reference MXRtc* over NVRTC;
+// here the kernel source is Python/Pallas — see capi_impl.rtc_create)
+typedef void* RtcHandle;
+
+int MXRtcCreate(char* name, uint32_t num_input, uint32_t num_output,
+                char** input_names, char** output_names,
+                NDArrayHandle* inputs, NDArrayHandle* outputs, char* kernel,
+                RtcHandle* out) {
+  Gil gil;
+  PyObject* in_names = PyList_New(num_input);
+  for (uint32_t i = 0; i < num_input; ++i)
+    PyList_SetItem(in_names, i, PyUnicode_FromString(input_names[i]));
+  PyObject* out_names = PyList_New(num_output);
+  for (uint32_t i = 0; i < num_output; ++i)
+    PyList_SetItem(out_names, i, PyUnicode_FromString(output_names[i]));
+  PyObject* ins = PyList_New(num_input);
+  for (uint32_t i = 0; i < num_input; ++i) {
+    PyObject* a = static_cast<PyObject*>(inputs[i]);
+    Py_INCREF(a);
+    PyList_SetItem(ins, i, a);
+  }
+  PyObject* outs = PyList_New(num_output);
+  for (uint32_t i = 0; i < num_output; ++i) {
+    PyObject* a = static_cast<PyObject*>(outputs[i]);
+    Py_INCREF(a);
+    PyList_SetItem(outs, i, a);
+  }
+  PyObject* rtc = Call("rtc_create",
+                       Py_BuildValue("(sNNNNs)", name, in_names, out_names,
+                                     ins, outs, kernel));
+  if (!rtc) return -1;
+  *out = rtc;
+  return 0;
+}
+
+int MXRtcPush(RtcHandle h, uint32_t num_input, uint32_t num_output,
+              NDArrayHandle* inputs, NDArrayHandle* outputs,
+              uint32_t gridDimX, uint32_t gridDimY, uint32_t gridDimZ,
+              uint32_t blockDimX, uint32_t blockDimY, uint32_t blockDimZ) {
+  Gil gil;
+  PyObject* ins = PyList_New(num_input);
+  for (uint32_t i = 0; i < num_input; ++i) {
+    PyObject* a = static_cast<PyObject*>(inputs[i]);
+    Py_INCREF(a);
+    PyList_SetItem(ins, i, a);
+  }
+  PyObject* outs = PyList_New(num_output);
+  for (uint32_t i = 0; i < num_output; ++i) {
+    PyObject* a = static_cast<PyObject*>(outputs[i]);
+    Py_INCREF(a);
+    PyList_SetItem(outs, i, a);
+  }
+  PyObject* grid = Py_BuildValue("(III)", gridDimX, gridDimY, gridDimZ);
+  PyObject* block = Py_BuildValue("(III)", blockDimX, blockDimY, blockDimZ);
+  return CallRC("rtc_push",
+                Py_BuildValue("(ONNNN)", static_cast<PyObject*>(h), ins,
+                              outs, grid, block));
+}
+
+int MXRtcFree(RtcHandle h) { return MXNDArrayFree(h); }
+
+// ---- custom op registration (reference CustomOpPropCreator protocol;
+// struct layouts declared in include/mxtpu/c_api.h, mirrored by the
+// ctypes Structures in capi_impl._custom_ctypes) ---------------------
+typedef bool (*CustomOpPropCreator)(const char* op_type, const int num_kwargs,
+                                    const char** keys, const char** values,
+                                    void* prop_info);
+
+int MXCustomOpRegister(const char* op_type, CustomOpPropCreator creator) {
+  Gil gil;
+  return CallRC("custom_op_register_c",
+                Py_BuildValue("(sn)", op_type,
+                              reinterpret_cast<Py_ssize_t>(creator)));
 }
 
 }  // extern "C"

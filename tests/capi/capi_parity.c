@@ -1,0 +1,600 @@
+/* C consumer for the reference-surface completion of the ABI: the MX*
+ * families added to reach the reference's full ~109-name c_api.h —
+ * NDArray extras, symbol listing/inference/grad, atomic-symbol info,
+ * function describe/invoke-ex, full Bind, monitor callback, kvstore
+ * roles/commands/server loop, data-iter index, optimizer creator
+ * lookup, Rtc, and a custom operator implemented ENTIRELY in C through
+ * the CustomOpPropCreator callback-struct protocol.
+ *
+ * Built and run by `make test-capi` (pytest wrapper sets
+ * MXTPU_SYMBOL_JSON / MXTPU_SCRATCH). */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+#define CHECK(rc) do { \
+    if ((rc) != 0) { \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, \
+              MXGetLastError()); \
+      return 1; \
+    } } while (0)
+
+#define EXPECT(cond, msg) do { \
+    if (!(cond)) { \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, msg); \
+      return 1; \
+    } } while (0)
+
+/* ---------------- custom op "cscale" implemented in C ---------------- */
+/* forward: out = 2 * in; backward: in_grad = 2 * out_grad */
+
+static int g_cscale_forward_calls = 0;
+static int g_cscale_backward_calls = 0;
+
+static bool cscale_list_arguments(char*** args, void* state) {
+  static char* names[] = {(char*)"data", NULL};
+  (void)state;
+  *args = names;
+  return true;
+}
+
+static bool cscale_list_outputs(char*** outputs, void* state) {
+  static char* names[] = {(char*)"output", NULL};
+  (void)state;
+  *outputs = names;
+  return true;
+}
+
+static bool cscale_list_aux(char*** aux, void* state) {
+  static char* names[] = {NULL};
+  (void)state;
+  *aux = names;
+  return true;
+}
+
+static bool cscale_infer_shape(int num_total, int* ndims, unsigned** shapes,
+                               void* state) {
+  (void)state;
+  /* one input, one output, no aux: output mirrors input */
+  if (num_total != 2) return false;
+  ndims[1] = ndims[0];
+  shapes[1] = shapes[0];
+  return true;
+}
+
+static bool cscale_backward_dep(const int* out_grad, const int* in_data,
+                                const int* out_data, int* num_deps,
+                                int** rdeps, void* state) {
+  static int deps[3];
+  (void)in_data;
+  (void)out_data;
+  (void)state;
+  deps[0] = out_grad[0];
+  *num_deps = 1;
+  *rdeps = deps;
+  return true;
+}
+
+static bool cscale_compute(int size, void** ptrs, int* tags,
+                           const int* reqs, const bool is_train,
+                           void* state) {
+  /* scale the tag-0 input (forward: in_data; backward: the out_grad
+   * arrives tagged 3) into the writable target (out_data=1 fwd,
+   * in_grad=2 bwd) through the public NDArray C API */
+  float buf[64];
+  int src = -1, dst = -1, i;
+  int is_fwd = (state == (void*)1);
+  (void)reqs;
+  (void)is_train;
+  for (i = 0; i < size; ++i) {
+    if (is_fwd && tags[i] == 0) src = i;
+    if (is_fwd && tags[i] == 1) dst = i;
+    if (!is_fwd && tags[i] == 3) src = i;
+    if (!is_fwd && tags[i] == 2) dst = i;
+  }
+  if (src < 0 || dst < 0) return false;
+  {
+    uint32_t ndim, shp[4], n = 1;
+    if (MXNDArrayGetShape(ptrs[src], &ndim, shp, 4) != 0) return false;
+    for (i = 0; i < (int)ndim; ++i) n *= shp[i];
+    if (n > 64) return false;
+    if (MXNDArraySyncCopyToCPU(ptrs[src], buf, n) != 0) return false;
+    for (i = 0; i < (int)n; ++i) buf[i] *= 2.0f;
+    if (MXNDArraySyncCopyFromCPU(ptrs[dst], buf, n) != 0) return false;
+  }
+  if (is_fwd) ++g_cscale_forward_calls; else ++g_cscale_backward_calls;
+  return true;
+}
+
+static bool cscale_del(void* state) {
+  (void)state;
+  return true;
+}
+
+static bool cscale_create_operator(const char* ctx, int num_inputs,
+                                   unsigned** shapes, int* ndims,
+                                   int* dtypes, struct MXCustomOpInfo* ret,
+                                   void* state) {
+  (void)ctx;
+  (void)num_inputs;
+  (void)shapes;
+  (void)ndims;
+  (void)dtypes;
+  (void)state;
+  ret->forward = cscale_compute;
+  ret->backward = cscale_compute;
+  ret->del = cscale_del;
+  ret->p_forward = (void*)1;   /* state flags fwd vs bwd dispatch */
+  ret->p_backward = (void*)0;
+  ret->p_del = NULL;
+  return true;
+}
+
+static bool cscale_creator(const char* op_type, const int num_kwargs,
+                           const char** keys, const char** values,
+                           struct MXCustomOpPropInfo* ret) {
+  (void)op_type;
+  (void)num_kwargs;
+  (void)keys;
+  (void)values;
+  ret->list_arguments = cscale_list_arguments;
+  ret->list_outputs = cscale_list_outputs;
+  ret->infer_shape = cscale_infer_shape;
+  ret->declare_backward_dependency = cscale_backward_dep;
+  ret->create_operator = cscale_create_operator;
+  ret->list_auxiliary_states = cscale_list_aux;
+  ret->del = cscale_del;
+  ret->p_list_arguments = NULL;
+  ret->p_list_outputs = NULL;
+  ret->p_infer_shape = NULL;
+  ret->p_declare_backward_dependency = NULL;
+  ret->p_create_operator = NULL;
+  ret->p_list_auxiliary_states = NULL;
+  ret->p_del = NULL;
+  return true;
+}
+
+/* ---------------- monitor + server-controller callbacks -------------- */
+static void monitor_cb(const char* name, NDArrayHandle arr, void* user) {
+  (void)name;
+  (void)arr;
+  ++*(int*)user;
+}
+
+static void server_controller(int head, const char* body, void* user) {
+  if (head == 7 && strcmp(body, "hello") == 0) ++*(int*)user;
+}
+
+int main(void) {
+  const char* scratch = getenv("MXTPU_SCRATCH");
+  EXPECT(scratch != NULL, "MXTPU_SCRATCH not set");
+
+  /* --- NDArray extras ------------------------------------------------ */
+  NDArrayHandle none_h;
+  CHECK(MXNDArrayCreateNone(&none_h));
+  CHECK(MXNDArrayFree(none_h));
+
+  uint32_t shape[2] = {2, 3};
+  NDArrayHandle a;
+  CHECK(MXNDArrayCreateEx(shape, 2, 1 /*cpu*/, 0, 0, 0 /*f32*/, &a));
+  int dev_type = -1, dev_id = -1;
+  CHECK(MXNDArrayGetContext(a, &dev_type, &dev_id));
+  EXPECT(dev_type == 1 && dev_id == 0, "context mismatch");
+
+  float vals[6] = {1, 2, 3, 4, 5, 6};
+  CHECK(MXNDArraySyncCopyFromCPU(a, vals, 6));
+  CHECK(MXNDArrayWaitToRead(a));
+  CHECK(MXNDArrayWaitToWrite(a));
+
+  float* pdata = NULL;
+  CHECK(MXNDArrayGetData(a, &pdata));
+  EXPECT(pdata != NULL && pdata[4] == 5.0f, "GetData snapshot wrong");
+
+  NDArrayHandle row;
+  CHECK(MXNDArrayAt(a, 1, &row));
+  uint32_t ndim, got[4];
+  CHECK(MXNDArrayGetShape(row, &ndim, got, 4));
+  EXPECT(ndim == 1 && got[0] == 3, "At() shape wrong");
+  CHECK(MXNDArrayFree(row));
+
+  size_t raw_size = 0;
+  const char* raw_buf = NULL;
+  CHECK(MXNDArraySaveRawBytes(a, &raw_size, &raw_buf));
+  EXPECT(raw_size > 6 * 4, "raw bytes too small");
+  NDArrayHandle b;
+  CHECK(MXNDArrayLoadFromRawBytes(raw_buf, raw_size, &b));
+  float back[6] = {0};
+  CHECK(MXNDArraySyncCopyToCPU(b, back, 6));
+  EXPECT(back[5] == 6.0f, "raw roundtrip wrong");
+  CHECK(MXNDArrayFree(b));
+
+  /* --- symbol listing / copy / group / internals / files ------------- */
+  const char* sym_json = getenv("MXTPU_SYMBOL_JSON");
+  EXPECT(sym_json != NULL, "MXTPU_SYMBOL_JSON not set");
+  SymbolHandle mlp;
+  CHECK(MXSymbolCreateFromFile(sym_json, &mlp));
+
+  uint32_t n_args = 0;
+  const char** arg_names = NULL;
+  CHECK(MXSymbolListArguments(mlp, &n_args, &arg_names));
+  EXPECT(n_args >= 3, "too few arguments");
+  EXPECT(strcmp(arg_names[0], "data") == 0, "first arg not data");
+
+  uint32_t n_outs = 0;
+  const char** out_names = NULL;
+  CHECK(MXSymbolListOutputs(mlp, &n_outs, &out_names));
+  EXPECT(n_outs == 1, "mlp should have one output");
+
+  uint32_t n_aux = 0;
+  const char** aux_names = NULL;
+  CHECK(MXSymbolListAuxiliaryStates(mlp, &n_aux, &aux_names));
+
+  SymbolHandle mlp2;
+  CHECK(MXSymbolCopy(mlp, &mlp2));
+  const char* printed = NULL;
+  CHECK(MXSymbolPrint(mlp2, &printed));
+  EXPECT(strlen(printed) > 10, "debug print too short");
+
+  SymbolHandle internals;
+  CHECK(MXSymbolGetInternals(mlp, &internals));
+  uint32_t n_int = 0;
+  const char** int_names = NULL;
+  CHECK(MXSymbolListOutputs(internals, &n_int, &int_names));
+  EXPECT(n_int > n_outs, "internals should expose more outputs");
+  CHECK(MXSymbolFree(internals));
+
+  SymbolHandle grp;
+  {
+    SymbolHandle parts[2] = {mlp, mlp2};
+    CHECK(MXSymbolCreateGroup(2, parts, &grp));
+    uint32_t n_grp = 0;
+    const char** grp_names = NULL;
+    CHECK(MXSymbolListOutputs(grp, &n_grp, &grp_names));
+    EXPECT(n_grp == 2, "group output count");
+    CHECK(MXSymbolFree(grp));
+  }
+
+  char fname[512];
+  snprintf(fname, sizeof fname, "%s/roundtrip-symbol.json", scratch);
+  CHECK(MXSymbolSaveToFile(mlp, fname));
+  SymbolHandle mlp3;
+  CHECK(MXSymbolCreateFromFile(fname, &mlp3));
+  CHECK(MXSymbolFree(mlp3));
+
+  uint32_t n_attr = 0;
+  const char** attrs = NULL;
+  CHECK(MXSymbolListAttr(mlp, &n_attr, &attrs));          /* deep ok */
+  CHECK(MXSymbolListAttrShallow(mlp, &n_attr, &attrs));   /* shallow ok */
+
+  /* --- CSR shape + type inference ------------------------------------ */
+  {
+    const char* keys[1] = {"data"};
+    uint32_t ind_ptr[2] = {0, 2};
+    uint32_t shape_data[2] = {2, 10};
+    uint32_t in_sz, out_sz, aux_sz;
+    const uint32_t *in_nd, *out_nd, *aux_nd;
+    const uint32_t **in_sh, **out_sh, **aux_sh;
+    int complete = 0;
+    CHECK(MXSymbolInferShape(mlp, 1, keys, ind_ptr, shape_data, &in_sz,
+                             &in_nd, &in_sh, &out_sz, &out_nd, &out_sh,
+                             &aux_sz, &aux_nd, &aux_sh, &complete));
+    EXPECT(complete == 1, "shape inference incomplete");
+    EXPECT(in_sz == n_args, "in shape count");
+    EXPECT(out_sz == 1 && out_nd[0] == 2 && out_sh[0][0] == 2,
+           "output shape wrong");
+
+    int type_data[1] = {0 /* f32 */};
+    uint32_t it_sz, ot_sz, at_sz;
+    const int *it_d, *ot_d, *at_d;
+    CHECK(MXSymbolInferType(mlp, 1, keys, type_data, &it_sz, &it_d, &ot_sz,
+                            &ot_d, &at_sz, &at_d, &complete));
+    EXPECT(ot_sz == 1 && ot_d[0] == 0, "output type wrong");
+
+    /* positional CSR form: one slot per argument, 0-dim = unknown */
+    {
+      uint32_t pos_ind[16];
+      uint32_t i;
+      EXPECT(n_args + 1 <= 16, "too many args for positional test");
+      pos_ind[0] = 0;
+      pos_ind[1] = 2;                 /* data gets (2, 10) */
+      for (i = 2; i <= n_args; ++i) pos_ind[i] = 2;  /* rest unknown */
+      CHECK(MXSymbolInferShape(mlp, n_args, NULL, pos_ind, shape_data,
+                               &in_sz, &in_nd, &in_sh, &out_sz, &out_nd,
+                               &out_sh, &aux_sz, &aux_nd, &aux_sh,
+                               &complete));
+      EXPECT(complete == 1, "positional inference incomplete");
+      EXPECT(out_sh[0][0] == 2, "positional output batch wrong");
+    }
+  }
+
+  /* --- atomic symbol creators ---------------------------------------- */
+  {
+    uint32_t n_creators = 0;
+    AtomicSymbolCreator* creators = NULL;
+    CHECK(MXSymbolListAtomicSymbolCreators(&n_creators, &creators));
+    EXPECT(n_creators > 80, "registry too small");
+    int found_fc = 0;
+    for (uint32_t i = 0; i < n_creators; ++i) {
+      const char* nm = NULL;
+      CHECK(MXSymbolGetAtomicSymbolName(creators[i], &nm));
+      if (strcmp(nm, "FullyConnected") == 0) {
+        const char *name2, *desc, *key_var;
+        uint32_t na;
+        const char **an, **at, **ad;
+        CHECK(MXSymbolGetAtomicSymbolInfo(creators[i], &name2, &desc, &na,
+                                          &an, &at, &ad, &key_var));
+        int has_nh = 0;
+        for (uint32_t k = 0; k < na; ++k)
+          if (strcmp(an[k], "num_hidden") == 0) has_nh = 1;
+        EXPECT(has_nh, "FullyConnected info lacks num_hidden");
+        found_fc = 1;
+      }
+    }
+    EXPECT(found_fc, "FullyConnected not listed");
+  }
+
+  /* --- function registry: get / describe / invoke-ex ------------------ */
+  {
+    FunctionHandle sqrt_fn;
+    CHECK(MXGetFunction("sqrt", &sqrt_fn));
+    uint32_t nu, ns, nm_;
+    int mask;
+    CHECK(MXFuncDescribe(sqrt_fn, &nu, &ns, &nm_, &mask));
+    EXPECT(nu == 1 && nm_ == 1, "sqrt arity wrong");
+
+    uint32_t sh4[1] = {4};
+    NDArrayHandle src, dst;
+    CHECK(MXNDArrayCreate(sh4, 1, &src));
+    CHECK(MXNDArrayCreate(sh4, 1, &dst));
+    float four[4] = {4, 9, 16, 25};
+    CHECK(MXNDArraySyncCopyFromCPU(src, four, 4));
+    NDArrayHandle uses[1] = {src}, muts[1] = {dst};
+    CHECK(MXFuncInvokeEx(sqrt_fn, uses, NULL, muts, 0, NULL, NULL));
+    float rooted[4];
+    CHECK(MXNDArraySyncCopyToCPU(dst, rooted, 4));
+    EXPECT(fabsf(rooted[3] - 5.0f) < 1e-5f, "sqrt result wrong");
+
+    /* keyword params through the key/value arrays */
+    FunctionHandle plus_s;
+    CHECK(MXGetFunction("_PlusScalar", &plus_s));
+    char* pkeys[1] = {(char*)"scalar"};
+    char* pvals[1] = {(char*)"10"};
+    CHECK(MXFuncInvokeEx(plus_s, uses, NULL, muts, 1, pkeys, pvals));
+    CHECK(MXNDArraySyncCopyToCPU(dst, rooted, 4));
+    EXPECT(fabsf(rooted[0] - 14.0f) < 1e-5f, "plus-scalar result wrong");
+
+    /* the reference's positional scalar-arg convention */
+    uint32_t nu2, ns2, nm2;
+    int mask2;
+    CHECK(MXFuncDescribe(plus_s, &nu2, &ns2, &nm2, &mask2));
+    EXPECT(ns2 == 1, "plus-scalar should describe one scalar arg");
+    float five[1] = {5.0f};
+    CHECK(MXFuncInvokeEx(plus_s, uses, five, muts, 0, NULL, NULL));
+    CHECK(MXNDArraySyncCopyToCPU(dst, rooted, 4));
+    EXPECT(fabsf(rooted[0] - 9.0f) < 1e-5f, "scalar-arg result wrong");
+    CHECK(MXNDArrayFree(src));
+    CHECK(MXNDArrayFree(dst));
+  }
+
+  /* --- full Bind with caller arrays + Outputs + monitor --------------- */
+  {
+    /* infer arg shapes, allocate every arg in C, bind, run */
+    const char* keys[1] = {"data"};
+    uint32_t ind_ptr[2] = {0, 2};
+    uint32_t shape_data[2] = {2, 10};
+    uint32_t in_sz, out_sz, aux_sz;
+    const uint32_t *in_nd, *out_nd, *aux_nd;
+    const uint32_t **in_sh, **out_sh, **aux_sh;
+    int complete = 0;
+    CHECK(MXSymbolInferShape(mlp, 1, keys, ind_ptr, shape_data, &in_sz,
+                             &in_nd, &in_sh, &out_sz, &out_nd, &out_sh,
+                             &aux_sz, &aux_nd, &aux_sh, &complete));
+    NDArrayHandle args[16];
+    uint32_t reqs[16];
+    EXPECT(in_sz <= 16, "too many args for test buffer");
+    for (uint32_t i = 0; i < in_sz; ++i) {
+      uint32_t dims[8];
+      for (uint32_t d = 0; d < in_nd[i]; ++d) dims[d] = in_sh[i][d];
+      CHECK(MXNDArrayCreate(dims, in_nd[i], &args[i]));
+      /* fill with small constants so forward is finite */
+      {
+        uint32_t n = 1, d;
+        float tmp[512];
+        for (d = 0; d < in_nd[i]; ++d) n *= dims[d];
+        EXPECT(n <= 512, "arg too big for fill buffer");
+        for (d = 0; d < n; ++d) tmp[d] = 0.01f * (float)(d % 7);
+        CHECK(MXNDArraySyncCopyFromCPU(args[i], tmp, n));
+      }
+      reqs[i] = 0; /* null grad: pure inference bind */
+    }
+    ExecutorHandle exec;
+    CHECK(MXExecutorBind(mlp, 1 /*cpu*/, 0, in_sz, args, NULL, reqs, 0,
+                         NULL, &exec));
+
+    int mon_count = 0;
+    CHECK(MXExecutorSetMonitorCallback(exec, monitor_cb, &mon_count));
+
+    uint32_t n_fwd_out = 0;
+    CHECK(MXExecutorForward(exec, 0, &n_fwd_out));
+    EXPECT(n_fwd_out == 1, "forward output count");
+    EXPECT(mon_count > 0, "monitor callback never fired");
+
+    uint32_t n_handles = 0;
+    NDArrayHandle* outs = NULL;
+    CHECK(MXExecutorOutputs(exec, &n_handles, &outs));
+    EXPECT(n_handles == 1, "outputs handle count");
+    float probs[4];
+    CHECK(MXNDArraySyncCopyToCPU(outs[0], probs, 4));
+    EXPECT(fabsf(probs[0] + probs[1] - 1.0f) < 1e-4f,
+           "softmax row does not sum to 1");
+
+    /* stable-handle contract: change an input, forward again, and the
+     * SAME handle must read the new values (reference MXExecutorOutputs
+     * aliases the executor's live output arrays) */
+    {
+      float newdata[20];
+      uint32_t d;
+      NDArrayHandle keep = outs[0];
+      for (d = 0; d < 20; ++d) newdata[d] = 1.0f + 0.1f * (float)d;
+      CHECK(MXExecutorSetArg(exec, "data", newdata, 20));
+      CHECK(MXExecutorForward(exec, 0, &n_fwd_out));
+      float probs2[4];
+      CHECK(MXNDArraySyncCopyToCPU(keep, probs2, 4));
+      EXPECT(fabsf(probs2[0] - probs[0]) > 1e-7f ||
+             fabsf(probs2[2] - probs[2]) > 1e-7f,
+             "output handle did not track the new forward");
+      EXPECT(fabsf(probs2[0] + probs2[1] - 1.0f) < 1e-4f,
+             "second forward not a softmax row");
+    }
+    CHECK(MXNDArrayFree(outs[0]));
+    CHECK(MXExecutorFree(exec));
+    for (uint32_t i = 0; i < in_sz; ++i) CHECK(MXNDArrayFree(args[i]));
+  }
+
+  /* --- symbol grad through C ------------------------------------------ */
+  {
+    SymbolHandle gsym;
+    const char* wrt[1] = {"data"};
+    CHECK(MXSymbolGrad(mlp, 1, wrt, &gsym));
+    uint32_t gn = 0;
+    const char** gnames = NULL;
+    CHECK(MXSymbolListArguments(gsym, &gn, &gnames));
+    EXPECT(gn == n_args + 1, "grad symbol should add one head-grad arg");
+    CHECK(MXSymbolFree(gsym));
+  }
+
+  /* --- kvstore roles / commands / server / fault ----------------------- */
+  {
+    int is_w = -1, is_s = -1, is_sched = -1;
+    CHECK(MXKVStoreIsWorkerNode(&is_w));
+    CHECK(MXKVStoreIsServerNode(&is_s));
+    CHECK(MXKVStoreIsSchedulerNode(&is_sched));
+    EXPECT(is_w == 1 && is_s == 0 && is_sched == 0,
+           "default role should be worker");
+
+    const char* env_keys[1] = {"MXTPU_CAPI_PS_TEST"};
+    const char* env_vals[1] = {"42"};
+    CHECK(MXInitPSEnv(1, env_keys, env_vals));
+
+    KVStoreHandle kv;
+    CHECK(MXKVStoreCreate("local", &kv));
+    CHECK(MXKVStoreSetBarrierBeforeExit(kv, 0));
+    int dead = -1;
+    CHECK(MXKVStoreGetNumDeadNode(kv, -1, &dead, 1));
+    EXPECT(dead == 0, "local kvstore should report no dead nodes");
+
+    int handled = 0;
+    CHECK(MXKVStoreSendCommmandToServers(kv, 7, "hello"));
+    CHECK(MXKVStoreSendCommmandToServers(kv, 0, ""));   /* kStopServer */
+    CHECK(MXKVStoreRunServer(kv, server_controller, &handled));
+    EXPECT(handled == 1, "server controller missed the command");
+    CHECK(MXKVStoreFree(kv));
+  }
+
+  /* --- data iter index -------------------------------------------------- */
+  {
+    char csv[512], kwargs[768];
+    FILE* f;
+    snprintf(csv, sizeof csv, "%s/iter.csv", scratch);
+    f = fopen(csv, "w");
+    EXPECT(f != NULL, "cannot write csv");
+    fprintf(f, "1,2\n3,4\n5,6\n7,8\n");
+    fclose(f);
+    snprintf(kwargs, sizeof kwargs,
+             "{\"data_csv\": \"%s\", \"data_shape\": [2], "
+             "\"batch_size\": 2}", csv);
+    DataIterHandle it;
+    CHECK(MXDataIterCreateIter("CSVIter", kwargs, &it));
+    int has_next = 0;
+    CHECK(MXDataIterNext(it, &has_next));
+    EXPECT(has_next == 1, "csv iter empty");
+    uint64_t* idx = NULL;
+    uint64_t idx_n = 0;
+    CHECK(MXDataIterGetIndex(it, &idx, &idx_n));
+    EXPECT(idx_n == 2, "batch index size wrong");
+    CHECK(MXDataIterFree(it));
+  }
+
+  /* --- optimizer creator lookup ---------------------------------------- */
+  {
+    OptimizerCreator creator = NULL;
+    CHECK(MXOptimizerFindCreator("sgd", &creator));
+    EXPECT(creator != NULL, "sgd creator null");
+    CHECK(MXNDArrayFree(creator));  /* handle-free convention */
+    EXPECT(MXOptimizerFindCreator("no_such_opt", &creator) == -1,
+           "unknown optimizer should fail");
+    EXPECT(strlen(MXGetLastError()) > 0, "last error empty after failure");
+  }
+
+  /* --- rtc: runtime kernel from source --------------------------------- */
+  {
+    uint32_t sh[1] = {4};
+    NDArrayHandle x, y;
+    CHECK(MXNDArrayCreate(sh, 1, &x));
+    CHECK(MXNDArrayCreate(sh, 1, &y));
+    float xs[4] = {1, 2, 3, 4};
+    CHECK(MXNDArraySyncCopyFromCPU(x, xs, 4));
+    char* in_names[1] = {(char*)"x"};
+    char* out_names[1] = {(char*)"y"};
+    NDArrayHandle ins[1] = {x}, outs[1] = {y};
+    RtcHandle rtc;
+    CHECK(MXRtcCreate((char*)"scale3", 1, 1, in_names, out_names, ins,
+                      outs, (char*)"def scale3(x):\n    return x * 3.0\n",
+                      &rtc));
+    CHECK(MXRtcPush(rtc, 1, 1, ins, outs, 1, 1, 1, 1, 1, 1));
+    float ys[4];
+    CHECK(MXNDArraySyncCopyToCPU(y, ys, 4));
+    EXPECT(fabsf(ys[2] - 9.0f) < 1e-5f, "rtc kernel result wrong");
+    CHECK(MXRtcFree(rtc));
+    CHECK(MXNDArrayFree(x));
+    CHECK(MXNDArrayFree(y));
+  }
+
+  /* --- custom op implemented in C: register, compose, train ------------ */
+  {
+    CHECK(MXCustomOpRegister("cscale", cscale_creator));
+
+    SymbolHandle var, atomic, composed;
+    CHECK(MXSymbolCreateVariable("data", &var));
+    CHECK(MXSymbolCreateAtomicSymbol("Custom",
+                                     "{\"op_type\": \"cscale\"}", "cs",
+                                     &atomic));
+    const char* ckeys[1] = {"data"};
+    SymbolHandle cargs[1] = {var};
+    CHECK(MXSymbolCompose(atomic, 1, ckeys, cargs, &composed));
+
+    ExecutorHandle exec;
+    CHECK(MXExecutorSimpleBindTrain(composed, "{\"data\": [2, 2]}", &exec));
+    float xin[4] = {1, 2, 3, 4};
+    CHECK(MXExecutorSetArg(exec, "data", xin, 4));
+    uint32_t n_out = 0;
+    CHECK(MXExecutorForward(exec, 1, &n_out));
+    float out2[4];
+    CHECK(MXExecutorOutputCopy(exec, 0, out2, 4));
+    EXPECT(fabsf(out2[3] - 8.0f) < 1e-5f, "custom op forward wrong");
+    EXPECT(g_cscale_forward_calls > 0, "C forward callback never ran");
+
+    CHECK(MXExecutorBackward(exec));
+    NDArrayHandle gh;
+    CHECK(MXExecutorGradHandle(exec, "data", &gh));
+    float gout[4];
+    CHECK(MXNDArraySyncCopyToCPU(gh, gout, 4));
+    EXPECT(fabsf(gout[0] - 2.0f) < 1e-5f, "custom op backward wrong");
+    EXPECT(g_cscale_backward_calls > 0, "C backward callback never ran");
+    CHECK(MXNDArrayFree(gh));
+    CHECK(MXExecutorFree(exec));
+    CHECK(MXSymbolFree(composed));
+    CHECK(MXSymbolFree(atomic));
+    CHECK(MXSymbolFree(var));
+  }
+
+  CHECK(MXSymbolFree(mlp2));
+  CHECK(MXSymbolFree(mlp));
+  CHECK(MXNDArrayFree(a));
+  CHECK(MXNotifyShutdown());
+  printf("capi_parity OK\n");
+  return 0;
+}

@@ -158,3 +158,68 @@ def test_infer_type_cast():
     assert arg_types[0] == np.float32
     with pytest.raises(MXNetError):
         c.infer_type(bogus=np.float32)
+
+
+def test_symbol_grad():
+    """Symbol.grad (Symbol::Grad parity, reference symbol.cc:569): the
+    grad symbol takes base args + head-grad vars named
+    '<headnode>_<idx>_grad' (static_graph.cc:448-452) and its outputs
+    match the executor backward of the same graph."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    x = sym.Variable("x")
+    w = sym.Variable("w")
+    y = sym.FullyConnected(x, weight=w, num_hidden=3, no_bias=True,
+                           name="fc")
+    g = y.grad(["x", "w"])
+    assert g.list_arguments() == ["x", "w", "fc_0_grad"]
+    assert [o.split("_", 1)[1] for o in g.list_outputs()] == \
+        ["x_grad", "w_grad"]
+
+    ex = g.simple_bind(mx.cpu(), grad_req="null", x=(2, 4), w=(3, 4),
+                       fc_0_grad=(2, 3))
+    rng = np.random.RandomState(3)
+    xs = rng.rand(2, 4).astype("f")
+    ws = rng.rand(3, 4).astype("f")
+    hg = rng.rand(2, 3).astype("f")
+    ex.arg_dict["x"][:] = xs
+    ex.arg_dict["w"][:] = ws
+    ex.arg_dict["fc_0_grad"][:] = hg
+    ex.forward()
+    gx, gw = [o.asnumpy() for o in ex.outputs]
+    assert np.allclose(gx, hg @ ws, atol=1e-5)
+    assert np.allclose(gw, hg.T @ xs, atol=1e-5)
+
+    with pytest.raises(MXNetError):
+        y.grad(["nope"])
+
+
+def test_symbol_grad_aux_train_mode():
+    """grad differentiates the TRAINING graph: BatchNorm uses batch
+    statistics, matching executor backward (not inference mode)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    x = sym.Variable("x")
+    net = sym.FullyConnected(x, num_hidden=4, name="fc")
+    net = sym.BatchNorm(net, name="bn")
+    g = net.grad(["x"])
+    assert any(a.endswith("bn_moving_mean")
+               for a in g.list_auxiliary_states())
+
+    ex = g.simple_bind(mx.cpu(), grad_req="null", x=(3, 5),
+                       **{"bn_0_grad": (3, 4)})
+    rng = np.random.RandomState(5)
+    for name, arr in ex.arg_dict.items():
+        arr[:] = rng.uniform(-1, 1, arr.shape).astype("f")
+    ex.forward()
+    gx = ex.outputs[0].asnumpy()
+
+    ex2 = net.simple_bind(mx.cpu(), grad_req="write", x=(3, 5))
+    for name in ex2.arg_dict:
+        ex2.arg_dict[name][:] = ex.arg_dict[name].asnumpy()
+    ex2.forward(is_train=True)
+    ex2.backward(out_grads=[mx.nd.array(
+        ex.arg_dict["bn_0_grad"].asnumpy())])
+    assert np.allclose(gx, ex2.grad_dict["x"].asnumpy(), atol=1e-4)
