@@ -24,8 +24,8 @@ all: $(LIBDIR)/libmxtpu.so
 # flat C ABI (src/c_api.cc) — embeds/attaches the Python interpreter
 capi: $(LIBDIR)/libmxtpu_capi.so
 
-$(LIBDIR)/libmxtpu_capi.so: src/c_api.cc | $(LIBDIR)
-	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) -shared $< -o $@ $(PY_LDFLAGS)
+$(LIBDIR)/libmxtpu_capi.so: src/c_api.cc include/mxtpu/c_api.h | $(LIBDIR)
+	$(CXX) $(CXXFLAGS) -Iinclude $(PY_INCLUDES) -shared $< -o $@ $(PY_LDFLAGS)
 
 $(LIBDIR)/capi_smoke: tests/capi/capi_smoke.c $(LIBDIR)/libmxtpu_capi.so
 	$(CC) -O2 -Wall -Iinclude $< -o $@ -L$(LIBDIR) -lmxtpu_capi \

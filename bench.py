@@ -125,17 +125,21 @@ def _probe_backend(timeout):
 
 def orchestrate():
     timeout = int(os.environ.get("BENCH_TIMEOUT", "1500"))
-    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
     errors = []
-    # probe the ambient platform (TPU when the tunnel is live); retry once —
-    # transient UNAVAILABLE from the plugin was the round-1 failure mode
+    # probe the ambient platform (TPU when the tunnel is live); retry —
+    # transient UNAVAILABLE from the plugin was the round-1 failure mode,
+    # and a recovering tunnel (leaked lease timing out server-side) can
+    # answer on the 2nd/3rd try minutes later (round-4 observation)
     platform = None
-    for _ in range(2):
+    retries = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+    for attempt in range(retries):
         platform, err = _probe_backend(probe_timeout)
         if platform is not None:
             break
         errors.append(err)
-        time.sleep(5)
+        if attempt + 1 < retries:   # no pointless backoff after the last
+            time.sleep(20 * (attempt + 1))
     if platform is not None:
         result, err = _run_child({}, timeout)
         if result is not None:

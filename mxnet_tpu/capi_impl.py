@@ -268,10 +268,13 @@ def dataiter_next(it):
         it._capi_batch = next(it)
     except StopIteration:
         it._capi_batch = None
+        it._capi_range = []
         return 0
-    # positional index of the batch's records (for iterators that don't
-    # track indices themselves — MXDataIterGetIndex falls back to this)
+    # positional index of the batch's REAL records — pad rows of a final
+    # partial batch carry no index (for iterators that don't track
+    # indices themselves; MXDataIterGetIndex falls back to this)
     n = int(it._capi_batch.data[0].shape[0])
+    n -= int(it._capi_batch.pad or 0)
     start = getattr(it, "_capi_pos", 0)
     it._capi_range = list(range(start, start + n))
     it._capi_pos = start + n
@@ -281,6 +284,8 @@ def dataiter_next(it):
 def dataiter_before_first(it):
     it.reset()
     it._capi_pos = 0
+    it._capi_batch = None
+    it._capi_range = []
     return 0
 
 
@@ -735,7 +740,13 @@ def func_invoke_into(name, param_keys, param_vals, use_vars, scalars,
         raise ValueError("op %r produced %d outputs for %d mutate vars"
                          % (name, len(outs), len(mutate_vars)))
     for dst, src in zip(mutate_vars, outs):
-        dst._set_data(src.data)
+        if dst._parent is None and dst.shape != src.shape:
+            # empty mutate target (MXNDArrayCreateNone placeholder): the
+            # advertised kAcceptEmptyMutateTarget contract — allocate by
+            # rebinding storage
+            dst._storage = src.data
+        else:
+            dst._set_data(src.data)
     return 0
 
 

@@ -72,12 +72,25 @@ class Predictor(object):
 
         self._input_names = list(input_shapes)
         arg_names = self.symbol.list_arguments()
+        # args in neither inputs nor params (a loss head's label slot)
+        # bind as inferred-shape zeros — the reference predictor does the
+        # same (c_predict_api.cc:149-170 allocates every arg at its
+        # inferred shape and copies params over where present)
+        inferred = {}
+        try:
+            arg_shapes, _, _ = self.symbol.infer_shape_partial(**input_shapes)
+            if arg_shapes is not None:
+                inferred = dict(zip(arg_names, arg_shapes))
+        except Exception:
+            pass
         args = {}
         for name in arg_names:
             if name in input_shapes:
                 args[name] = nd.zeros(input_shapes[name])
             elif name in arg_params:
                 args[name] = arg_params[name]
+            elif inferred.get(name) is not None:
+                args[name] = nd.zeros(inferred[name])
             else:
                 raise MXNetError("Predictor: missing parameter %r" % name)
         aux = {}

@@ -448,11 +448,15 @@ class _GradProp:
         return full_in, out_shapes, list(baux)
 
     def infer_type(self, in_types):
-        known = [t for t in in_types if t is not None]
-        base = known[0] if known else None
-        return ([base] * len(self.list_arguments()),
-                [base] * self.num_outputs,
-                [base] * len(self._aux_names))
+        # delegate to the base graph (mixed-dtype graphs: Embedding int
+        # ids, Cast heads) the same way infer_shape does
+        n = len(self._base_args)
+        known = {k: t for k, t in zip(self._base_args, in_types[:n])
+                 if t is not None}
+        barg, bout, baux = self._base.infer_type(**known)
+        full_in = list(barg) + list(bout)   # head grads typed like outputs
+        out_types = [barg[self._base_args.index(w)] for w in self._wrt]
+        return full_in, out_types, list(baux)
 
     # -- compute ----------------------------------------------------------
     def forward(self, inputs, aux, is_train, rng):

@@ -11,6 +11,8 @@
 // real C consumer, tests/capi/capi_smoke.c.
 #include <Python.h>
 
+#include "mxtpu/c_api.h"
+
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -135,11 +137,6 @@ int FillShape(PyObject* tup, uint32_t* ndim, uint32_t* shape,
 }  // namespace
 
 extern "C" {
-
-typedef void* NDArrayHandle;
-typedef void* SymbolHandle;
-typedef void* ExecutorHandle;
-typedef void* KVStoreHandle;
 
 const char* MXGetLastError() { return g_last_error.c_str(); }
 
@@ -291,8 +288,6 @@ int MXExecutorOutputCopy(ExecutorHandle h, uint32_t index, float* data,
 }
 
 // ---- Predict API (c_predict_api.cc parity subset) ------------------
-typedef void* PredictorHandle;
-
 int MXPredCreate(const char* symbol_json, const char* param_path,
                  const char* shapes_json, PredictorHandle* out) {
   Gil gil;
@@ -428,8 +423,6 @@ int FillInfo(FuncInfo* fi) {
 }
 
 }  // namespace
-
-typedef void* FunctionHandle;
 
 int MXListFunctions(uint32_t* out_size, FunctionHandle** out_array) {
   Gil gil;
@@ -630,8 +623,6 @@ int MXSymbolInferShapeJSON(SymbolHandle h, const char* in_json,
 }
 
 // ---- data iterators (c_api.cc:1101-1197 parity) --------------------
-typedef void* DataIterHandle;
-
 int MXListDataIters(uint32_t* out_size, FunctionHandle** out_array) {
   Gil gil;
   static std::vector<FuncInfo*>* iters = nullptr;  // leaked on purpose
@@ -721,8 +712,6 @@ int MXDataIterGetPadNum(DataIterHandle h, int* out) {
 }
 
 // ---- RecordIO (c_api.cc:1377-1454 parity) --------------------------
-typedef void* RecordIOHandle;
-
 int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out) {
   Gil gil;
   PyObject* w = Call("recordio_writer_create", Py_BuildValue("(s)", uri));
@@ -1043,9 +1032,6 @@ int MXKVStoreBarrier(KVStoreHandle h) {
 // Reference MXKVStoreSetUpdater: a C function becomes the kvstore's
 // merge-update rule (the "optimizer runs on the server" hook).  The
 // handles passed to the callback are borrowed for the call.
-typedef void (MXKVStoreUpdaterCB)(int key, NDArrayHandle recv,
-                                  NDArrayHandle local, void* user);
-
 int MXKVStoreSetUpdater(KVStoreHandle h, MXKVStoreUpdaterCB* updater,
                         void* user) {
   Gil gil;
@@ -1096,8 +1082,6 @@ int MXSymbolGetName(SymbolHandle h, char* buf, size_t cap) {
 }
 
 // ---- optimizer (c_api.cc:1525-1556 parity) -------------------------
-typedef void* OptimizerHandle;
-
 int MXOptimizerCreateOptimizer(const char* name, const char* kwargs_json,
                                OptimizerHandle* out) {
   Gil gil;
@@ -1559,8 +1543,6 @@ int MXSymbolInferType(SymbolHandle h, uint32_t num_args, const char** keys,
 }
 
 // ---- atomic symbol creators (c_api.cc:447-530) ---------------------
-typedef void* AtomicSymbolCreator;
-
 namespace {
 
 std::vector<FuncInfo*>* g_atomic_creators = nullptr;  // leaked on purpose
@@ -1852,8 +1834,6 @@ int MXExecutorOutputs(ExecutorHandle h, uint32_t* out_size,
   return 0;
 }
 
-typedef void (*ExecutorMonitorCallback)(const char*, NDArrayHandle, void*);
-
 int MXExecutorSetMonitorCallback(ExecutorHandle h,
                                  ExecutorMonitorCallback callback,
                                  void* callback_handle) {
@@ -1932,9 +1912,6 @@ int MXKVStoreSendCommmandToServers(KVStoreHandle h, int cmd_id,
                               cmd_body ? cmd_body : ""));
 }
 
-typedef void (MXKVStoreServerController)(int head, const char* body,
-                                         void* controller_handle);
-
 int MXKVStoreRunServer(KVStoreHandle h, MXKVStoreServerController controller,
                        void* controller_handle) {
   Gil gil;
@@ -1965,8 +1942,6 @@ int MXDataIterGetIndex(DataIterHandle h, uint64_t** out_index,
 }
 
 // ---- optimizer creator lookup ---------------------------------------
-typedef void* OptimizerCreator;
-
 int MXOptimizerFindCreator(const char* key, OptimizerCreator* out) {
   Gil gil;
   PyObject* name = Call("optimizer_find_creator", Py_BuildValue("(s)", key));
@@ -1977,8 +1952,6 @@ int MXOptimizerFindCreator(const char* key, OptimizerCreator* out) {
 
 // ---- Rtc: runtime kernels through C (reference MXRtc* over NVRTC;
 // here the kernel source is Python/Pallas — see capi_impl.rtc_create)
-typedef void* RtcHandle;
-
 int MXRtcCreate(char* name, uint32_t num_input, uint32_t num_output,
                 char** input_names, char** output_names,
                 NDArrayHandle* inputs, NDArrayHandle* outputs, char* kernel,
@@ -2039,10 +2012,6 @@ int MXRtcFree(RtcHandle h) { return MXNDArrayFree(h); }
 // ---- custom op registration (reference CustomOpPropCreator protocol;
 // struct layouts declared in include/mxtpu/c_api.h, mirrored by the
 // ctypes Structures in capi_impl._custom_ctypes) ---------------------
-typedef bool (*CustomOpPropCreator)(const char* op_type, const int num_kwargs,
-                                    const char** keys, const char** values,
-                                    void* prop_info);
-
 int MXCustomOpRegister(const char* op_type, CustomOpPropCreator creator) {
   Gil gil;
   return CallRC("custom_op_register_c",

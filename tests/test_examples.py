@@ -255,3 +255,46 @@ def test_moe_example():
     r = _run(os.path.join(REPO, "example/moe"), "moe_ep.py")
     assert r.returncode == 0, r.stderr[-1500:]
     assert "OK moe example" in r.stdout
+
+
+def test_cpp_predict_example(tmp_path):
+    """example/cpp: standalone C++ predictor over the MXPred ABI (role
+    parity: reference example/cpp/image-classification)."""
+    import shutil
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        import pytest
+        pytest.skip("no native toolchain")
+    build = subprocess.run(["make", "-s", "capi"], cwd=REPO,
+                           capture_output=True, text=True, timeout=300)
+    if build.returncode != 0 and "Python.h" in (build.stderr or ""):
+        import pytest
+        pytest.skip("python headers unavailable")
+    assert build.returncode == 0, build.stderr[-1500:]
+
+    ex_dir = os.path.join(REPO, "example/cpp/image-classification")
+    build = subprocess.run(["make", "-s"], cwd=ex_dir, capture_output=True,
+                           text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-1500:]
+
+    import json
+    import mxnet_tpu as mx
+    sym = mx.models.get_mlp(num_classes=10, hidden=(16,))
+    mod = mx.mod.Module(sym, context=mx.context.cpu())
+    mod.bind(data_shapes=[("data", (1, 32))],
+             label_shapes=[("softmax_label", (1,))])
+    mod.init_params(mx.init.Xavier())
+    mod.save_checkpoint(str(tmp_path / "mlp"), 0)
+    (tmp_path / "shapes.json").write_text(json.dumps({"data": [1, 32]}))
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [os.path.join(ex_dir, "image-classification-predict"),
+         str(tmp_path / "mlp-symbol.json"),
+         str(tmp_path / "mlp-0000.params"),
+         str(tmp_path / "shapes.json")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr[-1500:])
+    assert "CPP PREDICT OK" in r.stdout
+    assert "predicted class:" in r.stdout
