@@ -393,6 +393,25 @@ int MXRtcPush(RtcHandle h, uint32_t num_input, uint32_t num_output,
               uint32_t blockDimX, uint32_t blockDimY, uint32_t blockDimZ);
 int MXRtcFree(RtcHandle h);
 
+/* -- predict ABI completion (c_predict_api.h parity, 11/11 names).
+ * PartialOut predicts up to named INTERNAL outputs (keys are node names
+ * or their <name>_output form).  PartialForward: the graph is one fused
+ * XLA computation here, so step 0 runs it and *step_left comes back 0
+ * (the reference's `while (step_left)` loop contract still holds). */
+int MXPredCreatePartialOut(const char* symbol_json, const char* param_path,
+                           const char* shapes_json, uint32_t num_output_nodes,
+                           const char** output_keys, PredictorHandle* out);
+int MXPredPartialForward(PredictorHandle h, int step, int* step_left);
+/* NDList: read a named-array (.params) blob; data/shape pointers are
+ * owned by the list handle and live until MXNDListFree */
+typedef void* NDListHandle;
+int MXNDListCreate(const char* nd_file_bytes, int nd_file_size,
+                   NDListHandle* out, uint32_t* out_length);
+int MXNDListGet(NDListHandle h, uint32_t index, const char** out_key,
+                const float** out_data, const uint32_t** out_shape,
+                uint32_t* out_ndim);
+int MXNDListFree(NDListHandle h);
+
 /* -- custom ops from C: the reference's callback-struct protocol
  * (CustomOpPropCreator fills CustomOpPropInfo; its create_operator
  * fills CustomOpInfo).  Compute callbacks receive NDArrayHandle ptrs

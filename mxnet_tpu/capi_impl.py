@@ -909,6 +909,73 @@ def optimizer_find_creator(name):
 
 
 # ----------------------------------------------------------------------
+# predict ABI completion (c_predict_api.cc parity: partial-out
+# predictors and the NDList named-array reader)
+# ----------------------------------------------------------------------
+def pred_create_partial(symbol_json, param_path, shapes_json, output_keys):
+    """MXPredCreatePartialOut parity: predict up to the named internal
+    outputs (each key a node name or its '<name>_output' form)."""
+    import json
+    from . import symbol as sym_mod
+    from .predictor import Predictor
+    if symbol_json.endswith(".json"):
+        base = sym_mod.load(symbol_json)
+    else:
+        base = sym_mod.load_json(symbol_json)
+    internals = base.get_internals()
+    names = list(internals.list_outputs())
+    picked = []
+    for key in output_keys:
+        if key in names:
+            picked.append(internals[names.index(key)])
+        elif key + "_output" in names:
+            picked.append(internals[names.index(key + "_output")])
+        else:
+            raise ValueError("output %r not found among internals (e.g. %s)"
+                             % (key, names[:8]))
+    sub = sym_mod.Group(picked) if len(picked) > 1 else picked[0]
+    shapes = {k: tuple(v) for k, v in json.loads(shapes_json).items()}
+    return Predictor(sub.tojson(), param_path, shapes)
+
+
+def pred_partial_forward(pred, step):
+    """MXPredPartialForward parity.  The whole graph is ONE fused XLA
+    computation here (no per-op stepping to expose), so step 0 runs it
+    and there is nothing left — the reference's loop contract
+    (`while step_left`) still terminates correctly."""
+    if int(step) == 0:
+        pred_forward(pred)
+    return 0     # steps left
+
+
+def ndlist_create(buf):
+    """MXNDListCreate parity: parse a named-array file's bytes.  Items
+    keep the FILE's order (reference NDList index order); an unnamed
+    save (plain list, m=0 names) gets empty-string keys like the
+    reference.  Each item caches (key, f32 host array, u32 shape array)
+    on the handle so every pointer MXNDListGet hands out lives until
+    MXNDListFree."""
+    from .predictor import load_ndarray_file
+    raw = load_ndarray_file(bytes(buf))
+    pairs = raw.items() if isinstance(raw, dict) else \
+        (("", v) for v in raw)
+    items = []
+    for key, val in pairs:
+        arr = _np.ascontiguousarray(val.asnumpy().astype(_np.float32))
+        shape = _np.asarray(arr.shape, dtype=_np.uint32)
+        items.append((str(key), arr, shape))
+    return items
+
+
+def ndlist_get(items, index):
+    """-> (key, data address, shape address, ndim) for MXNDListGet —
+    addresses point into the handle's own caches."""
+    key, arr, shape = items[int(index)]
+    return (key, int(arr.ctypes.data), int(shape.ctypes.data),
+            int(shape.size))
+
+
+# ----------------------------------------------------------------------
 # MXCustomOpRegister: the reference's C custom-op protocol
 # (c_api.h CustomOpPropCreator / CustomOpPropInfo / CustomOpInfo;
 # consumed by src/operator/custom-inl.h:62-210).  A C creator fills a

@@ -2009,6 +2009,74 @@ int MXRtcPush(RtcHandle h, uint32_t num_input, uint32_t num_output,
 
 int MXRtcFree(RtcHandle h) { return MXNDArrayFree(h); }
 
+// ---- predict ABI completion (c_predict_api parity) -----------------
+int MXPredCreatePartialOut(const char* symbol_json, const char* param_path,
+                           const char* shapes_json,
+                           uint32_t num_output_nodes,
+                           const char** output_keys, PredictorHandle* out) {
+  Gil gil;
+  PyObject* keys = PyList_New(num_output_nodes);
+  for (uint32_t i = 0; i < num_output_nodes; ++i)
+    PyList_SetItem(keys, i, PyUnicode_FromString(output_keys[i]));
+  PyObject* pred = Call("pred_create_partial",
+                        Py_BuildValue("(sssN)", symbol_json, param_path,
+                                      shapes_json, keys));
+  if (!pred) return -1;
+  *out = pred;
+  return 0;
+}
+
+int MXPredPartialForward(PredictorHandle h, int step, int* step_left) {
+  Gil gil;
+  PyObject* n = Call("pred_partial_forward",
+                     Py_BuildValue("(Oi)", static_cast<PyObject*>(h),
+                                   step));
+  if (!n) return -1;
+  if (step_left) *step_left = static_cast<int>(PyLong_AsLong(n));
+  Py_DECREF(n);
+  return 0;
+}
+
+int MXNDListCreate(const char* nd_file_bytes, int nd_file_size,
+                   NDListHandle* out, uint32_t* out_length) {
+  Gil gil;
+  PyObject* items = Call("ndlist_create",
+                         Py_BuildValue("(N)",
+                                       ReadView(nd_file_bytes,
+                                                (size_t)nd_file_size)));
+  if (!items) return -1;
+  *out = items;
+  if (out_length)
+    *out_length = static_cast<uint32_t>(PyList_Size(items));
+  return 0;
+}
+
+// every returned pointer (key, data, shape) aims into caches owned by
+// the list handle: all stay valid until MXNDListFree, as documented
+int MXNDListGet(NDListHandle h, uint32_t index, const char** out_key,
+                const float** out_data, const uint32_t** out_shape,
+                uint32_t* out_ndim) {
+  Gil gil;
+  PyObject* tup = Call("ndlist_get",
+                       Py_BuildValue("(OI)", static_cast<PyObject*>(h),
+                                     index));
+  if (!tup) return -1;
+  if (out_key) *out_key = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
+  if (out_data)
+    *out_data = reinterpret_cast<const float*>(
+        PyLong_AsSize_t(PyTuple_GetItem(tup, 1)));
+  if (out_shape)
+    *out_shape = reinterpret_cast<const uint32_t*>(
+        PyLong_AsSize_t(PyTuple_GetItem(tup, 2)));
+  if (out_ndim)
+    *out_ndim = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(tup, 3)));
+  Py_DECREF(tup);
+  return 0;
+}
+
+int MXNDListFree(NDListHandle h) { return MXNDArrayFree(h); }
+
 // ---- custom op registration (reference CustomOpPropCreator protocol;
 // struct layouts declared in include/mxtpu/c_api.h, mirrored by the
 // ctypes Structures in capi_impl._custom_ctypes) ---------------------

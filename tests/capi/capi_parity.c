@@ -591,6 +591,72 @@ int main(void) {
     CHECK(MXSymbolFree(var));
   }
 
+  /* --- predict ABI completion: NDList + partial-out predictor --------- */
+  {
+    const char* params = getenv("MXTPU_PARAMS_FILE");
+    EXPECT(params != NULL, "MXTPU_PARAMS_FILE not set");
+    /* read the params blob */
+    FILE* f = fopen(params, "rb");
+    EXPECT(f != NULL, "cannot open params");
+    fseek(f, 0, SEEK_END);
+    long psize = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    char* pbytes = (char*)malloc((size_t)psize);
+    EXPECT(fread(pbytes, 1, (size_t)psize, f) == (size_t)psize,
+           "short read");
+    fclose(f);
+
+    NDListHandle ndl;
+    uint32_t n_items = 0;
+    CHECK(MXNDListCreate(pbytes, (int)psize, &ndl, &n_items));
+    EXPECT(n_items >= 4, "params list too short");
+    const char* key = NULL;
+    const float* data = NULL;
+    const uint32_t* nshape = NULL;
+    uint32_t nnd = 0;
+    CHECK(MXNDListGet(ndl, 0, &key, &data, &nshape, &nnd));
+    EXPECT(key != NULL && data != NULL && nnd >= 1, "NDList item empty");
+
+    /* partial-out predictor stopping at the first FC layer */
+    char shapes2[128];
+    snprintf(shapes2, sizeof shapes2, "{\"data\": [1, 10]}");
+    const char* want[1] = {"fc1"};
+    char* sym_text = NULL;
+    {
+      FILE* sf = fopen(sym_json, "rb");
+      EXPECT(sf != NULL, "cannot open symbol json");
+      fseek(sf, 0, SEEK_END);
+      long ssize = ftell(sf);
+      fseek(sf, 0, SEEK_SET);
+      sym_text = (char*)malloc((size_t)ssize + 1);
+      EXPECT(fread(sym_text, 1, (size_t)ssize, sf) == (size_t)ssize,
+             "short symbol read");
+      sym_text[ssize] = '\0';
+      fclose(sf);
+    }
+    PredictorHandle ppred;
+    CHECK(MXPredCreatePartialOut(sym_text, params, shapes2, 1, want,
+                                 &ppred));
+    float in10[10];
+    {
+      int i;
+      for (i = 0; i < 10; ++i) in10[i] = 0.1f * (float)i;
+    }
+    CHECK(MXPredSetInput(ppred, "data", in10, 10));
+    int step_left = 1;
+    int step;
+    for (step = 0; step_left != 0; ++step)
+      CHECK(MXPredPartialForward(ppred, step, &step_left));
+    uint32_t pnd, pshape[4];
+    CHECK(MXPredGetOutputShape(ppred, 0, &pnd, pshape, 4));
+    EXPECT(pnd == 2 && pshape[0] == 1 && pshape[1] == 8,
+           "partial-out shape should be the hidden layer's");
+    CHECK(MXPredFree(ppred));
+    CHECK(MXNDListFree(ndl));
+    free(pbytes);
+    free(sym_text);
+  }
+
   CHECK(MXSymbolFree(mlp2));
   CHECK(MXSymbolFree(mlp));
   CHECK(MXNDArrayFree(a));

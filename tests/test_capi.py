@@ -96,9 +96,15 @@ def test_capi_parity(tmp_path):
     sym = mx.models.get_mlp(num_classes=2, hidden=(8,))
     sym_path = str(tmp_path / "mlp-symbol.json")
     sym.save(sym_path)
+    mod = mx.mod.Module(sym, context=mx.context.cpu())
+    mod.bind(data_shapes=[("data", (2, 10))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.save_checkpoint(str(tmp_path / "mlp"), 0)
 
     env = dict(os.environ)
     env["MXTPU_SYMBOL_JSON"] = sym_path
+    env["MXTPU_PARAMS_FILE"] = str(tmp_path / "mlp-0000.params")
     env["MXTPU_SCRATCH"] = str(tmp_path)
     env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
