@@ -385,20 +385,20 @@ def optimizer_update(opt, index, weight, grad, lr, wd):
 def ndarray_save(fname, nds, names):
     from .ndarray import save
     if names:
-        save(fname, dict(zip(names, nds)))
+        # (name, array) pairs: order AND duplicates preserved (the
+        # reference writes names exactly as given)
+        save(fname, list(zip(names, nds)))
     else:
         save(fname, list(nds))
     return 0
 
 
 def ndarray_load(fname):
-    """-> (names list (may be empty), arrays list)."""
-    from .ndarray import load
-    data = load(fname)
-    if isinstance(data, dict):
-        names = sorted(data)
-        return names, [data[n] for n in names]
-    return [], list(data)
+    """-> (names list (may be empty), arrays list) in FILE order with
+    duplicates intact (the reference MXNDArrayLoad contract)."""
+    from .ndarray import load_raw
+    names, arrays = load_raw(fname)
+    return list(names), list(arrays)
 
 
 def ndarray_dtype(nd):

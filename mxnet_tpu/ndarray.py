@@ -692,15 +692,23 @@ def _load_one(fi) -> NDArray:
 
 
 def save(fname, data):
-    """Save a list or str->NDArray dict (save_checkpoint file format)."""
+    """Save NDArrays (save_checkpoint file format): a str->NDArray dict,
+    a list of arrays, or a list of (name, array) pairs.  Caller's order
+    is the file's order, duplicates included — the reference
+    MXNDArraySave writes names exactly as given."""
     if isinstance(data, NDArray):
         data = [data]
     names = []
     arrays = []
     if isinstance(data, dict):
-        for k in sorted(data):
+        for k in data:
             names.append(k)
             arrays.append(data[k])
+    elif data and all(isinstance(item, tuple) and len(item) == 2
+                      for item in data):
+        for k, v in data:
+            names.append(k)
+            arrays.append(v)
     else:
         arrays = list(data)
     from .stream import open_uri
@@ -714,7 +722,9 @@ def save(fname, data):
             _write_str(fo, name)
 
 
-def load(fname):
+def load_raw(fname):
+    """-> (names, arrays) exactly as stored — duplicates and file order
+    preserved (the C ABI's MXNDArrayLoad contract)."""
     from .stream import open_uri
     with open_uri(fname, "rb") as fi:
         magic, _ = struct.unpack("<QQ", fi.read(16))
@@ -724,6 +734,11 @@ def load(fname):
         arrays = [_load_one(fi) for _ in range(n)]
         (m,) = struct.unpack("<Q", fi.read(8))
         names = [_read_str(fi) for _ in range(m)]
+    return names, arrays
+
+
+def load(fname):
+    names, arrays = load_raw(fname)
     if names:
         return dict(zip(names, arrays))
     return arrays

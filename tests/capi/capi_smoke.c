@@ -452,8 +452,11 @@ int main(void) {
     const char** lnames = NULL;
     CHECK(MXNDArrayLoad("/tmp/mxtpu_capi_train.params", &ln, &larr,
                         &lnames_n, &lnames));
+    /* names come back in FILE order == the order passed to Save (the
+     * reference MXNDArrayLoad contract) */
     if (ln != 2 || lnames_n != 2 ||
-        strcmp(lnames[0], "arg:tfc_bias") != 0) {
+        strcmp(lnames[0], "arg:tfc_weight") != 0 ||
+        strcmp(lnames[1], "arg:tfc_bias") != 0) {
       fprintf(stderr, "FAIL save/load roundtrip (%u, %u)\n", ln, lnames_n);
       return 1;
     }
@@ -461,7 +464,7 @@ int main(void) {
     CHECK(MXNDArrayGetDType(larr[0], &dtype));
     NDArrayHandle resh;
     uint32_t rshape[1] = {8};
-    CHECK(MXNDArrayReshape(larr[1], 1, rshape, &resh));
+    CHECK(MXNDArrayReshape(larr[0], 1, rshape, &resh));  /* the weight */
     NDArrayHandle slc;
     CHECK(MXNDArraySlice(resh, 2, 6, &slc));
     uint32_t sn, ss[4];

@@ -298,3 +298,21 @@ def test_cpp_predict_example(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr[-1500:])
     assert "CPP PREDICT OK" in r.stdout
     assert "predicted class:" in r.stdout
+
+
+def test_notebooks_execute(tmp_path):
+    """example/notebooks: every code cell runs top-to-bottom (role
+    parity: the reference's notebook tutorials, kept executable)."""
+    import json
+    import glob
+    nbs = sorted(glob.glob(os.path.join(REPO, "example/notebooks/*.ipynb")))
+    assert len(nbs) >= 2
+    for path in nbs:
+        nb = json.load(open(path))
+        code = "\n\n".join(
+            "".join(c["source"]) for c in nb["cells"]
+            if c["cell_type"] == "code")
+        script = tmp_path / (os.path.basename(path) + ".py")
+        script.write_text(code)
+        r = _run(str(tmp_path), str(script))
+        assert r.returncode == 0, (path, r.stderr[-2000:])
