@@ -352,6 +352,11 @@ def measure():
             payload.update(_measure_allreduce(jax))
         except Exception as exc:  # noqa: BLE001
             payload["allreduce_error"] = repr(exc)
+        if os.environ.get("BENCH_TRANSFORMER", "1") != "0":
+            try:
+                payload.update(_measure_transformer(jax, platform))
+            except Exception as exc:  # noqa: BLE001
+                payload["transformer_error"] = repr(exc)
         _emit(payload)
 
 
@@ -442,6 +447,84 @@ def _measure_module_path(jax, platform):
         }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _measure_transformer(jax, platform):
+    """Transformer-LM fused-step secondary: tokens/sec + MFU of the
+    long-context path (ring-attention-capable MultiHeadAttention,
+    models/transformer.py) — the workload class the reference's
+    bucketed RNNs never reached.  Tightly bounded: one compile + a few
+    steps."""
+    import numpy as np
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+    on_tpu = platform == "tpu"
+    seq = int(os.environ.get("BENCH_TF_SEQ", "1024" if on_tpu else "64"))
+    dim = int(os.environ.get("BENCH_TF_DIM", "512" if on_tpu else "64"))
+    layers = int(os.environ.get("BENCH_TF_LAYERS", "4" if on_tpu else "2"))
+    vocab = int(os.environ.get("BENCH_TF_VOCAB",
+                               "8192" if on_tpu else "256"))
+    per_dev = int(os.environ.get("BENCH_TF_BATCH", "8" if on_tpu else "2"))
+    steps = int(os.environ.get("BENCH_TF_STEPS", "6" if on_tpu else "2"))
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    batch = per_dev * n_dev
+    mesh = make_mesh(devices, dp=n_dev)
+    sym = transformer.get_symbol(vocab_size=vocab, num_layers=layers,
+                                 num_heads=8, dim=dim, seq_len=seq)
+    optimizer = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9,
+                               rescale_grad=1.0 / (batch * seq))
+    trainer = ShardedTrainer(
+        sym, optimizer, mesh,
+        compute_dtype="bfloat16" if on_tpu else None)
+    params, opt_state, aux = trainer.init_params(
+        {"data": (batch, seq)},
+        label_shapes={"softmax_label": (batch, seq)})
+    rng = np.random.RandomState(0)
+    batch_arrays = trainer.shard_batch({
+        "data": rng.randint(0, vocab, (batch, seq)).astype(np.int32),
+        "softmax_label": rng.randint(0, vocab,
+                                     (batch, seq)).astype(np.float32),
+    })
+    for _ in range(2):
+        params, opt_state, aux, outs = trainer.step(
+            params, opt_state, aux, batch_arrays)
+    jax.block_until_ready(outs)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, aux, outs = trainer.step(
+            params, opt_state, aux, batch_arrays)
+    jax.block_until_ready(outs)
+    dt = (time.perf_counter() - t0) / steps
+    out = {
+        "transformer_tokens_per_sec": round(batch * seq / dt, 1),
+        "transformer_step_ms": round(dt * 1e3, 2),
+        "transformer_config": "L%d d%d s%d v%d b%d" % (layers, dim, seq,
+                                                       vocab, batch),
+    }
+    # MFU holes are REPORTED, never silent (the r2 lesson, see primary)
+    notes = []
+    try:
+        cost = trainer.compiled_step_cost_analysis()
+        peak, peak_note = _lookup_peak_tflops(
+            getattr(devices[0], "device_kind", platform))
+        if peak_note:
+            notes.append(peak_note)
+        if cost and cost.get("flops") and peak:
+            out["transformer_mfu"] = round(
+                float(cost["flops"]) / dt / (peak * 1e12 * n_dev), 4)
+        elif not (cost and cost.get("flops")):
+            notes.append("cost_analysis returned %r" % (
+                None if not cost else sorted(cost)[:4]))
+    except Exception as exc:  # noqa: BLE001
+        notes.append("cost_analysis failed: %r" % exc)
+    if notes:
+        out["transformer_mfu_notes"] = "; ".join(notes)
+    return out
 
 
 def _measure_allreduce(jax):
