@@ -1,0 +1,62 @@
+"""Extra symbol documents.
+
+Parity: python/mxnet/symbol_doc.py of the reference — worked examples
+for symbols whose semantics deserve more than the registry docstring.
+The examples below run as written (tests/test_base.py executes them).
+"""
+
+
+class SymbolDoc(object):
+    """The basic class."""
+
+
+class ConcatDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> import numpy as np
+    >>> import mxnet_tpu as mx
+    >>> data = mx.nd.array(np.arange(6).reshape((2, 1, 3)))
+    >>> a = mx.sym.Variable('a')
+    >>> b = mx.sym.Variable('b')
+    >>> for dim in range(3):
+    ...     cat = mx.sym.Concat(a, b, dim=dim)
+    ...     exe = cat.bind(mx.cpu(), args={'a': data, 'b': data})
+    ...     shape = exe.forward()[0].shape
+    >>> # dim 0 -> (4, 1, 3); dim 1 -> (2, 2, 3); dim 2 -> (2, 1, 6)
+    """
+
+
+class BroadcastPlusDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> import mxnet_tpu as mx
+    >>> a = mx.sym.Variable('a')
+    >>> b = mx.sym.Variable('b')
+    >>> c = mx.sym.broadcast_plus(a, b)
+    >>> exe = c.bind(mx.cpu(), args={'a': mx.nd.ones((2, 2)),
+    ...                              'b': mx.nd.ones((1, 2))})
+    >>> exe.forward()[0].asnumpy()       # (1, 2) broadcast over rows
+    array([[2., 2.],
+           [2., 2.]], dtype=float32)
+    """
+
+
+class SoftmaxOutputDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> import mxnet_tpu as mx
+    >>> x = mx.sym.Variable('x')
+    >>> out = mx.sym.SoftmaxOutput(x, name='softmax')
+    >>> # backward of the loss layer yields softmax(x) - onehot(label)
+    >>> # REGARDLESS of head gradients (the loss-layer contract).
+    """
+
+
+def get_output_shape(sym, **input_shapes):
+    """Convenience: the output shapes of ``sym`` as a name->shape dict
+    (reference symbol_doc.py helper)."""
+    _, s_outputs, _ = sym.infer_shape(**input_shapes)
+    return dict(zip(sym.list_outputs(), s_outputs))

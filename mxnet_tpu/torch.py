@@ -1,0 +1,77 @@
+"""Torch-backed imperative NDArray functions.
+
+Parity: python/mxnet/torch.py of the reference, which exposed
+Torch tensor math on NDArrays (``import mxnet.torch as th;
+th.add(a, b)``), executed by a Lua Torch backend behind
+``MXFuncInvokeEx``.  Here the backend is PyTorch on host: any
+``torch.<fn>`` usable on tensors is resolved lazily by name, applied to
+the NDArray inputs, and the result wrapped back — an interop
+convenience, NOT a device path (torch never reaches the TPU; use the
+registered ops for compiled compute).
+
+    import mxnet_tpu.torch as th
+    c = th.add(a, b)          # a, b: mx.nd.NDArray
+    m = th.mm(a, b)
+    th.exp(a, out=c)          # reference-style output buffer
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+_torch = None
+
+
+def _backend():
+    global _torch
+    if _torch is None:
+        try:
+            import torch as _t
+        except ImportError as exc:        # pragma: no cover
+            raise MXNetError("mxnet_tpu.torch needs the 'torch' package "
+                             "installed") from exc
+        _torch = _t
+    return _torch
+
+
+def _to_torch(value):
+    if isinstance(value, NDArray):
+        return _backend().from_numpy(_np.ascontiguousarray(value.asnumpy()))
+    return value
+
+
+def _apply(fn_name, *args, out=None, **kwargs):
+    torch = _backend()
+    fn = getattr(torch, fn_name, None)
+    if fn is None:
+        raise MXNetError("torch has no function %r" % fn_name)
+    res = fn(*[_to_torch(a) for a in args],
+             **{k: _to_torch(v) for k, v in kwargs.items()})
+    if isinstance(res, tuple):
+        res = res[0]
+    host = res.detach().cpu().numpy()
+    if out is not None:
+        out._set_data(host)
+        return out
+    return NDArray(host)
+
+
+def __getattr__(name):
+    """Resolve ``th.<name>`` lazily against the torch namespace (the
+    reference enumerated its TH registry at import; torch's surface is
+    the registry here)."""
+    if name.startswith("_"):
+        raise AttributeError(name)
+    torch = _backend()
+    if not callable(getattr(torch, name, None)):
+        raise AttributeError("torch has no function %r" % name)
+
+    def wrapped(*args, out=None, **kwargs):
+        return _apply(name, *args, out=out, **kwargs)
+
+    wrapped.__name__ = name
+    wrapped.__doc__ = (getattr(torch, name).__doc__ or
+                       "torch.%s on NDArrays" % name)
+    return wrapped

@@ -102,3 +102,78 @@ def test_name_manager():
         assert nm.get("explicit", "fc") == "explicit"
     with Prefix("net_") as nm:
         assert nm.get(None, "fc") == "net_fc0"
+
+
+def test_legacy_misc_scheduler():
+    """mxnet_tpu.misc: the legacy scheduler module (reference misc.py)."""
+    from mxnet_tpu.misc import FactorScheduler, LearningRateScheduler
+    s = FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 0.8
+    assert abs(s(0) - 0.8) < 1e-9
+    assert abs(s(10) - 0.4) < 1e-9
+    assert abs(s(25) - 0.2) < 1e-9
+    with pytest.raises(ValueError):
+        FactorScheduler(step=0)
+    with pytest.raises(NotImplementedError):
+        LearningRateScheduler()(1)
+
+
+def test_torch_backed_functions():
+    """mxnet_tpu.torch: torch math on NDArrays (reference torch.py role)."""
+    pytest.importorskip("torch")
+    import mxnet_tpu.torch as th
+
+    a = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], "f"))
+    b = mx.nd.array(np.array([[10.0, 20.0], [30.0, 40.0]], "f"))
+    c = th.add(a, b)
+    assert np.allclose(c.asnumpy(), [[11, 22], [33, 44]])
+    m = th.mm(a, b)
+    assert np.allclose(m.asnumpy(), a.asnumpy() @ b.asnumpy())
+    out = mx.nd.zeros((2, 2))
+    r = th.exp(a, out=out)
+    assert r is out
+    assert np.allclose(out.asnumpy(), np.exp(a.asnumpy()), rtol=1e-5)
+    # AttributeError specifically: hasattr/getattr-with-default callers
+    # depend on it
+    with pytest.raises(AttributeError):
+        th.definitely_not_a_torch_fn
+    assert not hasattr(th, "definitely_not_a_torch_fn")
+
+
+def test_symbol_doc_examples():
+    """symbol_doc: the documented examples run AS WRITTEN."""
+    from mxnet_tpu.symbol_doc import get_output_shape, ConcatDoc
+
+    # ConcatDoc: bind+forward over every dim with the documented shapes
+    data = mx.nd.array(np.arange(6).reshape((2, 1, 3)))
+    a = mx.sym.Variable('a')
+    b = mx.sym.Variable('b')
+    expect = {0: (4, 1, 3), 1: (2, 2, 3), 2: (2, 1, 6)}
+    for dim, want in expect.items():
+        cat = mx.sym.Concat(a, b, dim=dim)
+        exe = cat.bind(mx.context.cpu(), args={'a': data, 'b': data})
+        assert exe.forward()[0].shape == want
+    assert ConcatDoc.__doc__ is not None
+
+    shapes = get_output_shape(mx.sym.Concat(a, b, dim=1),
+                              a=(2, 1, 3), b=(2, 1, 3))
+    assert list(shapes.values())[0] == (2, 2, 3)
+
+    # BroadcastPlusDoc: (1, 2) broadcasts over rows, everything is 2.0
+    c = mx.sym.broadcast_plus(a, b)
+    exe = c.bind(mx.context.cpu(), args={'a': mx.nd.ones((2, 2)),
+                                         'b': mx.nd.ones((1, 2))})
+    assert np.allclose(exe.forward()[0].asnumpy(), 2.0)
+
+    # SoftmaxOutputDoc: backward == softmax - onehot despite head grads
+    x = mx.sym.Variable('x')
+    so = mx.sym.SoftmaxOutput(x, name='softmax')
+    exe = so.simple_bind(mx.context.cpu(), grad_req='write', x=(2, 3))
+    exe.arg_dict['x'][:] = [[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]]
+    exe.arg_dict['softmax_label'][:] = [2, 0]
+    probs = exe.forward(is_train=True)[0].asnumpy()
+    exe.backward()
+    onehot = np.zeros((2, 3), 'f')
+    onehot[0, 2] = onehot[1, 0] = 1.0
+    assert np.allclose(exe.grad_dict['x'].asnumpy(),
+                       probs - onehot, atol=1e-5)
