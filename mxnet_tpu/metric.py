@@ -301,6 +301,13 @@ class Torch(Loss):
         EvalMetric.__init__(self, "torch")
 
 
+class Caffe(Torch):
+    """Dummy metric for caffe criterions (reference metric.py Caffe)."""
+
+    def __init__(self):
+        EvalMetric.__init__(self, "caffe")
+
+
 class CustomMetric(EvalMetric):
     """Metric from a feval function (parity: metric.py CustomMetric)."""
 
@@ -352,7 +359,7 @@ def create(metric, **kwargs):
         "acc": Accuracy, "accuracy": Accuracy, "ce": CrossEntropy,
         "f1": F1, "mae": MAE, "mse": MSE, "rmse": RMSE,
         "top_k_accuracy": TopKAccuracy, "perplexity": Perplexity,
-        "loss": Loss, "torch": Torch,
+        "loss": Loss, "torch": Torch, "caffe": Caffe,
     }
     try:
         return metrics[metric.lower()](**kwargs)

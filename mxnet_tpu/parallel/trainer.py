@@ -234,15 +234,7 @@ class ShardedTrainer(object):
         Returns (params, opt_state, aux) dicts of jax.Arrays placed with
         their pjit shardings (so the first step doesn't reshard).
         """
-        shapes = dict(data_shapes)
-        if label_shapes:
-            shapes.update(label_shapes)
-        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
-        if arg_shapes is None:
-            raise MXNetError("init_params: cannot infer shapes from %s"
-                             % (shapes,))
-        shape_map = dict(zip(self._arg_names, arg_shapes))
-        aux_map = dict(zip(self._aux_names, aux_shapes))
+        shape_map, aux_map = self._shape_maps(data_shapes, label_shapes)
 
         from ..ndarray import NDArray
         from ..initializer import Uniform
@@ -267,6 +259,84 @@ class ShardedTrainer(object):
                 jnp.zeros(aux_map[name], dtype=dtype)
             aux[name] = jax.device_put(init_val, self._replicated())
         return params, opt_state, aux
+
+    def _shape_maps(self, data_shapes, label_shapes=None):
+        shapes = dict(data_shapes)
+        if label_shapes:
+            shapes.update(label_shapes)
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
+        if arg_shapes is None:
+            raise MXNetError("cannot infer shapes from %s" % (shapes,))
+        return (dict(zip(self._arg_names, arg_shapes)),
+                dict(zip(self._aux_names, aux_shapes)))
+
+    def abstract_state(self, data_shapes, label_shapes=None,
+                       dtype=_np.float32):
+        """(params, opt_state, aux) as sharding-annotated
+        ShapeDtypeStructs — the restore target for sharded checkpoints
+        (and a zero-alloc way to inspect placements)."""
+        shape_map, aux_map = self._shape_maps(data_shapes, label_shapes)
+
+        def _abs(shape, sharding):
+            return jax.ShapeDtypeStruct(tuple(shape), _np.dtype(dtype),
+                                        sharding=sharding)
+
+        params = {n: _abs(shape_map[n], self.param_sharding(n, shape_map[n]))
+                  for n in self.param_names}
+        opt_state = {}
+        for n in self.param_names:
+            # eval_shape: shapes only, no buffers — a full Adam state
+            # materialized here would OOM exactly the huge-model case
+            # this path exists for
+            s = jax.eval_shape(
+                lambda _n=n: self.optimizer.create_state_arrays(
+                    shape_map[_n], dtype))
+            if s is not None:
+                opt_state[n] = jax.tree_util.tree_map(
+                    lambda a, _n=n: _abs(
+                        a.shape, self.opt_state_sharding(_n, a.shape)), s)
+        aux = {n: _abs(aux_map[n], self._replicated())
+               for n in self._aux_names}
+        return params, opt_state, aux
+
+    # ------------------------------------------------------------------
+    # sharded checkpoints (orbax): each host writes/reads only its own
+    # shards — the pod-scale story the reference's gather-to-rank-0
+    # NDArray files cannot tell (models larger than one host's RAM).
+    # Classic 0x112-format checkpoints remain available through
+    # model.save_checkpoint for single-host/interchange use.
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, path, params, opt_state, aux):
+        """Write (params, opt_state, aux) + the update counter sharded
+        to ``path`` (a directory).  Multi-host: every process must call
+        this; arrays stay distributed end-to-end."""
+        import os
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.abspath(str(path)),
+                   {"params": params, "opt_state": opt_state, "aux": aux,
+                    "step": _np.int64(self.num_update)},
+                   force=True)
+        ckptr.wait_until_finished()
+        return path
+
+    def load_checkpoint(self, path, data_shapes, label_shapes=None,
+                        dtype=_np.float32):
+        """Restore (params, opt_state, aux) with this trainer's
+        shardings; arrays come back placed, ready for step().  The
+        trainer's update counter resumes too — Adam bias correction and
+        lr schedules continue where they stopped, not from step 1."""
+        import os
+        import orbax.checkpoint as ocp
+        params_t, opt_t, aux_t = self.abstract_state(
+            data_shapes, label_shapes, dtype)
+        ckptr = ocp.StandardCheckpointer()
+        restored = ckptr.restore(
+            os.path.abspath(str(path)),
+            {"params": params_t, "opt_state": opt_t, "aux": aux_t,
+             "step": _np.zeros((), _np.int64)})
+        self.num_update = int(restored["step"])
+        return restored["params"], restored["opt_state"], restored["aux"]
 
     def shard_batch(self, batch):
         """Place host batch arrays onto the mesh with dp/sp sharding —
