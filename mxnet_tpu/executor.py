@@ -256,6 +256,15 @@ class Executor:
         self._n_monitored_compiled = 0
         self._fused_cache = None  # (optimizer id, jitted step)
 
+    @property
+    def output_dict(self):
+        """name -> output NDArray (reference executor.py output_dict);
+        duplicate names raise, as the reference's _get_dict does."""
+        if len(set(self._out_names)) != len(self._out_names):
+            raise MXNetError("Duplicate names detected in outputs: %s"
+                             % (self._out_names,))
+        return dict(zip(self._out_names, self.outputs))
+
     def _publish_output(self, i, value):
         """Update output slot i IN PLACE: the NDArray object is stable for
         the life of the executor (MXExecutorOutputs handles stay aliased,

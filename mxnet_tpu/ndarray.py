@@ -484,6 +484,37 @@ def clip(data, a_min, a_max, out=None):
     return NDArray(res, ctx=data.context)
 
 
+def add(lhs, rhs):
+    """Elementwise sum, either operand NDArray or scalar (reference
+    ndarray.py add)."""
+    return lhs + rhs if isinstance(lhs, NDArray) else rhs + lhs
+
+
+def subtract(lhs, rhs):
+    if isinstance(lhs, NDArray):
+        return lhs - rhs
+    return rhs.__rsub__(lhs)
+
+
+def multiply(lhs, rhs):
+    return lhs * rhs if isinstance(lhs, NDArray) else rhs * lhs
+
+
+def divide(lhs, rhs):
+    if isinstance(lhs, NDArray):
+        return lhs / rhs
+    return rhs.__rtruediv__(lhs)
+
+
+true_divide = divide
+
+
+def power(lhs, rhs):
+    if isinstance(lhs, NDArray):
+        return lhs ** rhs
+    return rhs.__rpow__(lhs)
+
+
 def maximum(lhs, rhs):
     l = lhs.data if isinstance(lhs, NDArray) else lhs
     r = rhs.data if isinstance(rhs, NDArray) else rhs

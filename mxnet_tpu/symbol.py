@@ -359,6 +359,15 @@ class Symbol:
         node = _Node(op, name, entries, attrs)
         return Symbol([(node, i) for i in range(op.num_outputs)])
 
+    # -- pickling (reference Symbol __getstate__/__setstate__: the JSON
+    # form IS the pickled state) -------------------------------------------
+    def __getstate__(self):
+        return {"handle": self.tojson()}
+
+    def __setstate__(self, state):
+        restored = load_json(state["handle"])
+        self._heads = restored._heads
+
     # -- serialization (reference JSON layout) -----------------------------
     def tojson(self):
         topo = self._topo()
@@ -497,6 +506,36 @@ def Variable(name, attr=None, shape=None, **kwargs):
         attr = dict(attr)
         attr[k] = str(v)
     return Symbol([(_Node(None, name, [], attr), 0)])
+
+
+def _sym_or_scalar_binop(lhs, rhs, op_name, scalar_op, rscalar_op, what):
+    """Module-level two-operand helper (reference symbol.py maximum/
+    minimum/pow): symbol∘symbol, symbol∘scalar, or scalar∘symbol."""
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _create(op_name, lhs, rhs)
+    if isinstance(lhs, Symbol):
+        return _create(scalar_op, lhs, scalar=float(rhs))
+    if isinstance(rhs, Symbol):
+        return _create(rscalar_op, rhs, scalar=float(lhs))
+    raise MXNetError("%s needs at least one Symbol operand" % what)
+
+
+def maximum(lhs, rhs):
+    """Elementwise max (reference symbol.py maximum)."""
+    return _sym_or_scalar_binop(lhs, rhs, "_Maximum", "_MaximumScalar",
+                                "_MaximumScalar", "maximum")
+
+
+def minimum(lhs, rhs):
+    """Elementwise min (reference symbol.py minimum)."""
+    return _sym_or_scalar_binop(lhs, rhs, "_Minimum", "_MinimumScalar",
+                                "_MinimumScalar", "minimum")
+
+
+def pow(lhs, rhs):  # noqa: A001 (reference name)
+    """Elementwise power (reference symbol.py pow)."""
+    return _sym_or_scalar_binop(lhs, rhs, "_Power", "_PowerScalar",
+                                "_RPowerScalar", "pow")
 
 
 def Group(symbols):

@@ -177,3 +177,39 @@ def test_symbol_doc_examples():
     onehot[0, 2] = onehot[1, 0] = 1.0
     assert np.allclose(exe.grad_dict['x'].asnumpy(),
                        probs - onehot, atol=1e-5)
+
+
+def test_api_parity_helpers():
+    """Module-level helper parity: nd.add/subtract/..., sym.maximum/
+    minimum/pow, Symbol pickling, Executor.output_dict."""
+    import pickle
+
+    a = mx.nd.array(np.array([1.0, 4.0], "f"))
+    b = mx.nd.array(np.array([3.0, 2.0], "f"))
+    assert np.allclose(mx.nd.add(a, b).asnumpy(), [4, 6])
+    assert np.allclose(mx.nd.add(2, a).asnumpy(), [3, 6])
+    assert np.allclose(mx.nd.subtract(10, a).asnumpy(), [9, 6])
+    assert np.allclose(mx.nd.multiply(a, b).asnumpy(), [3, 8])
+    assert np.allclose(mx.nd.divide(8, b).asnumpy(), [8 / 3, 4])
+    assert np.allclose(mx.nd.power(a, 2).asnumpy(), [1, 16])
+    assert mx.nd.true_divide is mx.nd.divide
+
+    x = mx.sym.Variable('x')
+    y = mx.sym.Variable('y')
+    mx_sym = mx.sym.maximum(x, y)
+    exe = mx_sym.bind(mx.context.cpu(), args={'x': a, 'y': b})
+    assert np.allclose(exe.forward()[0].asnumpy(), [3, 4])
+    assert np.allclose(
+        mx.sym.minimum(x, 2.0).bind(mx.context.cpu(), args={'x': a})
+        .forward()[0].asnumpy(), [1, 2])
+    assert np.allclose(
+        mx.sym.pow(2.0, x).bind(mx.context.cpu(), args={'x': a})
+        .forward()[0].asnumpy(), [2, 16])
+    out_named = exe.output_dict
+    assert list(out_named.values())[0] is exe.outputs[0]
+
+    # Symbol round trips through pickle via its JSON form
+    net = mx.sym.FullyConnected(x, num_hidden=3, name="fc")
+    s2 = pickle.loads(pickle.dumps(net))
+    assert s2.list_arguments() == net.list_arguments()
+    assert s2.tojson() == net.tojson()
