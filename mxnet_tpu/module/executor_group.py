@@ -201,13 +201,10 @@ class DataParallelExecutorGroup(object):
         """numpy/NDArray -> global jax array with the given sharding; the
         value is this process's local portion (= the whole array when
         single-process)."""
-        import jax
+        from ..parallel.sharding import put_local_sharded
         if isinstance(value, NDArray):
             value = value.asnumpy()
-        value = _np.asarray(value)
-        if self._num_proc == 1:
-            return jax.device_put(value, sharding)
-        return jax.make_array_from_process_local_data(sharding, value)
+        return put_local_sharded(value, sharding)
 
     def _ensure_on_mesh(self, extra_trees=()):
         """Commit params/aux (replicated) and any extra pytrees onto the

@@ -21,7 +21,36 @@ import re
 
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["ShardingRules", "param_pspec", "batch_pspec"]
+__all__ = ["ShardingRules", "param_pspec", "batch_pspec",
+           "put_local_sharded", "put_replicated_host"]
+
+
+def put_local_sharded(value, sharding):
+    """host/device array -> global jax array with ``sharding``, where
+    ``value`` is this PROCESS's local portion (= the whole array when
+    single-process).  The one placement rule shared by trainer batches
+    and ExecutorGroup data loading."""
+    import jax
+    import numpy as _np
+    if hasattr(value, "asnumpy"):               # mxnet NDArray unwrap
+        value = value.data
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, _np.asarray(value))
+
+
+def put_replicated_host(value, sharding):
+    """Place identically-valued host data with ``sharding`` across every
+    process (each supplies only its addressable shards; device_put
+    cannot address remote devices)."""
+    import jax
+    import numpy as _np
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    host = _np.asarray(value)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx])
 
 
 def _divisible(dim, mesh, axis):

@@ -239,25 +239,26 @@ class ShardedTrainer(object):
         from ..ndarray import NDArray
         from ..initializer import Uniform
         initializer = initializer or Uniform(0.07)
+        from .sharding import put_replicated_host
         params = {}
         for name in self.param_names:
             host = NDArray(jnp.zeros(shape_map[name], dtype=dtype))
             initializer(name, host)
-            params[name] = jax.device_put(host.data,
-                                          self.param_sharding(name, host.shape))
+            params[name] = put_replicated_host(
+                host.data, self.param_sharding(name, host.shape))
         opt_state = {}
         for name in self.param_names:
             s = self.optimizer.create_state_arrays(shape_map[name], dtype)
             if s is not None:
                 opt_state[name] = jax.tree_util.tree_map(
-                    lambda a, _n=name: jax.device_put(
+                    lambda a, _n=name: put_replicated_host(
                         a, self.opt_state_sharding(_n, a.shape)), s)
         aux = {}
         for name in self._aux_names:
             init_val = jnp.ones(aux_map[name], dtype=dtype) \
                 if name.endswith("moving_var") else \
                 jnp.zeros(aux_map[name], dtype=dtype)
-            aux[name] = jax.device_put(init_val, self._replicated())
+            aux[name] = put_replicated_host(init_val, self._replicated())
         return params, opt_state, aux
 
     def _shape_maps(self, data_shapes, label_shapes=None):
@@ -340,11 +341,18 @@ class ShardedTrainer(object):
 
     def shard_batch(self, batch):
         """Place host batch arrays onto the mesh with dp/sp sharding —
-        the analog of executor_manager.load_data_batch slicing."""
+        the analog of executor_manager.load_data_batch slicing.
+
+        Multi-process: each process passes its PROCESS-LOCAL portion
+        (the reference's num_parts/part_index shard); the global batch
+        is their concatenation over the dp axis."""
+        from .sharding import put_local_sharded
         out = {}
         for name, arr in batch.items():
-            arr = jnp.asarray(getattr(arr, "data", arr))
-            out[name] = jax.device_put(arr, self.batch_sharding(arr.shape))
+            if hasattr(arr, "asnumpy"):         # mxnet NDArray unwrap
+                arr = arr.data
+            out[name] = put_local_sharded(arr,
+                                          self.batch_sharding(arr.shape))
         return out
 
     # ------------------------------------------------------------------

@@ -1,0 +1,86 @@
+"""Multi-process sharded checkpoint: each worker writes/reads only its
+own shards (the pod-scale resume path, ShardedTrainer.save_checkpoint /
+load_checkpoint over orbax), across REAL process boundaries.
+
+Both workers train a dp=2-sharded model 3 steps, save the distributed
+checkpoint to a shared directory, restore into a FRESH trainer in every
+process, and assert the next step matches a trainer that never stopped.
+
+Run directly:
+    MXTPU_SHCKPT_DIR=/tmp/shckpt python tools/launch.py -n 2 \
+        --launcher local python tests/nightly/dist_sharded_ckpt.py
+"""
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx  # noqa: F401  (boots jax.distributed via kvstore)
+
+
+def net():
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    out = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(out, name="softmax")
+
+
+def main():
+    workdir = os.environ.get("MXTPU_SHCKPT_DIR",
+                             "/tmp/mxtpu_shckpt")
+    kv = mx.kv.create("dist_sync")
+    nw = kv.num_workers
+    assert nw == 2, "launch with -n 2"
+
+    import jax
+    from mxnet_tpu import parallel
+
+    n_dev = len(jax.devices())          # GLOBAL devices over all workers
+    mesh = parallel.make_mesh(jax.devices(), dp=n_dev)
+    gbatch = 2 * n_dev
+    shapes = {"data": (gbatch, 6)}
+    lshapes = {"softmax_label": (gbatch,)}
+
+    def make():
+        opt = mx.optimizer.create("adam", learning_rate=0.05)
+        return parallel.ShardedTrainer(net(), opt, mesh)
+
+    tr = make()
+    mx.random.seed(11)
+    params, state, aux = tr.init_params(shapes, label_shapes=lshapes)
+    # each process feeds its LOCAL shard (reference num_parts protocol);
+    # derived from one seeded global batch so the run is deterministic
+    rng = np.random.RandomState(4)
+    gdata = rng.rand(gbatch, 6).astype(np.float32)
+    glabel = (rng.rand(gbatch) * 4).astype(np.float32)
+    lo = kv.rank * gbatch // nw
+    hi = (kv.rank + 1) * gbatch // nw
+    batch = tr.shard_batch({"data": gdata[lo:hi],
+                            "softmax_label": glabel[lo:hi]})
+    for _ in range(3):
+        params, state, aux, _ = tr.step(params, state, aux, batch)
+
+    ckpt = os.path.join(workdir, "ck")
+    kv.barrier()
+    tr.save_checkpoint(ckpt, params, state, aux)   # every process calls
+    kv.barrier()
+
+    tr2 = make()
+    p2, s2, a2 = tr2.load_checkpoint(ckpt, shapes, label_shapes=lshapes)
+    assert tr2.num_update == 3
+
+    pa, _, _, _ = tr.step(params, state, aux, batch)
+    pb, _, _, _ = tr2.step(p2, s2, a2, batch)
+    for name in pa:
+        ga = np.asarray(jax.device_get(pa[name]))
+        gb = np.asarray(jax.device_get(pb[name]))
+        assert np.allclose(ga, gb, atol=1e-6), name
+
+    kv.barrier()
+    if kv.rank == 0:
+        print("OK sharded checkpoint across processes")
+
+
+if __name__ == "__main__":
+    main()

@@ -79,3 +79,12 @@ def test_dist_allreduce_bandwidth():
         assert int(fields["devices"]) > 1
         assert float(fields["busbw_gbps"]) > 0
     assert "OK allreduce bench" in out
+
+
+def test_dist_sharded_checkpoint(tmp_path):
+    """Pod-scale resume across real process boundaries: both workers
+    write only their own shards, restore into fresh trainers, and the
+    next step matches a never-stopped trainer."""
+    out = _launch("dist_sharded_ckpt.py", port=9897,
+                  extra_env={"MXTPU_SHCKPT_DIR": str(tmp_path)})
+    assert "OK sharded checkpoint across processes" in out, out[-1500:]
