@@ -62,4 +62,12 @@ test: all
 test-nightly: all
 	MXTPU_NIGHTLY=1 python -m pytest tests/test_nightly_dist.py -q
 
-.PHONY: all clean test test-nightly
+.PHONY: all clean test test-nightly test-cpp
+
+# native C++ unit test for the engine (reference tests/cpp analog)
+$(LIBDIR)/engine_test: tests/cpp/engine_test.cc $(LIBDIR)/engine.o
+	$(CXX) $(CXXFLAGS) -Iinclude tests/cpp/engine_test.cc \
+	    $(LIBDIR)/engine.o -o $@ -lpthread
+
+test-cpp: $(LIBDIR)/engine_test
+	$(LIBDIR)/engine_test

@@ -231,3 +231,27 @@ def test_async_checkpoint_via_engine(tmp_path):
     # resumable
     m2 = mx.model.FeedForward.load(prefix, 3, ctx=mx.context.cpu())
     assert m2.predict(X).shape == (80, 2)
+
+
+import shutil as _shutil
+import subprocess as _subprocess
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(_shutil.which("g++") is None or
+                    _shutil.which("make") is None,
+                    reason="no native toolchain")
+def test_native_engine_cpp_unit():
+    """The C++ unit test for src/engine.cc (reference tests/cpp/
+    threaded_engine_test.cc analog): randomized replay vs serial on
+    1/2/4 threads, WaitForVar semantics, push throughput — no Python in
+    the loop."""
+    build = _subprocess.run(["make", "-s", "lib/engine_test"], cwd=_ROOT,
+                            capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-1500:]
+    proc = _subprocess.run([os.path.join(_ROOT, "lib", "engine_test")],
+                           capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-1000:])
+    assert "ENGINE CPP OK" in proc.stdout
+    assert proc.stdout.count("OK") >= 5
