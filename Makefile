@@ -69,5 +69,10 @@ $(LIBDIR)/engine_test: tests/cpp/engine_test.cc $(LIBDIR)/engine.o
 	$(CXX) $(CXXFLAGS) -Iinclude tests/cpp/engine_test.cc \
 	    $(LIBDIR)/engine.o -o $@ -lpthread
 
-test-cpp: $(LIBDIR)/engine_test
+$(LIBDIR)/recordio_test: tests/cpp/recordio_test.cc $(LIBDIR)/recordio.o
+	$(CXX) $(CXXFLAGS) -Iinclude tests/cpp/recordio_test.cc \
+	    $(LIBDIR)/recordio.o -o $@
+
+test-cpp: $(LIBDIR)/engine_test $(LIBDIR)/recordio_test
 	$(LIBDIR)/engine_test
+	$(LIBDIR)/recordio_test $$(mktemp -d)

@@ -1,6 +1,7 @@
 """Data IO tests (mirrors reference tests/python/unittest/test_io.py +
 test_recordio.py)."""
 import os
+import shutil
 
 import numpy as np
 import pytest
@@ -212,3 +213,22 @@ def test_mnist_iter(tmp_path):
     flat = mio.MNISTIter(image=ipath, label=lpath, batch_size=10, flat=True,
                          shuffle=False)
     assert next(flat).data[0].shape == (10, 784)
+
+
+@pytest.mark.skipif(shutil.which("g++") is None or
+                    shutil.which("make") is None,
+                    reason="no native toolchain")
+def test_native_recordio_cpp_unit(tmp_path):
+    """The C++ unit test for src/recordio.cc: write/read/skip/seek,
+    byte-range shard resync (num_parts protocol), and corruption
+    detection — no Python in the loop."""
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    build = subprocess.run(["make", "-s", "lib/recordio_test"], cwd=root,
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-1500:]
+    proc = subprocess.run([os.path.join(root, "lib", "recordio_test"),
+                           str(tmp_path)],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-1000:])
+    assert "RECORDIO CPP OK" in proc.stdout
