@@ -75,4 +75,5 @@ $(LIBDIR)/recordio_test: tests/cpp/recordio_test.cc $(LIBDIR)/recordio.o
 
 test-cpp: $(LIBDIR)/engine_test $(LIBDIR)/recordio_test
 	$(LIBDIR)/engine_test
-	$(LIBDIR)/recordio_test $$(mktemp -d)
+	d=$$(mktemp -d) && $(LIBDIR)/recordio_test $$d; rc=$$?; \
+	    rm -rf $$d; exit $$rc

@@ -26,7 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..base import MXNetError
 from .sharding import param_pspec, batch_pspec
 
-__all__ = ["ShardedTrainer"]
+__all__ = ["ShardedTrainer", "ShardedPredictor"]
 
 
 def _abstractify(a):
@@ -41,6 +41,17 @@ def _abstractify(a):
         return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
     a = jnp.asarray(a)
     return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+
+def _place_batch(batch, sharding_fn):
+    """dict of host/NDArray arrays -> placed jax arrays (the one batch
+    placement rule, shared by ShardedTrainer and ShardedPredictor)."""
+    from .sharding import put_local_sharded
+    out = {}
+    for name, arr in batch.items():
+        arr = getattr(arr, "data", arr) if hasattr(arr, "asnumpy") else arr
+        out[name] = put_local_sharded(arr, sharding_fn(arr.shape))
+    return out
 
 
 class ShardedTrainer(object):
@@ -346,14 +357,7 @@ class ShardedTrainer(object):
         Multi-process: each process passes its PROCESS-LOCAL portion
         (the reference's num_parts/part_index shard); the global batch
         is their concatenation over the dp axis."""
-        from .sharding import put_local_sharded
-        out = {}
-        for name, arr in batch.items():
-            if hasattr(arr, "asnumpy"):         # mxnet NDArray unwrap
-                arr = arr.data
-            out[name] = put_local_sharded(arr,
-                                          self.batch_sharding(arr.shape))
-        return out
+        return _place_batch(batch, self.batch_sharding)
 
     # ------------------------------------------------------------------
     # steps
@@ -429,3 +433,122 @@ class ShardedTrainer(object):
             from .ring_attention import sequence_parallel
             return sequence_parallel(self.mesh)
         return contextlib.nullcontext()
+
+
+class ShardedPredictor(object):
+    """Mesh-sharded inference: the serving-side counterpart of
+    ShardedTrainer (batch sharded over dp/sp, parameters placed by the
+    same tp rules, forward jitted once per input shape).
+
+    Beyond-reference: the reference predictor (c_predict_api) is
+    single-device; this one serves models that only fit sharded, from
+    either checkpoint format.
+
+    Parameters
+    ----------
+    symbol : inference symbol (loss heads fine — run is_train=False).
+    mesh / rules / seq_axis : as ShardedTrainer.
+    arg_params / aux_params : host dicts (e.g. from
+        model.load_checkpoint) — placed with the param shardings.
+    """
+
+    def __init__(self, symbol, mesh, arg_params, aux_params=None,
+                 rules=None, seq_axis=None, data_names=("data",),
+                 label_names=("softmax_label",), compute_dtype=None):
+        from .sharding import put_replicated_host
+        self.symbol = symbol
+        self.mesh = mesh
+        self.rules = rules
+        self.seq_axis = seq_axis
+        self.data_names = tuple(data_names)
+        self.label_names = tuple(label_names)
+        self.compute_dtype = (jnp.dtype(compute_dtype)
+                              if compute_dtype is not None else None)
+        from ..executor import _build_program
+        program = _build_program(symbol, {})
+        self._trace = program.trace
+
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        missing = [n for n in self._arg_names
+                   if n not in self.data_names and n not in arg_params
+                   and n not in self.label_names]
+        if missing:
+            raise MXNetError("ShardedPredictor: missing parameters %s"
+                             % missing)
+        self.params = {}
+        for name, value in arg_params.items():
+            host = _np.asarray(getattr(value, "asnumpy", lambda: value)())
+            sharding = NamedSharding(
+                mesh, param_pspec(name, host.shape, mesh, rules))
+            self.params[name] = put_replicated_host(host, sharding)
+        self.aux = {}
+        for name, value in (aux_params or {}).items():
+            host = _np.asarray(getattr(value, "asnumpy", lambda: value)())
+            self.aux[name] = put_replicated_host(
+                host, NamedSharding(mesh, P()))
+
+        cdt = self.compute_dtype
+
+        def _cast(tree):
+            if cdt is None:
+                return tree
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(cdt)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+        def forward(params, aux, batch, rng):
+            args = dict(_cast(params))
+            # loss-layer label slots bind as zeros (predict contract)
+            for n in self._arg_names:
+                if n not in args and n not in batch:
+                    shape = self._label_shape(n, batch)
+                    args[n] = jnp.zeros(shape, jnp.float32)
+            args.update({k: _cast(v) if k not in ("softmax_label",)
+                         else v for k, v in batch.items()})
+            outs, _ = self._trace(args, _cast(aux), rng, False)
+            return [o.astype(jnp.float32) if cdt is not None
+                    and jnp.issubdtype(o.dtype, jnp.floating) else o
+                    for o in outs]
+
+        self._jit_forward = jax.jit(forward)
+        self._label_shapes = {}
+
+    def _label_shape(self, name, batch):
+        key = tuple(sorted((k, tuple(v.shape)) for k, v in batch.items()))
+        cache = self._label_shapes.get(key)
+        if cache is None:
+            shapes = {k: tuple(v.shape) for k, v in batch.items()}
+            arg_shapes, _, _ = self.symbol.infer_shape_partial(**shapes)
+            cache = dict(zip(self._arg_names, arg_shapes or []))
+            self._label_shapes[key] = cache
+        shape = cache.get(name)
+        if shape is None:
+            raise MXNetError("cannot infer shape for %r" % name)
+        return shape
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, mesh, **kwargs):
+        """Build from a classic prefix-symbol.json + params checkpoint."""
+        from ..model import load_checkpoint
+        sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return cls(sym, mesh, arg_params, aux_params, **kwargs)
+
+    def batch_sharding(self, shape):
+        return NamedSharding(self.mesh,
+                             batch_pspec(shape, self.mesh, self.seq_axis))
+
+    def predict(self, batch):
+        """batch: dict name -> host/NDArray array (process-local portion
+        under multi-process).  Returns list of host numpy outputs (the
+        GLOBAL batch on every process)."""
+        placed = _place_batch(batch, self.batch_sharding)
+        rng = jax.random.PRNGKey(0)
+        outs = self._jit_forward(self.params, self.aux, placed, rng)
+        if jax.process_count() > 1:
+            # outputs stay dp-sharded across hosts: gather before the
+            # host copy (device_get cannot read non-addressable shards)
+            from jax.experimental import multihost_utils
+            return [_np.asarray(multihost_utils.process_allgather(
+                o, tiled=True)) for o in outs]
+        return [_np.asarray(jax.device_get(o)) for o in outs]
