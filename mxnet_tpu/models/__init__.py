@@ -19,6 +19,7 @@ from . import inception_bn
 from . import inception_v3
 from . import resnet
 from . import lstm
+from . import gru
 
 from . import transformer
 from .mlp import get_symbol as get_mlp
@@ -31,5 +32,5 @@ from .inception_v3 import get_symbol as get_inception_v3
 from .resnet import get_symbol as get_resnet
 
 __all__ = ["transformer", "mlp", "lenet", "alexnet", "vgg", "googlenet", "inception_bn",
-           "resnet", "lstm", "get_mlp", "get_lenet", "get_alexnet",
+           "resnet", "lstm", "gru", "get_mlp", "get_lenet", "get_alexnet",
            "get_vgg", "get_googlenet", "get_inception_bn", "get_resnet"]

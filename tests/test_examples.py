@@ -316,3 +316,14 @@ def test_notebooks_execute(tmp_path):
         script.write_text(code)
         r = _run(str(tmp_path), str(script))
         assert r.returncode == 0, (path, r.stderr[-2000:])
+
+
+def test_gru_bucketing_example():
+    """example/rnn/gru_bucketing.py trains hermetically on the synthetic
+    corpus (GRU cell parity with the reference's gru_bucketing)."""
+    r = _run(os.path.join(REPO, "example/rnn"), "gru_bucketing.py",
+             "--num-epochs", "1", "--batch-size", "8", "--num-hidden",
+             "16", "--num-embed", "16", "--num-gru-layer", "1",
+             "--buckets", "8,16")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Perplexity" in (r.stderr + r.stdout)
