@@ -96,3 +96,39 @@ def init_state_shapes(num_lstm_layer, batch_size, num_hidden):
     init_h = [("l%d_init_h" % l, (batch_size, num_hidden))
               for l in range(num_lstm_layer)]
     return init_c + init_h
+
+
+def lstm_inference_symbol(num_lstm_layer, input_size, num_hidden,
+                          num_embed, num_label, dropout=0.0):
+    """One-step LSTM for stateful inference (reference lstm.py
+    lstm_inference_symbol): outputs [softmax, l0_c, l0_h, l1_c, ...] as
+    a Group; weights share the unrolled symbol's names so trained
+    arg_params drop straight in."""
+    embed_weight = sym.Variable("embed_weight")
+    cls_weight = sym.Variable("cls_weight")
+    cls_bias = sym.Variable("cls_bias")
+    data = sym.Variable("data")
+    hidden = sym.Embedding(data=data, input_dim=input_size,
+                           weight=embed_weight, output_dim=num_embed,
+                           name="embed")
+    out_states = []
+    for i in range(num_lstm_layer):
+        param = LSTMParam(
+            i2h_weight=sym.Variable("l%d_i2h_weight" % i),
+            i2h_bias=sym.Variable("l%d_i2h_bias" % i),
+            h2h_weight=sym.Variable("l%d_h2h_weight" % i),
+            h2h_bias=sym.Variable("l%d_h2h_bias" % i))
+        prev = LSTMState(c=sym.Variable("l%d_init_c" % i),
+                         h=sym.Variable("l%d_init_h" % i))
+        dp = 0.0 if i == 0 else dropout
+        state = lstm_cell(num_hidden, indata=hidden, prev_state=prev,
+                          param=param, seqidx=0, layeridx=i, dropout=dp)
+        hidden = state.h
+        out_states.extend([state.c, state.h])
+    if dropout > 0.0:
+        hidden = sym.Dropout(data=hidden, p=dropout)
+    pred = sym.FullyConnected(data=hidden, num_hidden=num_label,
+                              weight=cls_weight, bias=cls_bias,
+                              name="pred")
+    softmax = sym.SoftmaxOutput(data=pred, name="softmax")
+    return sym.Group([softmax] + out_states)

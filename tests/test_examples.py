@@ -327,3 +327,44 @@ def test_gru_bucketing_example():
              "--buckets", "8,16")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "Perplexity" in (r.stderr + r.stdout)
+
+
+def test_lstm_inference_model_matches_unrolled():
+    """rnn_model.py: stepwise stateful inference reproduces the
+    unrolled network's per-position distributions exactly (states carry
+    correctly through the one-step executor)."""
+    import importlib.util
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.lstm import lstm_unroll
+
+    spec = importlib.util.spec_from_file_location(
+        "rnn_model", os.path.join(REPO, "example/rnn/rnn_model.py"))
+    rnn_model = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rnn_model)
+
+    V, H, E, L, S = 30, 12, 8, 2, 5
+    rng = np.random.RandomState(1)
+
+    unrolled = lstm_unroll(L, S, V, num_hidden=H, num_embed=E, num_label=V)
+    shapes = {"data": (1, S), "softmax_label": (1, S)}
+    shapes.update({"l%d_init_c" % i: (1, H) for i in range(L)})
+    shapes.update({"l%d_init_h" % i: (1, H) for i in range(L)})
+    exe = unrolled.simple_bind(mx.context.cpu(), grad_req="null", **shapes)
+    weights = {}
+    for name, arr in exe.arg_dict.items():
+        if name in ("data", "softmax_label") or name.endswith(
+                ("_init_c", "_init_h")):
+            continue
+        w = rng.uniform(-0.2, 0.2, arr.shape).astype(np.float32)
+        arr[:] = w
+        weights[name] = mx.nd.array(w)
+    toks = rng.randint(0, V, size=S).astype(np.float32)
+    exe.arg_dict["data"][:] = toks[None, :]
+    want = exe.forward()[0].asnumpy()          # (S, V): row t = position t
+
+    model = rnn_model.LSTMInferenceModel(L, V, H, E, V,
+                                         arg_params=weights)
+    for t in range(S):
+        got = model.forward(np.array([toks[t]], np.float32),
+                            new_seq=(t == 0))[0]
+        assert np.allclose(got, want[t], atol=1e-5), t
