@@ -41,7 +41,7 @@ class LSTMInferenceModel(object):
             getattr(input_data, "asnumpy", lambda: input_data)())
         outs = self.executor.forward()
         for key, out in zip(self._state_names, outs[1:]):
-            self.executor.arg_dict[key][:] = out.asnumpy()
+            out.copyto(self.executor.arg_dict[key])   # stays on device
         return outs[0].asnumpy()
 
 

@@ -14,9 +14,11 @@ The factory falls back to Naive when the native library is unavailable.
 """
 from __future__ import annotations
 
+import atexit
 import ctypes
 import os
 import threading
+import weakref
 
 from .base import MXNetError
 
@@ -104,6 +106,8 @@ class ThreadedEngine(Engine):
 
         self._tramp = _ENGINE_FN_TYPE(
             lambda token: _trampoline(int(token)))
+        self._closed = False
+        _LIVE_ENGINES.add(self)
 
     def _reraise(self):
         with self._cb_lock:
@@ -123,6 +127,9 @@ class ThreadedEngine(Engine):
             token = self._next_token[0]
             self._next_token[0] += 1
             self._cbs[token] = fn
+        # a drained engine (close()/atexit) runs this push INLINE on the
+        # calling thread, native-side — no handle race, no lock held
+        # around user code (Engine::Shutdown in src/engine.cc)
         n_c, n_m = len(const), len(mutable)
         c_arr = (ctypes.c_uint64 * max(n_c, 1))(*const)
         m_arr = (ctypes.c_uint64 * max(n_m, 1))(*mutable)
@@ -141,17 +148,50 @@ class ThreadedEngine(Engine):
     def delete_variable(self, var):
         self._lib.MXTPUEngineDeleteVar(self._h, var)
 
+    def close(self):
+        """Drain pending work and join the native workers (the handle
+        stays alive; later pushes run inline native-side).  Called from
+        the atexit hook while the interpreter is still healthy: worker
+        threads run Python callbacks, so letting them survive into
+        interpreter FINALIZATION aborts the process (ctypes callback
+        into a dying interpreter -> std::terminate)."""
+        with self._cb_lock:
+            if self._closed:
+                return
+            self._closed = True
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.MXTPUEngineShutdown(h)
+
     def __del__(self):
         try:
-            if getattr(self, "_h", None):
-                self._lib.MXTPUEngineFree(self._h)
-                self._h = None
+            self.close()
+            # free only during normal runtime: at interpreter exit the
+            # drained handle is deliberately leaked (straggler daemon
+            # threads may still inline-push through it)
+            import sys
+            if not sys.is_finalizing():
+                h, self._h = getattr(self, "_h", None), None
+                if h:
+                    self._lib.MXTPUEngineFree(h)
         except Exception:
             pass
 
 
 _ENGINE = None
 _ENGINE_LOCK = threading.Lock()
+_LIVE_ENGINES = weakref.WeakSet()
+
+
+@atexit.register
+def _close_live_engines():
+    """Drain every native engine before interpreter teardown begins —
+    after this, late GC of engines is a no-op (see ThreadedEngine.close)."""
+    for eng in list(_LIVE_ENGINES):
+        try:
+            eng.close()
+        except Exception:
+            pass
 
 
 def create(engine_type=None, num_threads=None):

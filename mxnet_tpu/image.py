@@ -85,21 +85,6 @@ def imresize(img, w, h):
     return out
 
 
-def _rotate(img, angle):
-    """Rotate about the centre, keeping size (image_aug_default.cc rotate)."""
-    if _cv2 is not None:
-        h, w = img.shape[:2]
-        mat = _cv2.getRotationMatrix2D((w / 2.0, h / 2.0), angle, 1.0)
-        out = _cv2.warpAffine(img, mat, (w, h), flags=_cv2.INTER_LINEAR)
-    else:
-        pimg = _PILImage.fromarray(img.squeeze() if img.shape[2] == 1 else img)
-        out = _np.asarray(pimg.rotate(angle, _PILImage.BILINEAR),
-                          dtype=img.dtype)
-    if out.ndim == 2:
-        out = out[:, :, None]
-    return out
-
-
 def _jitter_hsl(img, dh, ds, dl, rng):
     """Random hue/saturation/lightness shift (image_aug_default.cc HSL).
 
@@ -124,21 +109,114 @@ def _jitter_hsl(img, dh, ds, dl, rng):
     return _cv2.cvtColor(hls.astype(_np.uint8), _cv2.COLOR_HLS2RGB)
 
 
+def _affine_warp(img, M, out_w, out_h, fill_value=255):
+    """Inverse-map affine warp with bilinear sampling and constant fill —
+    the numpy form of the reference's cv::warpAffine(M, BORDER_CONSTANT,
+    fill_value) geometry path."""
+    if _cv2 is not None:
+        out = _cv2.warpAffine(
+            img, M[:2], (out_w, out_h), flags=_cv2.INTER_LINEAR,
+            borderMode=_cv2.BORDER_CONSTANT,
+            borderValue=tuple([float(fill_value)] * 3))
+        if out.ndim == 2:
+            out = out[:, :, None]
+        return out.astype(img.dtype)
+    A = _np.vstack([M[:2], [0.0, 0.0, 1.0]])
+    Ainv = _np.linalg.inv(A)
+    ys, xs = _np.mgrid[0:out_h, 0:out_w]
+    src_x = Ainv[0, 0] * xs + Ainv[0, 1] * ys + Ainv[0, 2]
+    src_y = Ainv[1, 0] * xs + Ainv[1, 1] * ys + Ainv[1, 2]
+    h, w = img.shape[:2]
+    x0 = _np.floor(src_x).astype(_np.int64)
+    y0 = _np.floor(src_y).astype(_np.int64)
+    fx = (src_x - x0)[..., None]
+    fy = (src_y - y0)[..., None]
+    out = _np.full((out_h, out_w, img.shape[2]), float(fill_value))
+    valid = (src_x >= 0) & (src_x <= w - 1) & (src_y >= 0) & (src_y <= h - 1)
+    x0c = _np.clip(x0, 0, w - 2)
+    y0c = _np.clip(y0, 0, h - 2)
+    f = img.astype(_np.float64)
+    samp = (f[y0c, x0c] * (1 - fx) * (1 - fy)
+            + f[y0c, x0c + 1] * fx * (1 - fy)
+            + f[y0c + 1, x0c] * (1 - fx) * fy
+            + f[y0c + 1, x0c + 1] * fx * fy)
+    out[valid] = samp[valid]
+    return _np.clip(out, 0, 255).astype(img.dtype)
+
+
 def augment(img, data_shape, rand_crop=False, rand_mirror=False, rng=None,
-            max_rotate_angle=0, min_random_scale=1.0, max_random_scale=1.0,
+            max_rotate_angle=0, rotate=-1, min_random_scale=1.0,
+            max_random_scale=1.0, max_aspect_ratio=0.0,
+            max_shear_ratio=0.0, min_crop_size=-1, max_crop_size=-1,
+            min_img_size=0.0, max_img_size=1e10, pad=0, fill_value=255,
             random_h=0, random_s=0, random_l=0):
-    """Default augmenter (parity: image_aug_default.cc DefaultImageAugmenter):
-    random scale + rotate + (random|center) crop to data_shape (C,H,W) +
-    mirror + HSL jitter.  All knobs default off, matching the reference's
+    """Default augmenter (parity: image_aug_default.cc
+    DefaultImageAugmenter): affine scale/aspect/shear/rotate with
+    constant fill, pad, random-size or fixed crop to data_shape (C,H,W),
+    mirror, HSL jitter.  All knobs default off, matching the reference's
     ImageRecordIter parameter defaults."""
     rng = rng or _np.random
     c, th, tw = data_shape
-    if max_rotate_angle > 0:
-        img = _rotate(img, rng.uniform(-max_rotate_angle, max_rotate_angle))
-    if max_random_scale != 1.0 or min_random_scale != 1.0:
+    if (min_crop_size > 0) != (max_crop_size > 0):
+        raise ValueError("min_crop_size and max_crop_size must be set "
+                         "together (reference CHECK)")
+    if min_crop_size > 0 and min_crop_size > max_crop_size:
+        raise ValueError("min_crop_size must be <= max_crop_size")
+    use_affine = (max_rotate_angle > 0 or rotate > 0
+                  or max_shear_ratio > 0.0 or max_aspect_ratio > 0.0
+                  or min_img_size != 0.0 or max_img_size != 1e10)
+    if use_affine:
+        # the reference's combined matrix (image_aug_default.cc): shear s,
+        # rotation (a, b), scale split across axes by the aspect ratio
+        s = rng.uniform(0, 1) * max_shear_ratio * 2 - max_shear_ratio
+        angle = int(rng.uniform(-max_rotate_angle, max_rotate_angle)) \
+            if max_rotate_angle > 0 else 0
+        if rotate > 0:
+            angle = rotate
+        a = _np.cos(angle / 180.0 * _np.pi)
+        b = _np.sin(angle / 180.0 * _np.pi)
+        scale = rng.uniform(min_random_scale, max_random_scale)
+        ratio = rng.uniform(0, 1) * max_aspect_ratio * 2 \
+            - max_aspect_ratio + 1
+        hs = 2 * scale / (1 + ratio)
+        ws = ratio * hs
+        h, w = img.shape[:2]
+        new_w = max(min_img_size, min(max_img_size, scale * w))
+        new_h = max(min_img_size, min(max_img_size, scale * h))
+        M = _np.zeros((2, 3))
+        M[0, 0] = hs * a - s * b * ws
+        M[1, 0] = -b * ws
+        M[0, 1] = hs * b + s * a * ws
+        M[1, 1] = a * ws
+        ori_cw = M[0, 0] * w + M[0, 1] * h
+        ori_ch = M[1, 0] * w + M[1, 1] * h
+        M[0, 2] = (new_w - ori_cw) / 2
+        M[1, 2] = (new_h - ori_ch) / 2
+        img = _affine_warp(img, M, int(round(new_w)), int(round(new_h)),
+                           fill_value)
+    elif max_random_scale != 1.0 or min_random_scale != 1.0:
         s = rng.uniform(min_random_scale, max_random_scale)
         h, w = img.shape[:2]
         img = imresize(img, max(tw, int(w * s + 0.5)), max(th, int(h * s + 0.5)))
+    if pad > 0:
+        img = _np.pad(img, ((pad, pad), (pad, pad), (0, 0)),
+                      constant_values=fill_value)
+    if min_crop_size > 0 and max_crop_size > 0:
+        # random square crop in [min, max] then resize to the target
+        # (image_aug_default.cc random-crop-size branch)
+        h, w = img.shape[:2]
+        hi = min(max_crop_size, min(h, w))
+        lo = min(min_crop_size, hi)
+        size = int(rng.uniform(0, 1) * (hi - lo + 1)) + lo \
+            if hi > lo else hi
+        y, x = h - size, w - size
+        if rand_crop:
+            y = rng.randint(0, y + 1)
+            x = rng.randint(0, x + 1)
+        else:
+            y //= 2
+            x //= 2
+        img = imresize(img[y:y + size, x:x + size], tw, th)
     h, w = img.shape[:2]
     # upscale if needed so a crop fits
     if h < th or w < tw:

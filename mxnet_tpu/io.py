@@ -21,10 +21,64 @@ import numpy as _np
 
 from .base import MXNetError
 from .ndarray import NDArray, array as nd_array
+# imported at module level ON PURPOSE: engine.py's atexit drain must
+# register BEFORE this module's _stop_producers (atexit is LIFO), so
+# producers stop first, engine drains second
+from . import engine as _engine_mod  # noqa: F401
 
 __all__ = ["DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
            "DataDesc"]
+
+# Producer threads must be out of the decode machinery before the
+# interpreter starts finalizing: a daemon thread force-unwound by
+# CPython inside a ctypes/native frame aborts the process
+# ("FATAL: exception not rethrown").  _SHUTTING_DOWN makes every
+# producer exit at its next loop step; the atexit hook (which runs
+# BEFORE engine.py's drain — io imports engine, so registers later,
+# and atexit is LIFO) joins them while the interpreter is healthy.
+_SHUTTING_DOWN = False
+_LIVE_PRODUCERS = None   # weakref.WeakSet, created lazily
+
+
+def _register_producer(thread):
+    global _LIVE_PRODUCERS
+    if _LIVE_PRODUCERS is None:
+        import weakref
+        _LIVE_PRODUCERS = weakref.WeakSet()
+    _LIVE_PRODUCERS.add(thread)
+
+
+_LIVE_PREFETCHERS = None
+
+
+def _register_prefetcher(it):
+    global _LIVE_PREFETCHERS
+    if _LIVE_PREFETCHERS is None:
+        import weakref
+        _LIVE_PREFETCHERS = weakref.WeakSet()
+    _LIVE_PREFETCHERS.add(it)
+
+
+def _stop_producers():
+    global _SHUTTING_DOWN
+    _SHUTTING_DOWN = True
+    for p in list(_LIVE_PREFETCHERS or ()):
+        try:
+            p.started = False
+            for e in p.data_taken:
+                e.set()
+        except Exception:
+            pass
+    for t in list(_LIVE_PRODUCERS or ()):
+        try:
+            t.join(timeout=10.0)
+        except Exception:
+            pass
+
+
+import atexit as _atexit
+_atexit.register(_stop_producers)
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -270,7 +324,7 @@ class PrefetchingIter(DataIter):
         def prefetch_func(self, i):
             while True:
                 self.data_taken[i].wait()
-                if not self.started:
+                if not self.started or _SHUTTING_DOWN:
                     break
                 try:
                     self.next_batch[i] = self.iters[i].next()
@@ -281,7 +335,9 @@ class PrefetchingIter(DataIter):
         self.prefetch_threads = [
             threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
             for i in range(self.n_iter)]
+        _register_prefetcher(self)
         for thread in self.prefetch_threads:
+            _register_producer(thread)
             thread.start()
 
     def __del__(self):
@@ -511,8 +567,11 @@ class ImageRecordIter(DataIter):
                  mean_img=None, scale=1.0, rand_crop=False, rand_mirror=False,
                  num_parts=1, part_index=0, preprocess_threads=4,
                  prefetch_buffer=4, seed=0, round_batch=True,
-                 max_rotate_angle=0, min_random_scale=1.0,
-                 max_random_scale=1.0, random_h=0, random_s=0, random_l=0,
+                 max_rotate_angle=0, rotate=-1, min_random_scale=1.0,
+                 max_random_scale=1.0, max_aspect_ratio=0.0,
+                 max_shear_ratio=0.0, min_crop_size=-1, max_crop_size=-1,
+                 min_img_size=0.0, max_img_size=1e10, pad=0, fill_value=255,
+                 random_h=0, random_s=0, random_l=0,
                  data_name="data", label_name="softmax_label",
                  dtype="float32", **kwargs):
         super().__init__()
@@ -545,15 +604,30 @@ class ImageRecordIter(DataIter):
         assert self.dtype in (_np.float32, _np.uint8), \
             "ImageRecordIter dtype must be float32 or uint8"
         self._aug = dict(rand_crop=rand_crop, rand_mirror=rand_mirror,
-                         max_rotate_angle=max_rotate_angle,
+                         max_rotate_angle=max_rotate_angle, rotate=rotate,
                          min_random_scale=min_random_scale,
                          max_random_scale=max_random_scale,
+                         max_aspect_ratio=max_aspect_ratio,
+                         max_shear_ratio=max_shear_ratio,
+                         min_crop_size=min_crop_size,
+                         max_crop_size=max_crop_size,
+                         min_img_size=min_img_size,
+                         max_img_size=max_img_size,
+                         pad=pad, fill_value=fill_value,
                          random_h=random_h, random_s=random_s,
                          random_l=random_l)
         # the native kernel covers the default augmenter (scale/crop/mirror);
-        # rotation/HSL jitter route through the python augmenter
-        self._native_aug_ok = (max_rotate_angle == 0 and random_h == 0
-                               and random_s == 0 and random_l == 0)
+        # affine geometry (rotate/aspect/shear), crop-size, pad, and HSL
+        # jitter route through the python augmenter
+        self._native_aug_ok = (max_rotate_angle == 0 and rotate <= 0
+                               and max_aspect_ratio == 0.0
+                               and max_shear_ratio == 0.0
+                               and min_crop_size <= 0
+                               and max_crop_size <= 0
+                               and min_img_size == 0.0
+                               and max_img_size == 1e10 and pad == 0
+                               and random_h == 0 and random_s == 0
+                               and random_l == 0)
         # per-channel mean vector (native-kernel friendly) vs full mean image
         self._mean_vec = None
         self._mean_full = None
@@ -728,6 +802,8 @@ class ImageRecordIter(DataIter):
     def _put_weak(q, wself, gen, item):
         import queue as _queue
         while True:
+            if _SHUTTING_DOWN:
+                return False
             s = wself()
             if s is None or gen != s._gen:
                 return False
@@ -778,6 +854,8 @@ class ImageRecordIter(DataIter):
             starts = list(range(0, order.size, self.batch_size))
             del self
             for start in starts:
+                if _SHUTTING_DOWN:
+                    return
                 self = wself()
                 if self is None or gen != self._gen:
                     return
@@ -798,6 +876,7 @@ class ImageRecordIter(DataIter):
         self._producer = threading.Thread(
             target=ImageRecordIter._produce,
             args=(weakref.ref(self), gen, self._epoch), daemon=True)
+        _register_producer(self._producer)
         self._producer.start()
 
     # -- DataIter protocol -------------------------------------------------
@@ -836,7 +915,15 @@ class ImageRecordIter(DataIter):
     def iter_next(self):
         if self._exhausted:
             return False
-        item = self._queue.get()
+        import queue as _queue_mod
+        while True:
+            try:
+                item = self._queue.get(timeout=0.2)
+                break
+            except _queue_mod.Empty:
+                if _SHUTTING_DOWN:      # interpreter exiting: unblock
+                    self._exhausted = True
+                    return False
         if item is None:
             self._exhausted = True
             return False

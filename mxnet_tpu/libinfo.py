@@ -65,9 +65,30 @@ def _find_lib_locked(build):
     except OSError:
         return None
 
+    # a stale .so from an older checkout may miss newer symbols: rebuild
+    # once, and if still incomplete fall back to pure python rather than
+    # crash with AttributeError at first use
+    if not hasattr(lib, "MXTPUEngineShutdown"):
+        rebuilt = False
+        import shutil
+        if build and shutil.which("make") and shutil.which("g++"):
+            rebuilt = _try_build()
+        if rebuilt:
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except OSError:
+                return None
+        if not hasattr(lib, "MXTPUEngineShutdown"):
+            import warnings
+            warnings.warn("mxnet_tpu: lib/libmxtpu.so is stale (missing "
+                          "MXTPUEngineShutdown); run `make` to rebuild — "
+                          "using the pure-python fallback")
+            return None
+
     lib.MXTPUEngineCreate.restype = ctypes.c_void_p
     lib.MXTPUEngineCreate.argtypes = [ctypes.c_int]
     lib.MXTPUEngineFree.argtypes = [ctypes.c_void_p]
+    lib.MXTPUEngineShutdown.argtypes = [ctypes.c_void_p]
     lib.MXTPUEngineNewVar.restype = ctypes.c_uint64
     lib.MXTPUEngineNewVar.argtypes = [ctypes.c_void_p]
     lib.MXTPUEnginePush.argtypes = [

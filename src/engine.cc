@@ -22,6 +22,7 @@
 #include <functional>
 #include <memory>
 #include <mutex>
+#include <shared_mutex>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -57,13 +58,7 @@ class Engine {
   }
 
   ~Engine() {
-    WaitForAll();
-    {
-      std::unique_lock<std::mutex> lk(ready_mu_);
-      shutdown_ = true;
-    }
-    ready_cv_.notify_all();
-    for (auto& t : workers_) t.join();
+    Shutdown();
   }
 
   uint64_t NewVariable() {
@@ -73,10 +68,41 @@ class Engine {
     return id;
   }
 
+  // Drain pending work and join the workers, keeping the engine object
+  // alive: pushes after Shutdown run INLINE on the calling thread.  This
+  // is the interpreter-exit story — a host-language atexit hook drains
+  // while callbacks into it are still safe; straggler producer threads
+  // then degrade to synchronous execution instead of racing a teardown.
+  void Shutdown() {
+    {
+      std::unique_lock<std::shared_mutex> lk(stop_mu_);
+      bool expected = false;
+      if (!stopped_.compare_exchange_strong(expected, true)) return;
+    }
+    WaitForAll();
+    {
+      std::unique_lock<std::mutex> lk(ready_mu_);
+      shutdown_ = true;
+    }
+    ready_cv_.notify_all();
+    for (auto& t : workers_) t.join();
+    workers_.clear();
+  }
+
+
   // Parity Engine::PushAsync (engine.h:120): dedup vars, register with each
   // queue, self-decrement the +1 guard, dispatch if already ready.
   void Push(Fn fn, std::vector<uint64_t> const_vars,
             std::vector<uint64_t> mutable_vars) {
+    // shared lock across the whole enqueue: Shutdown's exclusive flip of
+    // stopped_ cannot interleave mid-push (an op enqueued after the
+    // workers joined would never run and wedge WaitForAll)
+    std::shared_lock<std::shared_mutex> stop_lk(stop_mu_);
+    if (stopped_.load(std::memory_order_acquire)) {
+      stop_lk.unlock();
+      fn();            // drained engine: synchronous degradation
+      return;
+    }
     // enforce disjoint read/write sets here (not just in wrappers): a var
     // queued as both read and write would deadlock its own grant
     Dedup(&mutable_vars);
@@ -254,6 +280,8 @@ class Engine {
   std::condition_variable ready_cv_;
   std::deque<Opr*> ready_;
   bool shutdown_;
+  std::atomic<bool> stopped_{false};
+  std::shared_mutex stop_mu_;
 
   std::atomic<int64_t> pending_{0};
   std::mutex all_mu_;
@@ -276,6 +304,12 @@ void* MXTPUEngineCreate(int num_threads) {
 }
 
 void MXTPUEngineFree(void* h) { delete static_cast<mxtpu::Engine*>(h); }
+
+// Drain + join workers, keep the handle alive; later pushes run inline
+// on the caller (see Engine::Shutdown).
+void MXTPUEngineShutdown(void* h) {
+  static_cast<mxtpu::Engine*>(h)->Shutdown();
+}
 
 uint64_t MXTPUEngineNewVar(void* h) {
   return static_cast<mxtpu::Engine*>(h)->NewVariable();
