@@ -20,6 +20,7 @@ from . import inception_v3
 from . import resnet
 from . import lstm
 from . import gru
+from . import rnn
 
 from . import transformer
 from .mlp import get_symbol as get_mlp
@@ -31,6 +32,8 @@ from .inception_bn import get_symbol as get_inception_bn
 from .inception_v3 import get_symbol as get_inception_v3
 from .resnet import get_symbol as get_resnet
 
-__all__ = ["transformer", "mlp", "lenet", "alexnet", "vgg", "googlenet", "inception_bn",
-           "resnet", "lstm", "gru", "get_mlp", "get_lenet", "get_alexnet",
-           "get_vgg", "get_googlenet", "get_inception_bn", "get_resnet"]
+__all__ = ["transformer", "mlp", "lenet", "alexnet", "vgg", "googlenet",
+           "inception_bn", "inception_v3", "resnet", "lstm", "gru", "rnn",
+           "get_mlp", "get_lenet", "get_alexnet", "get_vgg",
+           "get_googlenet", "get_inception_bn", "get_inception_v3",
+           "get_resnet"]

@@ -222,3 +222,40 @@ def test_correlation_strided():
     want = _np_correlation(d1, d2, 1, 2, 2, 2, 0, True)
     assert outs[0].shape == want.shape
     assert_almost_equal(outs[0], want, rtol=1e-4, atol=1e-5)
+
+
+def test_vanilla_rnn_unroll_trains():
+    """models/rnn.py (reference rnn.py parity): the unrolled tanh-RNN LM
+    binds, steps, and reduces loss on a learnable pattern."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.rnn import rnn_unroll, init_state_shapes
+
+    V, H, E, L, S, B = 20, 16, 8, 1, 6, 8
+    net = rnn_unroll(L, S, V, num_hidden=H, num_embed=E, num_label=V)
+    shapes = {"data": (B, S), "softmax_label": (B, S)}
+    shapes.update(dict(init_state_shapes(L, B, H)))
+    exe = net.simple_bind(mx.context.cpu(), grad_req="write", **shapes)
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name not in shapes:
+            arr[:] = rng.uniform(-0.1, 0.1, arr.shape).astype("f")
+    data = rng.randint(1, V, (B, S)).astype("f")
+    label = data.copy()     # identity mapping: trivially learnable
+    exe.arg_dict["data"][:] = data
+    exe.arg_dict["softmax_label"][:] = label
+
+    def loss():
+        probs = exe.forward(is_train=True)[0].asnumpy()
+        flat = label.T.reshape(-1).astype(int)
+        return -np.log(np.maximum(
+            probs[np.arange(flat.size), flat], 1e-9)).mean()
+
+    first = loss()
+    for _ in range(60):
+        exe.forward(is_train=True)
+        exe.backward()
+        for name, g in exe.grad_dict.items():
+            if g is not None and name not in shapes:
+                exe.arg_dict[name][:] = (exe.arg_dict[name].asnumpy()
+                                         - 0.05 * g.asnumpy())
+    assert loss() < first * 0.7, (first, loss())
