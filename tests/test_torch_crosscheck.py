@@ -170,3 +170,76 @@ def test_fullyconnected_softmax_vs_torch():
                        atol=1e-4)
     assert np.allclose(exe.grad_dict["b"].asnumpy(), tb.grad.numpy(),
                        atol=1e-4)
+
+
+def _pack_torch_rnn(tmod, num_layers, bidirectional):
+    """torch LSTM/GRU parameters -> our flat RNN vector (per layer+dir:
+    w_x, w_h, b_x, b_h — same gate orders as torch)."""
+    chunks = []
+    for layer in range(num_layers):
+        for suffix in ("", "_reverse") if bidirectional else ("",):
+            chunks.append(getattr(
+                tmod, "weight_ih_l%d%s" % (layer, suffix)).detach()
+                .numpy().ravel())
+            chunks.append(getattr(
+                tmod, "weight_hh_l%d%s" % (layer, suffix)).detach()
+                .numpy().ravel())
+            chunks.append(getattr(
+                tmod, "bias_ih_l%d%s" % (layer, suffix)).detach()
+                .numpy().ravel())
+            chunks.append(getattr(
+                tmod, "bias_hh_l%d%s" % (layer, suffix)).detach()
+                .numpy().ravel())
+    return np.concatenate(chunks).astype("f")
+
+
+@pytest.mark.parametrize("mode,layers,bidir", [
+    ("lstm", 1, False), ("lstm", 2, False), ("lstm", 1, True),
+    ("gru", 1, False), ("gru", 2, True),
+])
+def test_fused_rnn_vs_torch(mode, layers, bidir):
+    """The fused RNN op (lax.scan per layer) matches torch.nn.LSTM/GRU
+    outputs and final states bit-close when fed torch's own parameters —
+    the cuDNN-parameterization contract the reference's RNN op carried."""
+    rng = np.random.RandomState(5)
+    S, B, I, H = 7, 3, 5, 4
+    x = rng.randn(S, B, I).astype("f")
+    ndir = 2 if bidir else 1
+
+    if mode == "lstm":
+        tmod = torch.nn.LSTM(I, H, num_layers=layers,
+                             bidirectional=bidir)
+    else:
+        tmod = torch.nn.GRU(I, H, num_layers=layers, bidirectional=bidir)
+    flat = _pack_torch_rnn(tmod, layers, bidir)
+    with torch.no_grad():
+        tout, tstate = tmod(torch.tensor(x))
+    if mode == "lstm":
+        th, tc = tstate
+    else:
+        th = tstate
+
+    args = {"data": sym.Variable("data"),
+            "parameters": sym.Variable("parameters"),
+            "state": sym.Variable("state"),
+            "state_size": H, "num_layers": layers, "mode": mode,
+            "bidirectional": bidir, "state_outputs": True, "name": "rnn"}
+    if mode == "lstm":
+        args["state_cell"] = sym.Variable("state_cell")
+    net = sym.RNN(**args)
+
+    shapes = {"data": x.shape, "parameters": flat.shape,
+              "state": (ndir * layers, B, H)}
+    if mode == "lstm":
+        shapes["state_cell"] = (ndir * layers, B, H)
+    exe = net.simple_bind(mx.context.cpu(), grad_req="null", **shapes)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["parameters"][:] = flat
+    exe.arg_dict["state"][:] = 0.0
+    if mode == "lstm":
+        exe.arg_dict["state_cell"][:] = 0.0
+    outs = exe.forward()
+    assert np.allclose(outs[0].asnumpy(), tout.numpy(), atol=1e-5), "out"
+    assert np.allclose(outs[1].asnumpy(), th.numpy(), atol=1e-5), "h_n"
+    if mode == "lstm":
+        assert np.allclose(outs[2].asnumpy(), tc.numpy(), atol=1e-5), "c_n"
