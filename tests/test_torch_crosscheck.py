@@ -172,24 +172,18 @@ def test_fullyconnected_softmax_vs_torch():
                        atol=1e-4)
 
 
-def _pack_torch_rnn(tmod, num_layers, bidirectional):
+def _pack_torch_rnn(tmod, num_layers, bidirectional,
+                    extract=lambda p: p.detach()):
     """torch LSTM/GRU parameters -> our flat RNN vector (per layer+dir:
-    w_x, w_h, b_x, b_h — same gate orders as torch)."""
+    w_x, w_h, b_x, b_h — same gate orders as torch).  ``extract`` picks
+    what to pack (values by default, ``lambda p: p.grad`` for
+    gradients) so the layout is defined exactly once."""
     chunks = []
     for layer in range(num_layers):
         for suffix in ("", "_reverse") if bidirectional else ("",):
-            chunks.append(getattr(
-                tmod, "weight_ih_l%d%s" % (layer, suffix)).detach()
-                .numpy().ravel())
-            chunks.append(getattr(
-                tmod, "weight_hh_l%d%s" % (layer, suffix)).detach()
-                .numpy().ravel())
-            chunks.append(getattr(
-                tmod, "bias_ih_l%d%s" % (layer, suffix)).detach()
-                .numpy().ravel())
-            chunks.append(getattr(
-                tmod, "bias_hh_l%d%s" % (layer, suffix)).detach()
-                .numpy().ravel())
+            for kind in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                p = getattr(tmod, "%s_l%d%s" % (kind, layer, suffix))
+                chunks.append(extract(p).numpy().ravel())
     return np.concatenate(chunks).astype("f")
 
 
@@ -212,8 +206,12 @@ def test_fused_rnn_vs_torch(mode, layers, bidir):
     else:
         tmod = torch.nn.GRU(I, H, num_layers=layers, bidirectional=bidir)
     flat = _pack_torch_rnn(tmod, layers, bidir)
+    h0 = rng.randn(ndir * layers, B, H).astype("f")
+    c0 = rng.randn(ndir * layers, B, H).astype("f")
     with torch.no_grad():
-        tout, tstate = tmod(torch.tensor(x))
+        tstate0 = (torch.tensor(h0), torch.tensor(c0)) \
+            if mode == "lstm" else torch.tensor(h0)
+        tout, tstate = tmod(torch.tensor(x), tstate0)
     if mode == "lstm":
         th, tc = tstate
     else:
@@ -235,11 +233,55 @@ def test_fused_rnn_vs_torch(mode, layers, bidir):
     exe = net.simple_bind(mx.context.cpu(), grad_req="null", **shapes)
     exe.arg_dict["data"][:] = x
     exe.arg_dict["parameters"][:] = flat
-    exe.arg_dict["state"][:] = 0.0
+    exe.arg_dict["state"][:] = h0
     if mode == "lstm":
-        exe.arg_dict["state_cell"][:] = 0.0
+        exe.arg_dict["state_cell"][:] = c0
     outs = exe.forward()
     assert np.allclose(outs[0].asnumpy(), tout.numpy(), atol=1e-5), "out"
     assert np.allclose(outs[1].asnumpy(), th.numpy(), atol=1e-5), "h_n"
     if mode == "lstm":
         assert np.allclose(outs[2].asnumpy(), tc.numpy(), atol=1e-5), "c_n"
+
+
+def test_fused_rnn_gradients_vs_torch():
+    """Backward through the fused RNN (vjp of the scan) matches torch's
+    data, packed-parameter, AND initial-state gradients, from RANDOM
+    initial states (all-zero states would mask state-indexing bugs)."""
+    rng = np.random.RandomState(6)
+    S, B, I, H, L = 5, 2, 4, 3, 2
+    x = rng.randn(S, B, I).astype("f")
+    h0 = rng.randn(L, B, H).astype("f")
+    c0 = rng.randn(L, B, H).astype("f")
+    tmod = torch.nn.LSTM(I, H, num_layers=L)
+    flat = _pack_torch_rnn(tmod, L, False)
+    tx = torch.tensor(x, requires_grad=True)
+    th0 = torch.tensor(h0, requires_grad=True)
+    tc0 = torch.tensor(c0, requires_grad=True)
+    tout, _ = tmod(tx, (th0, tc0))
+    hg = rng.randn(*tout.shape).astype("f")
+    tout.backward(torch.tensor(hg))
+    tgrad_flat = _pack_torch_rnn(tmod, L, False,
+                                 extract=lambda p: p.grad)
+
+    net = sym.RNN(data=sym.Variable("data"),
+                  parameters=sym.Variable("parameters"),
+                  state=sym.Variable("state"),
+                  state_cell=sym.Variable("state_cell"),
+                  state_size=H, num_layers=L, mode="lstm", name="rnn")
+    exe = net.simple_bind(mx.context.cpu(), grad_req="write",
+                          data=x.shape, parameters=flat.shape,
+                          state=(L, B, H), state_cell=(L, B, H))
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["parameters"][:] = flat
+    exe.arg_dict["state"][:] = h0
+    exe.arg_dict["state_cell"][:] = c0
+    exe.forward(is_train=True)
+    exe.backward(out_grads=[mx.nd.array(hg)])
+    assert np.allclose(exe.grad_dict["data"].asnumpy(), tx.grad.numpy(),
+                       atol=1e-4), "d_data"
+    assert np.allclose(exe.grad_dict["parameters"].asnumpy(), tgrad_flat,
+                       atol=1e-4), "d_parameters"
+    assert np.allclose(exe.grad_dict["state"].asnumpy(), th0.grad.numpy(),
+                       atol=1e-4), "d_state"
+    assert np.allclose(exe.grad_dict["state_cell"].asnumpy(),
+                       tc0.grad.numpy(), atol=1e-4), "d_state_cell"
