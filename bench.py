@@ -94,11 +94,19 @@ def _run_child(extra_env, timeout):
             out = out.decode(errors="replace")
         payload = _last_json(out)
         if payload is not None:
-            payload["note"] = "secondary metrics timed out"
+            prior = payload.get("note")
+            payload["note"] = ("%s; child timed out" % prior if prior
+                               else "secondary metrics timed out")
             return payload, None
         return None, "child timed out after %ds" % timeout
     payload = _last_json(proc.stdout)
     if payload is not None:
+        if proc.returncode != 0 and "preliminary" in str(payload.get("note", "")):
+            # child CRASHED mid-sweep: keep the salvage as a last resort
+            # but tell the caller to retry for the real measurement
+            tail = (proc.stderr or "").strip().splitlines()[-3:]
+            return None, ("child rc=%s after preliminary result: %s"
+                          % (proc.returncode, " | ".join(tail)))
         return payload, None
     tail = (proc.stderr or "").strip().splitlines()[-3:]
     return None, "child rc=%s: %s" % (proc.returncode, " | ".join(tail))
@@ -255,12 +263,36 @@ def measure():
         candidates = [int(x) for x in os.environ.get(
             "BENCH_AUTOTUNE_BATCHES", "64,128,256,512").split(",")]
         sweep = {}
+        best_ips = None
         for cand in candidates:
             try:
-                ips, _st, _tr = run_once(cand, max(3, steps // 4))
+                ips, st, _tr = run_once(cand, max(3, steps // 4))
                 sweep[cand] = round(ips, 1)
             except Exception as exc:  # noqa: BLE001 (OOM at big batch)
                 sweep[cand] = "failed: %r" % exc
+                continue
+            # salvage insurance: emit a preliminary line after EVERY
+            # completed candidate — if a slow remote compile blows the
+            # child timeout mid-sweep, the parent still has a real
+            # number (it takes the LAST JSON line, so the final payload
+            # supersedes these).  All fields come from the best
+            # candidate SO FAR, so the record is self-consistent.
+            if best_ips is None or ips > best_ips:
+                best_ips, best_st, best_cand = ips, st, cand
+            _emit({
+                "metric": "resnet%d_train_images_per_sec" % num_layers,
+                "value": round(best_ips, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(best_ips / BASELINE_IMAGES_PER_SEC, 3),
+                "platform": platform,
+                "device_kind": str(device_kind),
+                "n_devices": n_dev,
+                "global_batch": best_cand * n_dev,
+                "step_time_ms": round(best_st * 1e3, 2),
+                "compute_dtype": dtype or "float32",
+                "note": "preliminary (autotune sweep in progress)",
+                "batch_sweep": {str(k): v for k, v in sweep.items()},
+            })
         survivors = [(v, k) for k, v in sweep.items()
                      if not isinstance(v, str)]
         if survivors:   # else: every candidate failed — keep the default
