@@ -285,3 +285,57 @@ def test_fused_rnn_gradients_vs_torch():
                        atol=1e-4), "d_state"
     assert np.allclose(exe.grad_dict["state_cell"].asnumpy(),
                        tc0.grad.numpy(), atol=1e-4), "d_state_cell"
+
+
+def test_embedding_vs_torch():
+    """Embedding gather forward + scatter-add weight gradient."""
+    rng = np.random.RandomState(7)
+    V, D, N = 11, 6, 9
+    ids = rng.randint(0, V, N).astype("f")
+    w = rng.randn(V, D).astype("f")
+
+    net = sym.Embedding(sym.Variable("ids"), weight=sym.Variable("w"),
+                        input_dim=V, output_dim=D, name="emb")
+    exe = net.simple_bind(mx.context.cpu(), grad_req="write",
+                          ids=(N,), w=(V, D))
+    exe.arg_dict["ids"][:] = ids
+    exe.arg_dict["w"][:] = w
+    out = exe.forward(is_train=True)[0].asnumpy()
+    hg = rng.randn(*out.shape).astype("f")
+    exe.backward(out_grads=[mx.nd.array(hg)])
+
+    tw = torch.tensor(w, requires_grad=True)
+    ty = F.embedding(torch.tensor(ids, dtype=torch.long), tw)
+    ty.backward(torch.tensor(hg))
+    assert np.allclose(out, ty.detach().numpy(), atol=1e-6)
+    assert np.allclose(exe.grad_dict["w"].asnumpy(), tw.grad.numpy(),
+                       atol=1e-5), "scatter-add dw"
+
+
+def test_prelu_vs_torch():
+    """LeakyReLU(act_type='prelu'): learnable per-channel slope, forward
+    + data and slope gradients."""
+    rng = np.random.RandomState(8)
+    N, C, H, W = 3, 4, 5, 5
+    x = rng.randn(N, C, H, W).astype("f")
+    alpha = rng.rand(C).astype("f") * 0.5
+
+    net = sym.LeakyReLU(sym.Variable("x"), gamma=sym.Variable("gamma"),
+                        act_type="prelu", name="prelu")
+    exe = net.simple_bind(mx.context.cpu(), grad_req="write",
+                          x=x.shape, gamma=(C,))
+    exe.arg_dict["x"][:] = x
+    exe.arg_dict["gamma"][:] = alpha
+    out = exe.forward(is_train=True)[0].asnumpy()
+    hg = rng.randn(*out.shape).astype("f")
+    exe.backward(out_grads=[mx.nd.array(hg)])
+
+    tx = torch.tensor(x, requires_grad=True)
+    ta = torch.tensor(alpha, requires_grad=True)
+    ty = F.prelu(tx, ta)
+    ty.backward(torch.tensor(hg))
+    assert np.allclose(out, ty.detach().numpy(), atol=1e-6)
+    assert np.allclose(exe.grad_dict["x"].asnumpy(), tx.grad.numpy(),
+                       atol=1e-5)
+    assert np.allclose(exe.grad_dict["gamma"].asnumpy(), ta.grad.numpy(),
+                       atol=1e-4)
