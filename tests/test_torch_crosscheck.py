@@ -294,21 +294,16 @@ def test_embedding_vs_torch():
     ids = rng.randint(0, V, N).astype("f")
     w = rng.randn(V, D).astype("f")
 
-    net = sym.Embedding(sym.Variable("ids"), weight=sym.Variable("w"),
-                        input_dim=V, output_dim=D, name="emb")
-    exe = net.simple_bind(mx.context.cpu(), grad_req="write",
-                          ids=(N,), w=(V, D))
-    exe.arg_dict["ids"][:] = ids
-    exe.arg_dict["w"][:] = w
-    out = exe.forward(is_train=True)[0].asnumpy()
-    hg = rng.randn(*out.shape).astype("f")
-    exe.backward(out_grads=[mx.nd.array(hg)])
-
     tw = torch.tensor(w, requires_grad=True)
     ty = F.embedding(torch.tensor(ids, dtype=torch.long), tw)
+    hg = rng.randn(*ty.shape).astype("f")
     ty.backward(torch.tensor(hg))
+
+    net = sym.Embedding(sym.Variable("ids"), weight=sym.Variable("w"),
+                        input_dim=V, output_dim=D, name="emb")
+    out, grads = _run_fwd_bwd(net, {"ids": ids, "w": w}, hg)
     assert np.allclose(out, ty.detach().numpy(), atol=1e-6)
-    assert np.allclose(exe.grad_dict["w"].asnumpy(), tw.grad.numpy(),
+    assert np.allclose(grads["w"], tw.grad.numpy(),
                        atol=1e-5), "scatter-add dw"
 
 
@@ -320,22 +315,15 @@ def test_prelu_vs_torch():
     x = rng.randn(N, C, H, W).astype("f")
     alpha = rng.rand(C).astype("f") * 0.5
 
-    net = sym.LeakyReLU(sym.Variable("x"), gamma=sym.Variable("gamma"),
-                        act_type="prelu", name="prelu")
-    exe = net.simple_bind(mx.context.cpu(), grad_req="write",
-                          x=x.shape, gamma=(C,))
-    exe.arg_dict["x"][:] = x
-    exe.arg_dict["gamma"][:] = alpha
-    out = exe.forward(is_train=True)[0].asnumpy()
-    hg = rng.randn(*out.shape).astype("f")
-    exe.backward(out_grads=[mx.nd.array(hg)])
-
     tx = torch.tensor(x, requires_grad=True)
     ta = torch.tensor(alpha, requires_grad=True)
     ty = F.prelu(tx, ta)
+    hg = rng.randn(*ty.shape).astype("f")
     ty.backward(torch.tensor(hg))
+
+    net = sym.LeakyReLU(sym.Variable("x"), gamma=sym.Variable("gamma"),
+                        act_type="prelu", name="prelu")
+    out, grads = _run_fwd_bwd(net, {"x": x, "gamma": alpha}, hg)
     assert np.allclose(out, ty.detach().numpy(), atol=1e-6)
-    assert np.allclose(exe.grad_dict["x"].asnumpy(), tx.grad.numpy(),
-                       atol=1e-5)
-    assert np.allclose(exe.grad_dict["gamma"].asnumpy(), ta.grad.numpy(),
-                       atol=1e-4)
+    assert np.allclose(grads["x"], tx.grad.numpy(), atol=1e-5)
+    assert np.allclose(grads["gamma"], ta.grad.numpy(), atol=1e-4)
