@@ -327,3 +327,22 @@ def test_prelu_vs_torch():
     assert np.allclose(out, ty.detach().numpy(), atol=1e-6)
     assert np.allclose(grads["x"], tx.grad.numpy(), atol=1e-5)
     assert np.allclose(grads["gamma"], ta.grad.numpy(), atol=1e-4)
+
+
+def test_lrn_vs_torch():
+    """Cross-channel LRN: both sides use (k + alpha/n * sum)^-beta, so
+    forward and data gradient must match torch's local_response_norm."""
+    rng = np.random.RandomState(9)
+    x = rng.rand(2, 7, 5, 5).astype("f") + 0.1
+    nsize, alpha, beta, k = 5, 1e-2, 0.75, 2.0
+
+    tx = torch.tensor(x, requires_grad=True)
+    ty = F.local_response_norm(tx, nsize, alpha=alpha, beta=beta, k=k)
+    hg = rng.randn(*ty.shape).astype("f")
+    ty.backward(torch.tensor(hg))
+
+    net = sym.LRN(sym.Variable("x"), nsize=nsize, alpha=alpha, beta=beta,
+                  knorm=k, name="lrn")
+    out, grads = _run_fwd_bwd(net, {"x": x}, hg)
+    assert np.allclose(out, ty.detach().numpy(), atol=1e-5), "forward"
+    assert np.allclose(grads["x"], tx.grad.numpy(), atol=1e-4), "dx"
