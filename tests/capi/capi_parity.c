@@ -454,16 +454,58 @@ int main(void) {
     for (uint32_t i = 0; i < in_sz; ++i) CHECK(MXNDArrayFree(args[i]));
   }
 
-  /* --- symbol grad through C ------------------------------------------ */
+  /* --- symbol grad through C: build AND execute ------------------------ */
   {
-    SymbolHandle gsym;
-    const char* wrt[1] = {"data"};
-    CHECK(MXSymbolGrad(mlp, 1, wrt, &gsym));
+    /* d/dx of y = x*x via _Mul: grad symbol bound with caller handles,
+     * head grad of ones -> dx must equal 2x */
+    SymbolHandle xvar, atomic, prod, gsym;
+    CHECK(MXSymbolCreateVariable("x", &xvar));
+    CHECK(MXSymbolCreateAtomicSymbol("_Mul", "{}", "sq", &atomic));
+    {
+      SymbolHandle margs[2] = {xvar, xvar};   /* same node: y = x*x */
+      CHECK(MXSymbolCompose(atomic, 2, NULL, margs, &prod));
+    }
+    const char* wrt[1] = {"x"};
+    CHECK(MXSymbolGrad(prod, 1, wrt, &gsym));
+
     uint32_t gn = 0;
     const char** gnames = NULL;
     CHECK(MXSymbolListArguments(gsym, &gn, &gnames));
-    EXPECT(gn == n_args + 1, "grad symbol should add one head-grad arg");
+    EXPECT(gn == 2, "x + head grad expected");
+
+    NDArrayHandle gargs[2];
+    uint32_t gshape[1] = {4};
+    uint32_t greqs[2] = {0, 0};
+    float xs[4] = {1, 2, 3, 4}, ones4[4] = {1, 1, 1, 1};
+    CHECK(MXNDArrayCreate(gshape, 1, &gargs[0]));
+    CHECK(MXNDArrayCreate(gshape, 1, &gargs[1]));
+    CHECK(MXNDArraySyncCopyFromCPU(gargs[0], xs, 4));
+    CHECK(MXNDArraySyncCopyFromCPU(gargs[1], ones4, 4));
+    ExecutorHandle gexec;
+    CHECK(MXExecutorBind(gsym, 1 /*cpu*/, 0, 2, gargs, NULL, greqs, 0,
+                         NULL, &gexec));
+    uint32_t n_gout = 0;
+    CHECK(MXExecutorForward(gexec, 0, &n_gout));
+    EXPECT(n_gout == 1, "one gradient output");
+    float dx[4];
+    CHECK(MXExecutorOutputCopy(gexec, 0, dx, 4));
+    EXPECT(fabsf(dx[0] - 2.0f) < 1e-5f && fabsf(dx[3] - 8.0f) < 1e-5f,
+           "d(x*x)/dx must be 2x");
+    CHECK(MXExecutorFree(gexec));
+    CHECK(MXNDArrayFree(gargs[0]));
+    CHECK(MXNDArrayFree(gargs[1]));
     CHECK(MXSymbolFree(gsym));
+    CHECK(MXSymbolFree(prod));
+    CHECK(MXSymbolFree(atomic));
+    CHECK(MXSymbolFree(xvar));
+
+    /* the mlp's grad symbol still lists base args + one head grad */
+    SymbolHandle mg;
+    const char* mwrt[1] = {"data"};
+    CHECK(MXSymbolGrad(mlp, 1, mwrt, &mg));
+    CHECK(MXSymbolListArguments(mg, &gn, &gnames));
+    EXPECT(gn == n_args + 1, "grad symbol should add one head-grad arg");
+    CHECK(MXSymbolFree(mg));
   }
 
   /* --- kvstore roles / commands / server / fault ----------------------- */
