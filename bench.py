@@ -73,6 +73,33 @@ def _last_json_line(text):
     return None
 
 
+def _run_graceful(cmd, env, cwd, timeout):
+    """subprocess.run-alike that NEVER SIGKILLs on timeout.
+
+    A SIGKILLed chip-attached process leaks the TPU tunnel lease and
+    wedges the chip for every later client (the round-3/round-4 failure
+    mode).  On timeout: SIGTERM, wait a generous grace period, and if
+    the child still won't die, ABANDON it (orphan, keep the chip lease
+    alive until it finishes on its own) rather than kill -9 it.
+    Returns (returncode_or_None, stdout, stderr, timed_out)."""
+    proc = subprocess.Popen(cmd, cwd=cwd, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        return proc.returncode, out, err, False
+    except subprocess.TimeoutExpired:
+        proc.terminate()        # SIGTERM: jax exits cleanly, lease freed
+        try:
+            out, err = proc.communicate(timeout=90)
+        except subprocess.TimeoutExpired:
+            # Do NOT escalate to SIGKILL — walk away instead.  Streams
+            # stay open (the orphan may still be draining the device);
+            # nothing useful can be read without risking a hang here.
+            return None, "", "", True
+        return proc.returncode, out, err, True
+
+
 def _run_child(extra_env, timeout):
     env = dict(os.environ)
     env.update(extra_env)
@@ -81,17 +108,12 @@ def _run_child(extra_env, timeout):
     env["MXTPU_BENCH_CHILD"] = "1"
     _last_json = _last_json_line
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            cwd=here, env=env, timeout=timeout,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-    except subprocess.TimeoutExpired as exc:
+    rc, out, err, timed_out = _run_graceful(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, cwd=here, timeout=timeout)
+    if timed_out:
         # the child emits the primary metric BEFORE the optional
         # secondary measurements: salvage it from the captured stdout
-        out = exc.stdout
-        if isinstance(out, bytes):
-            out = out.decode(errors="replace")
         payload = _last_json(out)
         if payload is not None:
             prior = payload.get("note")
@@ -99,36 +121,33 @@ def _run_child(extra_env, timeout):
                                else "secondary metrics timed out")
             return payload, None
         return None, "child timed out after %ds" % timeout
-    payload = _last_json(proc.stdout)
+    payload = _last_json(out)
     if payload is not None:
-        if proc.returncode != 0 and "preliminary" in str(payload.get("note", "")):
+        if rc != 0 and "preliminary" in str(payload.get("note", "")):
             # child CRASHED mid-sweep: keep the salvage as a last resort
             # but tell the caller to retry for the real measurement
-            tail = (proc.stderr or "").strip().splitlines()[-3:]
+            tail = (err or "").strip().splitlines()[-3:]
             return None, ("child rc=%s after preliminary result: %s"
-                          % (proc.returncode, " | ".join(tail)))
+                          % (rc, " | ".join(tail)))
         return payload, None
-    tail = (proc.stderr or "").strip().splitlines()[-3:]
-    return None, "child rc=%s: %s" % (proc.returncode, " | ".join(tail))
+    tail = (err or "").strip().splitlines()[-3:]
+    return None, "child rc=%s: %s" % (rc, " | ".join(tail))
 
 
 def _probe_backend(timeout):
     """Cheap subprocess probe: does ambient backend init even complete?
     (The TPU plugin here can hang indefinitely — never probe in-process.)"""
     here = os.path.dirname(os.path.abspath(__file__))
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d = jax.devices(); print(d[0].platform)"],
-            cwd=here, env=dict(os.environ), timeout=timeout,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-    except subprocess.TimeoutExpired:
+    rc, out, err, timed_out = _run_graceful(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices(); print(d[0].platform)"],
+        env=dict(os.environ), cwd=here, timeout=timeout)
+    if timed_out:
         return None, "backend probe timed out after %ds" % timeout
-    if proc.returncode != 0:
-        tail = (proc.stderr or "").strip().splitlines()[-2:]
-        return None, "backend probe rc=%s: %s" % (proc.returncode,
-                                                  " | ".join(tail))
-    return proc.stdout.strip(), None
+    if rc != 0:
+        tail = (err or "").strip().splitlines()[-2:]
+        return None, "backend probe rc=%s: %s" % (rc, " | ".join(tail))
+    return out.strip(), None
 
 
 def orchestrate():
