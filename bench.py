@@ -428,7 +428,6 @@ def measure():
             payload["hbm_util"] = round(hbm_gbps / (peak_hbm * n_dev), 4)
         elif hbm_note:
             notes.append(hbm_note)
-            payload["mfu_notes"] = "; ".join(notes)
     if notes:
         payload["mfu_notes"] = "; ".join(notes)
     if sweep:

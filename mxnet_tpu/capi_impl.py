@@ -635,13 +635,25 @@ def symbol_get_internals(sym):
 
 def symbol_attr_pairs(sym, deep):
     """Flat [k0, v0, k1, v1, ...] attribute listing.  Deep walks every
-    node with ``<node>$<key>`` keys (MXSymbolListAttr); shallow lists the
-    head node only (MXSymbolListAttrShallow)."""
+    node with ``<node>_<key>`` keys — the reference's
+    kNamespaceSeparator is '_' (symbol.cc:19,526) — and propagates each
+    node's attrs onto its auxiliary-state names too (symbol.cc:532-538,
+    the multi-device aux-allocation hack C consumers parse); shallow
+    lists the head node only (MXSymbolListAttrShallow)."""
     pairs = []
     if deep:
-        for node_name, attrs in sorted(sym.attr_dict().items()):
-            for k in sorted(attrs):
-                pairs.extend(["%s$%s" % (node_name, k), str(attrs[k])])
+        flat = {}
+        for node in sym._topo():
+            if not node.attrs:
+                continue
+            for k, v in node.attrs.items():
+                flat["%s_%s" % (node.name, k)] = str(v)
+            if node.op is not None:
+                for aux in node.op.list_auxiliary_states():
+                    for k, v in node.attrs.items():
+                        flat["%s_%s_%s" % (node.name, aux, k)] = str(v)
+        for k in sorted(flat):
+            pairs.extend([k, flat[k]])
     else:
         for k, v in sorted(sym.list_attr().items()):
             pairs.extend([k, str(v)])
@@ -693,7 +705,8 @@ def symbol_infer_type_arrays(sym, keys, type_flags):
         return [(-1 if t is None else int(dtype_np_to_mx(_np.dtype(t))))
                 for t in (lst or [])]
 
-    complete = all(t is not None for t in (arg or []) + (out or []))
+    complete = all(t is not None
+                   for t in (arg or []) + (out or []) + (aux or []))
     return (_flags(arg), _flags(out), _flags(aux), int(complete))
 
 

@@ -504,7 +504,7 @@ class ShardedPredictor(object):
                 if n not in args and n not in batch:
                     shape = self._label_shape(n, batch)
                     args[n] = jnp.zeros(shape, jnp.float32)
-            args.update({k: _cast(v) if k not in ("softmax_label",)
+            args.update({k: _cast(v) if k not in self.label_names
                          else v for k, v in batch.items()})
             outs, _ = self._trace(args, _cast(aux), rng, False)
             return [o.astype(jnp.float32) if cdt is not None

@@ -29,6 +29,10 @@
 
 namespace mxtpu {
 
+// set while a worker thread executes an op body: a chained Push during
+// the shutdown drain must not wait on its own in-flight op
+thread_local bool in_worker_ = false;
+
 using Fn = std::function<void()>;
 
 struct Opr;
@@ -100,6 +104,17 @@ class Engine {
     std::shared_lock<std::shared_mutex> stop_lk(stop_mu_);
     if (stopped_.load(std::memory_order_acquire)) {
       stop_lk.unlock();
+      // A push can land here while Shutdown's WaitForAll is still
+      // draining predecessor ops on this fn's vars in worker threads:
+      // wait for the drain before running inline, or the inline op
+      // observes its dependencies half-done (write-after-read race in
+      // the shutdown window).  EXCEPT from a worker thread itself (an
+      // op body chaining a push, e.g. DeleteVariable from a callback):
+      // its own in-flight op keeps pending_ nonzero, so waiting would
+      // self-deadlock — run inline immediately; intra-thread program
+      // order already sequences it after its predecessors on that
+      // worker, matching the pre-stop guarantee for self-chained ops.
+      if (!in_worker_) WaitForAll();
       fn();            // drained engine: synchronous degradation
       return;
     }
@@ -231,7 +246,9 @@ class Engine {
         opr = ready_.front();
         ready_.pop_front();
       }
+      in_worker_ = true;
       if (opr->fn) opr->fn();
+      in_worker_ = false;
       OnComplete(opr);
     }
   }

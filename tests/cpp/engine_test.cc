@@ -11,6 +11,8 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
+#include <thread>
+#include <unistd.h>
 #include <vector>
 
 extern "C" {
@@ -24,6 +26,7 @@ void MXTPUEnginePush(void* h, MXTPUEngineFn fn, void* param,
 void MXTPUEngineWaitForVar(void* h, uint64_t var);
 void MXTPUEngineWaitForAll(void* h);
 void MXTPUEngineDeleteVar(void* h, uint64_t var);
+void MXTPUEngineShutdown(void* h);
 }
 
 // xorshift PRNG: deterministic workloads across runs/platforms
@@ -180,6 +183,44 @@ int main() {
     MXTPUEngineDeleteVar(eng, var);
     MXTPUEngineWaitForAll(eng);
     MXTPUEngineFree(eng);
+  }
+
+  // shutdown-window pushes: an op body that chains a push from a worker
+  // while Shutdown drains must run inline without self-deadlocking
+  // (waiting on pending_ would wait on its own in-flight op); an
+  // external straggler thread's push must wait for the full drain.
+  {
+    alarm(30);  // a regression here deadlocks: turn it into a hard fail
+    void* eng = MXTPUEngineCreate(2);
+    uint64_t var = MXTPUEngineNewVar(eng);
+    static std::atomic<int> a_started{0}, release_a{0}, chained{0};
+    struct Ctx { void* eng; uint64_t var; };
+    static Ctx ctx2;
+    ctx2.eng = eng;
+    ctx2.var = var;
+    auto a_fn = +[](void* p) {
+      auto* c = static_cast<Ctx*>(p);
+      a_started.store(1);
+      while (!release_a.load()) std::this_thread::yield();
+      // stopped_ is set by now: this push takes the drained branch on a
+      // worker thread mid-drain
+      MXTPUEnginePush(c->eng, +[](void*) { chained.fetch_add(1); },
+                      nullptr, nullptr, 0, &c->var, 1);
+    };
+    MXTPUEnginePush(eng, a_fn, &ctx2, nullptr, 0, &var, 1);
+    while (!a_started.load()) std::this_thread::yield();
+    std::thread shut([eng] { MXTPUEngineShutdown(eng); });
+    // give Shutdown time to flip stopped_ and block in WaitForAll on A
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    release_a.store(1);
+    shut.join();
+    if (chained.load() != 1) {
+      std::fprintf(stderr, "FAIL shutdown chain: %d\n", chained.load());
+      return 1;
+    }
+    MXTPUEngineFree(eng);
+    alarm(0);
+    std::printf("shutdown-window chain OK\n");
   }
 
   std::printf("ENGINE CPP OK\n");

@@ -116,3 +116,35 @@ def test_capi_parity(tmp_path):
                           timeout=600)
     assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
     assert "capi_parity OK" in proc.stdout
+
+
+def test_attr_listing_reference_format():
+    """Deep attr keys use the reference's '_' namespace separator
+    (symbol.cc:19,526) and propagate node attrs onto aux-state names
+    (symbol.cc:532-538) — the wire format C consumers parse."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import capi_impl
+
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn0", attr={"ctx_group": "dev1"})
+    pairs = capi_impl.symbol_attr_pairs(bn, deep=1)
+    d = dict(zip(pairs[0::2], pairs[1::2]))
+    assert d.get("bn0_ctx_group") == "dev1"
+    # aux propagation: every aux state of bn0 carries the node's attrs
+    for aux in ("moving_mean", "moving_var"):
+        assert d.get("bn0_%s_ctx_group" % aux) == "dev1", sorted(d)
+    assert not any("$" in k for k in d)
+
+
+def test_infer_type_complete_includes_aux():
+    """MXSymbolInferType's complete flag must account for aux states."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import capi_impl
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(mx.sym.FullyConnected(
+        data, num_hidden=4, name="fc"), name="bn0")
+    _arg, _out, aux_t, complete = capi_impl.symbol_infer_type_arrays(
+        net, ["data"], [0])        # 0 = float32 flag
+    # all aux inferable here -> complete stays 1 and auxes are typed
+    assert complete == 1 and all(t != -1 for t in aux_t)
