@@ -1,40 +1,107 @@
 """Memory-for-compute demo: activation recompute (mirroring).
 
-Parity: example/memcost/inception_memcost.py — tags stages with
-``force_mirroring`` so the backward pass recomputes activations instead of
-storing them.  On TPU this lowers to ``jax.checkpoint``/remat inside the
-compiled step (the reference splices mirror nodes in MakeBackwardPass,
-static_graph.cc:395).  Prints the bound executor's memory plan with and
-without mirroring.
+Parity: example/memcost/inception_memcost.py + the cifar mirroring
+example (train_cifar10_mirroring.py:126) — tags stages with
+``force_mirroring`` so the backward pass recomputes activations instead
+of storing them.  Here that lowers to per-segment ``jax.checkpoint``
+inside the compiled step (the reference splices mirror nodes in
+MakeBackwardPass, static_graph.cc:395).
+
+This demo ASSERTS the feature works, it doesn't just bind:
+- the optimized HLO of the mirrored step contains strictly more
+  activation-op instances (the recompute in backward);
+- the fwd->bwd saved-residual set is strictly smaller (the
+  backend-independent activation-memory number, read from the vjp
+  trace itself);
+- loss and gradients are numerically unchanged.
+XLA's compiled temp/peak byte attribution is also printed for
+reference (informational: XLA:CPU schedules remat for speed and may
+not shrink — the residual-set assertion is the honest cross-backend
+check).
 """
 import argparse
 import logging
+import re
+
+import numpy as np
 
 import mxnet_tpu as mx
 
 
-def build(mirror):
+def build(mirror, num_classes=100):
     attrs = {"force_mirroring": "True"} if mirror else {}
     with mx.AttrScope(**attrs):
-        net = mx.models.inception_bn.get_symbol(num_classes=100)
+        net = mx.models.inception_bn.get_symbol(num_classes=num_classes)
     return net
+
+
+def bind_and_measure(mirror, batch_size, image_size):
+    net = build(mirror)
+    exe = net.simple_bind(mx.cpu(), grad_req="write",
+                          data=(batch_size, 3, image_size, image_size),
+                          softmax_label=(batch_size,))
+    rs = np.random.RandomState(7)
+    for n, a in exe.arg_dict.items():
+        if n not in ("data", "softmax_label"):
+            a[:] = (rs.rand(*a.shape) * 0.1).astype(np.float32)
+    exe.arg_dict["data"][:] = rs.rand(
+        batch_size, 3, image_size, image_size).astype(np.float32)
+    exe.arg_dict["softmax_label"][:] = rs.randint(
+        0, 100, (batch_size,)).astype(np.float32)
+    exe.forward(is_train=True)
+    exe.backward()
+    out = exe.outputs[0].asnumpy()
+    grads = {n: g.asnumpy() for n, g in sorted(exe.grad_dict.items())[:5]}
+
+    opt = mx.optimizer.create("sgd", learning_rate=0.1)
+    states = exe.init_fused_states(opt)
+    resid = exe.backward_residual_bytes()
+    mem = exe.fused_step_memory_analysis(opt, states)
+    logging.info("mirror=%s XLA temp=%s peak=%s bytes (informational)",
+                 mirror, "{:,}".format(mem.temp_size_in_bytes),
+                 "{:,}".format(mem.peak_memory_in_bytes))
+    hlo = exe.lower_fused_step(opt, states)
+    # activation-op instances in the optimized program: recompute shows
+    # up as extra copies of the cheap ops (the heavy convs stay single
+    # per the reference's need_mirror skip list)
+    act_ops = sum(len(re.findall(kw, hlo))
+                  for kw in (r"maximum", r"tanh", r"rsqrt"))
+    return out, grads, resid, act_ops
 
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--image-size", type=int, default=64)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
-    for mirror in (False, True):
-        net = build(mirror)
-        exe = net.simple_bind(mx.cpu(), grad_req="write",
-                              data=(args.batch_size, 3, 224, 224),
-                              softmax_label=(args.batch_size,))
-        logging.info("mirroring=%s: bound ok, %d args, %d aux",
-                     mirror, len(exe.arg_dict), len(exe.aux_dict))
-    logging.info("memcost demo OK (remat decisions are made by XLA; "
-                 "force_mirroring attrs mark the recompute boundaries)")
+    out_p, g_p, res_p, acts_p = bind_and_measure(False, args.batch_size,
+                                                 args.image_size)
+    out_m, g_m, res_m, acts_m = bind_and_measure(True, args.batch_size,
+                                                 args.image_size)
+
+    logging.info("activation-op instances: plain=%d mirrored=%d",
+                 acts_p, acts_m)
+    assert acts_m > acts_p, (
+        "mirroring produced no recompute in the compiled backward "
+        "(%d vs %d activation-op instances)" % (acts_m, acts_p))
+
+    assert np.allclose(out_p, out_m, atol=1e-4), "outputs diverged"
+    for n in g_p:
+        assert np.allclose(g_p[n], g_m[n], atol=1e-4), (
+            "grad %s diverged" % n)
+    logging.info("numerics identical with mirroring ON")
+
+    if res_p is not None:
+        logging.info("fwd->bwd residual bytes: plain=%s mirrored=%s "
+                     "(%.1f%% saved)", "{:,}".format(res_p),
+                     "{:,}".format(res_m),
+                     100.0 * (1.0 - float(res_m) / res_p))
+        assert res_m < res_p, (
+            "mirroring did not shrink the saved-residual set "
+            "(%d vs %d bytes)" % (res_m, res_p))
+    logging.info("memcost demo OK: mirrored stages recompute in backward")
 
 
 if __name__ == "__main__":

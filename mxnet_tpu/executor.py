@@ -94,12 +94,70 @@ class _Program:
         return self._jit_forward_mon
 
 
+def _mirror_segments(op_nodes):
+    """Partition the op schedule into checkpoint segments — the
+    jax-native MakeBackwardPass mirror map (static_graph.cc:396-440).
+
+    A node recomputes in backward ("is mirrored") under the reference's
+    need_mirror rules (static_graph.cc:409-425): its ``force_mirroring``
+    attr, or MXNET_BACKWARD_DO_MIRROR=1 for every op type outside the
+    reference's skip list (heavy MXU ops whose recompute costs more than
+    the activation is worth), except every MXNET_BACKWARD_MIRROR_STEP-th
+    eligible node (a periodic keep so recompute chains stay bounded;
+    <=0 means no periodic keep).  Consecutive mirrored nodes form ONE
+    ``jax.checkpoint`` segment — internals dropped from the residual set
+    and recomputed in backward — split at differing ``mirror_stage``
+    attrs so users can pin stage boundaries.  ``op_nodes`` excludes
+    variables (hoisted to a prelude: a weight/bias variable must not
+    break an otherwise-contiguous mirror run).  Returns
+    [(is_mirror, [nodes])].
+    """
+    import os as _os
+    do_mirror = int(_os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") or 0)
+    mirror_step = int(_os.environ.get("MXNET_BACKWARD_MIRROR_STEP",
+                                      "100") or 100)
+    if mirror_step <= 0:
+        mirror_step = 1 << 62   # never hit the periodic keep
+    counter = [0]
+    env_skip = {"Convolution", "FullyConnected", "Concat", "SoftmaxOutput",
+                "CuDNNBatchNorm"}
+
+    def need(node):
+        t = type(node.op).op_name or type(node.op).__name__
+        if t == "Dropout":
+            return False
+        if str(node.attrs.get("force_mirroring", "")).lower() in ("true",
+                                                                  "1"):
+            return True
+        if not do_mirror:
+            return False
+        if t in env_skip:
+            return False
+        counter[0] += 1
+        if counter[0] % mirror_step == 0:
+            return False
+        return True
+
+    segments = []
+    for node in op_nodes:
+        m = need(node)
+        stage = node.attrs.get("mirror_stage") if m else None
+        if segments and segments[-1][0] == m and segments[-1][2] == stage:
+            segments[-1][1].append(node)
+        else:
+            segments.append([m, [node], stage])
+    return [(m, nodes) for m, nodes, _stage in segments]
+
+
 def _build_program(symbol, group2ctx):
     """Flatten the symbol into an executable schedule and jit it.
 
     Parity: the GraphExecutor Init pipeline (graph_executor.h:40-72); device
     placement for ctx_group nodes is resolved here (AssignContext analog,
-    graph_executor.cc:391) with XLA inserting the transfers.
+    graph_executor.cc:391) with XLA inserting the transfers.  Mirrored
+    nodes (static_graph.cc:396 MakeBackwardPass) lower to per-segment
+    ``jax.checkpoint``: their activations leave the residual set and are
+    recomputed during the vjp — the TPU-native memory/FLOPs trade.
     """
     topo = symbol._topo()
     heads = list(symbol._heads)
@@ -116,37 +174,115 @@ def _build_program(symbol, group2ctx):
             except Exception:
                 pass
 
+    variables = [n for n in topo if n.is_variable]
+    segments = _mirror_segments([n for n in topo if not n.is_variable])
+    any_mirror = any(m for m, _ in segments)
+    # (id(node), out_idx) values needed beyond each mirror segment: by
+    # external consumers or as graph heads — everything else is internal
+    # to its segment and free to drop+recompute.  Variables live in no
+    # segment (prelude; seg -2) so they are always segment inputs.
+    seg_of = {}
+    for si, (m, nodes) in enumerate(segments):
+        for n in nodes:
+            seg_of[id(n)] = si
+    ext_needed = {i: [] for i in range(len(segments))}
+    if any_mirror:
+        seen = set()
+
+        def _mark(key, consumer_seg):
+            psi = seg_of.get(key[0], -2)
+            if psi >= 0 and psi != consumer_seg and key not in seen:
+                seen.add(key)
+                ext_needed[psi].append(key)
+
+        for node in topo:
+            if node.is_variable:
+                continue
+            for c, ci in node.inputs:
+                _mark((id(c), ci), seg_of[id(node)])
+        for n, i in heads:
+            _mark((id(n), i), -1)
+
+    def _run_node(node, values, aux_values, aux_out, key, is_train,
+                  monitor):
+        op = node.op
+        ins = [values[(id(c), ci)] for c, ci in node.inputs]
+        aux_names = ["%s_%s" % (node.name, a)
+                     for a in op.list_auxiliary_states()]
+        aux_in = [aux_values[a] for a in aux_names]
+        outs, aux_updates = op.forward(ins, aux_in, is_train, key)
+        dev = node_device.get(id(node))
+        if dev is not None:
+            outs = [jax.device_put(o, dev) for o in outs]
+        for i, o in enumerate(outs):
+            values[(id(node), i)] = o
+        if aux_updates is not None:
+            for a, u in zip(aux_names, aux_updates):
+                aux_out[a] = u
+        if monitor is not None:
+            for oname, o in zip(op.list_outputs(), outs):
+                monitor("%s_%s" % (node.name, oname), o)
+
+    def _seg_aux_names(nodes):
+        names = []
+        for node in nodes:
+            names.extend("%s_%s" % (node.name, a)
+                         for a in node.op.list_auxiliary_states())
+        return names
+
     def trace(arg_values, aux_values, rng, is_train, monitor=None):
         """Evaluate the graph; pure & jax-traceable (the 'StaticGraph run')."""
         values = {}
         aux_out = dict(aux_values)
         rngs = jax.random.split(rng, n_rng) if needs_rng else None
         rng_i = 0
-        for node in topo:
-            if node.is_variable:
-                values[(id(node), 0)] = arg_values[node.name]
+        # a monitor observes every op output: that pins all activations
+        # live anyway AND a checkpointed callback would double-fire on
+        # recompute — monitored traces run unmirrored
+        mirror_active = any_mirror and monitor is None
+        for node in variables:
+            values[(id(node), 0)] = arg_values[node.name]
+        for si, (is_mirror, nodes) in enumerate(segments):
+            seg_n_rng = sum(1 for n in nodes if n.op.need_rng)
+            if not (is_mirror and mirror_active):
+                for node in nodes:
+                    key = None
+                    if node.op.need_rng:
+                        key = rngs[rng_i]
+                        rng_i += 1
+                    _run_node(node, values, aux_values, aux_out, key,
+                              is_train, monitor)
                 continue
-            op = node.op
-            ins = [values[(id(c), ci)] for c, ci in node.inputs]
-            aux_names = ["%s_%s" % (node.name, a)
-                         for a in op.list_auxiliary_states()]
-            aux_in = [aux_values[a] for a in aux_names]
-            key = None
-            if op.need_rng:
-                key = rngs[rng_i]
-                rng_i += 1
-            outs, aux_updates = op.forward(ins, aux_in, is_train, key)
-            dev = node_device.get(id(node))
-            if dev is not None:
-                outs = [jax.device_put(o, dev) for o in outs]
-            for i, o in enumerate(outs):
-                values[(id(node), i)] = o
-            if aux_updates is not None:
-                for a, u in zip(aux_names, aux_updates):
-                    aux_out[a] = u
-            if monitor is not None:
-                for oname, o in zip(op.list_outputs(), outs):
-                    monitor("%s_%s" % (node.name, oname), o)
+
+            ext_keys = sorted(
+                {(id(c), ci) for n in nodes for c, ci in n.inputs
+                 if seg_of.get(id(c), -2) != si})
+            out_keys = ext_needed[si]
+            aux_names = _seg_aux_names(nodes)
+            seg_keys = (rngs[rng_i:rng_i + seg_n_rng]
+                        if needs_rng else None)
+            rng_i += seg_n_rng
+
+            def seg_fn(ext_vals, aux_in, keys, _nodes=nodes,
+                       _ext_keys=ext_keys, _out_keys=out_keys):
+                local = dict(zip(_ext_keys, ext_vals))
+                local_aux_out = {}
+                ki = 0
+                for node in _nodes:
+                    key = None
+                    if node.op.need_rng:
+                        key = keys[ki]
+                        ki += 1
+                    _run_node(node, local, aux_in, local_aux_out, key,
+                              is_train, None)
+                return [local[k] for k in _out_keys], local_aux_out
+
+            seg_aux_in = {a: aux_values[a] for a in aux_names}
+            seg_outs, seg_aux_out = jax.checkpoint(seg_fn)(
+                [values[k] for k in ext_keys], seg_aux_in, seg_keys)
+            for k, v in zip(out_keys, seg_outs):
+                values[k] = v
+            aux_out.update(seg_aux_out)
         outputs = [values[(id(n), i)] for n, i in heads]
         return outputs, aux_out
 
@@ -511,17 +647,59 @@ class Executor:
             self.arg_dict[n]._set_data(new_w[n])
         return new_s
 
+    def _lower_fused(self, optimizer, states):
+        _wrt_names, jit_step = self._get_fused(optimizer)
+        arg_values = {n: a.data for n, a in self.arg_dict.items()}
+        aux_values = {n: a.data for n, a in self.aux_dict.items()}
+        return jit_step.lower(arg_values, aux_values, _zero_key(), states,
+                              jnp.float32(0.01), jnp.float32(0.0),
+                              jnp.int32(1))
+
     def lower_fused_step(self, optimizer, states):
         """Optimized-HLO text of the fused step for the currently bound
         arrays — introspection hook (tests assert the sharded step carries
         an all-reduce; the perf story's equivalent of debug_str)."""
-        _wrt_names, jit_step = self._get_fused(optimizer)
+        return self._lower_fused(optimizer, states).compile().as_text()
+
+    def fused_step_memory_analysis(self, optimizer, states):
+        """XLA's compiled memory analysis of the fused train step
+        (``temp_size_in_bytes`` is the activation/workspace peak the
+        mirroring trade shrinks — the MemoryCost introspection the
+        reference's example/memcost reads off the allocator logs)."""
+        return self._lower_fused(optimizer, states).compile(
+            ).memory_analysis()
+
+    def backward_residual_bytes(self):
+        """Bytes of residuals jax saves between forward and backward for
+        the bound shapes — the activation-memory quantity mirroring
+        (``force_mirroring``/MXNET_BACKWARD_DO_MIRROR ->
+        ``jax.checkpoint``) exists to shrink.  Backend-independent: read
+        from the partial-eval trace, not the compiled executable (XLA:CPU
+        does not attribute temp buffers).  Returns None when jax's
+        saved-residuals introspection is unavailable."""
+        try:
+            from jax._src.ad_checkpoint import saved_residuals
+        except ImportError:
+            return None
         arg_values = {n: a.data for n, a in self.arg_dict.items()}
         aux_values = {n: a.data for n, a in self.aux_dict.items()}
-        lowered = jit_step.lower(arg_values, aux_values, _zero_key(), states,
-                                 jnp.float32(0.01), jnp.float32(0.0),
-                                 jnp.int32(1))
-        return lowered.compile().as_text()
+        wrt_names = tuple(n for n in self._arg_names
+                          if self._grad_req.get(n, "null") != "null")
+        trace = self._program.trace
+        wrt = {n: arg_values[n] for n in wrt_names}
+
+        def f(wrt_values):
+            merged = dict(arg_values)
+            merged.update(wrt_values)
+            return trace(merged, aux_values, _zero_key(), True)
+
+        total = 0
+        for aval, _desc in saved_residuals(f, wrt):
+            size = getattr(aval, "size", None)
+            dtype = getattr(aval, "dtype", None)
+            if size is not None and dtype is not None:
+                total += int(size) * dtype.itemsize
+        return total
 
     def init_fused_states(self, optimizer):
         """Optimizer-state arrays for every learnable arg (fused path)."""

@@ -368,3 +368,14 @@ def test_lstm_inference_model_matches_unrolled():
         got = model.forward(np.array([toks[t]], np.float32),
                             new_seq=(t == 0))[0]
         assert np.allclose(got, want[t], atol=1e-5), t
+
+
+def test_memcost_mirroring_example():
+    """Activation recompute demo (reference example/memcost): asserts the
+    mirrored step recomputes in backward, shrinks the fwd->bwd residual
+    set, and leaves numerics unchanged — a demo that CAN fail."""
+    r = _run(os.path.join(REPO, "example/memcost"),
+             "inception_memcost.py", "--batch-size", "2",
+             "--image-size", "64")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "memcost demo OK" in r.stderr + r.stdout
