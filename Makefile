@@ -19,7 +19,12 @@ LDLIBS := $(if $(HAS_JPEG),-ljpeg,)
 PY_INCLUDES := $(shell python3-config --includes 2>/dev/null)
 PY_LDFLAGS := $(shell python3-config --ldflags --embed 2>/dev/null || python3-config --ldflags 2>/dev/null)
 
-all: $(LIBDIR)/libmxtpu.so
+all: $(LIBDIR)/libmxtpu.so $(if $(HAS_JPEG),tools/im2rec,)
+
+# native dataset packer (reference tools/im2rec.cc): multi-threaded
+# decode/resize/encode -> RecordIO; needs libjpeg
+tools/im2rec: src/im2rec.cc src/image_codec.h $(LIBDIR)/recordio.o
+	$(CXX) $(CXXFLAGS) src/im2rec.cc $(LIBDIR)/recordio.o -o $@ $(LDLIBS)
 
 # flat C ABI (src/c_api.cc) — embeds/attaches the Python interpreter
 capi: $(LIBDIR)/libmxtpu_capi.so
@@ -45,7 +50,7 @@ test-capi: $(LIBDIR)/capi_smoke $(LIBDIR)/capi_threads $(LIBDIR)/capi_parity
 $(LIBDIR):
 	mkdir -p $(LIBDIR)
 
-$(LIBDIR)/%.o: src/%.cc | $(LIBDIR)
+$(LIBDIR)/%.o: src/%.cc src/image_codec.h | $(LIBDIR)
 	$(CXX) $(CXXFLAGS) -c $< -o $@
 
 $(LIBDIR)/libmxtpu.so: $(OBJS)

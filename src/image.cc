@@ -25,103 +25,10 @@ extern "C" int MXTPUDecodeAugment(const uint8_t*, uint64_t, int, int, int,
 }
 #else
 
-#ifndef MEM_SRCDST_SUPPORTED
-#define MEM_SRCDST_SUPPORTED 1
-#endif
-#include <csetjmp>
 #include <cstdio>
-#include <cstdlib>
-#include <cstring>
 #include <vector>
 
-#include <jpeglib.h>
-
-namespace mxtpu {
-
-struct JpegErr {
-  jpeg_error_mgr pub;
-  jmp_buf jb;
-};
-
-static void JpegErrExit(j_common_ptr cinfo) {
-  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
-}
-
-// xorshift PRNG — deterministic per (seed) augmentation draws.
-static inline uint32_t NextRand(uint32_t* s) {
-  uint32_t x = *s ? *s : 0x9e3779b9u;
-  x ^= x << 13;
-  x ^= x >> 17;
-  x ^= x << 5;
-  *s = x;
-  return x;
-}
-
-// Decode JPEG to HWC u8 (RGB or grayscale).  Returns 0 and fills (h,w)
-// on success; -1 on malformed input.  `out` grows as needed.
-static int Decode(const uint8_t* buf, unsigned long len, int gray,
-                  std::vector<uint8_t>* out, int* h, int* w) {
-  jpeg_decompress_struct cinfo;
-  JpegErr jerr;
-  cinfo.err = jpeg_std_error(&jerr.pub);
-  jerr.pub.error_exit = JpegErrExit;
-  if (setjmp(jerr.jb)) {
-    jpeg_destroy_decompress(&cinfo);
-    return -1;
-  }
-  jpeg_create_decompress(&cinfo);
-  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf), len);
-  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
-    jpeg_destroy_decompress(&cinfo);
-    return -1;
-  }
-  cinfo.out_color_space = gray ? JCS_GRAYSCALE : JCS_RGB;
-  jpeg_start_decompress(&cinfo);
-  const int W = cinfo.output_width, H = cinfo.output_height;
-  const int C = cinfo.output_components;
-  out->resize(static_cast<size_t>(W) * H * C);
-  JSAMPROW row;
-  while (cinfo.output_scanline < cinfo.output_height) {
-    row = out->data() + static_cast<size_t>(cinfo.output_scanline) * W * C;
-    jpeg_read_scanlines(&cinfo, &row, 1);
-  }
-  jpeg_finish_decompress(&cinfo);
-  jpeg_destroy_decompress(&cinfo);
-  *h = H;
-  *w = W;
-  return 0;
-}
-
-// Bilinear resize HWC u8 (same channel count).
-static void Resize(const uint8_t* src, int sh, int sw, int c,
-                   uint8_t* dst, int dh, int dw) {
-  const float ry = dh > 1 ? static_cast<float>(sh - 1) / (dh - 1) : 0.f;
-  const float rx = dw > 1 ? static_cast<float>(sw - 1) / (dw - 1) : 0.f;
-  for (int y = 0; y < dh; ++y) {
-    float fy = y * ry;
-    int y0 = static_cast<int>(fy);
-    int y1 = y0 + 1 < sh ? y0 + 1 : y0;
-    float wy = fy - y0;
-    for (int x = 0; x < dw; ++x) {
-      float fx = x * rx;
-      int x0 = static_cast<int>(fx);
-      int x1 = x0 + 1 < sw ? x0 + 1 : x0;
-      float wx = fx - x0;
-      for (int k = 0; k < c; ++k) {
-        float v00 = src[(static_cast<size_t>(y0) * sw + x0) * c + k];
-        float v01 = src[(static_cast<size_t>(y0) * sw + x1) * c + k];
-        float v10 = src[(static_cast<size_t>(y1) * sw + x0) * c + k];
-        float v11 = src[(static_cast<size_t>(y1) * sw + x1) * c + k];
-        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
-                  v10 * wy * (1 - wx) + v11 * wy * wx;
-        dst[(static_cast<size_t>(y) * dw + x) * c + k] =
-            static_cast<uint8_t>(v + 0.5f);
-      }
-    }
-  }
-}
-
-}  // namespace mxtpu
+#include "image_codec.h"  // Decode/Resize/NextRand over libjpeg
 
 extern "C" {
 

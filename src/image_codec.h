@@ -1,0 +1,196 @@
+// Shared libjpeg codec + resize helpers for the native IO path
+// (src/image.cc streaming decode, src/im2rec.cc dataset packer).
+//
+// Parity note: the reference links OpenCV for imdecode/imencode/resize
+// (tools/im2rec.cc:22, src/io/image_aug_default.cc); this build carries
+// its own minimal JPEG + bilinear/NN/area kernels over libjpeg so the
+// TPU host path has no OpenCV dependency.
+#ifndef MXTPU_IMAGE_CODEC_H_
+#define MXTPU_IMAGE_CODEC_H_
+
+#if __has_include(<jpeglib.h>)
+#define MXTPU_HAS_LIBJPEG 1
+
+#ifndef MEM_SRCDST_SUPPORTED
+#define MEM_SRCDST_SUPPORTED 1
+#endif
+#include <csetjmp>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace mxtpu {
+
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+inline void JpegErrExit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
+}
+
+// xorshift PRNG — deterministic per-(seed) augmentation draws.
+inline uint32_t NextRand(uint32_t* s) {
+  uint32_t x = *s ? *s : 0x9e3779b9u;
+  x ^= x << 13;
+  x ^= x >> 17;
+  x ^= x << 5;
+  *s = x;
+  return x;
+}
+
+// Decode JPEG to HWC u8.  gray: 1 -> force grayscale, 0 -> force RGB,
+// -1 -> keep the source colorspace (libjpeg's default for the file).
+// Returns 0 and fills (h,w[,c]) on success; -1 on malformed input.
+inline int Decode(const uint8_t* buf, unsigned long len, int gray,
+                  std::vector<uint8_t>* out, int* h, int* w,
+                  int* c = nullptr) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrExit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf), len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  if (gray >= 0) cinfo.out_color_space = gray ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int W = cinfo.output_width, H = cinfo.output_height;
+  const int C = cinfo.output_components;
+  out->resize(static_cast<size_t>(W) * H * C);
+  JSAMPROW row;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    row = out->data() + static_cast<size_t>(cinfo.output_scanline) * W * C;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *h = H;
+  *w = W;
+  if (c) *c = C;
+  return 0;
+}
+
+// Encode HWC u8 (1 or 3 channels) to JPEG bytes.  Returns 0 on success.
+inline int EncodeJpeg(const uint8_t* img, int h, int w, int c, int quality,
+                      std::vector<uint8_t>* out) {
+  jpeg_compress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrExit;
+  // volatile: mutated by jpeg_mem_dest reallocs between setjmp/longjmp —
+  // a plain local is indeterminate in the error path (C11 7.13.2.1)
+  unsigned char* volatile mem = nullptr;
+  unsigned long mem_size = 0;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    if (mem) free(mem);
+    return -1;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, const_cast<unsigned char**>(&mem), &mem_size);
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = c;
+  cinfo.in_color_space = c == 1 ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  JSAMPROW row;
+  while (cinfo.next_scanline < cinfo.image_height) {
+    row = const_cast<uint8_t*>(img) +
+          static_cast<size_t>(cinfo.next_scanline) * w * c;
+    jpeg_write_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  out->assign(mem, mem + mem_size);
+  jpeg_destroy_compress(&cinfo);
+  free(mem);
+  return 0;
+}
+
+// Bilinear resize HWC u8 (same channel count).
+inline void Resize(const uint8_t* src, int sh, int sw, int c,
+                   uint8_t* dst, int dh, int dw) {
+  const float ry = dh > 1 ? static_cast<float>(sh - 1) / (dh - 1) : 0.f;
+  const float rx = dw > 1 ? static_cast<float>(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * ry;
+    int y0 = static_cast<int>(fy);
+    int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = x * rx;
+      int x0 = static_cast<int>(fx);
+      int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      float wx = fx - x0;
+      for (int k = 0; k < c; ++k) {
+        float v00 = src[(static_cast<size_t>(y0) * sw + x0) * c + k];
+        float v01 = src[(static_cast<size_t>(y0) * sw + x1) * c + k];
+        float v10 = src[(static_cast<size_t>(y1) * sw + x0) * c + k];
+        float v11 = src[(static_cast<size_t>(y1) * sw + x1) * c + k];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(static_cast<size_t>(y) * dw + x) * c + k] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+// Nearest-neighbour resize (reference inter_method=0).
+inline void ResizeNN(const uint8_t* src, int sh, int sw, int c,
+                     uint8_t* dst, int dh, int dw) {
+  for (int y = 0; y < dh; ++y) {
+    int sy = static_cast<int>(static_cast<int64_t>(y) * sh / dh);
+    for (int x = 0; x < dw; ++x) {
+      int sx = static_cast<int>(static_cast<int64_t>(x) * sw / dw);
+      const uint8_t* px = src + (static_cast<size_t>(sy) * sw + sx) * c;
+      uint8_t* dp = dst + (static_cast<size_t>(y) * dw + x) * c;
+      for (int k = 0; k < c; ++k) dp[k] = px[k];
+    }
+  }
+}
+
+// Box-filter ("area") resize for shrinking (reference inter_method=3).
+inline void ResizeArea(const uint8_t* src, int sh, int sw, int c,
+                       uint8_t* dst, int dh, int dw) {
+  const float ry = static_cast<float>(sh) / dh;
+  const float rx = static_cast<float>(sw) / dw;
+  for (int y = 0; y < dh; ++y) {
+    int y0 = static_cast<int>(y * ry);
+    int y1 = static_cast<int>((y + 1) * ry + 0.9999f);
+    if (y1 > sh) y1 = sh;
+    for (int x = 0; x < dw; ++x) {
+      int x0 = static_cast<int>(x * rx);
+      int x1 = static_cast<int>((x + 1) * rx + 0.9999f);
+      if (x1 > sw) x1 = sw;
+      for (int k = 0; k < c; ++k) {
+        float acc = 0.f;
+        int n = 0;
+        for (int yy = y0; yy < y1; ++yy)
+          for (int xx = x0; xx < x1; ++xx) {
+            acc += src[(static_cast<size_t>(yy) * sw + xx) * c + k];
+            ++n;
+          }
+        dst[(static_cast<size_t>(y) * dw + x) * c + k] =
+            static_cast<uint8_t>(acc / (n ? n : 1) + 0.5f);
+      }
+    }
+  }
+}
+
+}  // namespace mxtpu
+
+#endif  // __has_include(<jpeglib.h>)
+#endif  // MXTPU_IMAGE_CODEC_H_

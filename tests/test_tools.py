@@ -139,3 +139,140 @@ def test_amalgamation_standalone_predict(tmp_path):
         sys.path.pop(0)
     np.testing.assert_allclose(np.asarray(outs[0]), want, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_native_im2rec_byte_exact_and_fast(tmp_path):
+    """Native multi-threaded im2rec (reference tools/im2rec.cc):
+    unchanged=1 output is byte-exact with im2rec.py --raw; the
+    decode->resize->crop->re-encode path packs an MNIST-sized set over
+    3k rec/s (the reference's packed-RecordIO story, BASELINE.md)."""
+    import re
+    import shutil
+    import subprocess
+    import time
+
+    binary = os.path.join(ROOT, "tools", "im2rec")
+    if not os.path.exists(binary):
+        r = subprocess.run(["make", "-s", "tools/im2rec"], cwd=ROOT,
+                           capture_output=True, text=True, timeout=300)
+        if r.returncode != 0 or not os.path.exists(binary):
+            import pytest
+            pytest.skip("native im2rec unavailable (no toolchain/libjpeg)")
+
+    from mxnet_tpu.image import imencode, imdecode_bytes
+    from mxnet_tpu import recordio as rio
+    root = tmp_path / "imgs"
+    root.mkdir()
+    rs = np.random.RandomState(0)
+    n_img = 384
+    with open(tmp_path / "a.lst", "w") as f:
+        for i in range(n_img):
+            img = rs.randint(0, 255, (28, 28, 3), np.uint8)
+            (root / ("i%04d.jpg" % i)).write_bytes(imencode(img))
+            f.write("%d\t%d\ti%04d.jpg\n" % (i, i % 10, i))
+
+    r = _run(os.path.join(ROOT, "tools"), "im2rec.py",
+             str(tmp_path / "py"), str(root),
+             "--list", str(tmp_path / "a.lst"), "--raw")
+    assert r.returncode == 0, r.stderr[-1000:]
+    r = subprocess.run([binary, str(tmp_path / "a.lst"), str(root),
+                        str(tmp_path / "cc.rec"), "unchanged=1"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-1000:]
+    assert (tmp_path / "py.rec").read_bytes() == \
+        (tmp_path / "cc.rec").read_bytes()
+
+    r = subprocess.run([binary, str(tmp_path / "a.lst"), str(root),
+                        str(tmp_path / "enc.rec"),
+                        "resize=24", "center_crop=1", "quality=90"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-1000:]
+    if "without libjpeg" in r.stderr:
+        import pytest
+        pytest.skip("im2rec built without libjpeg: no re-encode path")
+    m = re.search(r"at (\d+) rec/s", r.stdout)
+    assert m, r.stdout
+    rate = int(m.group(1))
+    reader = rio.MXRecordIO(str(tmp_path / "enc.rec"), "r")
+    n = 0
+    while True:
+        item = reader.read()
+        if item is None:
+            break
+        hdr, buf = rio.unpack(item)
+        assert hdr.id == n and float(hdr.label) == n % 10
+        assert imdecode_bytes(buf).shape == (24, 24, 3)
+        n += 1
+    assert n == n_img
+    assert rate > 3000, "packed at %d rec/s (target >3000)" % rate
+
+
+def test_native_im2rec_nsplit_pack_label(tmp_path):
+    """nsplit/part slicing and pack_label multi-label records match the
+    python packer's wire format."""
+    import subprocess
+
+    binary = os.path.join(ROOT, "tools", "im2rec")
+    if not os.path.exists(binary):
+        import pytest
+        pytest.skip("native im2rec unavailable")
+
+    from mxnet_tpu.image import imencode
+    from mxnet_tpu import recordio as rio
+    root = tmp_path / "imgs"
+    root.mkdir()
+    rs = np.random.RandomState(1)
+    with open(tmp_path / "m.lst", "w") as f:
+        for i in range(10):
+            img = rs.randint(0, 255, (16, 16, 3), np.uint8)
+            (root / ("i%d.jpg" % i)).write_bytes(imencode(img))
+            f.write("%d\t%d\t%d\ti%d.jpg\n" % (i, i, i * 2, i))
+
+    # part 1 of 2 -> records 5..9; pack_label keeps both labels
+    r = subprocess.run([binary, str(tmp_path / "m.lst"), str(root),
+                        str(tmp_path / "p1.rec"), "unchanged=1",
+                        "label_width=2", "pack_label=1",
+                        "nsplit=2", "part=1"],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr[-1000:]
+    reader = rio.MXRecordIO(str(tmp_path / "p1.rec"), "r")
+    ids = []
+    while True:
+        item = reader.read()
+        if item is None:
+            break
+        hdr, _ = rio.unpack(item)
+        assert list(hdr.label) == [hdr.id, hdr.id * 2]
+        ids.append(hdr.id)
+    assert ids == [5, 6, 7, 8, 9]
+
+
+def test_native_im2rec_color_keep(tmp_path):
+    """color=-1 keeps the source colorspace: a grayscale JPEG stays
+    1-channel through the re-encode (reference IMREAD_UNCHANGED)."""
+    import io as _io
+    import subprocess
+
+    binary = os.path.join(ROOT, "tools", "im2rec")
+    if not os.path.exists(binary):
+        import pytest
+        pytest.skip("native im2rec unavailable")
+    from PIL import Image
+    from mxnet_tpu import recordio as rio
+
+    root = tmp_path / "imgs"
+    root.mkdir()
+    rs = np.random.RandomState(2)
+    img = Image.fromarray(rs.randint(0, 255, (20, 20), np.uint8), "L")
+    img.save(root / "g.jpg", "JPEG")
+    (tmp_path / "g.lst").write_text("0\t0\tg.jpg\n")
+    r = subprocess.run([binary, str(tmp_path / "g.lst"), str(root),
+                        str(tmp_path / "g.rec"), "color=-1", "quality=90"],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr[-1000:]
+    if "without libjpeg" in r.stderr:
+        import pytest
+        pytest.skip("im2rec built without libjpeg")
+    reader = rio.MXRecordIO(str(tmp_path / "g.rec"), "r")
+    _hdr, buf = rio.unpack(reader.read())
+    assert Image.open(_io.BytesIO(buf)).mode == "L"
