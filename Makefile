@@ -26,6 +26,16 @@ all: $(LIBDIR)/libmxtpu.so $(if $(HAS_JPEG),tools/im2rec,)
 tools/im2rec: src/im2rec.cc src/image_codec.h $(LIBDIR)/recordio.o
 	$(CXX) $(CXXFLAGS) src/im2rec.cc $(LIBDIR)/recordio.o -o $@ $(LDLIBS)
 
+# Python-free PJRT predictor (reference amalgamation/mxnet_predict0.cc
+# analog).  The PJRT C API header ships in the tensorflow wheel (OpenXLA,
+# Apache-2.0); located at build time, no TF linkage — the binary only
+# needs libdl and a PJRT plugin .so at runtime.
+PJRT_INC := $(shell python3 -c "import tensorflow, os; print(os.path.join(os.path.dirname(tensorflow.__file__), 'include'))" 2>/dev/null || python -c "import tensorflow, os; print(os.path.join(os.path.dirname(tensorflow.__file__), 'include'))" 2>/dev/null)
+example-pjrt: example/cpp/pjrt-predict
+example/cpp/pjrt-predict: example/cpp/pjrt_predict.c
+	@test -n "$(PJRT_INC)" || { echo "tensorflow wheel (pjrt_c_api.h) not found"; exit 1; }
+	$(CC) -O2 -Wall -I$(PJRT_INC) $< -o $@ -ldl
+
 # flat C ABI (src/c_api.cc) — embeds/attaches the Python interpreter
 capi: $(LIBDIR)/libmxtpu_capi.so
 
@@ -50,8 +60,11 @@ test-capi: $(LIBDIR)/capi_smoke $(LIBDIR)/capi_threads $(LIBDIR)/capi_parity
 $(LIBDIR):
 	mkdir -p $(LIBDIR)
 
-$(LIBDIR)/%.o: src/%.cc src/image_codec.h | $(LIBDIR)
+$(LIBDIR)/%.o: src/%.cc | $(LIBDIR)
 	$(CXX) $(CXXFLAGS) -c $< -o $@
+
+# only image.o actually includes the shared codec header
+$(LIBDIR)/image.o: src/image_codec.h
 
 $(LIBDIR)/libmxtpu.so: $(OBJS)
 	$(CXX) $(CXXFLAGS) -shared $(OBJS) -o $@ $(LDLIBS)

@@ -1,0 +1,504 @@
+/* Python-free prediction over the PJRT C API.
+ *
+ * The reference's amalgamation story is a dependency-free predict
+ * library for embedded/mobile deployment
+ * (amalgamation/mxnet_predict0.cc:1, jni/).  The TPU-native analog:
+ * tools/amalgamation.py exports the bound graph as raw StableHLO
+ * bytecode (model.mlir) + a trivially-parseable parameter pack
+ * (params.bin), and THIS runner — plain C, no libpython, no jax —
+ * dlopens any PJRT plugin (libtpu.so on TPU hosts, a CPU PJRT plugin
+ * elsewhere), compiles the module, and runs inference.
+ *
+ *   pjrt_predict <artifact_dir> <input.npy> <plugin.so> [out.npy]
+ *
+ * The PJRT C API header comes from the OpenXLA project (Apache-2.0;
+ * located at build time from the installed tensorflow wheel — see the
+ * Makefile's example-pjrt target).  Everything here speaks the
+ * versioned-struct ABI, so one binary works with any conforming plugin.
+ */
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+/* ---- error helper ---------------------------------------------------- */
+static const PJRT_Api* g_api = NULL;
+
+static void die_on(PJRT_Error* err, const char* what) {
+  if (err == NULL) return;
+  PJRT_Error_Message_Args m = {0};
+  m.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  m.error = err;
+  g_api->PJRT_Error_Message(&m);
+  fprintf(stderr, "pjrt_predict: %s failed: %.*s\n", what,
+          (int)m.message_size, m.message);
+  PJRT_Error_Destroy_Args d = {0};
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = err;
+  g_api->PJRT_Error_Destroy(&d);
+  exit(1);
+}
+
+static void await_event(PJRT_Event* ev, const char* what) {
+  PJRT_Event_Await_Args a = {0};
+  a.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  a.event = ev;
+  die_on(g_api->PJRT_Event_Await(&a), what);
+  PJRT_Event_Destroy_Args d = {0};
+  d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  d.event = ev;
+  g_api->PJRT_Event_Destroy(&d);
+}
+
+/* ---- tiny file + format readers -------------------------------------- */
+static char* read_file(const char* path, size_t* size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "cannot open %s\n", path); exit(1); }
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc(n);
+  if (fread(buf, 1, n, f) != (size_t)n) {
+    fprintf(stderr, "short read on %s\n", path);
+    exit(1);
+  }
+  fclose(f);
+  *size = (size_t)n;
+  return buf;
+}
+
+/* params.bin TLV (tools/amalgamation.py _write_params_bin) */
+typedef struct {
+  char name[256];
+  uint32_t dtype_code;
+  uint32_t ndim;
+  int64_t dims[16];
+  uint64_t nbytes;
+  const char* data;
+} Param;
+
+static uint32_t rd_u32(const char** p) {
+  uint32_t v;
+  memcpy(&v, *p, 4);
+  *p += 4;
+  return v;
+}
+
+static uint64_t rd_u64(const char** p) {
+  uint64_t v;
+  memcpy(&v, *p, 8);
+  *p += 8;
+  return v;
+}
+
+static void need_bytes(const char* p, const char* end, uint64_t n) {
+  if ((uint64_t)(end - p) < n) {
+    fprintf(stderr, "params.bin truncated\n");
+    exit(1);
+  }
+}
+
+static Param* read_params_bin(const char* path, uint32_t* count) {
+  size_t size;
+  char* buf = read_file(path, &size);
+  const char* p = buf;
+  const char* end = buf + size;
+  if (size < 12 || memcmp(p, "MXTB", 4) != 0) {
+    fprintf(stderr, "bad params.bin magic\n");
+    exit(1);
+  }
+  p += 4;
+  uint32_t version = rd_u32(&p);
+  if (version != 1) { fprintf(stderr, "params.bin v%u\n", version); exit(1); }
+  uint32_t n = rd_u32(&p);
+  Param* out = (Param*)calloc(n, sizeof(Param));
+  for (uint32_t i = 0; i < n; ++i) {
+    need_bytes(p, end, 4);
+    uint32_t nl = rd_u32(&p);
+    if (nl >= sizeof(out[i].name)) { fprintf(stderr, "name too long\n"); exit(1); }
+    need_bytes(p, end, nl);
+    memcpy(out[i].name, p, nl);
+    p += nl;
+    need_bytes(p, end, 8);
+    out[i].dtype_code = rd_u32(&p);
+    out[i].ndim = rd_u32(&p);
+    if (out[i].ndim > 16) { fprintf(stderr, "ndim too large\n"); exit(1); }
+    need_bytes(p, end, 8ull * out[i].ndim + 8);
+    for (uint32_t d = 0; d < out[i].ndim; ++d)
+      out[i].dims[d] = (int64_t)rd_u64(&p);
+    out[i].nbytes = rd_u64(&p);
+    need_bytes(p, end, out[i].nbytes);
+    out[i].data = p;
+    p += out[i].nbytes;
+  }
+  *count = n;
+  return out; /* `buf` intentionally kept alive: entries point into it */
+}
+
+static PJRT_Buffer_Type dtype_to_pjrt(uint32_t code) {
+  switch (code) {
+    case 1: return PJRT_Buffer_Type_F32;
+    case 2: return PJRT_Buffer_Type_F64;
+    case 3: return PJRT_Buffer_Type_S32;
+    case 4: return PJRT_Buffer_Type_S64;
+    case 5: return PJRT_Buffer_Type_U8;
+    case 6: return PJRT_Buffer_Type_PRED;
+    case 7: return PJRT_Buffer_Type_BF16;
+    case 8: return PJRT_Buffer_Type_F16;
+    default:
+      fprintf(stderr, "unknown dtype code %u\n", code);
+      exit(1);
+  }
+}
+
+/* minimal .npy reader: v1.0/2.0, C-order, little-endian */
+static char* read_npy(const char* path, char* descr_out, int64_t* dims,
+                      uint32_t* ndim, size_t* nbytes) {
+  size_t size;
+  char* buf = read_file(path, &size);
+  if (size < 10 || memcmp(buf, "\x93NUMPY", 6) != 0) {
+    fprintf(stderr, "%s: not a .npy file\n", path);
+    exit(1);
+  }
+  int major = buf[6];
+  size_t hlen, hoff;
+  if (major == 1) {
+    uint16_t h;
+    memcpy(&h, buf + 8, 2);
+    hlen = h;
+    hoff = 10;
+  } else {
+    uint32_t h;
+    memcpy(&h, buf + 8, 4);
+    hlen = h;
+    hoff = 12;
+  }
+  char* hdr = (char*)malloc(hlen + 1);
+  memcpy(hdr, buf + hoff, hlen);
+  hdr[hlen] = 0;
+  char* d = strstr(hdr, "'descr':");
+  char* s = strstr(hdr, "'shape':");
+  char* forder = strstr(hdr, "'fortran_order': True");
+  if (!d || !s || forder) {
+    fprintf(stderr, "%s: unsupported npy header: %s\n", path, hdr);
+    exit(1);
+  }
+  sscanf(d, "'descr': '%15[^']'", descr_out);
+  *ndim = 0;
+  char* q = strchr(s, '(');
+  if (q) {
+    ++q;
+    while (*q && *q != ')') {
+      while (*q == ' ' || *q == ',') ++q;
+      if (*q == ')' || !*q) break;
+      if (*ndim >= 16) {
+        fprintf(stderr, "%s: rank > 16 unsupported\n", path);
+        exit(1);
+      }
+      dims[(*ndim)++] = strtoll(q, &q, 10);
+    }
+  }
+  free(hdr);
+  *nbytes = size - hoff - hlen;
+  char* data = (char*)malloc(*nbytes);
+  memcpy(data, buf + hoff + hlen, *nbytes);
+  free(buf);
+  return data;
+}
+
+static PJRT_Buffer_Type descr_to_pjrt(const char* descr, size_t* itemsize) {
+  /* '<f4' etc; '|u1' for bytes */
+  const char* t = descr + 1;
+  if (descr[0] != '<' && descr[0] != '|' && descr[0] != '=') {
+    fprintf(stderr, "npy: big-endian input unsupported (%s)\n", descr);
+    exit(1);
+  }
+  if (strcmp(t, "f4") == 0) { *itemsize = 4; return PJRT_Buffer_Type_F32; }
+  if (strcmp(t, "f8") == 0) { *itemsize = 8; return PJRT_Buffer_Type_F64; }
+  if (strcmp(t, "i4") == 0) { *itemsize = 4; return PJRT_Buffer_Type_S32; }
+  if (strcmp(t, "i8") == 0) { *itemsize = 8; return PJRT_Buffer_Type_S64; }
+  if (strcmp(t, "u1") == 0) { *itemsize = 1; return PJRT_Buffer_Type_U8; }
+  if (strcmp(t, "b1") == 0) { *itemsize = 1; return PJRT_Buffer_Type_PRED; }
+  fprintf(stderr, "npy: unsupported dtype %s\n", descr);
+  exit(1);
+}
+
+/* meta.json: extract the "arg_order" string array (no general JSON
+ * parser needed for this fixed, tool-generated layout) */
+static char** read_arg_order(const char* path, uint32_t* count) {
+  size_t size;
+  char* buf = read_file(path, &size);
+  char* p = strstr(buf, "\"arg_order\"");
+  if (!p) { fprintf(stderr, "meta.json: no arg_order\n"); exit(1); }
+  p = strchr(p, '[');
+  char* end = strchr(p, ']');
+  uint32_t n = 0, cap = 256;
+  char** names = (char**)calloc(cap, sizeof(char*));
+  while (p < end) {
+    char* q0 = strchr(p, '"');
+    if (!q0 || q0 > end) break;
+    char* q1 = strchr(q0 + 1, '"');
+    if (n == cap) {
+      cap *= 2;
+      names = (char**)realloc(names, cap * sizeof(char*));
+    }
+    names[n] = (char*)malloc(q1 - q0);
+    memcpy(names[n], q0 + 1, q1 - q0 - 1);
+    names[n][q1 - q0 - 1] = 0;
+    ++n;
+    p = q1 + 1;
+  }
+  free(buf);
+  *count = n;
+  return names;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    printf("Usage: %s <artifact_dir> <input.npy> <plugin.so> [out.npy]\n"
+           "  artifact_dir: tools/amalgamation.py output (model.mlir,\n"
+           "                params.bin, meta.json)\n"
+           "  plugin.so:    any PJRT C API plugin (libtpu.so on TPU\n"
+           "                hosts)\n",
+           argv[0]);
+    return 2;
+  }
+  const char* art = argv[1];
+  const char* in_npy = argv[2];
+  const char* plugin = argv[3];
+  const char* out_npy = argc > 4 ? argv[4] : NULL;
+  char path[1024];
+
+  /* ---- plugin ---- */
+  void* dso = dlopen(plugin, RTLD_NOW | RTLD_LOCAL);
+  if (!dso) {
+    fprintf(stderr, "dlopen %s: %s\n", plugin, dlerror());
+    return 1;
+  }
+  typedef const PJRT_Api* (*GetPjrtApiFn)(void);
+  GetPjrtApiFn get_api = (GetPjrtApiFn)dlsym(dso, "GetPjrtApi");
+  if (!get_api) {
+    fprintf(stderr, "%s has no GetPjrtApi\n", plugin);
+    return 1;
+  }
+  g_api = get_api();
+  printf("plugin %s: PJRT C API v%d.%d\n", plugin,
+         g_api->pjrt_api_version.major_version,
+         g_api->pjrt_api_version.minor_version);
+
+  PJRT_Plugin_Initialize_Args ia = {0};
+  ia.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  die_on(g_api->PJRT_Plugin_Initialize(&ia), "Plugin_Initialize");
+
+  PJRT_Client_Create_Args ca = {0};
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  die_on(g_api->PJRT_Client_Create(&ca), "Client_Create");
+  PJRT_Client* client = ca.client;
+
+  PJRT_Client_AddressableDevices_Args da = {0};
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = client;
+  die_on(g_api->PJRT_Client_AddressableDevices(&da), "AddressableDevices");
+  if (da.num_addressable_devices == 0) {
+    fprintf(stderr, "no addressable devices\n");
+    return 1;
+  }
+  PJRT_Device* dev = da.addressable_devices[0];
+  printf("devices: %zu\n", da.num_addressable_devices);
+
+  /* ---- compile model.mlir ---- */
+  snprintf(path, sizeof(path), "%s/model.mlir", art);
+  size_t code_size;
+  char* code = read_file(path, &code_size);
+  PJRT_Program prog = {0};
+  prog.struct_size = PJRT_Program_STRUCT_SIZE;
+  prog.code = code;
+  prog.code_size = code_size;
+  prog.format = "mlir";
+  prog.format_size = 4;
+  /* minimal CompileOptionsProto: executable_build_options(field 3) with
+   * num_replicas(4)=1, num_partitions(5)=1 */
+  static const char copts[] = {0x1a, 0x04, 0x20, 0x01, 0x28, 0x01};
+  PJRT_Client_Compile_Args cc = {0};
+  cc.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  cc.client = client;
+  cc.program = &prog;
+  cc.compile_options = copts;
+  cc.compile_options_size = sizeof(copts);
+  die_on(g_api->PJRT_Client_Compile(&cc), "Compile");
+  PJRT_LoadedExecutable* exec = cc.executable;
+  printf("compiled %s (%zu bytes)\n", path, code_size);
+
+  /* ---- stage arguments ---- */
+  uint32_t n_params, n_args;
+  snprintf(path, sizeof(path), "%s/params.bin", art);
+  Param* params = read_params_bin(path, &n_params);
+  snprintf(path, sizeof(path), "%s/meta.json", art);
+  char** arg_order = read_arg_order(path, &n_args);
+
+  char descr[16] = {0};
+  int64_t in_dims[16];
+  uint32_t in_ndim;
+  size_t in_bytes;
+  char* in_data = read_npy(in_npy, descr, in_dims, &in_ndim, &in_bytes);
+  size_t in_item;
+  PJRT_Buffer_Type in_type = descr_to_pjrt(descr, &in_item);
+
+  /* exactly ONE arg may be the user-fed input; a second non-parameter
+   * name means a multi-input model this single-.npy CLI cannot feed */
+  uint32_t n_inputs = 0;
+  for (uint32_t i = 0; i < n_args; ++i) {
+    int found = 0;
+    for (uint32_t j = 0; j < n_params; ++j)
+      found |= strcmp(params[j].name, arg_order[i]) == 0;
+    if (!found) ++n_inputs;
+  }
+  if (n_inputs != 1) {
+    fprintf(stderr,
+            "model takes %u non-parameter inputs; this runner feeds "
+            "exactly one (.npy)\n", n_inputs);
+    return 1;
+  }
+
+  PJRT_Buffer** arg_bufs =
+      (PJRT_Buffer**)calloc(n_args, sizeof(PJRT_Buffer*));
+  for (uint32_t i = 0; i < n_args; ++i) {
+    const char* name = arg_order[i];
+    const void* data = NULL;
+    PJRT_Buffer_Type type;
+    const int64_t* dims;
+    size_t ndim;
+    for (uint32_t j = 0; j < n_params; ++j) {
+      if (strcmp(params[j].name, name) == 0) {
+        data = params[j].data;
+        type = dtype_to_pjrt(params[j].dtype_code);
+        dims = params[j].dims;
+        ndim = params[j].ndim;
+        break;
+      }
+    }
+    if (!data) { /* not a parameter: the user-fed input */
+      data = in_data;
+      type = in_type;
+      dims = in_dims;
+      ndim = in_ndim;
+    }
+    PJRT_Client_BufferFromHostBuffer_Args ba = {0};
+    ba.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    ba.client = client;
+    ba.data = data;
+    ba.type = type;
+    ba.dims = dims;
+    ba.num_dims = ndim;
+    ba.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    ba.device = dev;
+    die_on(g_api->PJRT_Client_BufferFromHostBuffer(&ba), "BufferFromHost");
+    await_event(ba.done_with_host_buffer, "host transfer");
+    arg_bufs[i] = ba.buffer;
+  }
+
+  /* ---- execute ---- */
+  PJRT_Executable_NumOutputs_Args no = {0};
+  no.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  {
+    PJRT_LoadedExecutable_GetExecutable_Args ge = {0};
+    ge.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    ge.loaded_executable = exec;
+    die_on(g_api->PJRT_LoadedExecutable_GetExecutable(&ge), "GetExecutable");
+    no.executable = ge.executable;
+  }
+  die_on(g_api->PJRT_Executable_NumOutputs(&no), "NumOutputs");
+  size_t n_out = no.num_outputs;
+
+  PJRT_Buffer** out_list = (PJRT_Buffer**)calloc(n_out, sizeof(PJRT_Buffer*));
+  PJRT_Buffer* const* arg_lists[1] = {arg_bufs};
+  PJRT_Buffer** out_lists[1] = {out_list};
+  PJRT_Event* done = NULL;
+  PJRT_ExecuteOptions eo = {0};
+  eo.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+  PJRT_LoadedExecutable_Execute_Args ea = {0};
+  ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ea.executable = exec;
+  ea.options = &eo;
+  ea.argument_lists = arg_lists;
+  ea.num_devices = 1;
+  ea.num_args = n_args;
+  ea.output_lists = out_lists;
+  ea.device_complete_events = &done;
+  die_on(g_api->PJRT_LoadedExecutable_Execute(&ea), "Execute");
+  await_event(done, "execute");
+
+  /* ---- fetch output 0 ---- */
+  PJRT_Buffer_Dimensions_Args bd = {0};
+  bd.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+  bd.buffer = out_list[0];
+  die_on(g_api->PJRT_Buffer_Dimensions(&bd), "Dimensions");
+  PJRT_Buffer_ElementType_Args et = {0};
+  et.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+  et.buffer = out_list[0];
+  die_on(g_api->PJRT_Buffer_ElementType(&et), "ElementType");
+  const char* out_descr;
+  switch (et.type) {
+    case PJRT_Buffer_Type_F32: out_descr = "<f4"; break;
+    case PJRT_Buffer_Type_F64: out_descr = "<f8"; break;
+    case PJRT_Buffer_Type_S32: out_descr = "<i4"; break;
+    case PJRT_Buffer_Type_S64: out_descr = "<i8"; break;
+    case PJRT_Buffer_Type_U8:  out_descr = "|u1"; break;
+    case PJRT_Buffer_Type_PRED: out_descr = "|b1"; break;
+    default:
+      fprintf(stderr, "output dtype %d has no npy mapping; dumping raw\n",
+              (int)et.type);
+      out_descr = "|u1";
+  }
+
+  PJRT_Buffer_ToHostBuffer_Args th = {0};
+  th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  th.src = out_list[0];
+  die_on(g_api->PJRT_Buffer_ToHostBuffer(&th), "ToHost(size)");
+  char* host = (char*)malloc(th.dst_size);
+  th.dst = host;
+  die_on(g_api->PJRT_Buffer_ToHostBuffer(&th), "ToHost");
+  await_event(th.event, "device->host");
+
+  printf("output[0] shape=(");
+  for (size_t i = 0; i < bd.num_dims; ++i)
+    printf("%s%lld", i ? ", " : "", (long long)bd.dims[i]);
+  printf(") %zu bytes dtype=%s\n", th.dst_size, out_descr);
+  if (et.type == PJRT_Buffer_Type_F32) {
+    double checksum = 0;
+    float* fv = (float*)host;
+    for (size_t i = 0; i < th.dst_size / 4; ++i) checksum += fv[i];
+    printf("output[0] f32-sum=%.6f\n", checksum);
+  }
+
+  if (out_npy) {
+    FILE* f = fopen(out_npy, "wb");
+    char hdr[256];
+    int hl = snprintf(hdr, sizeof(hdr),
+                      "{'descr': '%s', 'fortran_order': False, "
+                      "'shape': (", out_descr);
+    for (size_t i = 0; i < bd.num_dims; ++i)
+      hl += snprintf(hdr + hl, sizeof(hdr) - hl, "%lld, ",
+                     (long long)bd.dims[i]);
+    hl += snprintf(hdr + hl, sizeof(hdr) - hl, "), }");
+    /* header (incl. 10-byte preamble) pads to 64, ends with \n */
+    int hlen = ((10 + hl + 1 + 63) / 64) * 64 - 10;
+    fputs("\x93NUMPY", f);
+    fputc(1, f);
+    fputc(0, f);
+    uint16_t hlen16 = (uint16_t)hlen;
+    fwrite(&hlen16, 2, 1, f);
+    fwrite(hdr, 1, hl, f);
+    for (int i = 0; i < hlen - hl - 1; ++i) fputc(' ', f);
+    fputc('\n', f);
+    fwrite(host, 1, th.dst_size, f);
+    fclose(f);
+    printf("wrote %s\n", out_npy);
+  }
+  printf("PJRT predict OK (no python in this process)\n");
+  return 0;
+}

@@ -80,6 +80,35 @@ if __name__ == "__main__":
 '''
 
 
+_DTYPE_CODES = {"float32": 1, "float64": 2, "int32": 3, "int64": 4,
+                "uint8": 5, "bool": 6, "bfloat16": 7, "float16": 8}
+
+
+def _write_params_bin(path, params_np, np):
+    """TLV parameter pack for no-python consumers (pjrt_predict.c):
+    magic 'MXTB' u32 version u32 count, then per entry
+    u32 name_len | name | u32 dtype_code | u32 ndim | u64 dims[] |
+    u64 nbytes | raw LE bytes."""
+    import struct
+    with open(path, "wb") as f:
+        f.write(b"MXTB")
+        f.write(struct.pack("<II", 1, len(params_np)))
+        for name in sorted(params_np):
+            arr = np.ascontiguousarray(params_np[name])
+            code = _DTYPE_CODES.get(str(arr.dtype))
+            if code is None:
+                raise ValueError("params.bin: unsupported dtype %s for %s"
+                                 % (arr.dtype, name))
+            nb = name.encode()
+            f.write(struct.pack("<I", len(nb)))
+            f.write(nb)
+            f.write(struct.pack("<II", code, arr.ndim))
+            for d in arr.shape:
+                f.write(struct.pack("<Q", d))
+            f.write(struct.pack("<Q", arr.nbytes))
+            f.write(arr.tobytes())
+
+
 def build(prefix, epoch, input_shapes, out_dir):
     """Export checkpoint (prefix, epoch) bound at input_shapes into a
     standalone artifact at out_dir.  Returns the artifact path."""
@@ -122,10 +151,18 @@ def build(prefix, epoch, input_shapes, out_dir):
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, "model.stablehlo"), "wb") as f:
         f.write(exported.serialize())
+    # raw StableHLO module bytecode: what a PJRT C-API consumer compiles
+    # directly (example/cpp/pjrt_predict.c) — the jax.export wrapper
+    # above is for python consumers only
+    with open(os.path.join(out_dir, "model.mlir"), "wb") as f:
+        f.write(exported.mlir_module_serialized)
 
     params_np = {k: np.asarray(v) for k, v in arg_values.items()
                  if k not in input_names}
     np.savez(os.path.join(out_dir, "params.npz"), **params_np)
+    # params.bin: trivially-parseable TLV for no-python consumers
+    # (name, dtype, shape, raw little-endian bytes per entry)
+    _write_params_bin(os.path.join(out_dir, "params.bin"), params_np, np)
 
     meta = {
         "input_names": input_names,
@@ -133,6 +170,9 @@ def build(prefix, epoch, input_shapes, out_dir):
         "input_dtypes": {n: str(np.dtype(arg_values[n].dtype))
                          for n in input_names},
         "arg_order": arg_order,
+        "arg_shapes": {k: list(arg_values[k].shape) for k in arg_order},
+        "arg_dtypes": {k: str(np.dtype(arg_values[k].dtype))
+                       for k in arg_order},
         "num_outputs": len(exe.outputs),
         "aux_names": aux_names,
     }
