@@ -1,0 +1,46 @@
+#!/bin/bash
+# CI task runner (parity: tests/travis/run_test.sh task dispatch).
+# Tasks compose the same make targets developers run locally, so a CI
+# failure is always reproducible with one command.
+#
+#   TASK=lint        python lint (pyflakes if present, else compileall)
+#   TASK=python      fast suite on the virtual CPU mesh (tests/conftest.py
+#                    forces JAX_PLATFORMS=cpu + 8 fake devices)
+#   TASK=python_nonative  same suite with the native .so disabled —
+#                    certifies the pure-python fallback
+#   TASK=cpp         native engine/recordio unit tests
+#   TASK=capi        C ABI consumers (needs python headers)
+#   TASK=nightly     multi-process distributed suite (slow)
+set -e
+cd "$(dirname "$0")/../.."
+
+case "${TASK:-python}" in
+  lint)
+    if python -c "import pyflakes" 2>/dev/null; then
+      python -m pyflakes mxnet_tpu tools bench.py __graft_entry__.py
+    else
+      python -m compileall -q mxnet_tpu tools bench.py __graft_entry__.py
+    fi
+    ;;
+  python)
+    make -s all || echo "native build unavailable; python fallback"
+    python -m pytest tests/ -x -q
+    ;;
+  python_nonative)
+    MXTPU_NO_NATIVE=1 python -m pytest tests/ -x -q
+    ;;
+  cpp)
+    make -s test-cpp
+    ;;
+  capi)
+    make -s test-capi
+    ;;
+  nightly)
+    make -s all
+    MXTPU_NIGHTLY=1 python -m pytest tests/test_nightly_dist.py -x -q
+    ;;
+  *)
+    echo "unknown TASK=${TASK}" >&2
+    exit 1
+    ;;
+esac

@@ -366,3 +366,19 @@ def test_pjrt_predict_runner(tmp_path):
             assert got.shape == (2, 3)
         else:
             assert "Client_Create failed" in r.stderr
+
+
+def test_mfu_audit_smoke():
+    """tools/mfu_audit.py: structural audit runs without executing a
+    step and reports the bf16/transpose/donation facts as JSON."""
+    import json
+    p = _run_tool(os.path.join(ROOT, "tools", "mfu_audit.py"),
+                  "--batch", "4", "--layers", "18", timeout=600)
+    assert p.returncode == 0, p.stderr[-1500:]
+    line = [l for l in p.stdout.splitlines() if l.startswith("{")][-1]
+    audit = json.loads(line)["audit"][0]
+    assert audit["conv_count"] > 0
+    assert set(audit["conv_dtypes"]) == {"bf16"}  # bf16 end-to-end
+    assert audit["logical_transposes"] <= 5
+    assert audit["donation_alias_bytes"] > 0
+    assert audit["model_tflops_per_step"] > 0
