@@ -1,0 +1,61 @@
+"""BENCH_SMOKE contract: the <60s chip-health tier emits one JSON line
+with the step/donation/decode signals (docs/perf.md session-start
+ritual).  Runs the measurement child directly on forced-CPU — the
+orchestrator's probe/fallback logic is exercised by the driver."""
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_contract():
+    env = dict(os.environ)
+    env.update({
+        "MXTPU_BENCH_CHILD": "1",
+        "BENCH_SMOKE": "1",
+        "BENCH_FORCE_PLATFORM": "cpu",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": _ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    p = subprocess.run([sys.executable, os.path.join(_ROOT, "bench.py")],
+                       env=env, capture_output=True, text=True,
+                       timeout=500)
+    assert p.returncode == 0, p.stderr[-1500:]
+    lines = [l for l in p.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, p.stdout
+    d = json.loads(lines[0])
+    assert d["smoke"] is True
+    assert d["metric"] == "smoke_resnet18_step_ms" and d["value"] > 0
+    assert d["donation_ok"] is True
+    # decode check ran (float ms/record, or an explicit failure string —
+    # never silently absent)
+    assert "decode_ms_per_record" in d
+    assert d["compile_s"] > 0 and d["total_s"] > 0
+
+
+def test_bench_smoke_disabled_by_zero():
+    """BENCH_SMOKE=0 must run the FULL bench, not the smoke tier (the
+    file's boolean-knob convention: "0" disables)."""
+    env = dict(os.environ)
+    env.update({
+        "MXTPU_BENCH_CHILD": "1",
+        "BENCH_SMOKE": "0",
+        "BENCH_FORCE_PLATFORM": "cpu",
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_LAYERS": "18",
+        "BENCH_BATCH": "2",
+        "BENCH_STEPS": "1",
+        "BENCH_AUTOTUNE": "0",
+        "BENCH_SECONDARY": "0",
+        "PYTHONPATH": _ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    p = subprocess.run([sys.executable, os.path.join(_ROOT, "bench.py")],
+                       env=env, capture_output=True, text=True,
+                       timeout=500)
+    assert p.returncode == 0, p.stderr[-1500:]
+    d = json.loads([l for l in p.stdout.splitlines()
+                    if l.startswith("{")][-1])
+    assert d["metric"] == "resnet18_train_images_per_sec", d
+    assert "smoke" not in d
