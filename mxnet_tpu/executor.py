@@ -36,7 +36,34 @@ def _zero_key():
     return _ZERO_KEY
 
 
-__all__ = ["Executor", "simple_bind"]
+__all__ = ["Executor", "simple_bind", "trace_residual_bytes"]
+
+
+def trace_residual_bytes(trace, arg_values, aux_values, wrt_names):
+    """Bytes of residuals jax's vjp would save across ``trace`` when
+    differentiating wrt ``wrt_names`` — the backend-independent
+    activation-memory number (what mirroring shrinks).  Shared by
+    Executor.backward_residual_bytes, the multichip dryrun, and the
+    mirror tests.  Returns None when the saved-residuals introspection
+    (a private jax API) is unavailable."""
+    try:
+        from jax._src.ad_checkpoint import saved_residuals
+    except ImportError:
+        return None
+    wrt = {n: arg_values[n] for n in wrt_names}
+
+    def f(wrt_values):
+        merged = dict(arg_values)
+        merged.update(wrt_values)
+        return trace(merged, aux_values, _zero_key(), True)
+
+    total = 0
+    for aval, _desc in saved_residuals(f, wrt):
+        size = getattr(aval, "size", None)
+        dtype = getattr(aval, "dtype", None)
+        if size is not None and dtype is not None:
+            total += int(size) * dtype.itemsize
+    return total
 
 
 def _as_list(obj, names, what):
@@ -677,29 +704,12 @@ class Executor:
         from the partial-eval trace, not the compiled executable (XLA:CPU
         does not attribute temp buffers).  Returns None when jax's
         saved-residuals introspection is unavailable."""
-        try:
-            from jax._src.ad_checkpoint import saved_residuals
-        except ImportError:
-            return None
         arg_values = {n: a.data for n, a in self.arg_dict.items()}
         aux_values = {n: a.data for n, a in self.aux_dict.items()}
         wrt_names = tuple(n for n in self._arg_names
                           if self._grad_req.get(n, "null") != "null")
-        trace = self._program.trace
-        wrt = {n: arg_values[n] for n in wrt_names}
-
-        def f(wrt_values):
-            merged = dict(arg_values)
-            merged.update(wrt_values)
-            return trace(merged, aux_values, _zero_key(), True)
-
-        total = 0
-        for aval, _desc in saved_residuals(f, wrt):
-            size = getattr(aval, "size", None)
-            dtype = getattr(aval, "dtype", None)
-            if size is not None and dtype is not None:
-                total += int(size) * dtype.itemsize
-        return total
+        return trace_residual_bytes(self._program.trace, arg_values,
+                                    aux_values, wrt_names)
 
     def init_fused_states(self, optimizer):
         """Optimizer-state arrays for every learnable arg (fused path)."""

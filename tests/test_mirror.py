@@ -166,21 +166,11 @@ def test_mirror_on_sharded_trainer_path():
         # the recompute signal: residuals jax saves across the trainer's
         # OWN trace (what the fused step differentiates) — shrinks iff
         # the checkpoint segments actually engaged on this path
-        def f(wrt):
-            merged = dict(host_batch)
-            merged.update(wrt)
-            out_list, _aux = tr._trace(merged, dict(aux),
-                                       jax.random.PRNGKey(0), True)
-            return out_list
-        resid = 0
-        try:
-            from jax._src.ad_checkpoint import saved_residuals
-            host_params = {k: np.asarray(v) for k, v in params.items()}
-            for aval, _src in saved_residuals(f, host_params):
-                if getattr(aval, "size", None) is not None:
-                    resid += int(aval.size) * aval.dtype.itemsize
-        except ImportError:
-            resid = None
+        from mxnet_tpu.executor import trace_residual_bytes
+        host = {k: np.asarray(v) for k, v in params.items()}
+        host.update(host_batch)
+        resid = trace_residual_bytes(tr._trace, host, dict(aux),
+                                     tr.param_names)
         return jax.tree_util.tree_map(np.asarray, params), resid
 
     p_plain, res_plain = run({})
