@@ -16,7 +16,8 @@ Axes (by convention): ``dp`` data, ``tp`` tensor, ``pp`` pipeline,
 from .mesh import make_mesh, auto_mesh, local_device_count
 from .sharding import ShardingRules, param_pspec, batch_pspec
 from .trainer import ShardedTrainer, ShardedPredictor
+from .pipeline import GPipeTrainer, pipeline_apply
 
 __all__ = ["make_mesh", "auto_mesh", "local_device_count",
            "ShardingRules", "param_pspec", "batch_pspec", "ShardedTrainer",
-           "ShardedPredictor"]
+           "ShardedPredictor", "GPipeTrainer", "pipeline_apply"]

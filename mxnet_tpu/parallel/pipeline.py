@@ -1,0 +1,272 @@
+"""GPipe-style microbatched pipeline parallelism over a ``pp`` mesh axis.
+
+Beyond-reference scaling: the reference's model parallelism is manual
+placement (``ctx_group``/``group2ctx``, graph_executor.cc AssignContext)
+with no schedule — stage 1 idles while stage 0 computes.  This module
+implements the TPU-native pipeline: a stack of identical blocks is
+sharded over ``pp`` (each member holds ``L/K`` consecutive layers'
+parameters), the batch is split into microbatches, and activations flow
+stage-to-stage through ``lax.ppermute`` inside ``shard_map`` — the
+single-program collective schedule XLA compiles to direct ICI sends.
+Bubbles are the classic GPipe ``(K-1)/(M+K-1)`` fraction; gradients flow
+back through the transposed permutes (jax differentiates the collective)
+so fwd+bwd+update stays ONE XLA dispatch, like every other trainer here.
+
+Embedding and head run replicated on every member (cheap vs the block
+stack; keeps the schedule single-program).  Composes with a ``dp`` axis:
+microbatches carry the dp-sharded batch through the pipeline unchanged.
+
+Layer-map note: this is the jax-native scaling layer (like
+ring_attention.py), below the Symbol compatibility surface; the
+symbol-level ``ctx_group`` path remains for reference parity.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, **kw):
+        return _shard_map(f, check_vma=False, **kw)
+except ImportError:  # older jax: kwarg is check_rep, not check_vma
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, **kw):
+        return _shard_map(f, check_rep=False, **kw)
+
+from .mesh import make_mesh  # noqa: F401  (re-exported convenience)
+
+__all__ = ["pipeline_apply", "GPipeTrainer"]
+
+
+def _identity_perm(k):
+    return [(i, (i + 1) % k) for i in range(k)]
+
+
+def pipeline_apply(block_fn, local_params, microbatches, *, axis="pp"):
+    """Run the microbatch stream through the pipeline.  CALL INSIDE
+    shard_map (manual mode) over ``axis``.
+
+    block_fn : (layer_params, h) -> h for ONE block.
+    local_params : this member's stacked layer params, leading dim
+        L/K (consecutive layers; member i holds layers [i*L/K, ...)).
+    microbatches : [M, mb, ...] microbatch stream (same array on every
+        member; member 0 is the injector).
+    Returns [M, mb, ...] outputs of the LAST stage, valid on every
+    member (final ppermute broadcast-rotates the drained outputs; we
+    collect on the last member then rotate once to member 0 and rely on
+    the caller's psum/where; here we simply return what each member
+    drained — the caller masks by axis_index == K-1).
+    """
+    k = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = microbatches.shape[0]
+    ticks = m + k - 1
+
+    def local_stack(h):
+        def body(carry, layer_params):
+            return block_fn(layer_params, carry), None
+        out, _ = lax.scan(body, h, local_params)
+        return out
+
+    zero = jnp.zeros_like(microbatches[0])
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 injects microbatch t (clamped index keeps the gather
+        # in-bounds during the drain ticks; the value is masked off)
+        inject = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+        h_in = jnp.where(idx == 0, inject, state)
+        h_out = local_stack(h_in)
+        # last stage banks microbatch t-(K-1) once the fill is done
+        out_slot = jnp.clip(t - (k - 1), 0, m - 1)
+        bank = jnp.logical_and(idx == k - 1, t >= k - 1)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(bank,
+                      h_out,
+                      lax.dynamic_index_in_dim(outputs, out_slot, 0,
+                                               keepdims=False)),
+            out_slot, 0)
+        # rotate activations to the next stage for the next tick
+        state = lax.ppermute(h_out, axis, _identity_perm(k))
+        return (state, outputs), None
+
+    outputs0 = jnp.zeros((m,) + zero.shape, zero.dtype)
+    (_, outputs), _ = lax.scan(tick, (zero, outputs0),
+                               jnp.arange(ticks))
+    # make the drained outputs identical on every member: only the last
+    # stage banked real values, so a masked psum broadcasts them
+    outputs = lax.psum(jnp.where(idx == k - 1, outputs, 0.0), axis)
+    return outputs
+
+
+class GPipeTrainer:
+    """Microbatched pipeline trainer for repeated-block models.
+
+    Parameters
+    ----------
+    embed_fn / block_fn / head_loss_fn : pure functions
+        ``embed_fn(embed_params, batch) -> h`` (token/patch embedding),
+        ``block_fn(layer_params, h) -> h`` (ONE block; applied L times
+        from stacked params), ``head_loss_fn(head_params, h, batch) ->
+        scalar loss`` (mean over the microbatch).
+    params : dict with keys ``embed``, ``layers`` (stacked [L, ...]
+        pytree), ``head``.
+    mesh : mesh with a ``pp`` axis (optionally ``dp``).
+    num_microbatches : M; the global batch must divide into M * dp.
+    optimizer : mxnet_tpu optimizer (its jitted ``update_fn`` is reused).
+
+    One ``step()`` = fwd + bwd + update in a single XLA dispatch, with
+    the pipeline schedule inside.
+    """
+
+    def __init__(self, embed_fn, block_fn, head_loss_fn, params, mesh,
+                 optimizer, num_microbatches=4):
+        if "pp" not in mesh.axis_names:
+            raise ValueError("GPipeTrainer needs a 'pp' mesh axis")
+        self.mesh = mesh
+        self.pp = mesh.shape["pp"]
+        self.dp = mesh.shape.get("dp", 1)
+        self.m = int(num_microbatches)
+        self.optimizer = optimizer
+        n_layers = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
+        if n_layers % self.pp:
+            raise ValueError("pp (%d) must divide layers (%d)"
+                             % (self.pp, n_layers))
+        self.n_layers = n_layers
+
+        layer_spec = P("pp")     # shard the stacked-layer dim
+        self._shardings = {
+            "embed": jax.tree_util.tree_map(
+                lambda _: NamedSharding(mesh, P()), params["embed"]),
+            "layers": jax.tree_util.tree_map(
+                lambda _: NamedSharding(mesh, layer_spec),
+                params["layers"]),
+            "head": jax.tree_util.tree_map(
+                lambda _: NamedSharding(mesh, P()), params["head"]),
+        }
+        self.params = {
+            k: jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(jnp.asarray(a), s),
+                params[k], self._shardings[k])
+            for k in ("embed", "layers", "head")
+        }
+        # optimizer state per param LEAF (create_state_arrays may return
+        # None, an array, or a pytree e.g. Adam's (m, v)); each state
+        # array inherits its param's sharding (pp-sharded layer stacks
+        # keep their momentum pp-sharded)
+        def _leaf_state(p):
+            s = optimizer.create_state_arrays(p.shape, p.dtype)
+            if s is None:
+                return None
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(jnp.asarray(a), p.sharding), s)
+        self.opt_state = {
+            k: [_leaf_state(p)
+                for p in jax.tree_util.tree_leaves(self.params[k])]
+            for k in self.params
+        }
+        self._embed_fn = embed_fn
+        self._block_fn = block_fn
+        self._head_loss_fn = head_loss_fn
+        self._jit_step = None
+        self.num_update = 0
+
+    # -- the fused pipelined step --------------------------------------
+    def _build(self):
+        mesh, m, pp, dp = self.mesh, self.m, self.pp, self.dp
+        embed_fn, block_fn = self._embed_fn, self._block_fn
+        head_loss_fn = self._head_loss_fn
+        has_dp = "dp" in mesh.axis_names and dp > 1
+        batch_axes = ("dp",) if has_dp else ()
+
+        def loss_fn(params, batch):
+            # manual-mode SPMD: inside, arrays are the per-member shards
+            def inner(embed_p, layers_p, head_p, local_batch):
+                h = embed_fn(embed_p, local_batch)
+                mb = h.shape[0] // m
+                stream = h.reshape((m, mb) + h.shape[1:])
+                outs = pipeline_apply(block_fn, layers_p, stream)
+                h_out = outs.reshape(h.shape)
+                loss = head_loss_fn(head_p, h_out, local_batch)
+                if has_dp:
+                    loss = lax.pmean(loss, "dp")
+                return loss
+
+            in_specs = (jax.tree_util.tree_map(lambda _: P(),
+                                               params["embed"]),
+                        jax.tree_util.tree_map(lambda _: P("pp"),
+                                               params["layers"]),
+                        jax.tree_util.tree_map(lambda _: P(),
+                                               params["head"]),
+                        jax.tree_util.tree_map(
+                            lambda _: P(*batch_axes), batch))
+            fn = shard_map(inner, mesh=mesh, in_specs=in_specs,
+                           out_specs=P())
+            return fn(params["embed"], params["layers"], params["head"],
+                      batch)
+
+        opt_update = self.optimizer.update_fn
+        preprocess = self.optimizer._preprocess_grad
+
+        def step(params, opt_state, batch, lr, wd, num_update):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            new_params, new_state = {}, {}
+            for k in params:
+                flat_p, treedef = jax.tree_util.tree_flatten(params[k])
+                flat_g = jax.tree_util.tree_leaves(grads[k])
+                outs = [opt_update(p, preprocess(g), s, lr, wd,
+                                   num_update)
+                        for p, g, s in zip(flat_p, flat_g, opt_state[k])]
+                new_params[k] = jax.tree_util.tree_unflatten(
+                    treedef, [o[0] for o in outs])
+                new_state[k] = [o[1] for o in outs]
+            return new_params, new_state, loss
+
+        donate = (0, 1)
+        return jax.jit(step, donate_argnums=donate)
+
+    def step(self, batch):
+        """One pipelined train step on a host batch dict; returns loss."""
+        rows = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        if rows % (self.m * self.dp):
+            raise ValueError(
+                "batch rows (%d) must divide into num_microbatches (%d) "
+                "* dp (%d)" % (rows, self.m, self.dp))
+        if self._jit_step is None:
+            self._jit_step = self._build()
+        self.num_update += 1
+        opt = self.optimizer
+        lr = (opt.lr_scheduler(self.num_update)
+              if opt.lr_scheduler is not None else opt.lr)
+        batch_dev = jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                jnp.asarray(a),
+                NamedSharding(self.mesh,
+                              P("dp") if "dp" in self.mesh.axis_names
+                              and self.dp > 1 else P())), batch)
+        self.params, self.opt_state, loss = self._jit_step(
+            self.params, self.opt_state, batch_dev, jnp.float32(lr),
+            jnp.float32(opt.wd), jnp.int32(self.num_update))
+        return float(loss)
+
+    # reference (unpipelined) loss for testing/validation
+    def sequential_loss(self, batch):
+        params_host = jax.tree_util.tree_map(_np.asarray, self.params)
+
+        def f(params):
+            h = self._embed_fn(params["embed"], batch)
+
+            def body(carry, layer_params):
+                return self._block_fn(layer_params, carry), None
+            h, _ = lax.scan(body, h, params["layers"])
+            return self._head_loss_fn(params["head"], h, batch)
+        return float(f(params_host))

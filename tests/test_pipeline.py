@@ -1,0 +1,114 @@
+"""GPipe microbatched pipeline over the 'pp' mesh axis.
+
+Beyond-reference: the reference's model parallelism is placement only
+(ctx_group -> AssignContext, graph_executor.cc:391) with no schedule;
+this is the TPU-native microbatch pipeline (shard_map + ppermute, one
+XLA dispatch for fwd+bwd+update).  Verified against the sequential
+(unpipelined) evaluation of the same functions.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.pipeline import GPipeTrainer
+from mxnet_tpu import optimizer as opt_mod
+
+D, V = 12, 8
+
+
+def _embed(ep, batch):
+    return jnp.take(ep["table"], batch["tokens"].astype(jnp.int32),
+                    axis=0)
+
+
+def _block(lp, h):
+    return h + jnp.tanh(h @ lp["w"] + lp["b"])
+
+
+def _head_loss(hp, h, batch):
+    logp = jax.nn.log_softmax(h @ hp["w"])
+    labels = batch["labels"].astype(jnp.int32)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def _params(rs, n_layers):
+    return {
+        "embed": {"table": rs.randn(V, D).astype(np.float32) * 0.1},
+        "layers": {"w": rs.randn(n_layers, D, D).astype(np.float32) * 0.1,
+                   "b": np.zeros((n_layers, D), np.float32)},
+        "head": {"w": rs.randn(D, V).astype(np.float32) * 0.1},
+    }
+
+
+def _batch(rs, n):
+    return {"tokens": rs.randint(0, V, (n,)).astype(np.int32),
+            "labels": rs.randint(0, V, (n,)).astype(np.int32)}
+
+
+@pytest.mark.parametrize("cfg,layers,micro", [
+    ({"pp": 4}, 4, 4),
+    ({"pp": 2, "dp": 2}, 4, 2),
+    ({"pp": 2}, 6, 5),      # layers > pp, microbatches != pp
+])
+def test_pipeline_matches_sequential(cfg, layers, micro):
+    rs = np.random.RandomState(0)
+    ndev = cfg["pp"] * cfg.get("dp", 1)
+    mesh = make_mesh(jax.devices()[:ndev], **cfg)
+    opt = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9)
+    tr = GPipeTrainer(_embed, _block, _head_loss, _params(rs, layers),
+                      mesh, opt, num_microbatches=micro)
+    batch = _batch(rs, micro * cfg.get("dp", 1) * 4)
+    ref = tr.sequential_loss(batch)
+    got = tr.step(batch)
+    assert abs(got - ref) < 1e-5, (got, ref)
+    # gradients flowed through the ppermute chain: training descends
+    # and the post-update pipelined loss still equals sequential
+    for _ in range(8):
+        last = tr.step(batch)
+    assert last < got
+    ref_now = tr.sequential_loss(batch)   # BEFORE the step advances params
+    assert abs(tr.step(batch) - ref_now) < 1e-4
+
+
+def test_pipeline_single_dispatch_and_collectives():
+    """The whole schedule (M+K-1 ticks) compiles into ONE executable
+    whose HLO carries the collective-permute chain."""
+    rs = np.random.RandomState(1)
+    mesh = make_mesh(jax.devices()[:4], pp=4)
+    opt = opt_mod.create("sgd", learning_rate=0.1)
+    tr = GPipeTrainer(_embed, _block, _head_loss, _params(rs, 4),
+                      mesh, opt, num_microbatches=4)
+    batch = _batch(rs, 8)
+    tr.step(batch)
+    hlo = tr._jit_step.lower(
+        tr.params, tr.opt_state,
+        jax.tree_util.tree_map(jnp.asarray, batch),
+        jnp.float32(0.1), jnp.float32(0.0),
+        jnp.int32(1)).compile().as_text()
+    assert "collective-permute" in hlo
+
+
+def test_pipeline_validations():
+    rs = np.random.RandomState(2)
+    mesh = make_mesh(jax.devices()[:2], dp=2)
+    opt = opt_mod.create("sgd", learning_rate=0.1)
+    with pytest.raises(ValueError, match="pp"):
+        GPipeTrainer(_embed, _block, _head_loss, _params(rs, 4), mesh,
+                     opt)
+    mesh = make_mesh(jax.devices()[:4], pp=4)
+    with pytest.raises(ValueError, match="divide"):
+        GPipeTrainer(_embed, _block, _head_loss, _params(rs, 3), mesh,
+                     opt)
+
+
+def test_pipeline_batch_divisibility_validated():
+    rs = np.random.RandomState(3)
+    mesh = make_mesh(jax.devices()[:4], pp=4)
+    opt = opt_mod.create("sgd", learning_rate=0.1)
+    tr = GPipeTrainer(_embed, _block, _head_loss, _params(rs, 4), mesh,
+                      opt, num_microbatches=3)
+    with pytest.raises(ValueError, match="num_microbatches"):
+        tr.step(_batch(rs, 8))   # 8 rows don't divide into 3 microbatches
