@@ -258,6 +258,94 @@ class GPipeTrainer:
             jnp.float32(opt.wd), jnp.int32(self.num_update))
         return float(loss)
 
+    # -- symbol-language entry ----------------------------------------
+    @classmethod
+    def from_block_symbol(cls, block_sym, *, n_layers, mesh, optimizer,
+                          embed_fn, head_loss_fn, embed_params,
+                          head_params, input_shape, data_name="data",
+                          initializer=None, num_microbatches=4,
+                          seed=0):
+        """Build the pipeline from ONE block defined in the Symbol
+        language: the block symbol (e.g. FC->Activation residual cell,
+        or a transformer block built from mx.sym ops) is traced into
+        ``block_fn`` and replicated ``n_layers`` times with
+        independently-initialized stacked parameters.
+
+        Constraints (raise otherwise): the block must be aux-free (no
+        BatchNorm moving stats — pipeline microbatches would race the
+        update) and rng-free (no Dropout), and must map ``data_name``
+        -> single output of the same shape (a residual-style cell).
+        ``input_shape`` is the per-microbatch activation shape
+        EXCLUDING the leading batch dim.
+        """
+        from ..executor import _build_program
+        from .. import initializer as init_mod
+
+        if block_sym.list_auxiliary_states():
+            raise ValueError("pipeline block must be aux-free (found %s)"
+                             % block_sym.list_auxiliary_states())
+        program = _build_program(block_sym, {})
+        if program.needs_rng:
+            raise ValueError("pipeline block must be rng-free (Dropout "
+                             "etc. not supported in the microbatch "
+                             "schedule)")
+        args = block_sym.list_arguments()
+        if data_name not in args:
+            raise ValueError("block symbol has no input %r" % data_name)
+        param_names = [n for n in args if n != data_name]
+
+        if not param_names:
+            raise ValueError("pipeline block has no parameters: nothing "
+                             "to stack over %d layers" % n_layers)
+
+        # shapes at a probe batch of 1 (batch dim drops out of params)
+        arg_shapes, out_shapes, _aux = block_sym.infer_shape(
+            **{data_name: (1,) + tuple(input_shape)})
+        if arg_shapes is None:
+            raise ValueError(
+                "pipeline block shapes are underdetermined from input "
+                "%s: every parameter shape must follow from %r"
+                % (tuple(input_shape), data_name))
+        if len(out_shapes) != 1 or tuple(out_shapes[0][1:]) != tuple(
+                input_shape):
+            raise ValueError(
+                "pipeline block must map %s -> one output of the same "
+                "shape (got %s from %s)" % (input_shape, out_shapes,
+                                            input_shape))
+        shapes = dict(zip(args, arg_shapes))
+
+        from .. import ndarray as nd_mod
+        from .. import random as random_mod
+        init = initializer or init_mod.Xavier()
+        # a local PRNG stream: initializers draw via random.next_key(),
+        # so seed-then-restore keeps the caller's global mx.random state
+        # untouched by construction
+        saved_key = random_mod._get_key()
+        random_mod.seed(seed)
+        try:
+            stacked = {}
+            for n in param_names:
+                layers = []
+                for _li in range(n_layers):
+                    arr = nd_mod.zeros(shapes[n])
+                    init(n, arr)
+                    layers.append(arr.asnumpy())
+                stacked[n] = _np.stack(layers)
+        finally:
+            random_mod._state.key = saved_key
+
+        def block_fn(lp, h):
+            merged = dict(lp)
+            merged[data_name] = h
+            outs, _aux_out = program.trace(merged, {},
+                                           jax.random.PRNGKey(0), True)
+            return outs[0]
+
+        params = {"embed": embed_params, "layers": stacked,
+                  "head": head_params}
+        return cls(embed_fn, block_fn, head_loss_fn, params, mesh,
+                   optimizer, num_microbatches=num_microbatches)
+
     # reference (unpipelined) loss for testing/validation
     def sequential_loss(self, batch):
         params_host = jax.tree_util.tree_map(_np.asarray, self.params)

@@ -379,3 +379,11 @@ def test_memcost_mirroring_example():
              "--image-size", "64")
     assert r.returncode == 0, r.stderr[-1500:]
     assert "memcost demo OK" in r.stderr + r.stdout
+
+
+def test_gpipe_example():
+    """Pipeline-parallel LM demo: pipelined == sequential, trains."""
+    r = _run(os.path.join(REPO, "example/pipeline"), "gpipe_lm.py",
+             "--steps", "15")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "gpipe demo OK" in r.stderr + r.stdout

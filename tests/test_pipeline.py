@@ -112,3 +112,85 @@ def test_pipeline_batch_divisibility_validated():
                       opt, num_microbatches=3)
     with pytest.raises(ValueError, match="num_microbatches"):
         tr.step(_batch(rs, 8))   # 8 rows don't divide into 3 microbatches
+
+
+def test_pipeline_from_block_symbol():
+    """Symbol-language entry: a residual cell written in mx.sym runs
+    pipelined and matches its own sequential evaluation."""
+    import mxnet_tpu as mx
+
+    x = mx.sym.Variable("data")
+    cell = x + mx.sym.Activation(
+        mx.sym.FullyConnected(x, num_hidden=D, name="fc"),
+        act_type="tanh", name="act")
+
+    rs = np.random.RandomState(5)
+    mesh = make_mesh(jax.devices()[:4], pp=4)
+    opt = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9)
+    tr = GPipeTrainer.from_block_symbol(
+        cell, n_layers=4, mesh=mesh, optimizer=opt,
+        embed_fn=_embed, head_loss_fn=_head_loss,
+        embed_params={"table": rs.randn(V, D).astype(np.float32) * 0.1},
+        head_params={"w": rs.randn(D, V).astype(np.float32) * 0.1},
+        input_shape=(D,), num_microbatches=4)
+    batch = _batch(rs, 16)
+    ref = tr.sequential_loss(batch)
+    got = tr.step(batch)
+    assert abs(got - ref) < 1e-5, (got, ref)
+    first = got
+    for _ in range(8):
+        last = tr.step(batch)
+    assert last < first
+
+
+def test_pipeline_block_symbol_rejects_aux_and_rng():
+    import mxnet_tpu as mx
+    mesh = make_mesh(jax.devices()[:2], pp=2)
+    opt = opt_mod.create("sgd", learning_rate=0.1)
+    kw = dict(n_layers=2, mesh=mesh, optimizer=opt, embed_fn=_embed,
+              head_loss_fn=_head_loss, embed_params={}, head_params={},
+              input_shape=(D,))
+
+    x = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(x, name="bn")
+    with pytest.raises(ValueError, match="aux-free"):
+        GPipeTrainer.from_block_symbol(bn, **kw)
+    drop = mx.sym.Dropout(x, p=0.5, name="dr")
+    with pytest.raises(ValueError, match="rng-free"):
+        GPipeTrainer.from_block_symbol(drop, **kw)
+    shrink = mx.sym.FullyConnected(x, num_hidden=D // 2, name="fc2")
+    with pytest.raises(ValueError, match="same"):
+        GPipeTrainer.from_block_symbol(shrink, **kw)
+
+
+def test_pipeline_block_symbol_guards():
+    """Underdetermined shapes and parameter-free blocks fail with named
+    errors, and construction leaves the global mx.random stream intact."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import random as mxrand
+
+    mesh = make_mesh(jax.devices()[:2], pp=2)
+    opt = opt_mod.create("sgd", learning_rate=0.1)
+    kw = dict(n_layers=2, mesh=mesh, optimizer=opt, embed_fn=_embed,
+              head_loss_fn=_head_loss, embed_params={}, head_params={},
+              input_shape=(D,))
+
+    x = mx.sym.Variable("data")
+    nop = x + mx.sym.Activation(x, act_type="tanh", name="a")
+    with pytest.raises(ValueError, match="no parameters"):
+        GPipeTrainer.from_block_symbol(nop, **kw)
+
+    under = mx.sym.dot(x, mx.sym.Variable("w"))
+    with pytest.raises(ValueError, match="underdetermined"):
+        GPipeTrainer.from_block_symbol(under, **kw)
+
+    # constructor must not clobber the caller's seeded stream
+    mx.random.seed(123)
+    want = np.asarray(mx.random.uniform(shape=(4,)).asnumpy())
+    mx.random.seed(123)
+    cell = x + mx.sym.Activation(
+        mx.sym.FullyConnected(x, num_hidden=D, name="fc"),
+        act_type="tanh", name="act")
+    GPipeTrainer.from_block_symbol(cell, **kw)
+    got = np.asarray(mx.random.uniform(shape=(4,)).asnumpy())
+    np.testing.assert_array_equal(want, got)
