@@ -1,0 +1,46 @@
+"""Shared orbax save/restore core for the sharded trainers.
+
+One layout, two writers (ShardedTrainer, GPipeTrainer): a pytree under
+stable top-level keys plus an int64 ``step`` counter.  Each host writes
+and reads only its own shards; restore targets are abstract
+(ShapeDtypeStruct + sharding) so no transient full-size host buffers
+are materialized.
+"""
+import os as _os
+
+import numpy as _np
+
+import jax
+
+__all__ = ["ocp_save", "ocp_restore", "abstract_like"]
+
+
+def abstract_like(tree):
+    """ShapeDtypeStruct(+sharding) target mirroring a placed pytree."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                       sharding=a.sharding), tree)
+
+
+def ocp_save(path, tree, step):
+    """Write ``tree`` + the update counter sharded to ``path`` (dir).
+    Multi-host: every process must call this; blocks until durable."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    payload = dict(tree)
+    payload["step"] = _np.int64(step)
+    ckptr.save(_os.path.abspath(str(path)), payload, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def ocp_restore(path, abstract_tree):
+    """Restore against abstract targets; returns (tree, step) with
+    arrays already placed per the targets' shardings."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    target = dict(abstract_tree)
+    target["step"] = _np.zeros((), _np.int64)
+    restored = ckptr.restore(_os.path.abspath(str(path)), target)
+    step = int(restored.pop("step"))
+    return restored, step

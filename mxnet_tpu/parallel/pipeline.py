@@ -258,6 +258,29 @@ class GPipeTrainer:
             jnp.float32(opt.wd), jnp.int32(self.num_update))
         return float(loss)
 
+    # -- checkpoint / resume (same orbax layout as ShardedTrainer) ----
+    def save_checkpoint(self, path):
+        """Write params + optimizer state + update counter, sharded:
+        each host writes only its own shards (the pp-sharded layer
+        stacks stay distributed end-to-end)."""
+        from .ckpt import ocp_save
+        return ocp_save(path, {"params": self.params,
+                               "opt_state": self.opt_state},
+                        self.num_update)
+
+    def load_checkpoint(self, path):
+        """Restore in place with this trainer's shardings; the update
+        counter resumes (lr schedules / Adam bias correction continue
+        where they stopped)."""
+        from .ckpt import abstract_like, ocp_restore
+        restored, step = ocp_restore(
+            path, {"params": abstract_like(self.params),
+                   "opt_state": abstract_like(self.opt_state)})
+        self.params = restored["params"]
+        self.opt_state = restored["opt_state"]
+        self.num_update = step
+        return self
+
     # -- symbol-language entry ----------------------------------------
     @classmethod
     def from_block_symbol(cls, block_sym, *, n_layers, mesh, optimizer,

@@ -322,15 +322,9 @@ class ShardedTrainer(object):
         """Write (params, opt_state, aux) + the update counter sharded
         to ``path`` (a directory).  Multi-host: every process must call
         this; arrays stay distributed end-to-end."""
-        import os
-        import orbax.checkpoint as ocp
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.abspath(str(path)),
-                   {"params": params, "opt_state": opt_state, "aux": aux,
-                    "step": _np.int64(self.num_update)},
-                   force=True)
-        ckptr.wait_until_finished()
-        return path
+        from .ckpt import ocp_save
+        return ocp_save(path, {"params": params, "opt_state": opt_state,
+                               "aux": aux}, self.num_update)
 
     def load_checkpoint(self, path, data_shapes, label_shapes=None,
                         dtype=_np.float32):
@@ -338,16 +332,12 @@ class ShardedTrainer(object):
         shardings; arrays come back placed, ready for step().  The
         trainer's update counter resumes too — Adam bias correction and
         lr schedules continue where they stopped, not from step 1."""
-        import os
-        import orbax.checkpoint as ocp
+        from .ckpt import ocp_restore
         params_t, opt_t, aux_t = self.abstract_state(
             data_shapes, label_shapes, dtype)
-        ckptr = ocp.StandardCheckpointer()
-        restored = ckptr.restore(
-            os.path.abspath(str(path)),
-            {"params": params_t, "opt_state": opt_t, "aux": aux_t,
-             "step": _np.zeros((), _np.int64)})
-        self.num_update = int(restored["step"])
+        restored, step = ocp_restore(
+            path, {"params": params_t, "opt_state": opt_t, "aux": aux_t})
+        self.num_update = step
         return restored["params"], restored["opt_state"], restored["aux"]
 
     def shard_batch(self, batch):
