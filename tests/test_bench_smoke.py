@@ -17,7 +17,7 @@ def test_bench_smoke_contract():
         "BENCH_SMOKE": "1",
         "BENCH_FORCE_PLATFORM": "cpu",
         "JAX_PLATFORMS": "cpu",
-        "PYTHONPATH": _ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+        "PYTHONPATH": _ROOT,  # no ambient site dirs: never touch a real backend
     })
     p = subprocess.run([sys.executable, os.path.join(_ROOT, "bench.py")],
                        env=env, capture_output=True, text=True,
@@ -49,7 +49,7 @@ def test_bench_smoke_disabled_by_zero():
         "BENCH_STEPS": "1",
         "BENCH_AUTOTUNE": "0",
         "BENCH_SECONDARY": "0",
-        "PYTHONPATH": _ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+        "PYTHONPATH": _ROOT,  # no ambient site dirs: never touch a real backend
     })
     p = subprocess.run([sys.executable, os.path.join(_ROOT, "bench.py")],
                        env=env, capture_output=True, text=True,
@@ -59,3 +59,102 @@ def test_bench_smoke_disabled_by_zero():
                     if l.startswith("{")][-1])
     assert d["metric"] == "resnet18_train_images_per_sec", d
     assert "smoke" not in d
+
+def test_bench_replay_of_session_harvest(tmp_path):
+    """When every probe fails but a real-TPU measurement was banked
+    earlier in the session (by the chip watcher), the orchestrator must
+    replay it with explicit provenance markers instead of emitting a
+    meaningless CPU number."""
+    import time
+    harvest = {"metric": "resnet50_train_images_per_sec", "value": 2500.0,
+               "unit": "images/sec", "vs_baseline": 14.7,
+               "platform": "tpu", "device_kind": "TPU v5 lite",
+               "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                time.gmtime()),
+               "mfu": 0.31}
+    path = tmp_path / "harvest.json"
+    path.write_text(json.dumps(harvest) + "\n")
+    env = dict(os.environ)
+    env.update({
+        # invalid platform -> the probe child errors out instantly, so
+        # the orchestrator reaches its fallback chain without touching
+        # any real backend
+        "JAX_PLATFORMS": "__no_such_platform__",
+        "BENCH_PROBE_RETRIES": "1",
+        "BENCH_PROBE_TIMEOUT": "60",
+        "BENCH_SESSION_HARVEST": str(path),
+        "PYTHONPATH": _ROOT,  # no ambient site dirs: never touch a real backend
+    })
+    p = subprocess.run([sys.executable, os.path.join(_ROOT, "bench.py")],
+                       env=env, capture_output=True, text=True,
+                       timeout=500)
+    assert p.returncode == 0, p.stderr[-1500:]
+    d = json.loads([l for l in p.stdout.splitlines()
+                    if l.startswith("{")][-1])
+    assert d["platform"] == "tpu" and d["value"] == 2500.0
+    assert d["replayed_from_session_harvest"] is True
+    assert "banked_at_utc" in d and "banked at" in d["note"]
+
+    # BENCH_NO_REPLAY must disable the replay (honest-fallback knob).
+    # The orchestrator's attempt-4 child overrides JAX_PLATFORMS to cpu,
+    # so this leg lands on a real (tiny) CPU measurement — the assertion
+    # is that it is a fresh measurement, not a replay
+    env["BENCH_NO_REPLAY"] = "1"
+    env["BENCH_CPU_STEPS"] = "1"
+    env["BENCH_CPU_BATCH"] = "2"
+    env["BENCH_LAYERS"] = "18"   # keep the cpu-fallback leg fast
+    env["BENCH_SECONDARY"] = "0"
+    p = subprocess.run([sys.executable, os.path.join(_ROOT, "bench.py")],
+                       env=env, capture_output=True, text=True,
+                       timeout=500)
+    assert p.returncode == 0, p.stderr[-1500:]
+    d = json.loads([l for l in p.stdout.splitlines()
+                    if l.startswith("{")][-1])
+    assert "replayed_from_session_harvest" not in d
+    assert d.get("platform") == "cpu"   # fresh cpu-fallback measurement
+
+
+def test_bench_replay_rejects_smoke_and_stale(tmp_path):
+    """A banked smoke line, an over-age measurement, or a payload with
+    no embedded emit-time stamp must never be replayed as the headline
+    number (code-review findings r5)."""
+    import time
+    env_base = dict(os.environ)
+    env_base.update({
+        "JAX_PLATFORMS": "__no_such_platform__",
+        "BENCH_PROBE_RETRIES": "1",
+        "BENCH_PROBE_TIMEOUT": "60",
+        "BENCH_CPU_STEPS": "1",
+        "BENCH_CPU_BATCH": "2",
+        "BENCH_LAYERS": "18",
+        "BENCH_SECONDARY": "0",
+        "PYTHONPATH": _ROOT,  # no ambient site dirs: never touch a real backend
+    })
+    cases = {
+        "smoke": {"metric": "smoke_resnet18_step_ms", "value": 100.0,
+                  "smoke": True, "platform": "tpu",
+                  "measured_at_utc": time.strftime(
+                      "%Y-%m-%dT%H:%M:%SZ", time.gmtime())},
+        "unstamped": {"metric": "resnet50_train_images_per_sec",
+                      "value": 2500.0, "platform": "tpu"},
+        "stale": {"metric": "resnet50_train_images_per_sec",
+                  "value": 2500.0, "platform": "tpu",
+                  "measured_at_utc": "2026-01-01T00:00:00Z"},
+        "preliminary": {"metric": "resnet50_train_images_per_sec",
+                        "value": 1200.0, "platform": "tpu",
+                        "note": "preliminary (autotune sweep in progress)",
+                        "measured_at_utc": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())},
+    }
+    for name, harvest in cases.items():
+        path = tmp_path / ("%s.json" % name)
+        path.write_text(json.dumps(harvest) + "\n")
+        env = dict(env_base)
+        env["BENCH_SESSION_HARVEST"] = str(path)
+        p = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=500)
+        assert p.returncode == 0, p.stderr[-1500:]
+        d = json.loads([l for l in p.stdout.splitlines()
+                        if l.startswith("{")][-1])
+        assert "replayed_from_session_harvest" not in d, (name, d)
