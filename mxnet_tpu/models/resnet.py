@@ -75,13 +75,31 @@ _UNITS = {
 }
 
 
-def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224)):
+def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
+               mirror_blocks=False):
+    """``mirror_blocks=True`` tags every op inside each residual unit
+    with ``force_mirroring`` + a per-unit ``mirror_stage``, so the
+    executor's mirror lowering (executor.py ``_mirror_segments``)
+    recomputes whole blocks in backward and keeps only block-boundary
+    activations — block-granular remat, the TPU-idiomatic equivalent of
+    the reference's hand-tagged example/memcost graphs
+    (static_graph.cc:396-440).  ``force_mirroring`` overrides the
+    conv skip list, which is what makes the segments block-sized
+    instead of the tiny elementwise runs the env knob produces."""
     if num_layers not in _UNITS:
         raise MXNetError("resnet: num_layers must be one of %s" % sorted(_UNITS))
     units, bottle_neck = _UNITS[num_layers]
     filter_list = [64, 256, 512, 1024, 2048] if bottle_neck \
         else [64, 64, 128, 256, 512]
     nchannel, height, _ = image_shape
+
+    from ..attribute import AttrScope
+    import contextlib
+
+    def unit_scope(stage_name):
+        if not mirror_blocks:
+            return contextlib.nullcontext()
+        return AttrScope(force_mirroring="true", mirror_stage=stage_name)
 
     data = sym.Variable("data")
     data = sym.BatchNorm(data=data, fix_gamma=True, eps=eps,
@@ -102,13 +120,15 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224)):
 
     for i, n in enumerate(units):
         stride = (1, 1) if i == 0 else (2, 2)
-        body = residual_unit(body, filter_list[i + 1], stride, False,
-                             name="stage%d_unit%d" % (i + 1, 1),
-                             bottle_neck=bottle_neck)
+        name = "stage%d_unit%d" % (i + 1, 1)
+        with unit_scope(name):
+            body = residual_unit(body, filter_list[i + 1], stride, False,
+                                 name=name, bottle_neck=bottle_neck)
         for j in range(n - 1):
-            body = residual_unit(body, filter_list[i + 1], (1, 1), True,
-                                 name="stage%d_unit%d" % (i + 1, j + 2),
-                                 bottle_neck=bottle_neck)
+            name = "stage%d_unit%d" % (i + 1, j + 2)
+            with unit_scope(name):
+                body = residual_unit(body, filter_list[i + 1], (1, 1), True,
+                                     name=name, bottle_neck=bottle_neck)
 
     bn1 = sym.BatchNorm(data=body, fix_gamma=False, eps=eps,
                         momentum=bn_mom, name="bn1")

@@ -86,16 +86,20 @@ def _abstract_step_args(trainer, batch, image=224, num_classes=1000,
             scalar(jnp.float32), scalar(jnp.float32), scalar(jnp.int32))
 
 
-def _build_trainer(mesh, layers, batch, dtype, mirror=False,
+def _build_trainer(mesh, layers, batch, dtype, mirror=None,
                    num_classes=1000):
+    """mirror: None (off), "env" (MXNET_BACKWARD_DO_MIRROR need_mirror
+    rules), or "blocks" (resnet mirror_blocks attr tagging — whole
+    residual units recompute, block boundaries kept)."""
     from mxnet_tpu.models import resnet
     from mxnet_tpu import optimizer as opt_mod
     from mxnet_tpu.parallel.trainer import ShardedTrainer
 
-    sym = resnet.get_symbol(num_classes=num_classes, num_layers=layers)
+    sym = resnet.get_symbol(num_classes=num_classes, num_layers=layers,
+                            mirror_blocks=(mirror == "blocks"))
     optimizer = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9,
                                wd=1e-4, rescale_grad=1.0 / batch)
-    if not mirror:
+    if mirror != "env":
         return ShardedTrainer(sym, optimizer, mesh, compute_dtype=dtype)
     # env-driven mirroring (reference static_graph.cc:404 analog): the
     # need_mirror rules pick eligible ops with no per-op attrs needed
@@ -227,23 +231,22 @@ def entry_breakdown(hlo, top=12):
 
 
 def mirror_compare(mesh, layers, dtype, batch, image=112):
-    """Compile mirror-on vs mirror-off on the TPU backend and report the
-    real activation-memory (temp bytes) delta — the hardware-level proof
-    example/memcost asserts structurally.  Smaller image bounds compile
-    time."""
-    plain = _build_trainer(mesh, layers, batch, dtype, mirror=False)
-    mirr = _build_trainer(mesh, layers, batch, dtype, mirror=True)
-    c_plain, _ = aot_compile(plain, batch, image=image)
-    c_mirr, _ = aot_compile(mirr, batch, image=image)
-    tp = c_plain.memory_analysis().temp_size_in_bytes
-    tm = c_mirr.memory_analysis().temp_size_in_bytes
-    return {
-        "mirror_image": image,
-        "mirror_batch": batch,
-        "temp_bytes_plain": tp,
-        "temp_bytes_mirrored": tm,
-        "temp_saving_pct": round(100.0 * (tp - tm) / tp, 1) if tp else None,
-    }
+    """Compile plain vs env-mirrored vs block-mirrored on the TPU
+    backend and report real activation-memory (temp bytes) deltas — the
+    hardware-level numbers behind the recompute knobs.  Smaller image
+    bounds compile time."""
+    out = {"mirror_image": image, "mirror_batch": batch}
+    tp = None
+    for mode, key in ((None, "plain"), ("env", "env"), ("blocks", "blocks")):
+        tr = _build_trainer(mesh, layers, batch, dtype, mirror=mode)
+        compiled, _ = aot_compile(tr, batch, image=image)
+        t = compiled.memory_analysis().temp_size_in_bytes
+        out["temp_bytes_%s" % key] = t
+        if mode is None:
+            tp = t
+        elif tp:
+            out["temp_saving_pct_%s" % key] = round(100.0 * (tp - t) / tp, 1)
+    return out
 
 
 def main():
@@ -282,10 +285,12 @@ def main():
     if args.mirror_compare:
         payload["mirror"] = mirror_compare(mesh, args.layers, args.dtype,
                                            batch=int(args.batch.split(",")[0]))
-        print("mirror temp bytes: plain=%s mirrored=%s (%s%% saved)"
-              % (payload["mirror"]["temp_bytes_plain"],
-                 payload["mirror"]["temp_bytes_mirrored"],
-                 payload["mirror"]["temp_saving_pct"]))
+        print("mirror temp MB: plain=%.0f env=%.0f (%s%%) blocks=%.0f (%s%%)"
+              % (payload["mirror"]["temp_bytes_plain"] / 1e6,
+                 payload["mirror"]["temp_bytes_env"] / 1e6,
+                 payload["mirror"].get("temp_saving_pct_env"),
+                 payload["mirror"]["temp_bytes_blocks"] / 1e6,
+                 payload["mirror"].get("temp_saving_pct_blocks")))
     print(json.dumps(payload))
     return 0
 
