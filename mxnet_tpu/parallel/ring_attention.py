@@ -35,6 +35,9 @@ __all__ = ["attention_reference", "flash_attention", "ring_attention",
            "current_sequence_parallel"]
 
 _NEG_INF = -1e30
+# TPU lane width: logsumexp stats are stored broadcast across one lane
+# row so the pallas output block is a legal Mosaic (8,128) tile
+_LSE_LANES = 128
 
 
 def attention_reference(q, k, v, causal=False, scale=None,
@@ -137,7 +140,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
     m, l, o = lax.fori_loop(0, n_k_blocks, body, (m, l, o))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[...] = (o / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[...] = (m + jnp.log(l_safe)).astype(jnp.float32)
+    # stats broadcast across a 128-wide lane dim: Mosaic requires the
+    # block's last two dims to be (8,128)-tileable, so a 1-D (block_q,)
+    # stats row cannot be a TPU output block — lane 0 is read back
+    # outside the kernel
+    lse = (m + jnp.log(l_safe)).astype(jnp.float32)
+    lse_ref[...] = jnp.broadcast_to(lse[:, None], (block_q, _LSE_LANES))
 
 
 def _flash_forward_kernel_call(q, k, v, causal, scale, block_q, block_k,
@@ -162,15 +170,15 @@ def _flash_forward_kernel_call(q, k, v, causal, scale, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, _LSE_LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Sq, _LSE_LANES), jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3)
-    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
+    return out.reshape(B, H, Sq, D), lse[..., 0].reshape(B, H, Sq)
 
 
 def _flash_backward_blockwise(q, k, v, o, lse, do, causal, scale, block_k):
@@ -234,14 +242,25 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
     sq, sk = q.shape[-2], k.shape[-2]
-    if interpret is None:
-        # default: real kernel on TPU, fast jnp reference elsewhere
-        # (pass interpret=True to exercise the kernel off-TPU in tests)
-        interpret = False
-    if (not on_tpu and not interpret) or sq % block_q or sk % block_k:
+    if sq % block_q or sk % block_k:   # hard kernel constraint
         return attention_reference(q, k, v, causal=causal, scale=scale)
+    if interpret is None:
+        # default: real kernel on TPU, fast jnp reference elsewhere.
+        # An EXPLICIT interpret skips this ambient probe entirely:
+        # True exercises the kernel off-TPU (tests), False forces the
+        # Mosaic path.  MXTPU_FLASH_FORCE=1 does the same for callers
+        # that can't plumb the argument (MultiHeadAttention inside a
+        # traced step) — required when AOT-lowering against a TPU
+        # topology, where jax.devices() reports the cpu host backend
+        # (tools/aot_longcontext_check.py).
+        import os as _os
+        if _os.environ.get("MXTPU_FLASH_FORCE"):
+            interpret = False
+        elif not any(d.platform == "tpu" for d in jax.devices()):
+            return attention_reference(q, k, v, causal=causal, scale=scale)
+        else:
+            interpret = False
 
     @jax.custom_vjp
     def _fa(q, k, v):

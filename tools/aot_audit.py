@@ -41,20 +41,28 @@ def _peaks_for(device_kind):
     return tf * 1e12, gb * 1e9
 
 
-def _topology_mesh(name, n_devices=1):
-    """A Mesh of compile-only devices from the local TPU compiler, or
-    None if the plugin can't provide it."""
-    import numpy as np
-    import jax
+def topology_devices(name):
+    """Compile-only devices from the local TPU compiler, or None if the
+    plugin can't provide them (no libtpu / bad name / already in use —
+    libtpu serves ONE process at a time).  Shared by this tool and
+    aot_longcontext_check.py; both exit 2 on None (callers SKIP)."""
     from jax.experimental import topologies
-    from jax.sharding import Mesh
     try:
         topo = topologies.get_topology_desc(name, platform="tpu")
-    except Exception as exc:  # noqa: BLE001 (no libtpu / bad name)
+    except Exception as exc:  # noqa: BLE001
         print("topology %r unavailable: %s" % (name, exc), file=sys.stderr)
         return None
-    devs = list(topo.devices)[:n_devices]
-    return Mesh(np.array(devs), ("dp",))
+    return list(topo.devices)
+
+
+def _topology_mesh(name, n_devices=1):
+    """A 1-axis Mesh of compile-only devices, or None."""
+    import numpy as np
+    from jax.sharding import Mesh
+    devs = topology_devices(name)
+    if devs is None:
+        return None
+    return Mesh(np.array(devs[:n_devices]), ("dp",))
 
 
 def _abstract_step_args(trainer, batch, image=224, num_classes=1000,
