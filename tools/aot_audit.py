@@ -188,6 +188,11 @@ def audit(mesh, batch, layers, dtype):
                                 "roofline omitted" % str(kind))
     if os.environ.get("AOT_BREAKDOWN", "1") != "0":
         out["entry_breakdown"] = entry_breakdown(hlo)
+    dump = os.environ.get("AOT_DUMP_HLO")
+    if dump:
+        with open(dump, "w") as f:
+            f.write(hlo)
+        out["hlo_dumped_to"] = dump
     return out
     # (cost_analysis "optimal_seconds" is a negative sentinel on the
     # compile-only topology client — not reported)
