@@ -29,16 +29,34 @@ def transformer_block(x, name, num_heads, dim, seq_len, ffn_mult=4,
 
 
 def get_symbol(vocab_size=32000, num_layers=4, num_heads=8, dim=256,
-               seq_len=512, ffn_mult=4, dropout=0.0):
-    """LM symbol: data (B, S) token ids, softmax_label (B, S) next tokens."""
+               seq_len=512, ffn_mult=4, dropout=0.0, mirror_blocks=False):
+    """LM symbol: data (B, S) token ids, softmax_label (B, S) next tokens.
+
+    ``mirror_blocks=True`` tags every op inside each decoder layer with
+    ``force_mirroring`` + a per-layer ``mirror_stage`` (same mechanism
+    as models.resnet): backward recomputes whole layers and keeps only
+    layer-boundary activations — the standard per-layer remat for
+    HBM-limited long-context training, here expressed as symbol attrs
+    and lowered by the executor's mirror segments (executor.py
+    ``_mirror_segments``)."""
+    import contextlib
+    from ..attribute import AttrScope
+
+    def layer_scope(name):
+        if not mirror_blocks:
+            return contextlib.nullcontext()
+        return AttrScope(force_mirroring="true", mirror_stage=name)
+
     data = sym.Variable("data")
     pos = sym.Variable("pos_embed_weight", shape=(seq_len, dim))
     tok = sym.Embedding(data=data, input_dim=vocab_size, output_dim=dim,
                         name="tok_embed")
     x = sym.broadcast_add(tok, sym.expand_dims(pos, axis=0))
     for i in range(num_layers):
-        x = transformer_block(x, "layer%d" % i, num_heads, dim, seq_len,
-                              ffn_mult=ffn_mult, dropout=dropout)
+        with layer_scope("layer%d" % i):
+            x = transformer_block(x, "layer%d" % i, num_heads, dim,
+                                  seq_len, ffn_mult=ffn_mult,
+                                  dropout=dropout)
     x = sym.LayerNorm(data=x, name="final_ln")
     logits = sym.FullyConnected(
         data=sym.Reshape(data=x, shape=(-1, dim)),

@@ -190,9 +190,13 @@ def audit(mesh, batch, layers, dtype):
         out["entry_breakdown"] = entry_breakdown(hlo)
     dump = os.environ.get("AOT_DUMP_HLO")
     if dump:
-        with open(dump, "w") as f:
+        # one file per batch — a multi-batch audit must not silently
+        # overwrite earlier dumps
+        root, ext = os.path.splitext(dump)
+        path = "%s.b%d%s" % (root, batch, ext or ".hlo")
+        with open(path, "w") as f:
             f.write(hlo)
-        out["hlo_dumped_to"] = dump
+        out["hlo_dumped_to"] = path
     return out
     # (cost_analysis "optimal_seconds" is a negative sentinel on the
     # compile-only topology client — not reported)
