@@ -1,0 +1,142 @@
+"""Long-context LM training: sequence parallelism + per-layer remat.
+
+Beyond-reference demo (the reference's sequence story is unrolled
+LSTMs with bucketing, example/rnn/; its max context is the longest
+bucket).  Here ONE decoder-only transformer trains with the three
+levers that make long context fit and scale on TPU:
+
+1. **sequence parallelism**: the batch's sequence axis is sharded over
+   the mesh's ``sp`` axis; MultiHeadAttention lowers to ring attention
+   (parallel/ring_attention.py) — KV blocks rotate through
+   ``lax.ppermute`` so no device ever holds the full sequence;
+2. **flash attention**: within each ring hop the score matrix is never
+   materialized (pallas kernel on TPU; measured −47% activation bytes
+   vs the O(S²) graph, docs/mfu_gap.md);
+3. **per-layer remat**: ``transformer.get_symbol(mirror_blocks=True)``
+   tags each layer for recompute — backward keeps layer-boundary
+   activations only (measured −58% compiled temp bytes on the real
+   TPU compiler, docs/mfu_gap.md).
+
+The demo ASSERTS, not just runs: the sp-sharded step must match a
+single-device run numerically, the per-layer-remat residual set must be
+smaller, and the loss must descend.
+
+Runs anywhere: on a TPU slice the mesh axes map to real chips; on a
+dev box the host platform is faked to 4 devices.
+"""
+import argparse
+import logging
+import os
+
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=4")
+
+import numpy as np
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import transformer
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.trainer import ShardedTrainer
+
+
+def build_trainer(mesh, args, seq_axis, mirror):
+    sym = transformer.get_symbol(
+        vocab_size=args.vocab, num_layers=args.layers,
+        num_heads=args.heads, dim=args.dim, seq_len=args.seq,
+        mirror_blocks=mirror)
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                              rescale_grad=1.0 / (args.batch * args.seq))
+    return ShardedTrainer(sym, opt, mesh, seq_axis=seq_axis)
+
+
+def run_steps(tr, args, n_steps):
+    mx.random.seed(7)   # init draws from the global stream
+    params, opt_state, aux = tr.init_params(
+        {"data": (args.batch, args.seq)},
+        label_shapes={"softmax_label": (args.batch, args.seq)})
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, args.vocab, (args.batch, args.seq))
+    batch = tr.shard_batch({
+        "data": toks.astype(np.float32),
+        "softmax_label": np.roll(toks, -1, axis=1).astype(np.float32),
+    })
+    losses = []
+    for _ in range(n_steps):
+        params, opt_state, aux, outs = tr.step(params, opt_state, aux,
+                                               batch,
+                                               rng=jax.random.PRNGKey(3))
+        # outs[0] are softmax probs (B, S*V->reshaped); track the loss
+        # via the eval metric path users would call
+        p = np.asarray(outs[0]).reshape(args.batch * args.seq, args.vocab)
+        lab = np.roll(toks, -1, axis=1).reshape(-1)
+        losses.append(float(-np.mean(np.log(
+            np.maximum(p[np.arange(lab.size), lab], 1e-9)))))
+    return losses, params
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sp", type=int, default=2)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=15)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    sp = args.sp if n_dev % args.sp == 0 else 1
+    dp = n_dev // sp
+    mesh = make_mesh(devices, dp=dp, sp=sp)
+    logging.info("mesh: dp=%d sp=%d seq=%d", dp, sp, args.seq)
+
+    # sp-sharded ring-attention run, with per-layer remat
+    tr = build_trainer(mesh, args, seq_axis=1, mirror=True)
+    losses, params = run_steps(tr, args, args.steps)
+    assert losses[-1] < losses[0], losses
+    logging.info("ring+remat: loss %.4f -> %.4f", losses[0], losses[-1])
+
+    # cross-check: single-device (replicated) run must match step-for-step
+    mesh1 = make_mesh(devices[:1], dp=1)
+    tr1 = build_trainer(mesh1, args, seq_axis=None, mirror=False)
+    losses1, _ = run_steps(tr1, args, 3)
+    for a, b in zip(losses[:3], losses1):
+        assert abs(a - b) < 2e-3, (losses[:3], losses1)
+    logging.info("sp-sharded losses match single-device: %s ~= %s",
+                 ["%.4f" % x for x in losses[:3]],
+                 ["%.4f" % x for x in losses1])
+
+    # the remat story: per-layer mirroring must shrink the residual set
+    from mxnet_tpu.executor import trace_residual_bytes
+    tr_plain = build_trainer(mesh, args, seq_axis=1, mirror=False)
+    host = {"data": np.zeros((args.batch, args.seq), np.float32),
+            "softmax_label": np.zeros((args.batch, args.seq), np.float32)}
+
+    def resid(tr_x):
+        mx.random.seed(7)
+        p, _s, a = tr_x.init_params(
+            {"data": (args.batch, args.seq)},
+            label_shapes={"softmax_label": (args.batch, args.seq)})
+        full = {k: np.asarray(v) for k, v in p.items()}
+        full.update(host)
+        return trace_residual_bytes(tr_x._trace, full, dict(a),
+                                    tr_x.param_names)
+
+    rp, rm = resid(tr_plain), resid(tr)
+    if rp is not None:
+        assert rm < rp, (rm, rp)
+        logging.info("per-layer remat residuals: %d -> %d bytes (-%.0f%%)",
+                     rp, rm, 100.0 * (rp - rm) / rp)
+    logging.info("long-context demo OK")
+
+
+if __name__ == "__main__":
+    main()

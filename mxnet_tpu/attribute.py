@@ -48,3 +48,18 @@ class AttrScope:
         if not hasattr(AttrScope._current, "value"):
             AttrScope._current.value = AttrScope()
         return AttrScope._current.value
+
+
+def mirror_scope(stage_name, enabled=True):
+    """Attr scope tagging every op created inside it for activation
+    recompute: ``force_mirroring`` (overrides the env knob's conv skip
+    list) + ``mirror_stage=stage_name`` (segment boundary — ops sharing
+    a stage form ONE jax.checkpoint segment in the executor's mirror
+    lowering, executor.py ``_mirror_segments``).  ``enabled=False``
+    returns a no-op context so model builders can expose a
+    ``mirror_blocks`` flag without branching (models/resnet.py,
+    models/transformer.py)."""
+    if not enabled:
+        import contextlib
+        return contextlib.nullcontext()
+    return AttrScope(force_mirroring="true", mirror_stage=stage_name)

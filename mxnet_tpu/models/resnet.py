@@ -93,13 +93,10 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
         else [64, 64, 128, 256, 512]
     nchannel, height, _ = image_shape
 
-    from ..attribute import AttrScope
-    import contextlib
+    from ..attribute import mirror_scope
 
     def unit_scope(stage_name):
-        if not mirror_blocks:
-            return contextlib.nullcontext()
-        return AttrScope(force_mirroring="true", mirror_stage=stage_name)
+        return mirror_scope(stage_name, enabled=mirror_blocks)
 
     data = sym.Variable("data")
     data = sym.BatchNorm(data=data, fix_gamma=True, eps=eps,

@@ -39,13 +39,10 @@ def get_symbol(vocab_size=32000, num_layers=4, num_heads=8, dim=256,
     HBM-limited long-context training, here expressed as symbol attrs
     and lowered by the executor's mirror segments (executor.py
     ``_mirror_segments``)."""
-    import contextlib
-    from ..attribute import AttrScope
+    from ..attribute import mirror_scope
 
     def layer_scope(name):
-        if not mirror_blocks:
-            return contextlib.nullcontext()
-        return AttrScope(force_mirroring="true", mirror_stage=name)
+        return mirror_scope(name, enabled=mirror_blocks)
 
     data = sym.Variable("data")
     pos = sym.Variable("pos_embed_weight", shape=(seq_len, dim))

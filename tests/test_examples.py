@@ -387,3 +387,12 @@ def test_gpipe_example():
              "--steps", "15")
     assert r.returncode == 0, r.stderr[-1500:]
     assert "gpipe demo OK" in r.stderr + r.stdout
+
+
+def test_long_context_example():
+    """Long-context LM demo: sp ring attention == single-device
+    numerics, per-layer remat shrinks residuals, trains."""
+    r = _run(os.path.join(REPO, "example/long-context"),
+             "train_lm_long.py", "--steps", "10")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "long-context demo OK" in r.stderr + r.stdout
