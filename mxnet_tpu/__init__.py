@@ -26,6 +26,7 @@ from . import symbol as sym
 from .symbol import Symbol, Variable, Group
 from . import executor
 from .executor import Executor
+from . import analysis
 from . import operator
 symbol._init_symbol_module()  # pick up ops registered by operator (Custom)
 from . import lr_scheduler

@@ -1,0 +1,131 @@
+"""Static analysis over Symbol graphs: lint passes + bind-time validation.
+
+Entry points:
+
+- :func:`analyze` — run the registered passes over an in-memory Symbol
+  (with optional shape/type hints and bind/mesh context) and return
+  :class:`GraphIssue` findings;
+- :func:`analyze_json` — same over a *saved* symbol JSON, which
+  additionally exposes dead nodes/unused arg_nodes the in-memory graph
+  cannot represent;
+- ``Symbol.validate()`` (symbol.py) and the ``validate=`` knob on
+  ``Executor``/``bind``/``simple_bind`` (executor.py) wrap these;
+- ``tools/mxlint.py`` is the standalone CLI for saved graphs and the
+  bundled model zoo.
+
+Rule catalog (see docs/graph_lint.md):
+
+========  ========  ==================================================
+rule      severity  finding
+========  ========  ==================================================
+MXL-S001  warning   shapes unknown after propagation
+MXL-S002  error     contradictory shapes on one edge
+MXL-T001  warning   implicit float-width promotion at an op input
+MXL-T002  error     type propagation failure
+MXL-G001  warning   node unreachable from any head (saved graphs)
+MXL-G002  warning   declared input never consumed / ignored bind entry
+MXL-G003  warning   output aliases an input variable
+MXL-G004  error     duplicate node names
+MXL-B001  error     grad_req=write on a shared grad buffer
+MXL-B002  warning   partial args_grad silently downgraded to null
+MXL-B003  error     auxiliary-state name collision
+MXL-B004  error     invalid grad_req value
+MXL-B005  warning   ctx_group absent from group2ctx
+MXL-L001  error     op has no JAX lowering for the target platform
+MXL-L002  error     host callback inside a mirrored segment
+MXL-L003  info      host-callback op breaks fusion
+MXL-L004  error     sharding spec references axes missing from mesh
+========  ========  ==================================================
+
+Suppress per node with the ``__lint_ignore__`` attr (comma-separated
+rule ids, or ``all``).
+"""
+from __future__ import annotations
+
+import json as _json
+
+from .core import (GraphIssue, AnalysisContext, Rule, RULE_REGISTRY,
+                   register_rule, run_rules, format_issues,
+                   SEVERITIES, SEVERITY_RANK)
+
+# importing the pass modules registers their rules
+from . import shapes as _shapes      # noqa: F401
+from . import graph as _graph        # noqa: F401
+from . import bind as _bind          # noqa: F401
+from . import lowering as _lowering  # noqa: F401
+
+__all__ = ["GraphIssue", "AnalysisContext", "Rule", "RULE_REGISTRY",
+           "register_rule", "run_rules", "format_issues", "SEVERITIES",
+           "SEVERITY_RANK", "analyze", "analyze_json", "max_severity",
+           "GraphLintWarning"]
+
+
+class GraphLintWarning(UserWarning):
+    """Category for bind-time lint findings emitted in 'warn' mode."""
+
+
+def analyze(symbol, shapes=None, type_dict=None, args=None, args_grad=None,
+            grad_req=None, aux_states=None, group2ctx=None, mesh=None,
+            sharding_rules=None, target="tpu", json_graph=None,
+            select=None, skip=None):
+    """Run the lint passes over ``symbol``; returns issues, errors first.
+
+    Parameters mirror what the two call surfaces know: ``Symbol.validate``
+    passes shape/type/mesh hints, the Executor bind hook adds
+    args/args_grad/grad_req/aux_states/group2ctx, and the CLI adds the
+    raw ``json_graph`` dict of a saved symbol.  ``select``/``skip``
+    restrict which rule ids run.
+    """
+    ctx = AnalysisContext(symbol, shapes=shapes, type_dict=type_dict,
+                          args=args, args_grad=args_grad, grad_req=grad_req,
+                          aux_states=aux_states, group2ctx=group2ctx,
+                          mesh=mesh, sharding_rules=sharding_rules,
+                          target=target, json_graph=json_graph)
+    return run_rules(ctx, select=select, skip=skip)
+
+
+def analyze_json(json_src, **kwargs):
+    """Lint a saved symbol JSON (string or parsed dict).
+
+    Builds the Symbol through the normal loader, then analyzes with the
+    raw node list attached so dead-node/unused-arg detection sees what
+    the loader silently drops.  Nodes naming ops absent from the registry
+    become MXL-L001 errors (the loader would just raise); the graph-only
+    passes still run so one lint reports everything it can.
+    """
+    from ..symbol import load_json
+    from ..ops.registry import OP_REGISTRY
+    if isinstance(json_src, bytes):
+        json_src = json_src.decode("utf-8")
+    if isinstance(json_src, str):
+        graph = _json.loads(json_src)
+    else:
+        graph = json_src
+        json_src = _json.dumps(json_src)
+    registered = dict(OP_REGISTRY.items())
+    unknown = [spec for spec in graph.get("nodes", [])
+               if spec.get("op") not in ("null", "None")
+               and spec.get("op") not in registered]
+    if unknown:
+        issues = [GraphIssue("MXL-L001", "error", spec.get("name"),
+                             "op %r of node %r is not in the operator "
+                             "registry: the graph cannot load, let alone "
+                             "lower" % (spec.get("op"), spec.get("name")))
+                  for spec in unknown]
+        kwargs.pop("select", None)
+        kwargs.pop("skip", None)
+        issues += analyze(None, json_graph=graph,
+                          select={"MXL-G001", "MXL-G002"}, **kwargs)
+        issues.sort(key=lambda i: (-SEVERITY_RANK[i.severity], i.rule_id,
+                                   i.node or ""))
+        return issues
+    return analyze(load_json(json_src), json_graph=graph, **kwargs)
+
+
+def max_severity(issues):
+    """Highest severity present in ``issues`` (None when empty)."""
+    best = None
+    for i in issues:
+        if best is None or SEVERITY_RANK[i.severity] > SEVERITY_RANK[best]:
+            best = i.severity
+    return best

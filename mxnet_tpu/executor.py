@@ -335,11 +335,25 @@ class Executor:
     """Parity: include/mxnet/symbolic.h:323 + python/mxnet/executor.py."""
 
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None, group2ctx=None, shared_exec=None):
+                 aux_states=None, group2ctx=None, shared_exec=None,
+                 validate=None):
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
         self._group2ctx = group2ctx or {}
         self._monitor_callback = None
+
+        # bind-time graph validation knob: "warn" (default) surfaces lint
+        # findings as GraphLintWarning, "error" refuses to bind a graph
+        # with error-severity findings (the reference GraphExecutor's
+        # fail-at-bind contract), "off" skips the pass entirely.
+        # MXTPU_BIND_VALIDATE overrides the default for whole runs.
+        import os as _os
+        if validate is None:
+            validate = _os.environ.get("MXTPU_BIND_VALIDATE", "warn")
+        if validate not in ("warn", "error", "off"):
+            raise MXNetError("validate must be 'warn', 'error' or 'off', "
+                             "got %r" % (validate,))
+        self._validate_mode = validate
 
         self._arg_names = symbol.list_arguments()
         self._out_names = symbol.list_outputs()
@@ -380,6 +394,13 @@ class Executor:
         self.aux_arrays = aux_list
         self.aux_dict = dict(zip(self._aux_names, aux_list))
 
+        # static graph lint BEFORE tracing: a bad graph fails here with
+        # positioned findings instead of an opaque XLA trace error
+        # (GraphExecutor bind-time inference parity; analysis/).
+        self.bind_issues = []
+        if validate != "off":
+            self._validate_bind(args, args_grad, grad_req, aux_states)
+
         # outputs are allocated AT BIND and updated in place by forward:
         # a handle taken once (MXExecutorOutputs, reference c_api.cc
         # MXExecutorOutputs contract) stays aliased to the executor's
@@ -418,6 +439,33 @@ class Executor:
         self._n_fused_step = 0
         self._n_monitored_compiled = 0
         self._fused_cache = None  # (optimizer id, jitted step)
+
+    def _validate_bind(self, args, args_grad, grad_req, aux_states):
+        """Run the static analyzer with full bind context and apply the
+        validate= policy: 'warn' emits one GraphLintWarning summarizing
+        warning+error findings, 'error' raises MXNetError when any
+        error-severity finding exists (refuse-to-bind, the reference
+        GraphExecutor contract)."""
+        from .analysis import analyze, format_issues, GraphLintWarning
+        issues = analyze(
+            self._symbol,
+            shapes={n: tuple(a.shape) for n, a in self.arg_dict.items()},
+            args=args, args_grad=args_grad, grad_req=grad_req,
+            aux_states=aux_states, group2ctx=self._group2ctx,
+            target=self._ctx.device_type)
+        self.bind_issues = issues
+        errors = [i for i in issues if i.severity == "error"]
+        visible = [i for i in issues if i.severity != "info"]
+        if errors and self._validate_mode == "error":
+            raise MXNetError(
+                "bind validation failed with %d error(s) (pass "
+                "validate='warn'/'off' or fix the graph):\n%s"
+                % (len(errors), format_issues(errors)))
+        if visible:
+            import warnings
+            warnings.warn("graph lint found %d issue(s) at bind:\n%s"
+                          % (len(visible), format_issues(visible)),
+                          GraphLintWarning, stacklevel=3)
 
     @property
     def output_dict(self):
@@ -770,7 +818,7 @@ class Executor:
         aux = {n: a for n, a in self.aux_dict.items()}
         return Executor(self._symbol, self._ctx, new_args, new_grads,
                         self._grad_req, aux, group2ctx=self._group2ctx,
-                        shared_exec=self)
+                        shared_exec=self, validate=self._validate_mode)
 
     def debug_str(self):
         """Execution plan dump (GraphExecutor::Print parity); under jit the
@@ -784,7 +832,7 @@ class Executor:
 
 
 def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
-                shared_exec=None, **kwargs):
+                shared_exec=None, validate=None, **kwargs):
     """Allocate arg/grad/aux arrays from inferred shapes and bind
     (parity: symbol.py:630-710)."""
     arg_shapes, _, aux_shapes = symbol.infer_shape(**kwargs)
@@ -804,4 +852,5 @@ def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
             grads[name] = zeros(shape, ctx=ctx, dtype=dtype)
     aux = [zeros(s, ctx=ctx) for s in aux_shapes]
     return Executor(symbol, ctx, args, grads, grad_req, aux,
-                    group2ctx=group2ctx, shared_exec=shared_exec)
+                    group2ctx=group2ctx, shared_exec=shared_exec,
+                    validate=validate)

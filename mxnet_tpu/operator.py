@@ -216,6 +216,7 @@ class Custom(OperatorProperty):
     param_cls = None
     hint = "custom"
     accepts_any_attrs = True
+    host_callback = True    # pure_callback body: analysis/lowering.py lint
 
     def __init__(self, **attrs):
         # arbitrary user kwargs: bypass OperatorProperty's field validation
@@ -380,6 +381,7 @@ class _Native(OperatorProperty):
     param_cls = None
     hint = "native"
     accepts_any_attrs = True
+    host_callback = True    # pure_callback body: analysis/lowering.py lint
 
     def __init__(self, **attrs):
         self.attrs = {k: str(v) for k, v in attrs.items()}

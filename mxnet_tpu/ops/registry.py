@@ -69,6 +69,13 @@ class OperatorProperty:
     param_cls = None        # optional ParamStruct subclass
     need_rng = False        # request a PRNG key slice in forward
     hint = None             # name hint for auto naming (defaults to lowercased op)
+    # lowering metadata read by the static analyzer (analysis/lowering.py):
+    # host_callback marks ops whose forward round-trips through the host
+    # (jax.pure_callback — XLA cannot fuse/shard across them and they must
+    # not sit inside a jax.checkpoint mirror segment); unsupported_platforms
+    # lists target platforms the op cannot lower for at all.
+    host_callback = False
+    unsupported_platforms = ()
 
     # graph-level attrs that ride on nodes but are not op params
     _SYSTEM_ATTRS = frozenset(

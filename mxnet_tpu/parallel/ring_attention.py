@@ -23,8 +23,10 @@ final output o / l — associative across blocks, so ring order is free.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
+import os as _os
 
 import jax
 import jax.numpy as jnp
@@ -32,7 +34,30 @@ from jax import lax
 
 __all__ = ["attention_reference", "flash_attention", "ring_attention",
            "blockwise_combine", "sequence_parallel",
-           "current_sequence_parallel"]
+           "current_sequence_parallel", "aot_lowering_scope"]
+
+# >0 while inside aot_lowering_scope(): compile-only lowering against a
+# TPU topology, where the ambient backend is the cpu host — the only
+# context where MXTPU_FLASH_FORCE may force the Mosaic kernel path off
+# a real TPU (executing that path on cpu/gpu would just abort)
+_AOT_LOWERING_DEPTH = 0
+
+
+@contextlib.contextmanager
+def aot_lowering_scope():
+    """Mark a compile-only/AOT lowering region (tools/aot_*.py).
+
+    Inside the scope ``flash_attention`` honors ``MXTPU_FLASH_FORCE=1``
+    even though ``jax.devices()`` reports the cpu host backend, so the
+    fused step lowers the SAME Mosaic kernel graph the chip runs.
+    Outside it a leaked MXTPU_FLASH_FORCE on a non-TPU backend is
+    ignored (reference fallback) instead of crashing execution."""
+    global _AOT_LOWERING_DEPTH
+    _AOT_LOWERING_DEPTH += 1
+    try:
+        yield
+    finally:
+        _AOT_LOWERING_DEPTH -= 1
 
 _NEG_INF = -1e30
 # TPU lane width: logsumexp stats are stored broadcast across one lane
@@ -251,13 +276,17 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
         # True exercises the kernel off-TPU (tests), False forces the
         # Mosaic path.  MXTPU_FLASH_FORCE=1 does the same for callers
         # that can't plumb the argument (MultiHeadAttention inside a
-        # traced step) — required when AOT-lowering against a TPU
-        # topology, where jax.devices() reports the cpu host backend
-        # (tools/aot_longcontext_check.py).
-        import os as _os
-        if _os.environ.get("MXTPU_FLASH_FORCE"):
+        # traced step) — but ONLY inside aot_lowering_scope(), i.e.
+        # compile-only lowering against a TPU topology where
+        # jax.devices() reports the cpu host backend
+        # (tools/aot_longcontext_check.py).  A leaked MXTPU_FLASH_FORCE
+        # outside that scope must not force Mosaic onto a cpu/gpu
+        # backend, where it would abort execution.
+        on_tpu = any(d.platform == "tpu" for d in jax.devices())
+        if _os.environ.get("MXTPU_FLASH_FORCE") and (
+                on_tpu or _AOT_LOWERING_DEPTH > 0):
             interpret = False
-        elif not any(d.platform == "tpu" for d in jax.devices()):
+        elif not on_tpu:
             return attention_reference(q, k, v, causal=causal, scale=scale)
         else:
             interpret = False

@@ -115,3 +115,41 @@ class ShardingRules(object):
             if prog.match(name):
                 return fn(shape, self._mesh)
         return None
+
+    def validate(self, mesh, named_shapes):
+        """Check every matching rule against a concrete mesh.
+
+        ``named_shapes``: {param name: shape tuple}.  Yields
+        ``(name, spec, problem, fatal)`` for each defect: a spec naming a
+        mesh axis the mesh lacks (fatal — pjit rejects it at dispatch),
+        or partitioning a dimension the axis size doesn't divide
+        (non-fatal: GSPMD may still pad, but the layout is almost never
+        what the rule author meant).  Consumed by the MXL-L004 lint pass.
+        """
+        out = []
+        for name, shape in sorted(named_shapes.items()):
+            spec = self.match(name, shape)
+            if spec is None:
+                continue
+            entries = list(spec)
+            for dim, entry in enumerate(entries):
+                if entry is None:
+                    continue
+                axes = entry if isinstance(entry, tuple) else (entry,)
+                for axis in axes:
+                    if axis not in mesh.shape:
+                        out.append((name, spec,
+                                    "axis %r is not in mesh axes %s"
+                                    % (axis, sorted(mesh.shape)), True))
+                    elif dim < len(shape) and mesh.shape[axis] > 0 and \
+                            shape[dim] % mesh.shape[axis] != 0:
+                        out.append((name, spec,
+                                    "dim %d of shape %s is not divisible "
+                                    "by mesh axis %r (size %d)"
+                                    % (dim, tuple(shape), axis,
+                                       mesh.shape[axis]), False))
+            if len(entries) > len(shape):
+                out.append((name, spec,
+                            "spec has %d entries but the parameter is "
+                            "rank %d" % (len(entries), len(shape)), True))
+        return out

@@ -317,18 +317,42 @@ class Symbol:
         out_types = [types.get((id(n), i), base) for n, i in self._heads]
         return arg_types, out_types, aux_types
 
+    # -- static analysis (analysis/) ---------------------------------------
+    def validate(self, shapes=None, type_dict=None, mesh=None,
+                 sharding_rules=None, target="tpu", select=None, skip=None,
+                 **shape_kwargs):
+        """Run the static lint passes over this graph; returns
+        ``list[analysis.GraphIssue]``, most severe first.
+
+        The pre-trace counterpart of the reference GraphExecutor's
+        bind-time shape/type inference (static_graph.cc:59): catch
+        shape/dtype conflicts, dead inputs, and non-lowerable ops before
+        they become opaque XLA trace errors.  ``shapes`` (or shape
+        kwargs, ``infer_shape`` style) and ``type_dict`` seed
+        propagation; ``mesh``/``sharding_rules`` enable the sharding-axis
+        checks; ``select``/``skip`` filter rule ids.
+        """
+        from .analysis import analyze
+        known = dict(shapes or {})
+        known.update(shape_kwargs)
+        return analyze(self, shapes=known, type_dict=type_dict, mesh=mesh,
+                       sharding_rules=sharding_rules, target=target,
+                       select=select, skip=skip)
+
     # -- binding (implemented in executor.py) ------------------------------
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
-             group2ctx=None, shared_exec=None):
+             group2ctx=None, shared_exec=None, validate=None):
         from .executor import Executor
         return Executor(self, ctx, args, args_grad, grad_req, aux_states,
-                        group2ctx=group2ctx, shared_exec=shared_exec)
+                        group2ctx=group2ctx, shared_exec=shared_exec,
+                        validate=validate)
 
     def simple_bind(self, ctx, grad_req="write", type_dict=None, group2ctx=None,
-                    shared_exec=None, **kwargs):
+                    shared_exec=None, validate=None, **kwargs):
         from .executor import simple_bind
         return simple_bind(self, ctx, grad_req=grad_req, type_dict=type_dict,
-                           group2ctx=group2ctx, shared_exec=shared_exec, **kwargs)
+                           group2ctx=group2ctx, shared_exec=shared_exec,
+                           validate=validate, **kwargs)
 
     # -- grad (Symbol::Grad symbol.cc:569) ---------------------------------
     def grad(self, wrt):

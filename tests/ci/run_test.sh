@@ -4,6 +4,7 @@
 # failure is always reproducible with one command.
 #
 #   TASK=lint        python lint (pyflakes if present, else compileall)
+#                    + the mxlint graph-lint sweep over the model zoo
 #   TASK=python      fast suite on the virtual CPU mesh (tests/conftest.py
 #                    forces JAX_PLATFORMS=cpu + 8 fake devices)
 #   TASK=python_nonative  same suite with the native .so disabled —
@@ -21,6 +22,9 @@ case "${TASK:-python}" in
     else
       python -m compileall -q mxnet_tpu tools bench.py __graft_entry__.py
     fi
+    # graph lint sweep over the bundled model zoo (docs/graph_lint.md):
+    # every model must carry zero error-severity findings
+    JAX_PLATFORMS=cpu python tools/mxlint.py --all-models --fail-on=error
     ;;
   python)
     make -s all || echo "native build unavailable; python fallback"

@@ -1,0 +1,372 @@
+"""mxnet_tpu/analysis/: every lint rule gets a positive hit on a
+known-bad graph AND stays silent on the bundled clean models; plus the
+three wiring surfaces (Symbol.validate, the Executor validate= knob,
+analyze_json for saved graphs)."""
+import json
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import (GraphIssue, GraphLintWarning, analyze,
+                                analyze_json, max_severity)
+from mxnet_tpu.base import MXNetError
+
+
+def _ids(issues):
+    return {i.rule_id for i in issues}
+
+
+def _only(issues, rule_id):
+    return [i for i in issues if i.rule_id == rule_id]
+
+
+# ----------------------------------------------------------------------
+# clean models: no false positives
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("builder,shapes", [
+    (lambda: mx.models.get_mlp(), {"data": (32, 784)}),
+    (lambda: mx.models.get_alexnet(), {"data": (2, 3, 224, 224)}),
+])
+def test_clean_models_have_no_findings(builder, shapes):
+    issues = builder().validate(shapes=shapes)
+    assert issues == [], analysis.format_issues(issues)
+
+
+def test_clean_model_without_shapes_only_info():
+    """No shape hints: unknown shapes are expected, so MXL-S001 reports
+    at info severity and nothing else fires."""
+    issues = mx.models.get_mlp().validate()
+    assert all(i.severity == "info" for i in issues), issues
+    assert _ids(issues) <= {"MXL-S001"}
+
+
+# ----------------------------------------------------------------------
+# MXL-S / MXL-T: shape & dtype re-verification
+# ----------------------------------------------------------------------
+def test_s001_unknown_shape_is_warning_with_hints():
+    net = mx.models.get_mlp()
+    # a hint that leaves fc weights underdetermined: batch dim only
+    issues = net.validate(select={"MXL-S001"})
+    assert _only(issues, "MXL-S001"), "expected unknown-shape findings"
+
+
+def test_s002_contradictory_shapes():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=5, name="fc")
+    bad = fc + data          # (N, 5) + (N, 784): contradiction
+    issues = bad.validate(data=(8, 784))
+    hits = _only(issues, "MXL-S002")
+    assert hits and all(i.severity == "error" for i in hits)
+    # errors sort first
+    assert issues[0].severity == "error"
+
+
+def test_t001_mixed_float_widths():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = a + b
+    issues = out.validate(shapes={"a": (4, 4), "b": (4, 4)},
+                          type_dict={"a": np.float32, "b": jnp.bfloat16})
+    hits = _only(issues, "MXL-T001")
+    assert len(hits) == 1 and hits[0].severity == "warning"
+    assert "bfloat16" in hits[0].message
+    # uniform dtypes: silent
+    clean = out.validate(shapes={"a": (4, 4), "b": (4, 4)},
+                         type_dict={"a": np.float32, "b": np.float32})
+    assert not _only(clean, "MXL-T001")
+
+
+def test_t002_infer_type_failure():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc")
+
+    def boom(in_types):
+        raise TypeError("synthetic infer_type failure")
+
+    fc._heads[0][0].op.infer_type = boom
+    issues = fc.validate(data=(2, 8), select={"MXL-T002"})
+    hits = _only(issues, "MXL-T002")
+    assert hits and hits[0].severity == "error"
+    assert "synthetic" in hits[0].message
+
+
+# ----------------------------------------------------------------------
+# MXL-G: dead / unused / alias / duplicate names
+# ----------------------------------------------------------------------
+def _saved_graph_with_orphans():
+    """mlp JSON + one orphan op node (dead) + one orphan variable."""
+    graph = json.loads(mx.models.get_mlp().tojson())
+    n = len(graph["nodes"])
+    graph["nodes"].append({"op": "null", "name": "orphan_var",
+                           "attr": {}, "inputs": []})
+    graph["nodes"].append({"op": "Flatten", "name": "orphan_op",
+                           "attr": {}, "inputs": [[n, 0]]})
+    graph["arg_nodes"].append(n)
+    return graph
+
+
+def test_g001_g002_dead_nodes_in_saved_graph():
+    issues = analyze_json(_saved_graph_with_orphans())
+    dead = _only(issues, "MXL-G001")
+    unused = _only(issues, "MXL-G002")
+    assert [i.node for i in dead] == ["orphan_op"]
+    assert [i.node for i in unused] == ["orphan_var"]
+    # the clean round-trip has neither
+    assert not _ids(analyze_json(mx.models.get_mlp().tojson())) & \
+        {"MXL-G001", "MXL-G002"}
+
+
+def test_g002_ignored_bind_dict_keys():
+    net = mx.models.get_mlp()
+    issues = analyze(net, args={"data": None, "not_an_arg": None},
+                     select={"MXL-G002"})
+    hits = _only(issues, "MXL-G002")
+    assert len(hits) == 1 and "not_an_arg" in hits[0].message
+
+
+def test_g003_output_aliases_input():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=2, name="fc")
+    grouped = mx.sym.Group([fc, data])      # head 1 is a bare variable
+    hits = _only(grouped.validate(), "MXL-G003")
+    assert hits and hits[0].node == "data"
+    dup = mx.sym.Group([fc, fc])            # duplicate head
+    assert _only(dup.validate(), "MXL-G003")
+
+
+def test_g004_duplicate_node_names():
+    a = mx.sym.Variable("x")
+    f1 = mx.sym.FullyConnected(data=a, num_hidden=2, name="same")
+    f2 = mx.sym.FullyConnected(data=f1, num_hidden=2, name="same")
+    hits = _only(f2.validate(), "MXL-G004")
+    assert hits and hits[0].severity == "error"
+    assert "same" in hits[0].message
+
+
+# ----------------------------------------------------------------------
+# MXL-B: bind contract
+# ----------------------------------------------------------------------
+def _two_var_sum():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    return a + b
+
+
+def test_b001_shared_grad_buffer():
+    net = _two_var_sum()
+    g = mx.nd.zeros((4,))
+    issues = analyze(net, args_grad={"a": g, "b": g}, grad_req="write")
+    hits = _only(issues, "MXL-B001")
+    assert {i.node for i in hits} == {"a", "b"}
+    assert all(i.severity == "error" for i in hits)
+    # grad_req='add' on shared buffers is the supported pattern
+    assert not _only(analyze(net, args_grad={"a": g, "b": g},
+                             grad_req="add"), "MXL-B001")
+
+
+def test_b002_partial_args_grad():
+    net = _two_var_sum()
+    issues = analyze(net, args_grad={"a": mx.nd.zeros((4,))},
+                     grad_req="write")
+    hits = _only(issues, "MXL-B002")
+    assert [i.node for i in hits] == ["b"]
+    # all-None args_grad = intentional forward-only: silent
+    assert not _only(analyze(net, grad_req="write"), "MXL-B002")
+
+
+def test_b003_aux_name_collision():
+    data = mx.sym.Variable("data")
+    bn1 = mx.sym.BatchNorm(data=data, name="bn")
+    bn2 = mx.sym.BatchNorm(data=bn1, name="bn")
+    issues = analyze(bn2, grad_req="write")
+    assert _only(issues, "MXL-B003")
+    assert _only(issues, "MXL-G004")    # same root cause, both surfaced
+
+
+def test_b004_invalid_grad_req():
+    issues = analyze(_two_var_sum(), grad_req="wirte")   # typo'd "write"
+    hits = _only(issues, "MXL-B004")
+    assert hits and all(i.severity == "error" for i in hits)
+
+
+def test_b005_unmapped_ctx_group():
+    with mx.AttrScope(ctx_group="dev1"):
+        net = _two_var_sum()
+    issues = analyze(net, group2ctx={"dev2": mx.cpu()})
+    assert _only(issues, "MXL-B005")
+    # empty group2ctx: the attrs are inert, no finding
+    assert not _only(analyze(net), "MXL-B005")
+
+
+# ----------------------------------------------------------------------
+# MXL-L: TPU lowering lint
+# ----------------------------------------------------------------------
+def test_l001_unsupported_platform():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=2, name="fc")
+    fc._heads[0][0].op.unsupported_platforms = ("tpu",)
+    hits = _only(fc.validate(target="tpu"), "MXL-L001")
+    assert hits and hits[0].severity == "error"
+    assert not _only(fc.validate(target="cpu"), "MXL-L001")
+
+
+def test_l001_unregistered_op_in_saved_graph():
+    graph = json.loads(mx.models.get_mlp().tojson())
+    for spec in graph["nodes"]:
+        if spec["op"] == "FullyConnected":
+            spec["op"] = "NoSuchOp"
+            break
+    issues = analyze_json(graph)
+    hits = _only(issues, "MXL-L001")
+    assert hits and "NoSuchOp" in hits[0].message
+
+
+class _LintDemoProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+
+mx.operator.register("analysis_lintdemo")(_LintDemoProp)
+
+
+def test_l002_l003_host_callback():
+    data = mx.sym.Variable("data")
+    plain = mx.sym.Custom(data=data, op_type="analysis_lintdemo")
+    out = mx.sym.FullyConnected(data=plain, num_hidden=2, name="fc")
+    issues = out.validate(data=(2, 4))
+    assert _only(issues, "MXL-L003")          # info: fusion barrier
+    assert not _only(issues, "MXL-L002")      # not mirrored: no error
+
+    mirrored = mx.sym.Custom(data=data, op_type="analysis_lintdemo",
+                             attr={"force_mirroring": "1"})
+    out2 = mx.sym.FullyConnected(data=mirrored, num_hidden=2, name="fc")
+    hits = _only(out2.validate(data=(2, 4)), "MXL-L002")
+    assert hits and hits[0].severity == "error"
+
+
+def test_l004_sharding_axes_vs_mesh():
+    from jax.sharding import Mesh, PartitionSpec as P
+    from mxnet_tpu.parallel.sharding import ShardingRules
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    net = mx.models.get_mlp()
+    bad = ShardingRules([(r".*_weight", lambda s, m: P("mp", None))])
+    hits = _only(net.validate(data=(8, 784), mesh=mesh,
+                              sharding_rules=bad), "MXL-L004")
+    assert hits and all(i.severity == "error" for i in hits)
+    assert "mp" in hits[0].message
+    ok = ShardingRules([(r"fc1_weight", lambda s, m: P(None, "tp"))])
+    assert not _only(net.validate(data=(8, 784), mesh=mesh,
+                                  sharding_rules=ok), "MXL-L004")
+
+
+# ----------------------------------------------------------------------
+# framework: suppression, select/skip, ordering, issue type
+# ----------------------------------------------------------------------
+def test_suppression_via_node_attr():
+    data = mx.sym.Variable("data")
+    quiet = mx.sym.Custom(data=data, op_type="analysis_lintdemo",
+                          attr={"force_mirroring": "1",
+                                "__lint_ignore__": "MXL-L002,MXL-L003"})
+    out = mx.sym.FullyConnected(data=quiet, num_hidden=2, name="fc")
+    issues = out.validate(data=(2, 4))
+    assert not _ids(issues) & {"MXL-L002", "MXL-L003"}
+
+    all_quiet = mx.sym.Custom(data=data, op_type="analysis_lintdemo",
+                              attr={"force_mirroring": "1",
+                                    "__lint_ignore__": "all"})
+    out2 = mx.sym.FullyConnected(data=all_quiet, num_hidden=2, name="fc")
+    assert not _ids(out2.validate(data=(2, 4))) & {"MXL-L002", "MXL-L003"}
+
+
+def test_select_and_skip():
+    net = mx.models.get_mlp()
+    only = net.validate(select={"MXL-S001"})
+    assert _ids(only) <= {"MXL-S001"}
+    skipped = net.validate(skip={"MXL-S001"})
+    assert "MXL-S001" not in _ids(skipped)
+
+
+def test_issue_type_and_ordering():
+    i = GraphIssue("MXL-X999", "warning", "node1", "msg")
+    assert i.as_dict() == {"rule_id": "MXL-X999", "severity": "warning",
+                           "node": "node1", "message": "msg"}
+    assert "MXL-X999" in repr(i)
+    assert max_severity([]) is None
+    assert max_severity([i]) == "warning"
+    # registry sanity: every registered rule id is well-formed & unique
+    ids = list(analysis.RULE_REGISTRY)
+    assert len(ids) == len(set(ids))
+    assert all(r.startswith("MXL-") for r in ids)
+    assert all(analysis.RULE_REGISTRY[r].severity in analysis.SEVERITIES
+               for r in ids)
+
+
+# ----------------------------------------------------------------------
+# Executor wiring: validate="warn"|"error"|"off"
+# ----------------------------------------------------------------------
+def _bad_bind_kwargs():
+    net = _two_var_sum()
+    g = mx.nd.zeros((4,))
+    args = {"a": mx.nd.zeros((4,)), "b": mx.nd.zeros((4,))}
+    return net, dict(args=args, args_grad={"a": g, "b": g},
+                     grad_req="write")
+
+
+def test_bind_validate_default_warns():
+    net, kw = _bad_bind_kwargs()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        exe = net.bind(mx.cpu(), **kw)
+    lint = [w for w in rec if issubclass(w.category, GraphLintWarning)]
+    assert len(lint) == 1 and "MXL-B001" in str(lint[0].message)
+    assert {i.rule_id for i in exe.bind_issues} >= {"MXL-B001"}
+
+
+def test_bind_validate_error_raises():
+    net, kw = _bad_bind_kwargs()
+    with pytest.raises(MXNetError, match="MXL-B001"):
+        net.bind(mx.cpu(), validate="error", **kw)
+
+
+def test_bind_validate_off_is_silent():
+    net, kw = _bad_bind_kwargs()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        exe = net.bind(mx.cpu(), validate="off", **kw)
+    assert not [w for w in rec
+                if issubclass(w.category, GraphLintWarning)]
+    assert exe.bind_issues == []
+
+
+def test_bind_validate_env_default(monkeypatch):
+    monkeypatch.setenv("MXTPU_BIND_VALIDATE", "error")
+    net, kw = _bad_bind_kwargs()
+    with pytest.raises(MXNetError, match="bind validation failed"):
+        net.bind(mx.cpu(), **kw)
+
+
+def test_bind_validate_bad_mode_rejected():
+    net, kw = _bad_bind_kwargs()
+    with pytest.raises(MXNetError, match="validate"):
+        net.bind(mx.cpu(), validate="loud", **kw)
+
+
+def test_clean_bind_emits_no_warning():
+    net = mx.models.get_mlp()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        exe = net.simple_bind(mx.cpu(), data=(8, 784))
+    assert not [w for w in rec
+                if issubclass(w.category, GraphLintWarning)]
+    assert exe.bind_issues == []

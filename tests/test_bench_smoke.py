@@ -61,10 +61,12 @@ def test_bench_smoke_disabled_by_zero():
     assert "smoke" not in d
 
 def test_bench_replay_of_session_harvest(tmp_path):
-    """When every probe fails but a real-TPU measurement was banked
-    earlier in the session (by the chip watcher), the orchestrator must
-    replay it with explicit provenance markers instead of emitting a
-    meaningless CPU number."""
+    """When every probe fails, the operator opted in with
+    BENCH_ALLOW_REPLAY=1, and a real-TPU measurement was banked earlier
+    in the session (by the chip watcher), the orchestrator must replay
+    it with explicit provenance markers — including a metric renamed
+    with the _replayed suffix so naive consumers can't mistake it for a
+    fresh measurement — instead of emitting a meaningless CPU number."""
     import time
     harvest = {"metric": "resnet50_train_images_per_sec", "value": 2500.0,
                "unit": "images/sec", "vs_baseline": 14.7,
@@ -82,6 +84,7 @@ def test_bench_replay_of_session_harvest(tmp_path):
         "JAX_PLATFORMS": "__no_such_platform__",
         "BENCH_PROBE_RETRIES": "1",
         "BENCH_PROBE_TIMEOUT": "60",
+        "BENCH_ALLOW_REPLAY": "1",
         "BENCH_SESSION_HARVEST": str(path),
         "PYTHONPATH": _ROOT,  # no ambient site dirs: never touch a real backend
     })
@@ -92,6 +95,7 @@ def test_bench_replay_of_session_harvest(tmp_path):
     d = json.loads([l for l in p.stdout.splitlines()
                     if l.startswith("{")][-1])
     assert d["platform"] == "tpu" and d["value"] == 2500.0
+    assert d["metric"] == "resnet50_train_images_per_sec_replayed", d
     assert d["replayed_from_session_harvest"] is True
     assert "banked_at_utc" in d and "banked at" in d["note"]
 
@@ -130,6 +134,8 @@ def test_bench_replay_rejects_smoke_and_stale(tmp_path):
         "BENCH_SECONDARY": "0",
         "PYTHONPATH": _ROOT,  # no ambient site dirs: never touch a real backend
     })
+    # opted in: the rejections below must hold even when replay is allowed
+    env_base["BENCH_ALLOW_REPLAY"] = "1"
     cases = {
         "smoke": {"metric": "smoke_resnet18_step_ms", "value": 100.0,
                   "smoke": True, "platform": "tpu",
@@ -158,3 +164,22 @@ def test_bench_replay_rejects_smoke_and_stale(tmp_path):
         d = json.loads([l for l in p.stdout.splitlines()
                         if l.startswith("{")][-1])
         assert "replayed_from_session_harvest" not in d, (name, d)
+
+    # a fully eligible harvest without the BENCH_ALLOW_REPLAY=1 opt-in
+    # must also fall through to a fresh measurement
+    harvest = {"metric": "resnet50_train_images_per_sec", "value": 2500.0,
+               "platform": "tpu",
+               "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                time.gmtime())}
+    path = tmp_path / "eligible.json"
+    path.write_text(json.dumps(harvest) + "\n")
+    env = dict(env_base)
+    env.pop("BENCH_ALLOW_REPLAY")
+    env["BENCH_SESSION_HARVEST"] = str(path)
+    p = subprocess.run([sys.executable, os.path.join(_ROOT, "bench.py")],
+                       env=env, capture_output=True, text=True, timeout=500)
+    assert p.returncode == 0, p.stderr[-1500:]
+    d = json.loads([l for l in p.stdout.splitlines()
+                    if l.startswith("{")][-1])
+    assert "replayed_from_session_harvest" not in d, d
+    assert d.get("platform") == "cpu"

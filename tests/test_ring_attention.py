@@ -50,6 +50,29 @@ def test_flash_kernel_interpret_matches_reference(causal):
                         rtol=1e-4, atol=1e-5)
 
 
+def test_flash_force_ignored_outside_aot_scope(monkeypatch):
+    """A leaked MXTPU_FLASH_FORCE on a cpu backend must fall back to the
+    reference path (forcing Mosaic there aborts execution); inside
+    aot_lowering_scope() the override is honored for compile-only
+    lowering."""
+    from mxnet_tpu.parallel import ring_attention as ra
+    monkeypatch.setenv("MXTPU_FLASH_FORCE", "1")
+    q, k, v = _qkv(B=1, H=2, S=256, D=8)   # multiple of the 128 blocks
+    want = attention_reference(q, k, v)
+    got = flash_attention(q, k, v)   # env leaked, no scope: reference
+    assert_almost_equal(np.asarray(got), np.asarray(want),
+                        rtol=1e-4, atol=1e-5)
+    # inside the scope the override IS honored: flash_attention takes
+    # the Mosaic kernel path, which the cpu backend cannot lower — the
+    # error (instead of a silent reference fallback) proves the branch
+    with ra.aot_lowering_scope():
+        assert ra._AOT_LOWERING_DEPTH == 1
+        with pytest.raises(Exception):
+            jax.jit(lambda a, b, c: flash_attention(a, b, c)
+                    ).lower(q, k, v)
+    assert ra._AOT_LOWERING_DEPTH == 0
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_full(causal):
     n_sp = 4

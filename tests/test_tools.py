@@ -382,3 +382,70 @@ def test_mfu_audit_smoke():
     assert audit["logical_transposes"] <= 5
     assert audit["donation_alias_bytes"] > 0
     assert audit["model_tflops_per_step"] > 0
+
+
+# ----------------------------------------------------------------------
+# tools/mxlint.py: the static graph linter CLI
+# ----------------------------------------------------------------------
+def _mxlint(*argv, timeout=240):
+    return _run_tool(os.path.join(ROOT, "tools", "mxlint.py"), *argv,
+                     timeout=timeout)
+
+
+def test_mxlint_list_rules():
+    p = _mxlint("--list-rules")
+    assert p.returncode == 0, p.stderr
+    assert "MXL-S002" in p.stdout and "MXL-L001" in p.stdout
+
+
+def test_mxlint_clean_json_exits_zero(tmp_path):
+    path = tmp_path / "mlp.json"
+    mx.models.get_mlp().save(str(path))
+    p = _mxlint(str(path), "--shapes", "data=(8,784)",
+                "--fail-on=warning")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "clean" in p.stdout
+
+
+def test_mxlint_shape_conflict_exits_one(tmp_path):
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=5, name="fc")
+    (fc + data).save(str(tmp_path / "bad.json"))
+    p = _mxlint(str(tmp_path / "bad.json"), "--shapes", "data=(8,784)")
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "MXL-S002" in p.stdout
+    # --fail-on=never reports but never gates
+    p = _mxlint(str(tmp_path / "bad.json"), "--shapes", "data=(8,784)",
+                "--fail-on=never")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "MXL-S002" in p.stdout
+
+
+def test_mxlint_dead_node_in_saved_graph(tmp_path):
+    import json as _json
+    graph = _json.loads(mx.models.get_mlp().tojson())
+    n = len(graph["nodes"])
+    graph["nodes"].append({"op": "null", "name": "orphan_var",
+                           "attr": {}, "inputs": []})
+    graph["nodes"].append({"op": "Flatten", "name": "orphan_op",
+                           "attr": {}, "inputs": [[n, 0]]})
+    graph["arg_nodes"].append(n)
+    path = tmp_path / "dead.json"
+    path.write_text(_json.dumps(graph))
+    p = _mxlint(str(path), "--fail-on=warning", "--format", "json")
+    assert p.returncode == 1, p.stdout + p.stderr
+    doc = _json.loads(p.stdout)
+    ids = {i["rule_id"] for t in doc for i in t["issues"]}
+    assert {"MXL-G001", "MXL-G002"} <= ids
+
+
+def test_mxlint_model_sweep_single():
+    p = _mxlint("--model", "mlp", "--fail-on=warning")
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_mxlint_usage_errors_exit_two(tmp_path):
+    p = _mxlint("--model", "no_such_model")
+    assert p.returncode == 2, p.stdout + p.stderr
+    p = _mxlint(str(tmp_path / "missing.json"))
+    assert p.returncode == 2, p.stdout + p.stderr

@@ -32,10 +32,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def _peaks_for(device_kind):
     """(peak_flops, peak_hbm_bytes_s) for the topology's device kind,
-    from bench.py's single spec table (no second copy to drift)."""
+    through bench.py's lookup helpers (single spec table, and the
+    BENCH_PEAK_TFLOPS/BENCH_PEAK_HBM_GBPS env overrides apply here the
+    same as in the bench itself)."""
     import bench
-    tf = bench._lookup_peak(bench._PEAK_TFLOPS, device_kind)
-    gb = bench._lookup_peak(bench._PEAK_HBM_GBPS, device_kind)
+    tf, _tf_note = bench._lookup_peak_tflops(device_kind)
+    gb, _gb_note = bench._lookup_peak_hbm(device_kind)
     if tf is None or gb is None:
         return None, None
     return tf * 1e12, gb * 1e9

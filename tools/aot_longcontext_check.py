@@ -43,8 +43,21 @@ def main():
 
     # the production MHA path resolves flash-vs-reference from the
     # ambient backend (cpu here); force the Mosaic kernel so the fused
-    # transformer compiles the SAME graph the real chip runs
+    # transformer compiles the SAME graph the real chip runs.  The
+    # override only takes effect inside aot_lowering_scope() — and is
+    # unset again on exit so a child process / later import can't
+    # inherit it and force Mosaic onto real cpu execution.
+    from mxnet_tpu.parallel.ring_attention import aot_lowering_scope
     os.environ["MXTPU_FLASH_FORCE"] = "1"
+    try:
+        with aot_lowering_scope():
+            return _run(args, np, jax, jnp, Mesh, NamedSharding, P,
+                        topology_devices)
+    finally:
+        os.environ.pop("MXTPU_FLASH_FORCE", None)
+
+
+def _run(args, np, jax, jnp, Mesh, NamedSharding, P, topology_devices):
     devs = topology_devices(args.topology)
     if devs is None:
         print(json.dumps({"error": "topology unavailable",

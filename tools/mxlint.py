@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""mxlint: standalone static lint for Symbol graphs.
+
+Runs the mxnet_tpu/analysis/ pass framework outside any training
+process — over saved symbol JSON files (the only place dead nodes can
+still exist: the in-memory loader silently drops them) and over the
+bundled model zoo, so CI can gate every change on a clean lint sweep:
+
+  python tools/mxlint.py model-symbol.json --shapes "data=(8,3,224,224)"
+  python tools/mxlint.py --model resnet --model mlp
+  python tools/mxlint.py --all-models --fail-on=error     # the CI sweep
+
+Exit codes: 0 = nothing at/above --fail-on severity, 1 = findings at or
+above it, 2 = usage/load failure.  --fail-on=never always exits 0 (report
+only).  Rule catalog and suppression attrs: docs/graph_lint.md.
+"""
+import argparse
+import ast
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+# the zoo sweep: builder kwargs keep the big nets at lint-friendly sizes
+# (analysis is metadata-only — no tracing, no compute — so the cost is
+# a python graph walk either way; small configs keep CI latency flat)
+MODEL_SWEEP = [
+    ("mlp", {}, {"data": (32, 784)}),
+    ("lenet", {}, {"data": (32, 1, 28, 28)}),
+    ("alexnet", {}, {"data": (2, 3, 224, 224)}),
+    ("vgg", {"num_layers": 16}, {"data": (2, 3, 224, 224)}),
+    ("googlenet", {}, {"data": (2, 3, 224, 224)}),
+    ("inception_bn", {}, {"data": (2, 3, 224, 224)}),
+    ("inception_v3", {}, {"data": (2, 3, 299, 299)}),
+    ("resnet", {"num_layers": 18}, {"data": (2, 3, 224, 224)}),
+    ("transformer",
+     {"vocab_size": 512, "num_layers": 2, "num_heads": 4, "dim": 64,
+      "seq_len": 64},
+     {"data": (2, 64), "softmax_label": (2, 64)}),
+]
+
+
+def parse_shapes(specs):
+    """--shapes "data=(8,3,224,224),label=(8,)" -> {name: tuple}."""
+    out = {}
+    for spec in specs or ():
+        # split on commas that END a parenthesized tuple, not inside one
+        depth, start = 0, 0
+        parts = []
+        for i, ch in enumerate(spec):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                parts.append(spec[start:i])
+                start = i + 1
+        parts.append(spec[start:])
+        for part in parts:
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError("bad --shapes entry %r (want name=(d,...))"
+                                 % part)
+            name, val = part.split("=", 1)
+            shape = ast.literal_eval(val.strip())
+            if isinstance(shape, int):
+                shape = (shape,)
+            out[name.strip()] = tuple(int(d) for d in shape)
+    return out
+
+
+def lint_file(path, shapes, target, select, skip):
+    """Lint one saved symbol JSON; returns (label, issues)."""
+    from mxnet_tpu.analysis import analyze_json
+    with open(path) as f:
+        src = f.read()
+    return path, analyze_json(src, shapes=shapes, target=target,
+                              select=select, skip=skip)
+
+
+def build_model(name, kwargs):
+    import importlib
+    mod = importlib.import_module("mxnet_tpu.models.%s" % name)
+    if not hasattr(mod, "get_symbol"):
+        raise ValueError("model %r has no get_symbol builder" % name)
+    return mod.get_symbol(**kwargs)
+
+
+def lint_model(name, kwargs, shapes, target, select, skip):
+    from mxnet_tpu.analysis import analyze
+    sym = build_model(name, kwargs)
+    return "model:%s" % name, analyze(sym, shapes=shapes, target=target,
+                                      select=select, skip=skip)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("files", nargs="*", help="saved symbol JSON files")
+    ap.add_argument("--model", action="append", default=[],
+                    help="lint a bundled mxnet_tpu/models/<name> network "
+                         "(repeatable)")
+    ap.add_argument("--all-models", action="store_true",
+                    help="lint every bundled network (the CI sweep)")
+    ap.add_argument("--shapes", action="append", default=[],
+                    metavar="name=(d,...)",
+                    help="input shape hints, e.g. data=(8,3,224,224)")
+    ap.add_argument("--fail-on", default="error",
+                    choices=("error", "warning", "info", "never"),
+                    help="exit 1 when findings at/above this severity "
+                         "exist (default: error)")
+    ap.add_argument("--select", action="append", default=[],
+                    help="run only these rule ids (repeatable)")
+    ap.add_argument("--skip", action="append", default=[],
+                    help="skip these rule ids (repeatable)")
+    ap.add_argument("--target", default="tpu",
+                    help="lowering target platform (default: tpu)")
+    ap.add_argument("--format", default="text", choices=("text", "json"),
+                    dest="fmt")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.analysis import (RULE_REGISTRY, SEVERITY_RANK,
+                                    format_issues)
+
+    if args.list_rules:
+        for rule in RULE_REGISTRY.values():
+            print("%-9s %-8s %s" % (rule.rule_id, rule.severity, rule.doc))
+        return 0
+
+    if not args.files and not args.model and not args.all_models:
+        ap.error("nothing to lint: pass JSON files, --model, or "
+                 "--all-models")
+
+    try:
+        shapes = parse_shapes(args.shapes)
+    except (ValueError, SyntaxError) as exc:
+        print("mxlint: %s" % exc, file=sys.stderr)
+        return 2
+
+    select = set(args.select) or None
+    skip = set(args.skip) or None
+    targets = []    # (label, issues)
+    try:
+        for path in args.files:
+            targets.append(lint_file(path, shapes, args.target, select,
+                                     skip))
+        sweep = list(MODEL_SWEEP) if args.all_models else []
+        for name in args.model:
+            row = next((r for r in MODEL_SWEEP if r[0] == name),
+                       (name, {}, {}))
+            if row not in sweep:
+                sweep.append(row)
+        for name, kwargs, default_shapes in sweep:
+            targets.append(lint_model(name, kwargs,
+                                      shapes or default_shapes,
+                                      args.target, select, skip))
+    except (IOError, OSError, ValueError, ImportError) as exc:
+        print("mxlint: %s" % exc, file=sys.stderr)
+        return 2
+
+    worst = None
+    if args.fmt == "json":
+        doc = []
+        for label, issues in targets:
+            doc.append({"target": label,
+                        "issues": [i.as_dict() for i in issues]})
+        print(json.dumps(doc, indent=2))
+    for label, issues in targets:
+        if args.fmt == "text":
+            verdict = ("clean" if not issues
+                       else "%d issue(s)" % len(issues))
+            print("== %s: %s" % (label, verdict))
+            if issues:
+                print(format_issues(issues))
+        for i in issues:
+            if worst is None or \
+                    SEVERITY_RANK[i.severity] > SEVERITY_RANK[worst]:
+                worst = i.severity
+    if args.fail_on != "never" and worst is not None and \
+            SEVERITY_RANK[worst] >= SEVERITY_RANK[args.fail_on]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
