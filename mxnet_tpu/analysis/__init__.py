@@ -35,7 +35,22 @@ MXL-L001  error     op has no JAX lowering for the target platform
 MXL-L002  error     host callback inside a mirrored segment
 MXL-L003  info      host-callback op breaks fusion
 MXL-L004  error     sharding spec references axes missing from mesh
+MXL-P001  error     sharding conflict forces an implicit reshard
+MXL-P002  warning   sharded value consumed replicated (all-gather)
+MXL-P003  info      parameter degraded to replicated (not divisible)
+MXL-P004  info      sharded contraction: XLA inserts matching psum
+MXL-M001  error     estimated peak HBM exceeds per-device budget
+MXL-M002  warning   replicated parameter dominates the HBM budget
+MXL-C001  error     kvstore scope does not match the mesh scope
+MXL-C002  error     collective crosses a pipeline-stage boundary
+MXL-C003  warning   tp-sharded matmul missing its matching reduction
 ========  ========  ==================================================
+
+The MXL-P/M/C families only activate with SPMD context: pass ``mesh``
+(a ``jax.sharding.Mesh`` or the device-less ``parallel.LogicalMesh``)
+to enable propagation, plus ``hbm_bytes``/``MXTPU_HBM_GB`` for the
+memory budget and ``kvstore`` for the scope audit.  ``select``/``skip``
+accept fnmatch wildcards (``MXL-P*``).
 
 Suppress per node with the ``__lint_ignore__`` attr (comma-separated
 rule ids, or ``all``).
@@ -53,11 +68,17 @@ from . import shapes as _shapes      # noqa: F401
 from . import graph as _graph        # noqa: F401
 from . import bind as _bind          # noqa: F401
 from . import lowering as _lowering  # noqa: F401
+from . import propagation as _propagation  # noqa: F401
+from . import memory as _memory      # noqa: F401
+from . import collectives as _collectives  # noqa: F401
+from .propagation import comm_report
+from .memory import peak_hbm_report, hbm_capacity_bytes
 
 __all__ = ["GraphIssue", "AnalysisContext", "Rule", "RULE_REGISTRY",
            "register_rule", "run_rules", "format_issues", "SEVERITIES",
            "SEVERITY_RANK", "analyze", "analyze_json", "max_severity",
-           "GraphLintWarning"]
+           "GraphLintWarning", "comm_report", "peak_hbm_report",
+           "hbm_capacity_bytes"]
 
 
 class GraphLintWarning(UserWarning):
@@ -67,20 +88,29 @@ class GraphLintWarning(UserWarning):
 def analyze(symbol, shapes=None, type_dict=None, args=None, args_grad=None,
             grad_req=None, aux_states=None, group2ctx=None, mesh=None,
             sharding_rules=None, target="tpu", json_graph=None,
-            select=None, skip=None):
+            kvstore=None, hbm_bytes=None, data_names=None,
+            label_names=None, select=None, skip=None, _ctx_out=None):
     """Run the lint passes over ``symbol``; returns issues, errors first.
 
-    Parameters mirror what the two call surfaces know: ``Symbol.validate``
+    Parameters mirror what the call surfaces know: ``Symbol.validate``
     passes shape/type/mesh hints, the Executor bind hook adds
     args/args_grad/grad_req/aux_states/group2ctx, and the CLI adds the
-    raw ``json_graph`` dict of a saved symbol.  ``select``/``skip``
-    restrict which rule ids run.
+    raw ``json_graph`` dict of a saved symbol plus the SPMD context
+    (``mesh``/``kvstore``/``hbm_bytes``; ``data_names``/``label_names``
+    steer the sharding seeds).  ``select``/``skip`` restrict which rule
+    ids run (fnmatch wildcards like ``MXL-P*`` work).  ``_ctx_out``, when
+    a list, receives the AnalysisContext so callers (the CLI's cost
+    report) can reuse the cached propagation/memory facts.
     """
     ctx = AnalysisContext(symbol, shapes=shapes, type_dict=type_dict,
                           args=args, args_grad=args_grad, grad_req=grad_req,
                           aux_states=aux_states, group2ctx=group2ctx,
                           mesh=mesh, sharding_rules=sharding_rules,
-                          target=target, json_graph=json_graph)
+                          target=target, json_graph=json_graph,
+                          kvstore=kvstore, hbm_bytes=hbm_bytes,
+                          data_names=data_names, label_names=label_names)
+    if _ctx_out is not None:
+        _ctx_out.append(ctx)
     return run_rules(ctx, select=select, skip=skip)
 
 

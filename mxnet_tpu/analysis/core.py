@@ -25,6 +25,7 @@ Per-node suppression rides on node attrs (the same channel as
 """
 from __future__ import annotations
 
+import fnmatch
 from collections import OrderedDict
 
 __all__ = ["GraphIssue", "AnalysisContext", "Rule", "RULE_REGISTRY",
@@ -118,7 +119,8 @@ class AnalysisContext(object):
     def __init__(self, symbol, shapes=None, type_dict=None, args=None,
                  args_grad=None, grad_req=None, aux_states=None,
                  group2ctx=None, mesh=None, sharding_rules=None,
-                 target="tpu", json_graph=None):
+                 target="tpu", json_graph=None, kvstore=None,
+                 hbm_bytes=None, data_names=None, label_names=None):
         self.symbol = symbol
         self.shapes = dict(shapes or {})        # arg name -> shape tuple
         self.type_dict = dict(type_dict or {})  # arg name -> dtype
@@ -131,7 +133,16 @@ class AnalysisContext(object):
         self.sharding_rules = sharding_rules
         self.target = target
         self.json_graph = json_graph            # raw dict of a saved symbol
+        self.kvstore = kvstore                  # kvstore type str (MXL-C001)
+        self.hbm_bytes = hbm_bytes              # per-device budget (MXL-M001)
+        # which variables are batch tensors (batch_pspec) vs parameters
+        # (param_pspec) when seeding the SPMD propagation — mirrors the
+        # ShardedTrainer's data/label split
+        self.data_names = tuple(data_names) if data_names else ("data",)
+        self.label_names = (tuple(label_names) if label_names
+                            else ("softmax_label",))
         self.topo = symbol._topo() if symbol is not None else []
+        self.cache = {}                         # cross-pass memo (propagation)
         self._rule = None                       # set by run_rules
         self._issues = []
 
@@ -175,18 +186,25 @@ class AnalysisContext(object):
         return [n for n in self.topo if n.is_variable]
 
 
+def _matches(rule_id, patterns):
+    """True when any pattern matches: exact ids and fnmatch wildcards
+    (``MXL-P*``) both work."""
+    return any(fnmatch.fnmatchcase(rule_id, p) for p in patterns)
+
+
 def run_rules(ctx, select=None, skip=None):
     """Run registered passes over ``ctx``; returns issues, errors first.
 
-    ``select``/``skip`` are iterables of rule ids filtering which passes
-    run (select wins over skip when both name a rule).
+    ``select``/``skip`` are iterables of rule ids — or fnmatch-style
+    wildcards like ``MXL-P*`` — filtering which passes run (select wins
+    over skip when both name a rule).
     """
-    select = set(select) if select is not None else None
-    skip = set(skip or ())
+    select = list(select) if select is not None else None
+    skip = list(skip or ())
     for rule_id, rule in RULE_REGISTRY.items():
-        if select is not None and rule_id not in select:
+        if select is not None and not _matches(rule_id, select):
             continue
-        if select is None and rule_id in skip:
+        if select is None and _matches(rule_id, skip):
             continue
         ctx._rule = rule_id
         try:

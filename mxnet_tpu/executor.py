@@ -450,6 +450,7 @@ class Executor:
         issues = analyze(
             self._symbol,
             shapes={n: tuple(a.shape) for n, a in self.arg_dict.items()},
+            type_dict={n: a.dtype for n, a in self.arg_dict.items()},
             args=args, args_grad=args_grad, grad_req=grad_req,
             aux_states=aux_states, group2ctx=self._group2ctx,
             target=self._ctx.device_type)

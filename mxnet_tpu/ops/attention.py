@@ -14,7 +14,8 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..dparam import Field, ParamStruct
-from .registry import OperatorProperty, register_op, require_known
+from .registry import (OperatorProperty, register_op, require_known,
+                       contract_sharding, dedup_axes)
 
 
 class _LayerNormParam(ParamStruct):
@@ -46,6 +47,13 @@ class LayerNorm(OperatorProperty):
         shape = [1] * x.ndim
         shape[ax] = x.shape[ax]
         return [y * gamma.reshape(shape) + beta.reshape(shape)], None
+
+    def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
+        data = in_specs[0]
+        ax = self.param.axis % len(data) if data else 0
+        norm = data[ax] if data else ()
+        return {"out": [tuple(data)],
+                "in": [None, (norm,), (norm,)]}
 
 
 class _MHAParam(ParamStruct):
@@ -108,3 +116,50 @@ class MultiHeadAttention(OperatorProperty):
             mask = jax.random.bernoulli(rng, keep, o.shape)
             o = jnp.where(mask, o / keep, 0.0).astype(o.dtype)
         return [o @ wo.T + bo], None
+
+    def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
+        data, qkv_w = in_specs[0], in_specs[1]
+        out_w = in_specs[3]
+        required = [None] * len(in_specs)
+        reduce = {}
+        notes = []
+        # input projection: data feature dim contracts against qkv_w dim 1
+        d_c = data[2] if len(data) > 2 else ()
+        w_c = qkv_w[1] if len(qkv_w) > 1 else ()
+        r, n, conflict = contract_sharding(d_c, w_c, 0, 1,
+                                           "MultiHeadAttention qkv")
+        reduce.update(r)
+        notes.extend(n)
+        if conflict:
+            required[0] = (tuple(data[0]), tuple(data[1]), tuple(w_c))
+        # head-parallel attention (qkv_w dim 0 over tp = heads split) must
+        # be closed by a row-parallel out projection (out_w dim 1 on the
+        # same axis) whose psum merges the per-head partial outputs
+        head = tuple(qkv_w[0] if qkv_w else ())
+        out_c = tuple(out_w[1] if len(out_w) > 1 else ())
+        if head and head == out_c:
+            reduce[head] = ("head-parallel attention closed by row-parallel "
+                            "out projection: partial sums over %s"
+                            % "+".join(head))
+        elif head or out_c:
+            axes = head or out_c
+            notes.append({
+                "kind": "attn_unreduced", "arg": 1 if head else 3,
+                "axes": axes,
+                "message": "attention is head-parallel over %s but the out "
+                           "projection does not close it with a matching "
+                           "row-parallel reduction: XLA all-gathers the "
+                           "per-head activations instead" % "+".join(axes)})
+        required[2] = (head,)
+        batch = tuple(data[0] if data else ())
+        seq = tuple(data[1] if len(data) > 1 else ())
+        feat = dedup_axes(out_w[0] if out_w else (), batch + seq)
+        if head and head == out_c:
+            feat = ()          # row-parallel out proj: output replicated
+        required[4] = (feat,)
+        out = {"out": [(batch, seq, feat)], "in": required}
+        if reduce:
+            out["reduce"] = reduce
+        if notes:
+            out["notes"] = notes
+        return out

@@ -65,6 +65,16 @@ class SoftmaxOutput(OperatorProperty):
         _softmax_out.defvjp(_fwd, _bwd)
         return [_softmax_out(inputs[0], inputs[1])], None
 
+    def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
+        data = in_specs[0]
+        # label aligns with data's LEADING dims (batch[, spatial]), not by
+        # numpy trailing-broadcast: label (B,) matches data (B, C)
+        if self.param.multi_output:
+            label = (tuple(data[0]),) + tuple(tuple(e) for e in data[2:])
+        else:
+            label = tuple(tuple(e) for e in data[:len(in_specs[1])])
+        return {"out": [tuple(data)], "in": [None, label]}
+
     def _softmax(self, data):
         if self.param.multi_output:
             return jax.nn.softmax(data, axis=1)

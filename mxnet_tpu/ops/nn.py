@@ -17,7 +17,8 @@ from jax import lax
 
 from ..base import MXNetError
 from ..dparam import Field, ParamStruct
-from .registry import OperatorProperty, register_op, require_known
+from .registry import (OperatorProperty, register_op, require_known,
+                       contract_sharding, dedup_axes, reshape_carry)
 
 
 # ----------------------------------------------------------------------
@@ -142,6 +143,31 @@ class FullyConnected(OperatorProperty):
             y = y + inputs[2]
         return [y], None
 
+    def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
+        data, weight = in_specs[0], in_specs[1]
+        # forward flattens data[1:]: any sharded non-batch dim is part of
+        # the contraction against weight dim 1
+        c_idx = next((i for i in range(1, len(data)) if data[i]), None)
+        d_c = data[c_idx] if c_idx is not None else ()
+        w_c = weight[1] if len(weight) > 1 else ()
+        reduce, notes, conflict = contract_sharding(
+            d_c, w_c, 0, 1, "FullyConnected")
+        required = [None] * len(in_specs)
+        if conflict:
+            req = list(data)
+            req[c_idx] = w_c
+            required[0] = tuple(req)
+        batch = data[0] if data else ()
+        cols = dedup_axes(weight[0] if weight else (), batch)
+        if not self.param.no_bias and len(required) > 2:
+            required[2] = (cols,)
+        out = {"out": [(tuple(batch), cols)], "in": required}
+        if reduce:
+            out["reduce"] = reduce
+        if notes:
+            out["notes"] = notes
+        return out
+
 
 # ----------------------------------------------------------------------
 # Convolution / Deconvolution
@@ -223,6 +249,31 @@ class Convolution(OperatorProperty):
         if not p.no_bias:
             y = y + inputs[2].reshape((1, -1) + (1,) * len(k))
         return [y], None
+
+    def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
+        data, weight = in_specs[0], in_specs[1]
+        # input channels (data dim 1 x weight dim 1) are the contraction;
+        # spatial dims stay replicated (halo exchange is out of scope)
+        d_c = data[1] if len(data) > 1 else ()
+        w_c = weight[1] if len(weight) > 1 else ()
+        reduce, notes, conflict = contract_sharding(
+            d_c, w_c, 0, 1, "Convolution")
+        required = [None] * len(in_specs)
+        if conflict:
+            req = list(data)
+            req[1] = w_c
+            required[0] = tuple(req)
+        batch = data[0] if data else ()
+        cols = dedup_axes(weight[0] if weight else (), batch)
+        if not self.param.no_bias and len(required) > 2:
+            required[2] = (cols,)
+        spec = (tuple(batch), cols) + ((),) * (len(out_shapes[0]) - 2)
+        out = {"out": [spec], "in": required}
+        if reduce:
+            out["reduce"] = reduce
+        if notes:
+            out["notes"] = notes
+        return out
 
 
 class _DeconvParam(_ConvParam):
@@ -405,6 +456,12 @@ class BatchNorm(OperatorProperty):
             gamma.reshape(bshape) + beta.reshape(bshape)
         return [out], aux_updates
 
+    def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
+        data = in_specs[0]
+        chan = data[1] if len(data) > 1 else ()
+        return {"out": [tuple(data)],
+                "in": [None, (chan,), (chan,)]}
+
 
 # ----------------------------------------------------------------------
 # Dropout
@@ -440,6 +497,10 @@ class Flatten(OperatorProperty):
 
     def forward(self, inputs, aux, is_train, rng):
         return [inputs[0].reshape((inputs[0].shape[0], -1))], None
+
+    def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
+        return {"out": [reshape_carry(in_specs[0], in_shapes[0],
+                                      out_shapes[0], mesh_shape)]}
 
 
 class _ReshapeParam(ParamStruct):
@@ -484,6 +545,10 @@ class Reshape(OperatorProperty):
 
     def forward(self, inputs, aux, is_train, rng):
         return [inputs[0].reshape(self._target(inputs[0].shape))], None
+
+    def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
+        return {"out": [reshape_carry(in_specs[0], in_shapes[0],
+                                      out_shapes[0], mesh_shape)]}
 
 
 class _ConcatParam(ParamStruct):
@@ -643,6 +708,19 @@ class Embedding(OperatorProperty):
     def forward(self, inputs, aux, is_train, rng):
         ids = inputs[0].astype(jnp.int32)
         return [jnp.take(inputs[1], ids, axis=0)], None
+
+    def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
+        data, weight = in_specs[0], in_specs[1]
+        used = [a for e in data for a in e]
+        feat = dedup_axes(weight[1] if len(weight) > 1 else (), used)
+        out = {"out": [tuple(data) + (feat,)]}
+        vocab = tuple(weight[0] if weight else ())
+        if vocab:
+            # vocab-sharded table: each shard gathers local hits only and
+            # the partial one-hot matmul is psummed across the axis
+            out["reduce"] = {vocab: "vocab-sharded Embedding lookup: each "
+                                    "shard contributes rows it owns"}
+        return out
 
 
 # ----------------------------------------------------------------------

@@ -18,7 +18,9 @@ from ..base import MXNetError
 from ..registry import Registry
 
 __all__ = ["OperatorProperty", "register_op", "create_operator", "OP_REGISTRY",
-           "require_known"]
+           "require_known", "SHARDING_XFER", "register_sharding_rule",
+           "sharding_transfer", "contract_sharding", "dedup_axes",
+           "reshape_carry"]
 
 OP_REGISTRY = Registry("operator")
 
@@ -128,6 +130,175 @@ class OperatorProperty:
         return ([base] * n_in, [base] * self.num_outputs,
                 [base] * len(self.list_auxiliary_states()))
 
+    # -- SPMD sharding transfer (analysis/propagation.py) ------------------
+    def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
+        """Forward PartitionSpec transfer rule, registered alongside the
+        lowering metadata above so an op's semantics and its sharding
+        behavior live in one place.
+
+        Specs here are NORMALIZED: one entry per dim, each entry a tuple
+        of mesh-axis names (``()`` = replicated on that dim).
+        ``mesh_shape`` maps axis name -> size.  Returns a dict:
+
+        - ``out``    list of specs, one per output (required);
+        - ``in``     required/resolved input layouts (``None`` entries =
+          unconstrained).  The propagation pass diffs each actual input
+          spec against this: replicated->sharded is a free reslice,
+          sharded->replicated is an implicit all-gather (MXL-P002), two
+          different axes on one dim is a forced reshard (MXL-P001);
+        - ``reduce`` ``{axes: reason}`` — the output is a partial sum
+          over those mesh axes (sharded contraction) and XLA inserts the
+          matching psum (MXL-P004; audited by MXL-C003);
+        - ``notes``  list of dicts ``{kind, arg, axes, message}`` —
+          structural findings for the MXL-C pass (``matmul_gather``,
+          ``attn_unreduced``).
+
+        Default: dim-for-dim carry from input 0 onto every output where
+        the dim size is unchanged; no constraints, no reductions.  Ops
+        with real dataflow structure (matmuls, embeddings, reshapes,
+        losses) override this next to their shape rules.
+        """
+        base = in_specs[0] if in_specs else ()
+        base_shape = in_shapes[0] if in_shapes else None
+        outs = []
+        for oshape in out_shapes:
+            spec = [()] * len(oshape)
+            if base_shape is not None:
+                for d in range(min(len(oshape), len(base_shape))):
+                    if base[d] and oshape[d] == base_shape[d]:
+                        spec[d] = base[d]
+            outs.append(tuple(spec))
+        return {"out": outs}
+
     # -- compute -----------------------------------------------------------
     def forward(self, inputs, aux, is_train, rng):
         raise NotImplementedError(self.op_name)
+
+
+# ----------------------------------------------------------------------
+# sharding transfer registry: name-keyed rules for ops whose classes are
+# factory-generated (elementwise binaries) or live outside ops/ — the
+# analyzer resolves SHARDING_XFER first, then the class method.
+# ----------------------------------------------------------------------
+SHARDING_XFER = {}      # op_name -> fn(op, in_specs, in_shapes, out_shapes, mesh_shape)
+
+
+def register_sharding_rule(*op_names):
+    """Function decorator: register a sharding transfer rule (same
+    contract as ``OperatorProperty.infer_sharding``, with the op
+    instance as first argument) under one or more op names."""
+    def _wrap(fn):
+        for n in op_names:
+            SHARDING_XFER[n] = fn
+        return fn
+    return _wrap
+
+
+def sharding_transfer(op, in_specs, in_shapes, out_shapes, mesh_shape):
+    """Resolve and run the transfer rule for one op node."""
+    fn = SHARDING_XFER.get(type(op).op_name)
+    if fn is not None:
+        return fn(op, in_specs, in_shapes, out_shapes, mesh_shape)
+    return op.infer_sharding(in_specs, in_shapes, out_shapes, mesh_shape)
+
+
+def contract_sharding(d_axes, w_axes, d_arg=0, w_arg=1, what="matmul"):
+    """Shared contraction-dim classifier for matmul-like transfer rules.
+
+    Both sides sharded over the SAME axes -> sharded contraction: the
+    output is a partial sum and XLA inserts the matching psum
+    (``reduce``).  One side sharded only -> XLA all-gathers that operand
+    before the matmul (a ``matmul_gather`` note, audited by MXL-C003).
+    Different axes on the two sides -> irreconcilable: the caller must
+    emit a required-spec conflict (``conflict=True`` -> MXL-P001).
+
+    Returns ``(reduce_dict, notes_list, conflict)``.
+    """
+    d_axes = tuple(d_axes or ())
+    w_axes = tuple(w_axes or ())
+    if d_axes and d_axes == w_axes:
+        return ({d_axes: "%s contraction dim sharded over %s: output is a "
+                         "partial sum" % (what, "+".join(d_axes))}, [], False)
+    if d_axes and w_axes:
+        return {}, [], True
+    if d_axes or w_axes:
+        arg = d_arg if d_axes else w_arg
+        axes = d_axes or w_axes
+        note = {"kind": "matmul_gather", "arg": arg, "axes": axes,
+                "message": "%s contraction dim sharded over %s on one side "
+                           "only: XLA all-gathers the sharded operand before "
+                           "the matmul" % (what, "+".join(axes))}
+        return {}, [note], False
+    return {}, [], False
+
+
+def dedup_axes(entry, used):
+    """Clear ``entry`` when it reuses a mesh axis already spent on another
+    dim of the same tensor (a spec may name each axis once)."""
+    return () if set(entry or ()) & set(used or ()) else tuple(entry or ())
+
+
+def reshape_carry(spec, ishape, oshape, mesh_shape):
+    """Sharding carry rule for Reshape/Flatten: keep the spec on every
+    leading/trailing dim whose size survives the reshape; the merged or
+    split middle block keeps its combined axes on its first output dim
+    iff the new dim size is still divisible by the axis product (else the
+    layout degrades to replicated there)."""
+    out = [()] * len(oshape)
+    i = 0
+    while i < min(len(ishape), len(oshape)) and ishape[i] == oshape[i]:
+        out[i] = tuple(spec[i])
+        i += 1
+    j = 0
+    while len(ishape) - 1 - j >= i and len(oshape) - 1 - j >= i and \
+            ishape[-1 - j] == oshape[-1 - j]:
+        out[len(oshape) - 1 - j] = tuple(spec[len(ishape) - 1 - j])
+        j += 1
+    mid = []
+    for d in range(i, len(ishape) - j):
+        mid.extend(spec[d])
+    if mid and i < len(oshape) - j:
+        prod = 1
+        for a in mid:
+            prod *= mesh_shape.get(a, 1)
+        if oshape[i] % prod == 0:
+            out[i] = tuple(mid)
+    return tuple(out)
+
+
+@register_sharding_rule("_Plus", "_Minus", "_Mul", "_Div", "_Power",
+                        "_Maximum", "_Minimum", "ElementWiseSum",
+                        "element_mask")
+def _broadcast_join(op, in_specs, in_shapes, out_shapes, mesh_shape):
+    """Elementwise/broadcast ops: each output dim takes the union of the
+    (numpy trailing-broadcast) aligned input dims, and every input is
+    then required to match the union on its own dims.  A replicated
+    input is resliced for free; an input sharded over a *different* axis
+    on some dim is the classic implicit-reshard conflict the MXL-P pass
+    flags."""
+    oshape = out_shapes[0]
+    orank = len(oshape)
+    joined = [()] * orank
+    used = set()
+    for spec, shape in zip(in_specs, in_shapes):
+        if shape is None:
+            continue
+        off = orank - len(shape)
+        for d, entry in enumerate(spec):
+            # a broadcast (size-1) dim carries no sharding
+            if not entry or shape[d] == 1:
+                continue
+            od = off + d
+            if not joined[od] and not (set(entry) & used):
+                joined[od] = entry
+                used.update(entry)
+    required = []
+    for spec, shape in zip(in_specs, in_shapes):
+        if shape is None:
+            required.append(None)
+            continue
+        off = orank - len(shape)
+        required.append(tuple(
+            joined[off + d] if shape[d] != 1 else ()
+            for d in range(len(shape))))
+    return {"out": [tuple(joined) for _ in out_shapes], "in": required}

@@ -8,12 +8,13 @@ decision is a PartitionSpec over its axes.
 from __future__ import annotations
 
 import math
+from collections import OrderedDict
 
 import numpy as _np
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "auto_mesh", "local_device_count"]
+__all__ = ["make_mesh", "auto_mesh", "local_device_count", "LogicalMesh"]
 
 AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")  # outer→inner; tp innermost so
 # its collectives ride the fastest ICI links (scaling-book layout rule)
@@ -56,6 +57,38 @@ def make_mesh(devices=None, **axis_sizes):
         raise ValueError("mesh axes %s multiply to %d but %d devices present"
                          % (dict(zip(names, sizes)), known, n))
     return Mesh(devices.reshape(sizes), axis_names=tuple(names))
+
+
+class LogicalMesh(object):
+    """A device-less mesh: named axes and sizes only.
+
+    The static analyzer (mxnet_tpu/analysis) consumes nothing but
+    ``mesh.shape`` (axis -> size) and ``mesh.axis_names``, so
+    ``tools/mxlint.py --mesh dp=64,tp=4`` can lint a pod-sized layout
+    from a dev box with one CPU device — :func:`make_mesh` would demand
+    the axis sizes multiply to the live device count.  Not bindable:
+    trainers and pjit need a real ``jax.sharding.Mesh``.
+    """
+
+    devices = None      # the analyzer's "is this physical" probe
+
+    def __init__(self, **axis_sizes):
+        names = [a for a in AXIS_ORDER if a in axis_sizes]
+        names += [a for a in axis_sizes if a not in AXIS_ORDER]
+        for a in names:
+            if int(axis_sizes[a]) < 1:
+                raise ValueError("axis %r must have size >= 1, got %r"
+                                 % (a, axis_sizes[a]))
+        self.axis_names = tuple(names)
+        self.shape = OrderedDict((a, int(axis_sizes[a])) for a in names)
+
+    @property
+    def size(self):
+        return int(math.prod(self.shape.values())) if self.shape else 1
+
+    def __repr__(self):
+        return "LogicalMesh(%s)" % ", ".join(
+            "%s=%d" % kv for kv in self.shape.items())
 
 
 def auto_mesh(n_devices=None, tp=1, sp=1, pp=1, ep=1):

@@ -21,7 +21,7 @@ import re
 
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["ShardingRules", "param_pspec", "batch_pspec",
+__all__ = ["ShardingRules", "param_pspec", "batch_pspec", "named_pspecs",
            "put_local_sharded", "put_replicated_host"]
 
 
@@ -58,8 +58,20 @@ def _divisible(dim, mesh, axis):
         mesh.shape[axis] > 1
 
 
-def param_pspec(name, shape, mesh, rules=None):
-    """PartitionSpec for one parameter."""
+# normalization parameters (and their moving stats) are elementwise
+# against an unsharded feature dim: sharding them buys nothing and forces
+# a gather at every use, so the default policy keeps them replicated
+_NORM_PARAM_SUFFIXES = ("_gamma", "_beta", "_moving_mean", "_moving_var")
+
+
+def param_pspec(name, shape, mesh, rules=None, notes=None):
+    """PartitionSpec for one parameter.
+
+    ``notes``, when a list, collects degradation messages: a parameter
+    the tp policy *wanted* to shard but couldn't (no dim divisible by
+    the axis size) falls back to replicated, and the fallthrough is
+    recorded here instead of happening silently (the MXL-P003 lint rule
+    surfaces one info finding per such parameter)."""
     if rules is not None:
         spec = rules.match(name, shape)
         if spec is not None:
@@ -68,6 +80,8 @@ def param_pspec(name, shape, mesh, rules=None):
             and "expert" in name and shape[0] % mesh.shape["ep"] == 0:
         # MoE expert stacks: leading num_experts axis over 'ep'
         return P("ep", *([None] * (len(shape) - 1)))
+    if name.endswith(_NORM_PARAM_SUFFIXES):
+        return P(*([None] * len(shape)))
     if "tp" in mesh.shape and mesh.shape["tp"] > 1 and shape:
         # shard the widest shardable axis over tp: prefer axis 0 (out-features
         # / vocab) — column parallel; fall back to axis 1 (row parallel)
@@ -77,6 +91,11 @@ def param_pspec(name, shape, mesh, rules=None):
             return P(None, "tp", *([None] * (len(shape) - 2)))
         if len(shape) == 1 and _divisible(shape[0], mesh, "tp"):
             return P("tp")
+        if notes is not None and any(d > 1 for d in shape):
+            notes.append(
+                "shape %s has no dim divisible by mesh axis 'tp' (size %d): "
+                "replicated on every tp device instead of sharded"
+                % (tuple(shape), mesh.shape["tp"]))
     return P(*([None] * len(shape)))
 
 
@@ -89,6 +108,35 @@ def batch_pspec(shape, mesh, seq_axis=None):
             and len(shape) > seq_axis:
         spec[seq_axis] = "sp"
     return P(*spec)
+
+
+def named_pspecs(named_shapes, mesh, rules=None, data_names=("data",),
+                 label_names=("softmax_label",), seq_axis=None, notes=None):
+    """Queryable per-name PartitionSpec map for a whole argument set.
+
+    The one place the seeding policy lives: names in ``data_names`` /
+    ``label_names`` get :func:`batch_pspec` (axis 0 over dp, sequence
+    axis over sp), everything else :func:`param_pspec` (explicit
+    ``rules`` first, then the default megatron-style tp policy).  The
+    static analyzer (analysis/propagation.py) seeds its dataflow from
+    this map, so what it lints is exactly what ``ShardedTrainer`` would
+    bind.  ``notes`` (a list, optional) collects ``(name, message)``
+    degradation records from :func:`param_pspec`."""
+    out = {}
+    batchy = set(data_names or ()) | set(label_names or ())
+    for name, shape in named_shapes.items():
+        if shape is None:
+            out[name] = None
+        elif name in batchy:
+            out[name] = batch_pspec(
+                shape, mesh,
+                seq_axis if name in (data_names or ()) else None)
+        else:
+            local = [] if notes is not None else None
+            out[name] = param_pspec(name, shape, mesh, rules, notes=local)
+            if local:
+                notes.extend((name, msg) for msg in local)
+    return out
 
 
 class ShardingRules(object):
@@ -115,6 +163,19 @@ class ShardingRules(object):
             if prog.match(name):
                 return fn(shape, self._mesh)
         return None
+
+    def pspec(self, name, shape, mesh=None, notes=None):
+        """The queryable per-name entry point: explicit rule match
+        first, then the default parameter policy for ``mesh`` (or the
+        rule set's own mesh).  With no mesh at all, falls back to fully
+        replicated — a spec is always returned."""
+        spec = self.match(name, shape)
+        if spec is not None:
+            return spec
+        mesh = mesh if mesh is not None else self._mesh
+        if mesh is None:
+            return P(*([None] * len(shape or ())))
+        return param_pspec(name, shape, mesh, rules=None, notes=notes)
 
     def validate(self, mesh, named_shapes):
         """Check every matching rule against a concrete mesh.

@@ -320,7 +320,8 @@ class Symbol:
     # -- static analysis (analysis/) ---------------------------------------
     def validate(self, shapes=None, type_dict=None, mesh=None,
                  sharding_rules=None, target="tpu", select=None, skip=None,
-                 **shape_kwargs):
+                 kvstore=None, hbm_bytes=None, grad_req=None,
+                 data_names=None, label_names=None, **shape_kwargs):
         """Run the static lint passes over this graph; returns
         ``list[analysis.GraphIssue]``, most severe first.
 
@@ -329,15 +330,19 @@ class Symbol:
         shape/dtype conflicts, dead inputs, and non-lowerable ops before
         they become opaque XLA trace errors.  ``shapes`` (or shape
         kwargs, ``infer_shape`` style) and ``type_dict`` seed
-        propagation; ``mesh``/``sharding_rules`` enable the sharding-axis
-        checks; ``select``/``skip`` filter rule ids.
+        propagation; ``mesh``/``sharding_rules`` enable the SPMD passes
+        (sharding propagation MXL-P, peak-HBM MXL-M, collective audit
+        MXL-C) with ``kvstore``/``hbm_bytes``/``grad_req`` refining their
+        context; ``select``/``skip`` filter rule ids (wildcards work).
         """
         from .analysis import analyze
         known = dict(shapes or {})
         known.update(shape_kwargs)
         return analyze(self, shapes=known, type_dict=type_dict, mesh=mesh,
                        sharding_rules=sharding_rules, target=target,
-                       select=select, skip=skip)
+                       kvstore=kvstore, hbm_bytes=hbm_bytes,
+                       grad_req=grad_req, data_names=data_names,
+                       label_names=label_names, select=select, skip=skip)
 
     # -- binding (implemented in executor.py) ------------------------------
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,

@@ -25,6 +25,11 @@ case "${TASK:-python}" in
     # graph lint sweep over the bundled model zoo (docs/graph_lint.md):
     # every model must carry zero error-severity findings
     JAX_PLATFORMS=cpu python tools/mxlint.py --all-models --fail-on=error
+    # SPMD sweep: sharding propagation + collective audit + peak-HBM
+    # report on the transformer under a dp=2,tp=2 logical mesh — no
+    # implicit reshard (MXL-P001) may appear at error severity
+    JAX_PLATFORMS=cpu python tools/mxlint.py --model transformer \
+      --mesh dp=2,tp=2 --fail-on=error
     ;;
   python)
     make -s all || echo "native build unavailable; python fallback"

@@ -370,3 +370,274 @@ def test_clean_bind_emits_no_warning():
     assert not [w for w in rec
                 if issubclass(w.category, GraphLintWarning)]
     assert exe.bind_issues == []
+
+
+# ----------------------------------------------------------------------
+# MXL-P/M/C: SPMD propagation, memory, collective audit
+# ----------------------------------------------------------------------
+def _mesh22():
+    from mxnet_tpu.parallel import LogicalMesh
+    return LogicalMesh(dp=2, tp=2)
+
+
+def _transformer():
+    from mxnet_tpu.models.transformer import get_symbol
+    return get_symbol(vocab_size=512, num_layers=2, num_heads=4, dim=64,
+                      seq_len=64), {"data": (2, 64), "softmax_label": (2, 64)}
+
+
+def test_spmd_transformer_clean_under_mesh():
+    """The bundled transformer under dp=2,tp=2 has no sharding errors:
+    only the expected row-parallel psum (info) and the one-sided
+    contractions the default policy leaves open (warning)."""
+    net, shapes = _transformer()
+    issues = net.validate(shapes=shapes, mesh=_mesh22())
+    assert max_severity(issues) != "error", analysis.format_issues(issues)
+    assert _only(issues, "MXL-P004")
+    assert _only(issues, "MXL-C003")
+    # and the communication report prices the implied collectives
+    ctxs = []
+    analyze(net, shapes=shapes, mesh=_mesh22(), _ctx_out=ctxs)
+    comm = analysis.comm_report(ctxs[0])
+    assert comm["complete"] and comm["total_bytes"] > 0
+    assert comm["by_kind"]["reduce"]["count"] >= 1
+
+
+def _mis_sharded():
+    """fc1 col-parallel makes its output tp-sharded on dim 1; fc2's rule
+    claims dp on the same contraction dim -> forced reshard (MXL-P001)."""
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.sharding import ShardingRules
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    fc2 = mx.sym.FullyConnected(data=fc1, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+    rules = ShardingRules([
+        (r"fc1_weight", lambda s, m: P("tp", None)),
+        (r"fc2_weight", lambda s, m: P(None, "dp")),
+        (r".*_bias", lambda s, m: P(None)),
+    ])
+    return net, {"data": (8, 16), "softmax_label": (8,)}, rules
+
+
+def test_p001_mis_sharded_graph_errors_with_bytes():
+    net, shapes, rules = _mis_sharded()
+    ctxs = []
+    issues = analyze(net, shapes=shapes, mesh=_mesh22(),
+                     sharding_rules=rules, _ctx_out=ctxs)
+    hits = _only(issues, "MXL-P001")
+    assert hits and all(i.severity == "error" for i in hits)
+    assert hits[0].node == "fc2"
+    assert "reshard" in hits[0].message
+    resh = analysis.comm_report(ctxs[0])["by_kind"]["reshard"]
+    assert resh["bytes"] > 0
+    # without the conflicting rules the same graph is reshard-free
+    clean = analyze(net, shapes=shapes, mesh=_mesh22())
+    assert not _only(clean, "MXL-P001")
+
+
+def test_p002_sharded_value_consumed_replicated():
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.sharding import ShardingRules
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    net = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    # weight replicated but bias tp-sharded: the add needs it whole
+    rules = ShardingRules([(r"fc1_weight", lambda s, m: P(None, None)),
+                           (r"fc1_bias", lambda s, m: P("tp"))])
+    issues = analyze(net, shapes={"data": (8, 16), "softmax_label": (8,)},
+                     mesh=_mesh22(), sharding_rules=rules)
+    hits = _only(issues, "MXL-P002")
+    assert hits and hits[0].severity == "warning"
+    assert "all-gather" in hits[0].message
+
+
+def test_p003_non_divisible_param_degrades_to_replicated():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc1")
+    net = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    # (3, 5) has no dim divisible by tp=2: the default policy degrades
+    issues = analyze(net, shapes={"data": (4, 5), "softmax_label": (4,)},
+                     mesh=_mesh22())
+    hits = _only(issues, "MXL-P003")
+    assert any(i.node == "fc1_weight" for i in hits)
+    assert all(i.severity == "info" for i in hits)
+    assert "replicated" in hits[0].message
+
+
+def test_p004_row_parallel_contraction_psum():
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.sharding import ShardingRules
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    fc2 = mx.sym.FullyConnected(data=fc1, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+    rules = ShardingRules([(r"fc1_weight", lambda s, m: P("tp", None)),
+                           (r"fc2_weight", lambda s, m: P(None, "tp")),
+                           (r".*_bias", lambda s, m: P(None))])
+    issues = analyze(net, shapes={"data": (8, 16), "softmax_label": (8,)},
+                     mesh=_mesh22(), sharding_rules=rules)
+    hits = _only(issues, "MXL-P004")
+    assert any(i.node == "fc2" for i in hits)
+    assert "psum" in hits[0].message
+    assert not _only(issues, "MXL-P001")
+
+
+def test_c003_one_sided_contraction():
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.sharding import ShardingRules
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    net = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    # only the weight's contraction dim is sharded: XLA must gather
+    rules = ShardingRules([(r"fc1_weight", lambda s, m: P(None, "tp")),
+                           (r"fc1_bias", lambda s, m: P(None))])
+    issues = analyze(net, shapes={"data": (8, 16), "softmax_label": (8,)},
+                     mesh=_mesh22(), sharding_rules=rules)
+    hits = _only(issues, "MXL-C003")
+    assert hits and hits[0].node == "fc1"
+    assert hits[0].severity == "warning"
+
+
+def test_c001_kvstore_scope():
+    from mxnet_tpu.parallel import LogicalMesh
+    net = mx.models.get_mlp()
+    # unknown type: error even without a mesh
+    issues = analyze(net, shapes={"data": (8, 784)}, kvstore="bogus")
+    hits = _only(issues, "MXL-C001")
+    assert hits and hits[0].severity == "error"
+    # device-scope kvstore under a pod-sized mesh: silently local
+    big = LogicalMesh(dp=64, tp=4)
+    issues = analyze(net, shapes={"data": (8, 784)}, kvstore="device",
+                     mesh=big)
+    hits = _only(issues, "MXL-C001")
+    assert hits and hits[0].severity == "error"
+    assert "dist_sync" in hits[0].message
+    # dist_async: documented sync-semantics divergence, warning only
+    issues = analyze(net, shapes={"data": (8, 784)}, kvstore="dist_async",
+                     mesh=big)
+    hits = _only(issues, "MXL-C001")
+    assert hits and hits[0].severity == "warning"
+    # a matching scope is silent
+    issues = analyze(net, shapes={"data": (8, 784)}, kvstore="dist_sync",
+                     mesh=big)
+    assert not _only(issues, "MXL-C001")
+
+
+def test_c002_collective_across_pipeline_stage():
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.sharding import ShardingRules
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="stage0"):
+        fc1 = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    with mx.AttrScope(ctx_group="stage1"):
+        fc2 = mx.sym.FullyConnected(data=fc1, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+    rules = ShardingRules([(r"fc1_weight", lambda s, m: P("tp", None)),
+                           (r"fc2_weight", lambda s, m: P(None, "tp")),
+                           (r".*_bias", lambda s, m: P(None))])
+    shapes = {"data": (8, 16), "softmax_label": (8,)}
+    issues = analyze(net, shapes=shapes, mesh=_mesh22(),
+                     sharding_rules=rules)
+    hits = _only(issues, "MXL-C002")
+    assert hits and hits[0].node == "fc2"
+    assert "pipeline" in hits[0].message
+    # a single-stage graph never trips the audit
+    single = _mis_sharded()[0]
+    assert not _only(analyze(single, shapes=shapes, mesh=_mesh22()),
+                     "MXL-C002")
+
+
+def test_m001_peak_hbm_over_budget():
+    net = mx.models.get_mlp()
+    issues = net.validate(data=(8, 784), mesh=_mesh22(), hbm_bytes=1024)
+    hits = _only(issues, "MXL-M001")
+    assert hits and hits[0].severity == "error"
+    assert "exceeds the budget" in hits[0].message
+    assert "params" in hits[0].message       # breakdown included
+    # generous budget: silent
+    ok = net.validate(data=(8, 784), mesh=_mesh22(), hbm_bytes=1 << 40)
+    assert not _only(ok, "MXL-M001")
+
+
+def test_m002_big_replicated_param():
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.sharding import ShardingRules
+    net = mx.models.get_mlp()
+    repl = ShardingRules([(r".*", lambda s, m: P(*([None] * len(s))))])
+    issues = net.validate(data=(8, 784), mesh=_mesh22(),
+                          sharding_rules=repl, hbm_bytes=1_500_000)
+    hits = _only(issues, "MXL-M002")
+    assert any(i.node == "fc1_weight" for i in hits)
+    assert all(i.severity == "warning" for i in hits)
+    # sharded by the default policy: nothing to reclaim
+    sharded = net.validate(data=(8, 784), mesh=_mesh22(),
+                           hbm_bytes=1_500_000)
+    assert not _only(sharded, "MXL-M002")
+
+
+def test_memory_estimate_matches_analytic():
+    """Training-mode peak on a graph small enough to price by hand:
+    the estimate must land within the documented 2% tolerance (it is
+    exact here — no mirroring, no fusion credit taken)."""
+    from mxnet_tpu.analysis import AnalysisContext, peak_hbm_report
+    from mxnet_tpu.parallel import LogicalMesh
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+    ctx = AnalysisContext(net, shapes={"data": (4, 8),
+                                      "softmax_label": (4,)},
+                          mesh=LogicalMesh(dp=1), grad_req="write")
+    rep = peak_hbm_report(ctx)
+    params = 4 * (16 * 8 + 16 + 2 * 16 + 2 + 4 * 8 + 4)  # + data + label
+    grads = 4 * (16 * 8 + 16 + 2 * 16 + 2)
+    acts = 4 * (4 * 16 + 4 * 16 + 4 * 2 + 4 * 2)
+    assert rep["mode"] == "training" and rep["complete"]
+    assert rep["params_bytes"] == params
+    assert rep["grads_bytes"] == grads
+    assert rep["activations_bytes"] == acts
+    analytic = params + grads + acts
+    assert abs(rep["peak_bytes"] - analytic) <= 0.02 * analytic
+    # inference mode: no grads, liveness peak <= sum of activations
+    infer = AnalysisContext(net, shapes={"data": (4, 8),
+                                         "softmax_label": (4,)},
+                            mesh=LogicalMesh(dp=1), grad_req="null")
+    irep = peak_hbm_report(infer)
+    assert irep["mode"] == "inference"
+    assert irep["grads_bytes"] == 0
+    assert irep["activations_bytes"] <= acts
+    assert irep["peak_bytes"] < rep["peak_bytes"]
+
+
+def test_spmd_rules_respect_lint_ignore():
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.sharding import ShardingRules
+    rules = ShardingRules([(r"fc1_weight", lambda s, m: P(None, "tp")),
+                           (r"fc1_bias", lambda s, m: P(None))])
+    shapes = {"data": (8, 16), "softmax_label": (8,)}
+
+    def build(attr):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1",
+                                   attr=attr)
+        return mx.sym.SoftmaxOutput(data=fc, name="softmax")
+
+    loud = analyze(build(None), shapes=shapes, mesh=_mesh22(),
+                   sharding_rules=rules)
+    assert _only(loud, "MXL-C003")
+    quiet = analyze(build({"__lint_ignore__": "MXL-C003"}), shapes=shapes,
+                    mesh=_mesh22(), sharding_rules=rules)
+    assert not _only(quiet, "MXL-C003")
+
+
+def test_wildcard_select_isolates_spmd_family():
+    net, shapes, rules = _mis_sharded()
+    issues = analyze(net, shapes=shapes, mesh=_mesh22(),
+                     sharding_rules=rules, select={"MXL-P*"})
+    assert issues
+    assert all(i.rule_id.startswith("MXL-P") for i in issues)
+    skipped = analyze(net, shapes=shapes, mesh=_mesh22(),
+                      sharding_rules=rules, skip={"MXL-P*"})
+    assert not any(i.rule_id.startswith("MXL-P") for i in skipped)

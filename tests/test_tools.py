@@ -449,3 +449,113 @@ def test_mxlint_usage_errors_exit_two(tmp_path):
     assert p.returncode == 2, p.stdout + p.stderr
     p = _mxlint(str(tmp_path / "missing.json"))
     assert p.returncode == 2, p.stdout + p.stderr
+
+
+def test_mxlint_mesh_cost_report():
+    """The acceptance run: transformer under dp=2,tp=2 exits 0 at
+    --fail-on=error and prints the reshard + peak-HBM report."""
+    p = _mxlint("--model", "transformer", "--mesh", "dp=2,tp=2",
+                "--fail-on=error")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "MXL-C003" in p.stdout          # one-sided contractions listed
+    assert "MXL-P004" in p.stdout          # row-parallel psum listed
+    assert "communication (per device" in p.stdout
+    assert "over ICI" in p.stdout
+    assert "peak HBM estimate" in p.stdout
+    assert "training mode" in p.stdout
+
+
+def test_mxlint_mesh_json_cost():
+    import json as _json
+    p = _mxlint("--model", "mlp", "--mesh", "dp=2,tp=2", "--format",
+                "json", "--fail-on=error")
+    assert p.returncode == 0, p.stdout + p.stderr
+    doc = _json.loads(p.stdout)
+    cost = doc[0]["cost"]
+    assert cost["memory"]["peak_bytes"] > 0
+    assert cost["memory"]["mode"] == "training"
+    assert cost["communication"]["total_bytes"] >= 0
+
+
+def test_mxlint_hbm_budget_gates():
+    p = _mxlint("--model", "mlp", "--mesh", "dp=2,tp=2",
+                "--hbm-gb", "0.000001")
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "MXL-M001" in p.stdout
+    p = _mxlint("--model", "mlp", "--mesh", "dp=2,tp=2", "--hbm-gb", "16")
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_mxlint_wildcard_select_and_skip():
+    p = _mxlint("--model", "transformer", "--mesh", "dp=2,tp=2",
+                "--select", "MXL-P*", "--fail-on=warning")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "MXL-P004" in p.stdout
+    assert "MXL-C003" not in p.stdout
+    p = _mxlint("--model", "transformer", "--mesh", "dp=2,tp=2",
+                "--skip", "MXL-C*", "--fail-on=warning")
+    assert "MXL-C003" not in p.stdout
+    assert "MXL-P004" in p.stdout
+
+
+def test_mxlint_github_annotations():
+    p = _mxlint("--model", "transformer", "--mesh", "dp=2,tp=2",
+                "--format", "github")
+    assert p.returncode == 0, p.stdout + p.stderr
+    lines = [l for l in p.stdout.splitlines() if l.startswith("::")]
+    assert lines, p.stdout
+    assert any(l.startswith("::warning title=MXL-C003") for l in lines)
+    assert any("model:transformer" in l for l in lines)
+    # annotations are single-line even for multi-line messages
+    assert all("\n" not in l for l in lines)
+
+
+def test_mxlint_sharding_flag():
+    # explicit rules override the default policy: a one-sided
+    # row-parallel weight turns into MXL-C003 warnings
+    p = _mxlint("--model", "mlp", "--mesh", "dp=2,tp=2",
+                "--sharding", r".*_weight=(None,tp);.*_bias=-",
+                "--fail-on=error")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "MXL-C003" in p.stdout
+    # a bad spec is a usage error
+    p = _mxlint("--model", "mlp", "--mesh", "dp=2,tp=2",
+                "--sharding", "no-equals-sign-here")
+    assert p.returncode == 2, p.stdout + p.stderr
+
+
+def test_mxlint_bad_mesh_is_usage_error():
+    p = _mxlint("--model", "mlp", "--mesh", "dp=banana")
+    assert p.returncode == 2, p.stdout + p.stderr
+    p = _mxlint("--model", "mlp", "--mesh", "dp")
+    assert p.returncode == 2, p.stdout + p.stderr
+
+
+def test_mxlint_kvstore_audit():
+    p = _mxlint("--model", "mlp", "--mesh", "dp=64,tp=4",
+                "--kvstore", "device")
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "MXL-C001" in p.stdout
+    p = _mxlint("--model", "mlp", "--mesh", "dp=64,tp=4",
+                "--kvstore", "dist_sync")
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_parse_shapes_edge_cases():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import mxlint
+        # whitespace everywhere is tolerated
+        assert mxlint.parse_shapes([" data = ( 8 , 784 ) "]) == \
+            {"data": (8, 784)}
+        # several entries in one flag, trailing comma, bare int
+        assert mxlint.parse_shapes(["a=(2,3),b=(4,),c=5,"]) == \
+            {"a": (2, 3), "b": (4,), "c": (5,)}
+        # nested tuples are not shapes
+        import pytest
+        with pytest.raises(ValueError, match="flat tuple"):
+            mxlint.parse_shapes(["data=((2,3),4)"])
+        with pytest.raises(ValueError):
+            mxlint.parse_shapes(["data=(a,b)"])
+    finally:
+        sys.path.pop(0)

@@ -152,6 +152,8 @@ def audit(mesh, batch, layers, dtype):
         compiled_convs[ty] = compiled_convs.get(ty, 0) + 1
 
     ca = compiled.cost_analysis() or {}
+    if isinstance(ca, (list, tuple)):   # per-device list on some backends
+        ca = ca[0] if ca else {}
     flops = float(ca.get("flops") or 0.0)
     byts = float(ca.get("bytes accessed") or 0.0)
     mem = compiled.memory_analysis()
@@ -173,6 +175,34 @@ def audit(mesh, batch, layers, dtype):
     kind = getattr(mesh.devices.flat[0], "device_kind", "")
     peak_tf, peak_hbm = _peaks_for(kind)
     out["device_kind"] = str(kind)
+
+    # cross-check the static analyzer's liveness-based peak-HBM estimate
+    # (analysis/memory.py) against the TPU compiler's own memory
+    # analysis: the estimate must land in the same regime as
+    # argument+temp bytes, and both must fit the device's HBM
+    try:
+        from mxnet_tpu.analysis import (AnalysisContext, peak_hbm_report,
+                                        hbm_capacity_bytes)
+        ctx = AnalysisContext(
+            trainer.symbol,
+            shapes={"data": (batch, 3, 224, 224),
+                    "softmax_label": (batch,)},
+            mesh=mesh, sharding_rules=trainer.rules, grad_req="write")
+        rep = peak_hbm_report(ctx)
+        out["analysis_peak_hbm_bytes"] = rep["peak_bytes"]
+        compiled_live = mem.argument_size_in_bytes + mem.temp_size_in_bytes
+        if compiled_live:
+            # > 1: the analyzer over-estimates (no fusion credit, no
+            # optimizer state in the static graph); the audit line shows
+            # how far
+            out["analysis_vs_compiled"] = round(
+                float(rep["peak_bytes"]) / compiled_live, 2)
+        cap = hbm_capacity_bytes(kind)
+        if cap:
+            out["hbm_capacity_bytes"] = cap
+            out["analysis_peak_hbm_ok"] = bool(rep["peak_bytes"] <= cap)
+    except Exception as exc:  # noqa: BLE001 — audit must not die on lint
+        out["analysis_note"] = "static memory cross-check failed: %s" % exc
     if flops and byts and peak_tf:
         intensity = flops / byts
         ridge = peak_tf / peak_hbm

@@ -10,9 +10,19 @@ bundled model zoo, so CI can gate every change on a clean lint sweep:
   python tools/mxlint.py --model resnet --model mlp
   python tools/mxlint.py --all-models --fail-on=error     # the CI sweep
 
+With a mesh the SPMD passes activate — sharding propagation (MXL-P),
+peak-HBM estimation (MXL-M), collective audit (MXL-C) — and each graph
+gets a communication/memory cost report:
+
+  python tools/mxlint.py --model transformer --mesh dp=2,tp=2
+  python tools/mxlint.py --model mlp --mesh dp=8 --hbm-gb 16 \\
+      --sharding ".*embed.*_weight=(tp,None);.*_bias=-"
+
 Exit codes: 0 = nothing at/above --fail-on severity, 1 = findings at or
 above it, 2 = usage/load failure.  --fail-on=never always exits 0 (report
-only).  Rule catalog and suppression attrs: docs/graph_lint.md.
+only).  --select/--skip accept fnmatch wildcards ("MXL-P*").
+--format=github emits workflow-command annotations for CI logs.
+Rule catalog and suppression attrs: docs/graph_lint.md.
 """
 import argparse
 import ast
@@ -69,17 +79,91 @@ def parse_shapes(specs):
             shape = ast.literal_eval(val.strip())
             if isinstance(shape, int):
                 shape = (shape,)
-            out[name.strip()] = tuple(int(d) for d in shape)
+            try:
+                shape = tuple(int(d) for d in shape)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "bad --shapes entry %r: %r is not a flat tuple of ints"
+                    % (part, val.strip()))
+            out[name.strip()] = shape
     return out
 
 
-def lint_file(path, shapes, target, select, skip):
-    """Lint one saved symbol JSON; returns (label, issues)."""
+def parse_mesh(spec):
+    """--mesh "dp=2,tp=4" -> parallel.LogicalMesh (device-less: lints a
+    pod-sized layout from a dev box)."""
+    if not spec:
+        return None
+    axes = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError("bad --mesh entry %r (want axis=size)" % part)
+        name, val = part.split("=", 1)
+        try:
+            axes[name.strip()] = int(val)
+        except ValueError:
+            raise ValueError("bad --mesh size %r for axis %r"
+                             % (val, name.strip()))
+    if not axes:
+        raise ValueError("--mesh given but no axes parsed from %r" % spec)
+    from mxnet_tpu.parallel import LogicalMesh
+    return LogicalMesh(**axes)
+
+
+def _parse_pspec(val):
+    """"(tp,None)" / "tp" / "-" -> PartitionSpec (None = no constraint)."""
+    from jax.sharding import PartitionSpec as P
+    val = val.strip()
+    if val in ("-", "None", ""):
+        return P()
+    if val.startswith("(") and val.endswith(")"):
+        val = val[1:-1]
+    entries = []
+    for e in val.split(","):
+        e = e.strip()
+        if not e:
+            continue
+        entries.append(None if e in ("None", "-") else e)
+    return P(*entries)
+
+
+def parse_sharding(spec):
+    """--sharding "pattern=(axes);pattern=axes" -> ShardingRules.
+
+    Entries are ';'-separated ``regex=(axis,axis,...)`` pairs (the
+    rightmost '=' splits, so regexes may contain '='); axis ``None`` or
+    ``-`` means replicated on that dim.  Names the rules don't match
+    fall back to the default tp policy."""
+    if not spec:
+        return None
+    from mxnet_tpu.parallel import ShardingRules
+    rules = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError("bad --sharding entry %r "
+                             "(want regex=(axis,...))" % part)
+        pat, val = part.rsplit("=", 1)
+        pspec = _parse_pspec(val)
+        rules.append((pat.strip(), lambda s, m, _p=pspec: _p))
+    return ShardingRules(rules)
+
+
+def lint_file(path, shapes, target, select, skip, **spmd):
+    """Lint one saved symbol JSON; returns (label, issues, ctx|None)."""
     from mxnet_tpu.analysis import analyze_json
     with open(path) as f:
         src = f.read()
-    return path, analyze_json(src, shapes=shapes, target=target,
-                              select=select, skip=skip)
+    ctx_out = []
+    issues = analyze_json(src, shapes=shapes, target=target,
+                          select=select, skip=skip, _ctx_out=ctx_out,
+                          **spmd)
+    return path, issues, (ctx_out[0] if ctx_out else None)
 
 
 def build_model(name, kwargs):
@@ -90,11 +174,74 @@ def build_model(name, kwargs):
     return mod.get_symbol(**kwargs)
 
 
-def lint_model(name, kwargs, shapes, target, select, skip):
+def lint_model(name, kwargs, shapes, target, select, skip, **spmd):
     from mxnet_tpu.analysis import analyze
     sym = build_model(name, kwargs)
-    return "model:%s" % name, analyze(sym, shapes=shapes, target=target,
-                                      select=select, skip=skip)
+    ctx_out = []
+    issues = analyze(sym, shapes=shapes, target=target, select=select,
+                     skip=skip, _ctx_out=ctx_out, **spmd)
+    return "model:%s" % name, issues, (ctx_out[0] if ctx_out else None)
+
+
+def cost_report_lines(ctx):
+    """The per-graph communication + memory cost report (text mode)."""
+    from mxnet_tpu.analysis import comm_report, peak_hbm_report
+    from mxnet_tpu.analysis.propagation import fmt_bytes
+    lines = []
+    comm = comm_report(ctx)
+    lines.append("-- communication (per device, per step):")
+    if comm["events"]:
+        for kind in sorted(comm["by_kind"]):
+            entry = comm["by_kind"][kind]
+            lines.append("   %-15s %3d event(s)  %s"
+                         % (kind, entry["count"],
+                            fmt_bytes(entry["bytes"])))
+        lines.append("   %-15s %s over ICI%s"
+                     % ("total", fmt_bytes(comm["total_bytes"]),
+                        "" if comm["complete"]
+                        else "  (partial: some shapes unknown)"))
+    else:
+        lines.append("   no implicit collectives")
+    mem = peak_hbm_report(ctx)
+    lines.append("-- peak HBM estimate (per device, %s mode):"
+                 % (mem["mode"] or "unknown"))
+    lines.append("   params %s + grads %s + aux %s + activations %s"
+                 % (fmt_bytes(mem["params_bytes"]),
+                    fmt_bytes(mem["grads_bytes"]),
+                    fmt_bytes(mem["aux_bytes"]),
+                    fmt_bytes(mem["activations_bytes"])))
+    budget = mem["budget_bytes"]
+    lines.append("   peak %s%s%s"
+                 % (fmt_bytes(mem["peak_bytes"]),
+                    (" of %s budget (%.0f%%)"
+                     % (fmt_bytes(budget),
+                        100.0 * mem["peak_bytes"] / budget))
+                    if budget else "",
+                    "" if mem["complete"]
+                    else "  (partial: some shapes unknown)"))
+    return lines
+
+
+def cost_report_dict(ctx):
+    from mxnet_tpu.analysis import comm_report, peak_hbm_report
+    return {"communication": comm_report(ctx),
+            "memory": peak_hbm_report(ctx)}
+
+
+def _gh_escape(text):
+    return (str(text).replace("%", "%25").replace("\r", "%0D")
+            .replace("\n", "%0A"))
+
+
+_GH_LEVEL = {"error": "error", "warning": "warning", "info": "notice"}
+
+
+def gh_annotation(label, issue):
+    """One GitHub Actions workflow-command line per finding."""
+    where = issue.node or "graph"
+    return "::%s title=%s [%s] %s::%s" % (
+        _GH_LEVEL.get(issue.severity, "notice"), issue.rule_id,
+        _gh_escape(label), _gh_escape(where), _gh_escape(issue.message))
 
 
 def main(argv=None):
@@ -110,18 +257,39 @@ def main(argv=None):
     ap.add_argument("--shapes", action="append", default=[],
                     metavar="name=(d,...)",
                     help="input shape hints, e.g. data=(8,3,224,224)")
+    ap.add_argument("--mesh", default=None, metavar="dp=2,tp=4",
+                    help="logical device mesh: activates the SPMD passes "
+                         "(MXL-P/M/C) and the per-graph cost report; no "
+                         "physical devices needed")
+    ap.add_argument("--sharding", default=None,
+                    metavar="regex=(axis,...);...",
+                    help="explicit ShardingRules overriding the default tp "
+                         "policy, e.g. \".*embed.*_weight=(tp,None)\"")
+    ap.add_argument("--kvstore", default=None,
+                    help="kvstore type the trainer would use (enables the "
+                         "MXL-C001 scope audit)")
+    ap.add_argument("--grad-req", default="write",
+                    help="gradient request the trainer would bind "
+                         "(write/add/null; default write = training-mode "
+                         "memory estimate)")
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="per-device HBM budget in GiB for MXL-M001 "
+                         "(default: the MXTPU_HBM_GB env var, else no "
+                         "budget check)")
     ap.add_argument("--fail-on", default="error",
                     choices=("error", "warning", "info", "never"),
                     help="exit 1 when findings at/above this severity "
                          "exist (default: error)")
     ap.add_argument("--select", action="append", default=[],
-                    help="run only these rule ids (repeatable)")
+                    help="run only these rule ids (repeatable; fnmatch "
+                         "wildcards like 'MXL-P*' work)")
     ap.add_argument("--skip", action="append", default=[],
-                    help="skip these rule ids (repeatable)")
+                    help="skip these rule ids (repeatable; wildcards work)")
     ap.add_argument("--target", default="tpu",
                     help="lowering target platform (default: tpu)")
-    ap.add_argument("--format", default="text", choices=("text", "json"),
-                    dest="fmt")
+    ap.add_argument("--format", default="text",
+                    choices=("text", "json", "github"), dest="fmt",
+                    help="github = workflow-command annotations for CI")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -140,17 +308,31 @@ def main(argv=None):
 
     try:
         shapes = parse_shapes(args.shapes)
+        mesh = parse_mesh(args.mesh)
+        sharding_rules = parse_sharding(args.sharding)
     except (ValueError, SyntaxError) as exc:
         print("mxlint: %s" % exc, file=sys.stderr)
         return 2
 
+    spmd = {}
+    if mesh is not None:
+        spmd["mesh"] = mesh
+    if sharding_rules is not None:
+        spmd["sharding_rules"] = sharding_rules
+    if args.kvstore:
+        spmd["kvstore"] = args.kvstore
+    if args.grad_req:
+        spmd["grad_req"] = args.grad_req
+    if args.hbm_gb is not None:
+        spmd["hbm_bytes"] = int(args.hbm_gb * (1 << 30))
+
     select = set(args.select) or None
     skip = set(args.skip) or None
-    targets = []    # (label, issues)
+    targets = []    # (label, issues, ctx|None)
     try:
         for path in args.files:
             targets.append(lint_file(path, shapes, args.target, select,
-                                     skip))
+                                     skip, **spmd))
         sweep = list(MODEL_SWEEP) if args.all_models else []
         for name in args.model:
             row = next((r for r in MODEL_SWEEP if r[0] == name),
@@ -160,7 +342,7 @@ def main(argv=None):
         for name, kwargs, default_shapes in sweep:
             targets.append(lint_model(name, kwargs,
                                       shapes or default_shapes,
-                                      args.target, select, skip))
+                                      args.target, select, skip, **spmd))
     except (IOError, OSError, ValueError, ImportError) as exc:
         print("mxlint: %s" % exc, file=sys.stderr)
         return 2
@@ -168,17 +350,28 @@ def main(argv=None):
     worst = None
     if args.fmt == "json":
         doc = []
-        for label, issues in targets:
-            doc.append({"target": label,
-                        "issues": [i.as_dict() for i in issues]})
+        for label, issues, ctx in targets:
+            entry = {"target": label,
+                     "issues": [i.as_dict() for i in issues]}
+            if mesh is not None and ctx is not None and \
+                    ctx.symbol is not None:
+                entry["cost"] = cost_report_dict(ctx)
+            doc.append(entry)
         print(json.dumps(doc, indent=2))
-    for label, issues in targets:
+    for label, issues, ctx in targets:
         if args.fmt == "text":
             verdict = ("clean" if not issues
                        else "%d issue(s)" % len(issues))
             print("== %s: %s" % (label, verdict))
             if issues:
                 print(format_issues(issues))
+            if mesh is not None and ctx is not None and \
+                    ctx.symbol is not None:
+                for line in cost_report_lines(ctx):
+                    print(line)
+        elif args.fmt == "github":
+            for i in issues:
+                print(gh_annotation(label, i))
         for i in issues:
             if worst is None or \
                     SEVERITY_RANK[i.severity] > SEVERITY_RANK[worst]:
