@@ -46,6 +46,7 @@ from . import kvstore_server
 # training (parity: reference __init__.py:35 _init_kvstore_server_module)
 kvstore_server._init_kvstore_server_module()
 from . import parallel
+from . import resilience
 from . import model
 from .model import FeedForward, save_checkpoint, load_checkpoint
 from . import module

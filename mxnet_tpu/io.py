@@ -171,26 +171,36 @@ def _init_data(data, allow_empty, default_name):
 
 class NDArrayIter(DataIter):
     """Iterate over in-memory arrays (parity: io.py:320 NDArrayIter):
-    shuffle, last_batch_handle pad/discard/roll_over, pad accounting."""
+    shuffle, last_batch_handle pad/discard/roll_over, pad accounting.
+
+    Beyond-reference (docs/resilience.md): ``seed`` makes shuffling a
+    pure function of (seed, epoch) — the arrays are never physically
+    reordered, batches are gathered through a permutation array that is
+    deterministically reseeded at every ``reset()``.  Combined with
+    ``state()``/``set_state()`` a preempted job replays the exact batch
+    order it would have seen uninterrupted.  With ``seed=None`` the
+    legacy semantics hold: one global-RNG shuffle at construction, same
+    order every epoch.
+    """
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", seed=None):
         super().__init__()
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
 
-        self.idx = _np.arange(self.data[0][1].shape[0])
-        if shuffle:
-            _np.random.shuffle(self.idx)
-            self.data = [(k, v[self.idx]) for k, v in self.data]
-            self.label = [(k, v[self.idx]) for k, v in self.label]
-
+        self.shuffle = bool(shuffle)
+        self.seed = seed
+        self.epoch = 0
+        self._total = self.data[0][1].shape[0]
         if last_batch_handle == "discard":
-            new_n = self.data[0][1].shape[0] - self.data[0][1].shape[0] % batch_size
-            self.data = [(k, v[:new_n]) for k, v in self.data]
-            self.label = [(k, v[:new_n]) for k, v in self.label]
-            self.idx = self.idx[:new_n]
+            self._kept = self._total - self._total % batch_size
+        else:
+            self._kept = self._total
+        self.idx = _np.arange(self._kept)
+        if self.shuffle:
+            self._reshuffle()
 
         self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
         self.num_source = len(self.data_list)
@@ -200,6 +210,37 @@ class NDArrayIter(DataIter):
         self.cursor = -batch_size
         self.batch_size = batch_size
         self.last_batch_handle = last_batch_handle
+
+    def _reshuffle(self):
+        """Rebuild the permutation for the current epoch."""
+        order = _np.arange(self._total)
+        if self.seed is not None:
+            rng = _np.random.RandomState(
+                (int(self.seed) * 1000003 + self.epoch) % (2 ** 31 - 1))
+            rng.shuffle(order)
+        else:
+            _np.random.shuffle(order)     # legacy: ambient global RNG
+        self.idx = order[:self._kept]
+
+    # -- resumable iteration state (docs/resilience.md) ----------------
+    def state(self):
+        """Position as a small dict: ``{"epoch", "cursor"}`` — snapshot
+        it next to a checkpoint to make the batch stream resumable."""
+        return {"epoch": self.epoch, "cursor": int(self.cursor)}
+
+    def set_state(self, state):
+        """Restore a :meth:`state` snapshot; the next batch drawn is
+        exactly the one the snapshotted run would have drawn.  Requires
+        ``seed`` when shuffling (the legacy global-RNG order is not
+        reconstructible)."""
+        if self.shuffle and self.seed is None:
+            raise MXNetError(
+                "NDArrayIter.set_state needs seed= when shuffle=True "
+                "(an unseeded shuffle order cannot be replayed)")
+        self.epoch = int(state["epoch"])
+        if self.shuffle:
+            self._reshuffle()
+        self.cursor = int(state["cursor"])
 
     @property
     def provide_data(self):
@@ -217,6 +258,9 @@ class NDArrayIter(DataIter):
         self.cursor = -self.batch_size
 
     def reset(self):
+        self.epoch += 1
+        if self.shuffle and self.seed is not None:
+            self._reshuffle()         # deterministic per-epoch reshuffle
         if self.last_batch_handle == "roll_over" and \
                 self.cursor > self.num_data:
             self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
@@ -236,11 +280,11 @@ class NDArrayIter(DataIter):
     def _getdata(self, data_source):
         assert self.cursor < self.num_data, "DataIter need reset."
         if self.cursor + self.batch_size <= self.num_data:
-            return [nd_array(v[self.cursor:self.cursor + self.batch_size])
-                    for _, v in data_source]
-        pad = self.batch_size - self.num_data + self.cursor
-        return [nd_array(_np.concatenate([v[self.cursor:], v[:pad]], axis=0))
-                for _, v in data_source]
+            sel = self.idx[self.cursor:self.cursor + self.batch_size]
+        else:
+            pad = self.batch_size - self.num_data + self.cursor
+            sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
+        return [nd_array(v[sel]) for _, v in data_source]
 
     def getdata(self):
         return self._getdata(self.data)

@@ -84,12 +84,12 @@ class KVStore(object):
     """
 
     def __init__(self, kvtype="local"):
-        import time as _time
         self.type = kvtype
         self._store = {}
         self._updater = None
         self._barrier_before_exit = True
-        self._created = _time.time()
+        self._created = _now()
+        self._ar_seq = 0         # kv-fallback allreduce round counter
 
     # -- identity (include/mxnet/kvstore.h:222-241) -----------------------
     @property
@@ -151,6 +151,18 @@ class KVStore(object):
         """
         if not (self.type.startswith("dist") and jax.process_count() > 1):
             return merged
+        timeout = _collective_timeout_s()
+        if timeout:
+            # a peer that died mid-push leaves everyone else wedged in
+            # the collective forever; the watchdog bounds that to a
+            # structured abort + restart (docs/resilience.md)
+            from .resilience import run_with_timeout
+            return run_with_timeout(
+                lambda: self._allreduce_dist(merged), timeout,
+                phase="kvstore_push", rank=self.rank)
+        return self._allreduce_dist(merged)
+
+    def _allreduce_dist(self, merged):
         # Pick the path ONCE, cluster-wide.  A per-process probe could
         # split workers between two different collectives and deadlock the
         # pod (probe failing on a subset), so rank 0 probes and publishes
@@ -163,9 +175,45 @@ class KVStore(object):
             _CSUM_CACHE["enabled"] = enabled
         if enabled:
             return _collective_sum(merged)
-        from jax.experimental import multihost_utils
-        gathered = multihost_utils.process_allgather(merged)
-        return jnp.sum(gathered, axis=0)
+        return self._kv_allreduce(merged)
+
+    def _kv_allreduce(self, merged):
+        """Backend-free gradient sum through the coordination-service KV.
+
+        Used when the compile-only probe says the backend cannot build
+        cross-process XLA programs at all (multi-process CPU — where
+        the resilience drills run — rejects them, and so does the
+        process_allgather fallback, which is itself a jitted
+        multi-process computation).  Each rank publishes its tensor
+        under a per-round key and sums everyone's; string RPC only, so
+        it works on any backend.  Slow — a correctness/testing path,
+        never the pod fast path (that is the in-step psum)."""
+        client = _dist_client()
+        if client is None:
+            return merged
+        import numpy as _onp
+        seq = self._ar_seq
+        self._ar_seq += 1
+        host = _onp.asarray(jax.device_get(merged))
+        client.key_value_set("mxtpu_ar/%d/%d" % (seq, self.rank),
+                             _encode_array(host), allow_overwrite=True)
+        timeout_ms = int((_collective_timeout_s() or 600.0) * 1000.0)
+        total = None
+        for r in range(self.num_workers):
+            a = host if r == self.rank else _decode_array(
+                client.blocking_key_value_get(
+                    "mxtpu_ar/%d/%d" % (seq, r), timeout_ms))
+            total = a if total is None else total + a
+        # clear this rank's round-(seq-2) key: every peer finished round
+        # seq-1 (which required reading this rank's seq-2 round first)
+        # before it could contribute to the current round
+        if seq >= 2:
+            try:
+                client.key_value_delete(
+                    "mxtpu_ar/%d/%d" % (seq - 2, self.rank))
+            except Exception:
+                pass
+        return jnp.asarray(total)
 
     @staticmethod
     def _decide_csum_path():
@@ -201,7 +249,7 @@ class KVStore(object):
         except Exception as exc:  # noqa: BLE001
             logging.warning(
                 "kvstore: XLA collective sum unavailable (%r); the cluster "
-                "will use the allgather fallback", exc)
+                "will use the coordination-service KV fallback", exc)
             enabled = False
         if client is not None:
             try:
@@ -244,9 +292,13 @@ class KVStore(object):
         RPC jitter and modest cross-host clock skew.  Returns 0 for
         non-dist stores.
         """
-        import time as _time
         if timeout is None:
             timeout = 5 * _HB_INTERVAL
+        if self.type.startswith("dist"):
+            from .resilience.faultinject import maybe_fault
+            spec = maybe_fault("dead_node")
+            if spec is not None and spec.kind == "dead_node":
+                return int(spec.n)
         client = _dist_client()
         if client is None or not self.type.startswith("dist"):
             return 0
@@ -257,7 +309,7 @@ class KVStore(object):
             # included): the cluster is lost — report everyone dead so
             # restart watchdogs fire rather than report a healthy 0
             return self.num_workers
-        now = _time.time()
+        now = _now()
         ranks = [node_id] if node_id is not None \
             else range(self.num_workers)
         dead = 0
@@ -277,10 +329,23 @@ class KVStore(object):
 
     # -- misc --------------------------------------------------------------
     def barrier(self):
-        """Global worker barrier (parity kvstore.h:249; ps Postoffice barrier)."""
+        """Global worker barrier (parity kvstore.h:249; ps Postoffice barrier).
+
+        Under ``MXTPU_STEP_TIMEOUT_S`` a barrier a dead peer will never
+        join raises :class:`~mxnet_tpu.resilience.ResilienceError`
+        instead of hanging forever."""
         if self.type.startswith("dist") and jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+            timeout = _collective_timeout_s()
+
+            def _sync():
+                global_barrier("kv_barrier", timeout_s=timeout)
+
+            if timeout:
+                from .resilience import run_with_timeout
+                run_with_timeout(_sync, timeout, phase="kvstore_barrier",
+                                 rank=self.rank)
+            else:
+                _sync()
 
     def _barrier(self):
         self.barrier()
@@ -325,6 +390,73 @@ _HB_PREFIX = "mxtpu_hb/"
 _HB_INTERVAL = 2.0
 
 _CSUM_CACHE = {}
+
+
+def _now():
+    """Wall clock behind the liveness math — module-level so tests can
+    monkeypatch it to step time deterministically."""
+    import time as _time
+    return _time.time()
+
+
+def _collective_timeout_s():
+    """Watchdog timeout for kvstore collectives (MXTPU_STEP_TIMEOUT_S)."""
+    from .resilience import step_timeout_s
+    return step_timeout_s()
+
+
+_BARRIER_STATE = {"xla_ok": None, "seq": {}}
+
+
+def global_barrier(tag, timeout_s=None):
+    """Cross-process barrier that works on any backend.
+
+    Prefers ``sync_global_devices`` (a device-level fence).  Backends
+    that cannot run multi-process XLA programs at all — multi-process
+    CPU, where the resilience drills run — reject it, so the first such
+    failure flips this process to the coordination-service
+    ``wait_at_barrier`` RPC for good.  The probe outcome is a property
+    of the backend, identical on every rank, so no rank can end up in a
+    different barrier implementation than its peers.
+    """
+    if jax.process_count() <= 1:
+        return
+    if _BARRIER_STATE["xla_ok"] is not False:
+        try:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxtpu_" + tag)
+            _BARRIER_STATE["xla_ok"] = True
+            return
+        except Exception:
+            if _BARRIER_STATE["xla_ok"] is True:
+                raise  # it worked before: a real failure, not a backend gap
+            _BARRIER_STATE["xla_ok"] = False
+    client = _dist_client()
+    if client is None:
+        return
+    n = _BARRIER_STATE["seq"].get(tag, 0) + 1
+    _BARRIER_STATE["seq"][tag] = n
+    timeout_ms = int((timeout_s or 600.0) * 1000.0)
+    client.wait_at_barrier("mxtpu_%s_%d" % (tag, n), timeout_ms)
+
+
+def _encode_array(arr):
+    """Array -> coordination-KV string: `dtype|shape|base64(bytes)`."""
+    import base64
+    import numpy as _onp
+    arr = _onp.asarray(arr)
+    shape = ",".join(str(d) for d in arr.shape)
+    return "%s|%s|%s" % (arr.dtype.str, shape,
+                         base64.b64encode(arr.tobytes(order="C")).decode("ascii"))
+
+
+def _decode_array(text):
+    import base64
+    import numpy as _onp
+    dtype, shape, payload = text.split("|", 2)
+    shape = tuple(int(d) for d in shape.split(",")) if shape else ()
+    buf = base64.b64decode(payload)
+    return _onp.frombuffer(buf, dtype=_onp.dtype(dtype)).reshape(shape)
 
 
 def _collective_sum(value):
@@ -384,32 +516,56 @@ def _dist_client():
         return None
 
 
+_HB_STATE = {"thread": None, "stop": None}
+
+
 def _start_heartbeat():
     """Background liveness stamping for num_dead_nodes (ps-lite heartbeat
-    analog).  Idempotent per process."""
-    if getattr(_start_heartbeat, "_thread", None) is not None:
+    analog).  Idempotent per process; the thread is a daemon AND is
+    stopped via atexit, so interpreter shutdown can neither hang joining
+    it nor race it against a torn-down coordination client."""
+    t = _HB_STATE["thread"]
+    if t is not None and t.is_alive():
         return
     client = _dist_client()
     if client is None:
         return
+    import atexit
     import threading
     import time as _time
     rank = jax.process_index()
     key = "%s%d" % (_HB_PREFIX, rank)
+    stop = threading.Event()
 
     def _beat():
-        while True:
+        while not stop.is_set():
             try:
                 client.key_value_set(key, repr(_time.time()),
                                      allow_overwrite=True)
             except Exception:
                 return       # cluster shut down
-            _time.sleep(_HB_INTERVAL)
+            # Event.wait, not sleep: _stop_heartbeat returns promptly
+            # instead of waiting out the remainder of an interval
+            stop.wait(_HB_INTERVAL)
 
     t = threading.Thread(target=_beat, daemon=True,
                          name="mxtpu-kv-heartbeat")
     t.start()
-    _start_heartbeat._thread = t
+    if _HB_STATE["thread"] is None:          # register atexit hook once
+        atexit.register(_stop_heartbeat)
+    _HB_STATE["thread"] = t
+    _HB_STATE["stop"] = stop
+
+
+def _stop_heartbeat():
+    """Signal the heartbeat thread to exit and wait (bounded) for it."""
+    t, stop = _HB_STATE["thread"], _HB_STATE["stop"]
+    if stop is not None:
+        stop.set()
+    if t is not None and t.is_alive():
+        t.join(2 * _HB_INTERVAL)
+    _HB_STATE["thread"] = None
+    _HB_STATE["stop"] = None
 
 
 _VALID_TYPES = ("local", "local_update_cpu", "local_allreduce_cpu",
@@ -454,10 +610,17 @@ def _maybe_init_distributed():
             "process mode." % (" and ".join(missing),
                                "is" if len(missing) == 1 else "are"))
     try:
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=int(os.environ["MXTPU_NUM_WORKERS"]),
-            process_id=int(os.environ["MXTPU_WORKER_RANK"]))
+        # rendezvous is the one retryable distributed phase: a worker
+        # routinely dials before the coordinator is listening.  Retry
+        # transient connect/deadline failures with backoff; anything
+        # deterministic (bad config) propagates on the first attempt.
+        from .resilience import RetryPolicy, retry_call
+        retry_call(
+            lambda: jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(os.environ["MXTPU_NUM_WORKERS"]),
+                process_id=int(os.environ["MXTPU_WORKER_RANK"])),
+            policy=RetryPolicy(), phase="jax.distributed.initialize")
     except RuntimeError as exc:
         raise MXNetError(
             "kvstore.create('dist_*') must run before any jax/NDArray "

@@ -252,6 +252,13 @@ class BaseModule(object):
             validation_metric = eval_metric
         eval_metric = _as_metric(eval_metric)
 
+        # numeric sentinel (MXTPU_SENTINEL): a NaN/Inf/spiking grad-norm
+        # skips the update instead of poisoning the parameters
+        from ..resilience import Sentinel
+        from ..resilience import sentinel as _sentinel_mod
+        sentinel = Sentinel.from_env(logger=self.logger)
+        num_step = 0
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -259,7 +266,16 @@ class BaseModule(object):
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
-                self.update()
+                num_step += 1
+                skip = False
+                if sentinel is not None:
+                    grads = getattr(self, "_exec_group", None)
+                    grads = getattr(grads, "grad_arrays", None)
+                    gnorm = Sentinel.grad_norm(grads) if grads else None
+                    skip = sentinel.check(
+                        num_step, grad_norm=gnorm) != _sentinel_mod.OK
+                if not skip:
+                    self.update()
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()

@@ -410,6 +410,19 @@ class Module(BaseModule):
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod
 
+    @staticmethod
+    def load_latest(prefix, **kwargs):
+        """``(module, epoch)`` from the newest ``prefix-NNNN.params`` on
+        disk, or ``(None, None)`` on a fresh run — the auto-resume
+        entry for preemptible jobs (docs/resilience.md).  Keyword
+        arguments pass through to :meth:`load` (including
+        ``load_optimizer_states``)."""
+        from ..resilience import latest_classic_epoch
+        epoch = latest_classic_epoch(prefix)
+        if epoch is None:
+            return None, None
+        return Module.load(prefix, epoch, **kwargs), epoch
+
     def save_optimizer_states(self, fname):
         if not self.optimizer_initialized:
             raise MXNetError("init_optimizer first")

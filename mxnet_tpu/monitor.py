@@ -42,7 +42,8 @@ class Monitor(object):
     and ``toc()``/``toc_print()`` after it.
     """
 
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 alarm_nonfinite=False):
         self.interval = interval
         self.stat_func = stat_func or _abs_mean
         self._pattern = re.compile(pattern)
@@ -51,6 +52,11 @@ class Monitor(object):
         self.activated = False
         self.exes = []
         self.queue = []
+        # nonfinite sentinel mode (docs/resilience.md): record which
+        # monitored tensor first went NaN/Inf — the localization tool
+        # the global grad-norm sentinel can't be
+        self.alarm_nonfinite = bool(alarm_nonfinite)
+        self.nonfinite_records = []       # [(step, name, stat), ...]
         # bound method, captured once: executors hold this as their
         # monitor callback
         self.stat_helper = self._record
@@ -58,7 +64,18 @@ class Monitor(object):
     # -- callback fired per monitored op output -----------------------
     def _record(self, name, array):
         if self.activated and self._pattern.match(name):
-            self.queue.append((self.step, name, self.stat_func(array)))
+            stat = self.stat_func(array)
+            if self.alarm_nonfinite:
+                import numpy as _np
+                vals = stat if isinstance(stat, (list, tuple)) else (stat,)
+                if not all(_np.isfinite(_np.asarray(v).astype(_np.float64))
+                           .all() for v in vals):
+                    self.nonfinite_records.append((self.step, name, stat))
+                    del self.nonfinite_records[:-100]    # bounded
+                    logging.warning(
+                        "Monitor: NON-FINITE stat at step %d tensor %r: %r",
+                        self.step, name, stat)
+            self.queue.append((self.step, name, stat))
 
     def _sync_args(self):
         for exe in self.exes:

@@ -5,8 +5,19 @@ stable top-level keys plus an int64 ``step`` counter.  Each host writes
 and reads only its own shards; restore targets are abstract
 (ShapeDtypeStruct + sharding) so no transient full-size host buffers
 are materialized.
+
+Saves are **atomic with respect to preemption** (docs/resilience.md):
+the payload is written to a sibling scratch path, made durable, and
+swapped into place — a crash at any instant leaves the previous
+checkpoint at ``path`` readable (or, in the instant between the two
+commit renames, intact under ``path.old.*`` with the complete new one
+under ``path.tmp.*``).  The naive protocol this replaces
+(``StandardCheckpointer.save(force=True)``) deleted the existing
+checkpoint *before* writing the new one, so a preemption mid-save lost
+both.
 """
 import os as _os
+import shutil as _shutil
 
 import numpy as _np
 
@@ -22,15 +33,80 @@ def abstract_like(tree):
                                        sharding=a.sharding), tree)
 
 
-def ocp_save(path, tree, step):
+def _fsync_dir(path):
+    try:
+        fd = _os.open(path, _os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        _os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        _os.close(fd)
+
+
+def _is_coordinator():
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def _barrier(tag):
+    try:
+        if jax.process_count() > 1:
+            from ..kvstore import global_barrier
+            global_barrier(tag)
+    except Exception:
+        pass
+
+
+def ocp_save(path, tree, step, atomic=True):
     """Write ``tree`` + the update counter sharded to ``path`` (dir).
-    Multi-host: every process must call this; blocks until durable."""
+    Multi-host: every process must call this; blocks until durable.
+
+    ``atomic=True`` (default) runs the scratch-write + rename commit
+    protocol above.  ``atomic=False`` writes ``path`` directly — for
+    callers that already own a commit protocol (CheckpointManager
+    renames the whole directory itself).
+    """
     import orbax.checkpoint as ocp
+    from ..resilience.faultinject import maybe_fault
+
+    path = _os.path.abspath(str(path))
     ckptr = ocp.StandardCheckpointer()
     payload = dict(tree)
-    payload["step"] = _np.int64(step)
-    ckptr.save(_os.path.abspath(str(path)), payload, force=True)
+    # 0-d ndarray, not a numpy scalar: StandardCheckpointer rejects
+    # np.int64(...) as an unsupported leaf type
+    payload["step"] = _np.asarray(int(step), dtype=_np.int64)
+    if not atomic:
+        ckptr.save(path, payload, force=True)
+        ckptr.wait_until_finished()
+        return path
+
+    maybe_fault("ckpt_write", step=step)
+    tmp = "%s.tmp.%d" % (path, _os.getpid())
+    old = "%s.old.%d" % (path, _os.getpid())
+    for stale in (tmp, old):
+        if _os.path.isdir(stale):
+            _shutil.rmtree(stale)
+    ckptr.save(tmp, payload, force=True)
     ckptr.wait_until_finished()
+    _fsync_dir(_os.path.dirname(tmp))
+    # the scratch checkpoint is durable; crashing anywhere before the
+    # rename below leaves the previous `path` untouched
+    maybe_fault("ckpt_commit", step=step)
+    _barrier("mxtpu_ocp_commit")
+    if _is_coordinator():
+        had_old = _os.path.isdir(path)
+        if had_old:
+            _os.rename(path, old)
+        _os.rename(tmp, path)                    # the commit point
+        _fsync_dir(_os.path.dirname(path))
+        if had_old:
+            _shutil.rmtree(old, ignore_errors=True)
+    _barrier("mxtpu_ocp_done")
     return path
 
 

@@ -71,7 +71,9 @@ class ShardedTrainer(object):
     def __init__(self, symbol, optimizer, mesh, data_names=("data",),
                  label_names=("softmax_label",), rules=None, seq_axis=None,
                  donate=True, compute_dtype=None, remat=False,
-                 cast_exempt=(), zero1=False, fsdp=False):
+                 cast_exempt=(), zero1=False, fsdp=False, sentinel=None,
+                 loss_scale_init=2.0 ** 15, loss_scale_growth=200,
+                 step_timeout_s=None):
         self.symbol = symbol
         self.optimizer = optimizer
         self.mesh = mesh
@@ -103,6 +105,19 @@ class ShardedTrainer(object):
         # Optimizer state follows the parameter sharding automatically.
         self.fsdp = bool(fsdp) and "dp" in mesh.shape \
             and mesh.shape["dp"] > 1
+        # numeric sentinel (resilience): gate the update INSIDE the
+        # compiled step on all-gradients-finite, with dynamic loss
+        # scaling — a host-side check would force a device sync every
+        # step, so the skip/backoff decision is traced (docs/resilience.md)
+        from .. import resilience as _resilience
+        self.sentinel = _resilience.sentinel_enabled() if sentinel is None \
+            else bool(sentinel)
+        self._loss_scale_init = float(loss_scale_init)
+        self._loss_scale_growth = int(loss_scale_growth)
+        self._sentinel_state = None
+        # step watchdog timeout (None = env MXTPU_STEP_TIMEOUT_S at call
+        # time, so a launcher can arm it without touching user code)
+        self.step_timeout_s = step_timeout_s
 
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
@@ -191,8 +206,95 @@ class ShardedTrainer(object):
                     new_opt_state[name] = s
             return new_params, new_opt_state, aux_out, outs
 
-        donate_argnums = (0, 1, 2) if donate else ()
-        self._jit_step = jax.jit(train_step, donate_argnums=donate_argnums)
+        growth = jnp.int32(self._loss_scale_growth)
+        min_scale, max_scale = jnp.float32(1.0), jnp.float32(2.0 ** 24)
+
+        def train_step_sentinel(params, opt_state, aux, batch, rng, lr,
+                                wd, t, sstate):
+            """train_step + the compiled numeric gate: check every
+            gradient finite and WHERE the update —
+            a non-finite step keeps the old params/state/aux, halves
+            the loss scale, and bumps the skip counter, all without a
+            host round-trip (the sentinel contract, docs/resilience.md)."""
+            def run(p):
+                args = dict(_to_compute(p))
+                args.update(_batch_to_compute(batch))
+                outs, aux_out = trace(args, _to_compute(aux), rng, True)
+                if cdt is not None:
+                    aux_out = {k: v.astype(aux[k].dtype)
+                               for k, v in aux_out.items()}
+                return outs, aux_out
+
+            # NOTE on the loss scale: the built-in loss heads keep the
+            # reference's backward semantics (SoftmaxOutput bwd =
+            # p - onehot, head gradient IGNORED unless out_grad=True),
+            # so a scaled cotangent seed would not reach the gradients
+            # — the gate therefore checks the TRUE grads, and the
+            # dynamic scale is pure backoff state: halved on a bad
+            # step, grown after good ones, exported via
+            # sentinel_stats() for losses that do consume it
+            # (out_grad=True heads, custom grad_scale).
+            scale = sstate["scale"]
+            (outs, aux_out), vjp_fn = jax.vjp(run, params)
+            ones = [jnp.ones_like(o) for o in outs]
+            zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_out)
+            grads = vjp_fn((ones, zero_aux))[0]
+
+            gs = {name: preprocess(grads[name]) for name in params}
+            finite = jnp.bool_(True)
+            for name in params:
+                finite = jnp.logical_and(
+                    finite, jnp.all(jnp.isfinite(gs[name])))
+
+            new_params = {}
+            new_opt_state = {}
+            for name in params:
+                w, s = opt_update(params[name], gs[name],
+                                  opt_state.get(name), lr, wd, t)
+                w = jnp.where(finite, w, params[name])
+                if s is not None:
+                    s = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(finite, new, old),
+                        s, opt_state[name])
+                if self.zero1:
+                    w = jax.lax.with_sharding_constraint(
+                        w, self.param_sharding(name, w.shape))
+                    if s is not None:
+                        s = jax.tree_util.tree_map(
+                            lambda a: jax.lax.with_sharding_constraint(
+                                a, self.opt_state_sharding(name, a.shape)),
+                            s)
+                new_params[name] = w
+                if s is not None:
+                    new_opt_state[name] = s
+            aux_out = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(finite, new, old), aux_out, aux)
+
+            good = jnp.where(finite, sstate["good_steps"] + 1,
+                             jnp.int32(0))
+            grow = good >= growth
+            new_scale = jnp.where(
+                finite,
+                jnp.where(grow, jnp.minimum(scale * 2.0, max_scale),
+                          scale),
+                jnp.maximum(scale * 0.5, min_scale))
+            new_sstate = {
+                "scale": new_scale,
+                "good_steps": jnp.where(grow, jnp.int32(0), good),
+                "skipped": sstate["skipped"]
+                + jnp.where(finite, jnp.int32(0), jnp.int32(1)),
+                "last_good": jnp.where(finite, t, sstate["last_good"]),
+            }
+            return new_params, new_opt_state, aux_out, outs, new_sstate
+
+        if self.sentinel:
+            donate_argnums = (0, 1, 2, 8) if donate else ()
+            self._jit_step = jax.jit(train_step_sentinel,
+                                     donate_argnums=donate_argnums)
+        else:
+            donate_argnums = (0, 1, 2) if donate else ()
+            self._jit_step = jax.jit(train_step,
+                                     donate_argnums=donate_argnums)
         self._abstract_args = None   # ShapeDtypeStructs of the step args
         self._lowered = None         # cached jax.stages.Lowered
 
@@ -340,6 +442,45 @@ class ShardedTrainer(object):
         self.num_update = step
         return restored["params"], restored["opt_state"], restored["aux"]
 
+    def checkpoint_manager(self, directory, keep=None):
+        """A :class:`mxnet_tpu.resilience.CheckpointManager` rooted at
+        ``directory`` for versioned keep-last-K checkpoints of this
+        trainer's state (see save_checkpoint_versioned/auto_resume)."""
+        from ..resilience import CheckpointManager
+        return CheckpointManager(directory, keep=keep)
+
+    def save_checkpoint_versioned(self, directory, params, opt_state, aux,
+                                  keep=None):
+        """Commit an atomic ``step_<NNNNNNNN>`` checkpoint under
+        ``directory`` (pruned to keep-last-K); safe against preemption
+        at any instant — see docs/resilience.md."""
+        mgr = self.checkpoint_manager(directory, keep=keep)
+        return mgr.save({"params": params, "opt_state": opt_state,
+                         "aux": aux}, self.num_update)
+
+    def latest_step(self, directory):
+        """Newest committed step under ``directory``, or None."""
+        return self.checkpoint_manager(directory).latest_step()
+
+    def auto_resume(self, directory, data_shapes, label_shapes=None,
+                    dtype=_np.float32):
+        """Resume from the latest committed checkpoint under
+        ``directory``: returns (params, opt_state, aux, step) with the
+        trainer's update counter restored, or None when the run is
+        fresh.  The one call a preemptible training script makes before
+        its loop."""
+        mgr = self.checkpoint_manager(directory)
+        params_t, opt_t, aux_t = self.abstract_state(
+            data_shapes, label_shapes, dtype)
+        got = mgr.auto_resume(
+            {"params": params_t, "opt_state": opt_t, "aux": aux_t})
+        if got is None:
+            return None
+        restored, step = got
+        self.num_update = step
+        return (restored["params"], restored["opt_state"],
+                restored["aux"], step)
+
     def shard_batch(self, batch):
         """Place host batch arrays onto the mesh with dp/sp sharding —
         the analog of executor_manager.load_data_batch slicing.
@@ -352,6 +493,28 @@ class ShardedTrainer(object):
     # ------------------------------------------------------------------
     # steps
     # ------------------------------------------------------------------
+    def _init_sentinel_state(self):
+        """Replicated device scalars for the compiled sentinel gate."""
+        from .sharding import put_replicated_host
+        rep = self._replicated()
+        return {
+            "scale": put_replicated_host(
+                jnp.float32(self._loss_scale_init), rep),
+            "good_steps": put_replicated_host(jnp.int32(0), rep),
+            "skipped": put_replicated_host(jnp.int32(0), rep),
+            "last_good": put_replicated_host(jnp.int32(0), rep),
+        }
+
+    def sentinel_stats(self):
+        """Host view of the sentinel counters: dict with ``scale``,
+        ``good_steps``, ``skipped``, ``last_good`` — or None when the
+        sentinel is off or no step has run.  Forces a device sync, so
+        poll it at logging cadence, not every step."""
+        if self._sentinel_state is None:
+            return None
+        return {k: _np.asarray(jax.device_get(v)).item()
+                for k, v in self._sentinel_state.items()}
+
     def step(self, params, opt_state, aux, batch, rng=None):
         """Run one fused train step; returns (params, opt_state, aux, outputs)."""
         self.num_update += 1
@@ -364,14 +527,47 @@ class ShardedTrainer(object):
             from .. import random as _random
             rng = _random.next_key() if self._needs_rng \
                 else jax.random.PRNGKey(0)
+
+        from .. import resilience as _resilience
+        inj = _resilience.injector()
+        if inj is not None:
+            spec = inj.match("batch", step=self.num_update)
+            if spec is not None and spec.kind == "nan":
+                batch = dict(batch)
+                for name in self.data_names:
+                    if name in batch:
+                        batch[name] = _resilience.poison_nan(batch[name])
+
         step_args = (params, opt_state, aux, batch, rng,
                      jnp.float32(lr), jnp.float32(opt.wd),
                      jnp.int32(self.num_update))
+        if self.sentinel:
+            if self._sentinel_state is None:
+                self._sentinel_state = self._init_sentinel_state()
+            step_args = step_args + (self._sentinel_state,)
         if self._abstract_args is None:
             self._abstract_args = jax.tree_util.tree_map(
                 _abstractify, step_args)
-        with self._sp_scope():
-            return self._jit_step(*step_args)
+
+        def dispatch():
+            # inside the guarded region so injected hangs are caught
+            # exactly like a wedged collective would be
+            _resilience.maybe_fault("step", step=self.num_update)
+            with self._sp_scope():
+                out = self._jit_step(*step_args)
+            if self.sentinel:
+                self._sentinel_state = out[4]
+                return out[:4]
+            return out
+
+        timeout = self.step_timeout_s
+        if timeout is None:
+            timeout = _resilience.step_timeout_s()
+        if timeout:
+            return _resilience.run_with_timeout(
+                dispatch, timeout, phase="train_step",
+                step=self.num_update)
+        return dispatch()
 
     def eval(self, params, aux, batch, rng=None):
         if rng is None:

@@ -1,0 +1,155 @@
+"""resilience: make a pod-scale training job survive its three real
+failure modes.
+
+The reference's entire fault surface is ps-lite heartbeats exposed as
+``get_num_dead_node`` (kvstore_dist.h:149-158, SURVEY §5).  The
+TPU-native recovery model is different: a preempted, hung, or
+numerically-poisoned worker must become a **bounded restart** —
+checkpoint/resume with pod restart — never a corrupted checkpoint or a
+silent hang inside a collective.  This package supplies the pieces:
+
+- **preemption** → :mod:`.ckptmgr`: atomic, versioned, auto-pruned
+  checkpoints (write to ``tmp.<step>``, fsync, rename; keep-last-K)
+  with ``latest_step()``/``auto_resume()``.
+- **hangs** → :mod:`.watchdog`: configurable step/collective timeouts
+  that convert a stuck dispatch into a structured
+  :class:`ResilienceError` carrying rank/step/phase, and
+  :mod:`.retry`: exponential-backoff retry for the retryable
+  distributed-init paths.
+- **numeric faults** → :mod:`.sentinel`: NaN/Inf/loss-spike detection
+  with skip-step, dynamic loss-scale backoff, and a rolling
+  last-good-step record (host-side here; the compiled in-step gate
+  lives in ``parallel.trainer.ShardedTrainer(sentinel=True)``).
+- **testability** → :mod:`.faultinject`: a deterministic fault
+  injector (env ``MXTPU_FAULT_SPEC``) that plants NaN grads,
+  checkpoint-write crashes, slow/hung steps, and dead-node reports at
+  the trainer/ckpt/kvstore seams, so every recovery path has a real
+  unit test on a CPU dev box.
+
+Exit-code contract (docs/resilience.md): ``3`` means "restart me" —
+the signal ``tests/nightly/dist_resume.py`` documents and
+``tools/launch.py`` propagates (killing sibling workers promptly so
+the pod restarts bounded instead of draining a hang).
+"""
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+from ..base import MXNetError
+
+#: Process exit code meaning "state is consistent, restart the job".
+EXIT_RESTART = 3
+
+
+class ResilienceError(MXNetError):
+    """A failure the runtime converted into a restartable condition.
+
+    Carries structured context (phase/rank/step/kind) so the restart
+    machinery — and the human reading the log — knows exactly where
+    the job stopped.  Uncaught, the contract is to exit with
+    :data:`EXIT_RESTART`.
+    """
+
+    exit_code = EXIT_RESTART
+
+    def __init__(self, message, phase=None, rank=None, step=None,
+                 kind="timeout", timeout_s=None):
+        self.phase = phase
+        self.rank = rank
+        self.step = step
+        self.kind = kind
+        self.timeout_s = timeout_s
+        super().__init__("%s [%s]" % (message, self.context()))
+
+    def context(self):
+        """``key=value`` context string (grep-stable, docs/resilience.md)."""
+        parts = ["kind=%s" % self.kind]
+        for key in ("phase", "rank", "step", "timeout_s"):
+            val = getattr(self, key)
+            if val is not None:
+                parts.append("%s=%s" % (key, val))
+        return " ".join(parts)
+
+
+def exit_for_restart(err):
+    """Log ``err`` with full context and exit with :data:`EXIT_RESTART`.
+
+    Uses ``os._exit`` on purpose: the failed thread may be wedged in a
+    native collective that normal interpreter teardown would join
+    forever on — the exact hang this package exists to bound.
+    """
+    print("RESILIENCE ABORT: %s" % err, file=_sys.stderr, flush=True)
+    _os._exit(getattr(err, "exit_code", EXIT_RESTART))
+
+
+def install_excepthook():
+    """Make an uncaught :class:`ResilienceError` exit with code 3.
+
+    Training scripts call this once; any watchdog/sentinel escalation
+    that unwinds to top level then produces the restart signal instead
+    of a generic traceback + exit 1.
+    """
+    prev = _sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        if isinstance(exc, ResilienceError):
+            prev(exc_type, exc, tb)
+            exit_for_restart(exc)
+        prev(exc_type, exc, tb)
+
+    _sys.excepthook = _hook
+
+
+# ----------------------------------------------------------------------
+# env knobs (docs/env_vars.md) — read at call time so tests can
+# monkeypatch the environment
+# ----------------------------------------------------------------------
+def step_timeout_s(default=None):
+    """``MXTPU_STEP_TIMEOUT_S``: watchdog timeout for train steps and
+    kvstore collectives (float seconds); None/unset disables."""
+    raw = _os.environ.get("MXTPU_STEP_TIMEOUT_S")
+    if not raw:
+        return default
+    return float(raw)
+
+
+def retry_max(default=3):
+    """``MXTPU_RETRY_MAX``: attempts for retryable distributed-init."""
+    raw = _os.environ.get("MXTPU_RETRY_MAX")
+    return int(raw) if raw else default
+
+
+def ckpt_keep(default=3):
+    """``MXTPU_CKPT_KEEP``: checkpoints retained by CheckpointManager."""
+    raw = _os.environ.get("MXTPU_CKPT_KEEP")
+    return int(raw) if raw else default
+
+
+def sentinel_enabled(default=False):
+    """``MXTPU_SENTINEL``: enable NaN/Inf/spike sentinels by default."""
+    raw = _os.environ.get("MXTPU_SENTINEL")
+    if raw is None:
+        return default
+    return raw.lower() not in ("", "0", "false", "off")
+
+
+from .faultinject import (FaultSpec, FaultInjector, InjectedFault,  # noqa: E402
+                          parse_fault_spec, maybe_fault, injector,
+                          poison_nan)
+from .watchdog import Watchdog, run_with_timeout  # noqa: E402
+from .retry import RetryPolicy, retry_call  # noqa: E402
+from .sentinel import Sentinel  # noqa: E402
+from .ckptmgr import CheckpointManager, latest_classic_epoch  # noqa: E402
+
+__all__ = [
+    "EXIT_RESTART", "ResilienceError", "exit_for_restart",
+    "install_excepthook",
+    "step_timeout_s", "retry_max", "ckpt_keep", "sentinel_enabled",
+    "FaultSpec", "FaultInjector", "InjectedFault", "parse_fault_spec",
+    "maybe_fault", "injector", "poison_nan",
+    "Watchdog", "run_with_timeout",
+    "RetryPolicy", "retry_call",
+    "Sentinel",
+    "CheckpointManager", "latest_classic_epoch",
+]

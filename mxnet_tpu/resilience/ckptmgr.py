@@ -1,0 +1,217 @@
+"""Preemption-safe checkpoint management: atomic, versioned, pruned.
+
+The failure model (docs/resilience.md): a TPU pod job can be preempted
+at ANY instruction, including halfway through writing a checkpoint.
+The invariant this module maintains is therefore single: **the newest
+readable checkpoint is never clobbered or corrupted**.  Mechanics:
+
+- every save writes to ``<dir>/tmp.<step>.<pid>``, is made durable
+  (orbax wait + directory fsync), and only then renamed to
+  ``<dir>/step_<NNNNNNNN>`` — the rename is the commit point, so a
+  crash at any moment leaves either the old set intact (tmp garbage
+  ignored) or the old set plus one complete new checkpoint;
+- ``latest_step()`` sees only committed directories;
+- keep-last-K pruning (``MXTPU_CKPT_KEEP``) deletes oldest *after*
+  the new save commits, so the retained count never dips below K;
+- stale ``tmp.*`` from a previous incarnation is swept on save.
+
+Multi-host: every process calls :meth:`CheckpointManager.save` (orbax
+coordinates the sharded write); the commit rename and pruning run on
+process 0 only, fenced by global barriers so no rank can observe a
+half-committed state.
+"""
+from __future__ import annotations
+
+import logging
+import os as _os
+import re as _re
+import shutil as _shutil
+
+from . import ckpt_keep
+
+_STEP_FMT = "step_%08d"
+_STEP_RE = _re.compile(r"^step_(\d{8})$")
+_TMP_RE = _re.compile(r"^tmp\.")
+
+
+def _fsync_dir(path):
+    """Make directory entries durable (best-effort on exotic fs)."""
+    try:
+        fd = _os.open(path, _os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        _os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        _os.close(fd)
+
+
+def _is_coordinator():
+    try:
+        import jax
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def _barrier(tag):
+    try:
+        import jax
+        if jax.process_count() > 1:
+            from ..kvstore import global_barrier
+            global_barrier(tag)
+    except Exception:
+        pass
+
+
+class CheckpointManager(object):
+    """Versioned checkpoints for one training run under ``directory``.
+
+    Parameters
+    ----------
+    directory : str
+        Root directory; committed checkpoints live at
+        ``directory/step_<NNNNNNNN>``.
+    keep : int, optional
+        Checkpoints retained (keep-last-K); defaults to
+        ``MXTPU_CKPT_KEEP`` (3).  ``keep <= 0`` disables pruning.
+    """
+
+    def __init__(self, directory, keep=None, logger=None):
+        self.directory = _os.path.abspath(str(directory))
+        self.keep = ckpt_keep() if keep is None else int(keep)
+        self.logger = logger or logging
+
+    # ------------------------------------------------------------------
+    # discovery
+    # ------------------------------------------------------------------
+    def step_path(self, step):
+        return _os.path.join(self.directory, _STEP_FMT % int(step))
+
+    def all_steps(self):
+        """Sorted committed steps (tmp/partial writes are invisible)."""
+        try:
+            names = _os.listdir(self.directory)
+        except OSError:
+            return []
+        steps = []
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self):
+        """Newest committed step, or None when the run is fresh."""
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # ------------------------------------------------------------------
+    # save / restore
+    # ------------------------------------------------------------------
+    def save(self, tree, step):
+        """Atomically commit ``tree`` as the checkpoint for ``step``.
+
+        Every process must call this (sharded write); blocks until the
+        checkpoint is durable AND committed.  Returns the committed
+        path.
+        """
+        from ..parallel.ckpt import ocp_save
+        from .faultinject import maybe_fault
+        step = int(step)
+        final = self.step_path(step)
+        if _os.path.isdir(final):
+            raise ValueError("checkpoint for step %d already exists at %s"
+                             % (step, final))
+        _os.makedirs(self.directory, exist_ok=True)
+        self._sweep_tmp()
+        maybe_fault("ckpt_write", step=step)
+        tmp = _os.path.join(self.directory,
+                            "tmp.%d.%d" % (step, _os.getpid()))
+        # ocp_save's own commit protocol is redundant under the manager
+        # (tmp IS the scratch name); atomic=False writes tmp directly
+        ocp_save(tmp, tree, step, atomic=False)
+        maybe_fault("ckpt_commit", step=step)
+        _barrier("mxtpu_ckpt_commit_%d" % step)
+        if _is_coordinator():
+            _os.rename(tmp, final)               # the commit point
+            _fsync_dir(self.directory)
+            self.prune()
+        _barrier("mxtpu_ckpt_done_%d" % step)
+        self.logger.info("checkpoint committed: %s", final)
+        return final
+
+    def restore(self, abstract_tree, step=None):
+        """Restore ``step`` (default: latest committed).
+
+        Returns ``(tree, step)``; raises if nothing is committed.
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    "no committed checkpoint under %s" % self.directory)
+        from ..parallel.ckpt import ocp_restore
+        tree, saved_step = ocp_restore(self.step_path(step), abstract_tree)
+        return tree, saved_step
+
+    def auto_resume(self, abstract_tree):
+        """``(tree, step)`` from the latest committed checkpoint, or
+        None when the run is fresh — the one-liner a preemptible
+        training script puts before its loop."""
+        if self.latest_step() is None:
+            return None
+        return self.restore(abstract_tree)
+
+    # ------------------------------------------------------------------
+    # hygiene
+    # ------------------------------------------------------------------
+    def prune(self):
+        """Delete committed checkpoints beyond keep-last-K."""
+        if self.keep <= 0:
+            return
+        steps = self.all_steps()
+        for step in steps[:-self.keep]:
+            path = self.step_path(step)
+            try:
+                _shutil.rmtree(path)
+                self.logger.info("checkpoint pruned: %s", path)
+            except OSError:
+                self.logger.warning("could not prune %s", path)
+
+    def _sweep_tmp(self):
+        """Remove tmp leftovers from crashed predecessors (they are by
+        definition uncommitted; a restart never resumes a tmp)."""
+        try:
+            names = _os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if _TMP_RE.match(name):
+                try:
+                    _shutil.rmtree(_os.path.join(self.directory, name))
+                except OSError:
+                    pass
+
+
+# ----------------------------------------------------------------------
+# classic prefix-NNNN.params checkpoints (model.save_checkpoint format)
+# ----------------------------------------------------------------------
+def latest_classic_epoch(prefix):
+    """Newest epoch N for which ``prefix-%04d.params`` exists, or None.
+
+    The discovery half of ``FeedForward.fit(resume="auto")`` /
+    ``Module.load_latest`` for the reference's 0x112-format
+    checkpoints (one file per epoch, written atomically enough for
+    single-host use by virtue of being per-epoch files).
+    """
+    directory, base = _os.path.split(_os.path.abspath(str(prefix)))
+    pat = _re.compile(r"^%s-(\d{4})\.params$" % _re.escape(base))
+    try:
+        names = _os.listdir(directory or ".")
+    except OSError:
+        return None
+    epochs = [int(m.group(1)) for m in map(pat.match, names) if m]
+    return max(epochs) if epochs else None

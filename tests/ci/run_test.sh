@@ -12,6 +12,7 @@
 #   TASK=cpp         native engine/recordio unit tests
 #   TASK=capi        C ABI consumers (needs python headers)
 #   TASK=nightly     multi-process distributed suite (slow)
+#   TASK=resilience  fault-injection recovery matrix + graph lint
 set -e
 cd "$(dirname "$0")/../.."
 
@@ -47,6 +48,16 @@ case "${TASK:-python}" in
   nightly)
     make -s all
     MXTPU_NIGHTLY=1 python -m pytest tests/test_nightly_dist.py -x -q
+    ;;
+  resilience)
+    # fault-injection matrix (docs/resilience.md): injected NaN/hang/
+    # ckpt-crash/dead-node faults must each hit their recovery path,
+    # plus the kill-one-worker resume smoke
+    JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
+    # lint must stay clean under the resilience wiring (github-annotated
+    # output so findings land on the PR diff)
+    JAX_PLATFORMS=cpu python tools/mxlint.py --all-models \
+      --format=github --fail-on=error
     ;;
   *)
     echo "unknown TASK=${TASK}" >&2

@@ -11,9 +11,15 @@ survivor detects it via ``kv.num_dead_nodes`` within a few heartbeats and
 aborts cleanly with exit code 3 (the restart signal) instead of hanging
 in a collective.
 
-Phase B (``MXTPU_RESUME=1``): a fresh launch loads the phase-A checkpoint
-and trains one more epoch, asserting the loss kept improving — the
-restart half of kill-and-resume.
+Phase B (``MXTPU_RESUME=1``): a fresh launch discovers the phase-A
+checkpoint with ``Module.load_latest``, verifies the seeded data
+iterator replays the EXACT batch order the uninterrupted run would
+have used for this epoch (order hash recorded by phase A), and trains
+one more epoch asserting the loss kept improving — the restart half of
+kill-and-resume.
+
+Exit codes follow docs/resilience.md: 0 OK, 3 = restart signal
+(``mx.resilience.EXIT_RESTART``), 4 = detection/replay failure.
 
 Run (the wrapper in tests/test_nightly_dist.py does this):
     python tools/launch.py -n 2 --launcher local \
@@ -28,6 +34,13 @@ import numpy as np
 import mxnet_tpu as mx
 
 PREFIX = os.environ.get("MXTPU_RESUME_PREFIX", "/tmp/mxtpu_dist_resume")
+DATA_SEED = 11          # seeded shuffle: batch order = f(seed, epoch)
+
+
+def order_hash(it):
+    """Fingerprint of the iterator's upcoming batch order."""
+    import hashlib
+    return hashlib.sha1(it.idx.tobytes()).hexdigest()
 
 
 def build_data(rank, nw):
@@ -57,15 +70,28 @@ def main():
     resume = os.environ.get("MXTPU_RESUME") == "1"
 
     X, y = build_data(rank, nw)
-    train = mx.io.NDArrayIter(X, y, batch_size=30)
+    train = mx.io.NDArrayIter(X, y, batch_size=30, shuffle=True,
+                              seed=DATA_SEED)
     net = mx.models.get_mlp(num_classes=2, hidden=(16,))
     mod = mx.mod.Module(net, context=mx.context.cpu())
 
     epoch0 = 0
     if resume:
-        mod = mx.mod.Module.load(PREFIX, 1, load_optimizer_states=True,
-                                 context=mx.context.cpu())
-        epoch0 = 1
+        mod, epoch0 = mx.mod.Module.load_latest(
+            PREFIX, load_optimizer_states=True, context=mx.context.cpu())
+        if mod is None:
+            print("rank %d FAILED: no checkpoint to resume from" % rank,
+                  flush=True)
+            os._exit(4)
+        # replay the interrupted run's batch stream: position the
+        # iterator at (epoch0, start) and check the order is the one
+        # the uninterrupted run recorded (acceptance (d))
+        train.set_state({"epoch": epoch0, "cursor": -train.batch_size})
+        expected = open("%s.order%d" % (PREFIX, rank)).read().strip()
+        if order_hash(train) != expected:
+            print("rank %d FAILED: resumed batch order diverged" % rank,
+                  flush=True)
+            os._exit(4)
     mod.bind(data_shapes=train.provide_data,
              label_shapes=train.provide_label)
     mod.init_params(mx.init.Uniform(0.1))
@@ -88,7 +114,14 @@ def main():
         sys.stdout.flush()
         os._exit(0)
 
-    # phase A: checkpoint, then inject the fault
+    # phase A: checkpoint + record the batch order the next epoch will
+    # use (pure function of (seed, epoch) — phase B must replay it),
+    # then inject the fault
+    probe = mx.io.NDArrayIter(X, y, batch_size=30, shuffle=True,
+                              seed=DATA_SEED)
+    probe.set_state({"epoch": 1, "cursor": -probe.batch_size})
+    with open("%s.order%d" % (PREFIX, rank), "w") as f:
+        f.write(order_hash(probe))
     if rank == 0:
         mod.save_checkpoint(PREFIX, 1, save_optimizer_states=True)
     kv.barrier()
@@ -104,7 +137,7 @@ def main():
             print("rank %d detected %d dead node(s); aborting for restart"
                   % (rank, dead), flush=True)
             sys.stdout.flush()
-            os._exit(3)                  # restart signal
+            os._exit(mx.resilience.EXIT_RESTART)   # restart signal
     print("rank %d FAILED to detect dead worker" % rank, flush=True)
     os._exit(4)
 

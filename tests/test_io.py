@@ -287,3 +287,77 @@ def test_imagerecorditer_geometry_aug(tmp_path):
     assert not it._native_aug_ok
     batch = next(it)
     assert batch.data[0].shape == (4, 3, 24, 24)
+
+
+def test_ndarray_iter_seeded_shuffle_deterministic():
+    """With seed=, the batch order is a pure function of (seed, epoch):
+    two iterators agree epoch by epoch, epochs differ from each other,
+    and a different seed gives a different stream (docs/resilience.md)."""
+    data = np.arange(120).reshape(30, 4).astype(np.float32)
+
+    def epoch_order(it):
+        order = [b.data[0].asnumpy()[:, 0].copy() for b in it]
+        it.reset()
+        return np.concatenate(order)
+
+    a = mio.NDArrayIter(data, None, batch_size=5, shuffle=True, seed=9)
+    b = mio.NDArrayIter(data, None, batch_size=5, shuffle=True, seed=9)
+    orders = []
+    for _ in range(3):
+        oa, ob = epoch_order(a), epoch_order(b)
+        assert np.array_equal(oa, ob)
+        orders.append(oa)
+    assert not np.array_equal(orders[0], orders[1])   # reshuffled per epoch
+
+    c = mio.NDArrayIter(data, None, batch_size=5, shuffle=True, seed=10)
+    assert not np.array_equal(epoch_order(c), orders[0])
+
+    # legacy: shuffle without seed keeps the shuffle-once behavior
+    d = mio.NDArrayIter(data, None, batch_size=5, shuffle=True)
+    assert np.array_equal(epoch_order(d), epoch_order(d))
+
+
+def test_ndarray_iter_state_resume_at_step_k():
+    """state()/set_state(): a run interrupted at step k and resumed in a
+    fresh process replays exactly the batches the uninterrupted run saw."""
+    data = np.arange(200).reshape(50, 4).astype(np.float32)
+    label = np.arange(50).astype(np.float32)
+
+    def stream(it, n):
+        """Draw n batches across epoch boundaries (auto-reset)."""
+        out = []
+        for _ in range(n):
+            try:
+                b = next(it)
+            except StopIteration:
+                it.reset()
+                b = next(it)
+            out.append((b.data[0].asnumpy().copy(),
+                        b.label[0].asnumpy().copy()))
+        return out
+
+    # uninterrupted reference run: 2 epochs = 10 batches
+    ref_it = mio.NDArrayIter(data, label, batch_size=10, shuffle=True,
+                             seed=4)
+    ref = stream(ref_it, 10)
+
+    # interrupted run: draw 7 batches, snapshot, "crash"
+    it_a = mio.NDArrayIter(data, label, batch_size=10, shuffle=True,
+                           seed=4)
+    first = stream(it_a, 7)
+    snap = it_a.state()
+    for (da, la), (dr, lr) in zip(first, ref[:7]):
+        assert np.array_equal(da, dr) and np.array_equal(la, lr)
+
+    # fresh-process resume: same ctor args + set_state
+    it_b = mio.NDArrayIter(data, label, batch_size=10, shuffle=True,
+                           seed=4)
+    it_b.set_state(snap)
+    rest = stream(it_b, 3)
+    for (db, lb), (dr, lr) in zip(rest, ref[7:]):
+        assert np.array_equal(db, dr) and np.array_equal(lb, lr)
+
+    # an unseeded shuffled iterator refuses: its order can't be replayed
+    it_c = mio.NDArrayIter(data, label, batch_size=10, shuffle=True)
+    with pytest.raises(mx.base.MXNetError):
+        it_c.set_state({"epoch": 0, "cursor": 0})

@@ -1,0 +1,699 @@
+"""Resilience subsystem tests: the fault-injection recovery matrix.
+
+Every recovery path in mxnet_tpu.resilience (docs/resilience.md) is
+exercised here on the CPU mesh with deterministically injected faults:
+NaN gradients, checkpoint-write crashes, hung steps, dead-node
+reports, plus the 2-worker kill-and-resume smoke (the full drill stays
+in tests/nightly/dist_resume.py; phases A+B run here too, promoted to
+tier-1).
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel, resilience
+from mxnet_tpu.resilience import (CheckpointManager, FaultSpec,
+                                  InjectedFault, ResilienceError,
+                                  RetryPolicy, Sentinel, Watchdog,
+                                  faultinject, latest_classic_epoch,
+                                  parse_fault_spec, retry_call,
+                                  run_with_timeout)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Each test starts and ends with no armed fault specs."""
+    monkeypatch.delenv("MXTPU_FAULT_SPEC", raising=False)
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", spec)
+    faultinject.reset()
+
+
+# ----------------------------------------------------------------------
+# fault-spec grammar
+# ----------------------------------------------------------------------
+def test_parse_fault_spec_grammar():
+    specs = parse_fault_spec(
+        "step=3:kind=hang:seconds=60;step=9:kind=ckpt_crash")
+    assert len(specs) == 2
+    assert specs[0].kind == "hang" and specs[0].step == 3 \
+        and specs[0].seconds == 60.0 and specs[0].seam == "step"
+    assert specs[1].kind == "ckpt_crash" and specs[1].seam == "ckpt_commit"
+
+    (s,) = parse_fault_spec("kind=dead_node:n=2:rank=0")
+    assert s.n == 2 and s.rank == 0 and s.seam == "dead_node"
+
+    (s,) = parse_fault_spec("kind=nan:sticky=1")
+    assert s.sticky and s.seam == "batch"
+    assert parse_fault_spec("") == []
+
+
+def test_parse_fault_spec_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_fault_spec("kind=frobnicate")
+    with pytest.raises(ValueError):
+        parse_fault_spec("step=3")                  # no kind
+    with pytest.raises(ValueError):
+        parse_fault_spec("kind=nan:wat=1")          # unknown key
+    with pytest.raises(ValueError):
+        parse_fault_spec("kind")                    # not key=value
+
+
+def test_fault_spec_fires_once_unless_sticky():
+    once = FaultSpec("nan", step=2)
+    assert not once.matches("batch", step=1)
+    assert once.matches("batch", step=2)
+    once.fired = True
+    assert not once.matches("batch", step=2)
+
+    sticky = FaultSpec("nan", sticky=True)
+    sticky.fired = True
+    assert sticky.matches("batch", step=7)
+
+
+def test_maybe_fault_env_round_trip(monkeypatch):
+    _arm(monkeypatch, "step=2:kind=ckpt_crash:seam=ckpt_write")
+    assert resilience.maybe_fault("ckpt_write", step=1) is None
+    with pytest.raises(InjectedFault):
+        resilience.maybe_fault("ckpt_write", step=2)
+    # consumed: does not fire twice
+    assert resilience.maybe_fault("ckpt_write", step=2) is None
+
+
+def test_poison_nan_keeps_int_arrays():
+    f = resilience.poison_nan(np.ones(3, np.float32))
+    assert np.isnan(f).all()
+    i = resilience.poison_nan(np.arange(3))
+    assert (i == np.arange(3)).all()
+
+
+# ----------------------------------------------------------------------
+# checkpoint manager: atomic, versioned, pruned
+# ----------------------------------------------------------------------
+def _tree():
+    return {"w": jnp.arange(8, dtype=jnp.float32),
+            "b": jnp.zeros((3,), jnp.float32)}
+
+
+def test_ckptmgr_save_latest_prune_auto_resume(tmp_path):
+    from mxnet_tpu.parallel.ckpt import abstract_like
+    mgr = CheckpointManager(str(tmp_path / "run"), keep=2)
+    assert mgr.latest_step() is None
+    assert mgr.auto_resume(abstract_like(_tree())) is None
+
+    for step in (1, 2, 5):
+        tree = {"w": jnp.arange(8, dtype=jnp.float32) * step,
+                "b": jnp.zeros((3,), jnp.float32)}
+        mgr.save(tree, step)
+    assert mgr.all_steps() == [2, 5]           # keep-last-2 pruned step 1
+    assert mgr.latest_step() == 5
+
+    restored, step = mgr.auto_resume(abstract_like(_tree()))
+    assert step == 5
+    assert np.allclose(np.asarray(restored["w"]), np.arange(8) * 5)
+
+    with pytest.raises(ValueError):
+        mgr.save(_tree(), 5)                   # step already committed
+
+
+def test_ckptmgr_injected_crash_keeps_prior_checkpoint(tmp_path,
+                                                       monkeypatch):
+    """Acceptance (b): a crash mid-save leaves latest_step() at the
+    prior intact checkpoint; the partial write is swept later."""
+    mgr = CheckpointManager(str(tmp_path / "run"), keep=0)
+    mgr.save(_tree(), 1)
+
+    # crash between the durable tmp write and the commit rename
+    _arm(monkeypatch, "kind=ckpt_crash")
+    with pytest.raises(InjectedFault):
+        mgr.save({"w": jnp.ones(8), "b": jnp.ones(3)}, 2)
+    assert mgr.latest_step() == 1              # tmp garbage is invisible
+    leftovers = [n for n in os.listdir(mgr.directory)
+                 if n.startswith("tmp.")]
+    assert leftovers, "expected the uncommitted tmp write on disk"
+
+    # crash BEFORE the write: nothing new on disk either
+    _arm(monkeypatch, "kind=ckpt_crash:seam=ckpt_write")
+    with pytest.raises(InjectedFault):
+        mgr.save({"w": jnp.ones(8), "b": jnp.ones(3)}, 3)
+    assert mgr.latest_step() == 1
+
+    # next incarnation saves fine and sweeps the stale tmp
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    faultinject.reset()
+    mgr.save({"w": jnp.ones(8), "b": jnp.ones(3)}, 4)
+    assert mgr.latest_step() == 4
+    assert not [n for n in os.listdir(mgr.directory)
+                if n.startswith("tmp.")]
+
+
+def test_ocp_save_overwrite_is_atomic(tmp_path, monkeypatch):
+    """The flat (non-versioned) ocp_save must never clobber the
+    existing checkpoint before the replacement is durable."""
+    from mxnet_tpu.parallel.ckpt import ocp_save, ocp_restore, abstract_like
+    path = str(tmp_path / "ck")
+    ocp_save(path, _tree(), 7)
+
+    _arm(monkeypatch, "kind=ckpt_crash")       # between write and commit
+    with pytest.raises(InjectedFault):
+        ocp_save(path, {"w": jnp.ones(8), "b": jnp.ones(3)}, 8)
+    tree, step = ocp_restore(path, abstract_like(_tree()))
+    assert step == 7                           # old checkpoint intact
+    assert np.allclose(np.asarray(tree["w"]), np.arange(8))
+
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    faultinject.reset()
+    ocp_save(path, {"w": jnp.ones(8), "b": jnp.ones(3)}, 8)
+    tree, step = ocp_restore(path, abstract_like(_tree()))
+    assert step == 8 and np.allclose(np.asarray(tree["w"]), 1.0)
+
+
+def test_latest_classic_epoch_and_module_load_latest(tmp_path):
+    prefix = str(tmp_path / "cls")
+    assert latest_classic_epoch(prefix) is None
+    mod, epoch = mx.mod.Module.load_latest(prefix)
+    assert mod is None and epoch is None
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+    args = {"fc_weight": mx.nd.array(np.ones((2, 4), np.float32)),
+            "fc_bias": mx.nd.array(np.zeros(2, np.float32))}
+    mx.model.save_checkpoint(prefix, 1, net, args, {})
+    mx.model.save_checkpoint(prefix, 3, net, args, {})
+    assert latest_classic_epoch(prefix) == 3
+
+    mod, epoch = mx.mod.Module.load_latest(prefix)
+    assert epoch == 3 and mod is not None
+    assert set(mod._arg_params) == {"fc_weight", "fc_bias"}
+
+
+# ----------------------------------------------------------------------
+# watchdog
+# ----------------------------------------------------------------------
+def test_run_with_timeout_passthrough_and_timeout():
+    assert run_with_timeout(lambda: 41 + 1, 5.0, phase="quick") == 42
+    assert run_with_timeout(lambda: 7, None, phase="off") == 7
+    with pytest.raises(ZeroDivisionError):
+        run_with_timeout(lambda: 1 / 0, 5.0, phase="err")
+
+    t0 = time.monotonic()
+    with pytest.raises(ResilienceError) as exc:
+        run_with_timeout(lambda: time.sleep(30), 0.3, phase="stuck",
+                         step=12)
+    assert time.monotonic() - t0 < 5.0         # bounded, not 30s
+    err = exc.value
+    assert err.kind == "timeout" and err.phase == "stuck" \
+        and err.step == 12 and err.timeout_s == 0.3
+    assert "phase=stuck" in str(err) and "step=12" in str(err)
+
+
+def test_watchdog_monitor_fires_on_stall():
+    fired = []
+    wd = Watchdog(timeout_s=0.3, phase="loop", on_timeout=fired.append,
+                  poll_s=0.05)
+    with wd:
+        wd.feed(step=1)
+        time.sleep(0.1)
+        wd.feed(step=2)                        # progress: no fire
+        assert not wd.fired
+        time.sleep(0.8)                        # stall
+    assert wd.fired and len(fired) == 1
+    err = fired[0]
+    assert err.kind == "stall" and err.step == 2 and err.phase == "loop"
+
+
+def test_watchdog_disabled_without_timeout():
+    wd = Watchdog(timeout_s=None, on_timeout=lambda e: None)
+    with wd:
+        assert wd._thread is None              # unarmed: no monitor
+
+
+def test_exit_for_restart_subprocess_exits_3():
+    """Acceptance (c), exit-code half: the watchdog abort path must
+    produce exit code 3 (docs/resilience.md contract)."""
+    code = (
+        "import time\n"
+        "from mxnet_tpu.resilience import run_with_timeout\n"
+        "run_with_timeout(lambda: time.sleep(60), 0.2, phase='step',\n"
+        "                 step=4, on_timeout='exit')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_ROOT + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          timeout=300, capture_output=True, text=True)
+    assert proc.returncode == resilience.EXIT_RESTART, proc.stderr[-2000:]
+    assert "RESILIENCE ABORT" in proc.stderr
+    assert "phase=step" in proc.stderr and "step=4" in proc.stderr
+
+
+# ----------------------------------------------------------------------
+# retry
+# ----------------------------------------------------------------------
+def test_retry_call_transient_then_success():
+    calls, naps = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("connection refused (transient)")
+        return "up"
+
+    policy = RetryPolicy(max_tries=4, base_delay_s=0.5)
+    assert retry_call(flaky, policy, sleep=naps.append) == "up"
+    assert len(calls) == 3
+    assert naps == [0.5, 1.0]                  # exponential, deterministic
+
+
+def test_retry_call_nonretryable_propagates_immediately():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("num_processes mismatch")  # deterministic config bug
+
+    with pytest.raises(ValueError):
+        retry_call(broken, RetryPolicy(max_tries=5), sleep=lambda s: None)
+    assert len(calls) == 1                     # no retry for non-transient
+
+
+def test_retry_call_exhausts_and_raises_last():
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise RuntimeError("deadline exceeded")
+
+    with pytest.raises(RuntimeError):
+        retry_call(always_down, RetryPolicy(max_tries=3),
+                   sleep=lambda s: None)
+    assert len(calls) == 3
+
+
+# ----------------------------------------------------------------------
+# host-side sentinel
+# ----------------------------------------------------------------------
+def test_sentinel_skips_nonfinite_and_backs_off():
+    s = Sentinel()
+    scale0 = s.loss_scale.scale
+    assert s.check(1, loss=0.9) == "ok"
+    assert s.check(2, loss=float("nan")) == "skip-nonfinite"
+    assert s.loss_scale.scale == scale0 / 2
+    assert s.check(3, grad_norm=float("inf")) == "skip-nonfinite"
+    assert s.check(4, loss=0.8) == "ok"
+    assert s.last_good_step == 4
+    assert [rec[0] for rec in s.skipped] == [2, 3]
+
+
+def test_sentinel_spike_detection():
+    s = Sentinel(spike_factor=100.0, warmup_steps=3)
+    for step in range(1, 6):
+        assert s.check(step, loss=1.0) == "ok"
+    assert s.check(6, loss=1e6) == "skip-spike"
+    assert s.check(7, loss=1.1) == "ok"
+
+
+def test_sentinel_escalates_after_max_consecutive_skips():
+    s = Sentinel(max_consecutive_skips=3)
+    s.check(1, loss=1.0)
+    s.check(2, loss=float("nan"))
+    s.check(3, loss=float("nan"))
+    with pytest.raises(ResilienceError) as exc:
+        s.check(4, loss=float("nan"))
+    assert exc.value.kind == "numeric"
+
+
+def test_dynamic_loss_scale_growth_and_clamp():
+    from mxnet_tpu.resilience.sentinel import DynamicLossScale
+    ls = DynamicLossScale(init=4.0, growth_interval=2, min_scale=1.0,
+                          max_scale=8.0)
+    ls.good(); ls.good()
+    assert ls.scale == 8.0
+    ls.good(); ls.good()
+    assert ls.scale == 8.0                     # clamped at max
+    for _ in range(5):
+        ls.bad()
+    assert ls.scale == 1.0                     # clamped at min
+
+
+def test_sentinel_grad_norm_module_structure():
+    g = [[mx.nd.array(np.array([3.0, 4.0], np.float32))],
+         [None]]
+    assert abs(Sentinel.grad_norm(g) - 5.0) < 1e-6
+    g_bad = [[mx.nd.array(np.array([np.nan], np.float32))]]
+    assert np.isnan(Sentinel.grad_norm(g_bad))
+
+
+# ----------------------------------------------------------------------
+# fused trainer: compiled sentinel gate + injected faults
+# ----------------------------------------------------------------------
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _trainer(sentinel=False, step_timeout_s=None, lr=0.5):
+    mesh = parallel.make_mesh(jax.devices()[:2], dp=2)
+    opt = mx.optimizer.create("sgd", learning_rate=lr, momentum=0.9,
+                              rescale_grad=1.0 / 16)
+    tr = parallel.ShardedTrainer(_mlp(), opt, mesh, sentinel=sentinel,
+                                 step_timeout_s=step_timeout_s)
+    mx.random.seed(3)
+    params, opt_state, aux = tr.init_params(
+        {"data": (16, 8)}, label_shapes={"softmax_label": (16,)})
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    batch = tr.shard_batch({"data": x, "softmax_label": y})
+    return tr, params, opt_state, aux, batch
+
+
+def _host(params):
+    return {k: np.asarray(jax.device_get(v)) for k, v in params.items()}
+
+
+def test_trainer_sentinel_skips_injected_nan_step(monkeypatch):
+    """Acceptance (a): NaN injected at step k -> that step is skipped
+    (params unchanged), loss scale halves, training continues."""
+    tr, params, opt_state, aux, batch = _trainer(sentinel=True)
+    _arm(monkeypatch, "step=3:kind=nan")
+
+    scale0 = None
+    for step in range(1, 6):
+        before = _host(params)
+        params, opt_state, aux, outs = tr.step(params, opt_state, aux,
+                                               batch)
+        after = _host(params)
+        stats = tr.sentinel_stats()
+        if step == 1:
+            scale0 = stats["scale"]
+        if step == 3:
+            for name in before:
+                assert np.array_equal(before[name], after[name]), \
+                    "poisoned step %d must not move %r" % (step, name)
+            assert stats["skipped"] == 1
+            assert stats["scale"] == scale0 / 2
+        else:
+            moved = any(not np.array_equal(before[n], after[n])
+                        for n in before)
+            assert moved, "clean step %d should update params" % step
+            assert np.isfinite(np.asarray(outs[0])).all()
+    stats = tr.sentinel_stats()
+    assert stats["skipped"] == 1 and stats["last_good"] == 5
+
+
+def test_trainer_sentinel_off_matches_plain_step():
+    """The sentinel-off trainer is byte-identical to the pre-resilience
+    step (no scaled cotangents, no gating)."""
+    tr_a, pa, oa, aa, batch = _trainer(sentinel=False)
+    tr_b, pb, ob, ab, _ = _trainer(sentinel=True)
+    for _ in range(3):
+        pa, oa, aa, _ = tr_a.step(pa, oa, aa, batch)
+        pb, ob, ab, _ = tr_b.step(pb, ob, ab, batch)
+    ha, hb = _host(pa), _host(pb)
+    for name in ha:
+        assert np.allclose(ha[name], hb[name], rtol=1e-5, atol=1e-6), name
+
+
+def test_trainer_sentinel_learns():
+    tr, params, opt_state, aux, batch = _trainer(sentinel=True)
+    y = None
+    for _ in range(30):
+        params, opt_state, aux, outs = tr.step(params, opt_state, aux,
+                                               batch)
+    stats = tr.sentinel_stats()
+    assert stats["skipped"] == 0
+    pred = np.asarray(outs[0]).argmax(axis=1)
+    x = np.asarray(jax.device_get(batch["data"]))
+    labels = (x.sum(axis=1) > 0).astype(np.int64)
+    assert (pred == labels).mean() > 0.9
+
+
+def test_trainer_watchdog_catches_injected_hang(monkeypatch):
+    """Acceptance (c): an injected hang inside the step converts into a
+    structured ResilienceError within the timeout."""
+    tr, params, opt_state, aux, batch = _trainer(step_timeout_s=1.0)
+    # step 1 compiles + runs clean; step 2 hangs
+    params, opt_state, aux, _ = tr.step(params, opt_state, aux, batch)
+    _arm(monkeypatch, "step=2:kind=hang:seconds=20")
+    t0 = time.monotonic()
+    with pytest.raises(ResilienceError) as exc:
+        tr.step(params, opt_state, aux, batch)
+    assert time.monotonic() - t0 < 10.0
+    err = exc.value
+    assert err.kind == "timeout" and err.phase == "train_step" \
+        and err.step == 2 and err.rank == 0
+
+
+def test_trainer_slow_step_under_timeout_succeeds(monkeypatch):
+    tr, params, opt_state, aux, batch = _trainer(step_timeout_s=30.0)
+    params, opt_state, aux, _ = tr.step(params, opt_state, aux, batch)
+    _arm(monkeypatch, "step=2:kind=slow:seconds=0.2")
+    params, opt_state, aux, _ = tr.step(params, opt_state, aux, batch)
+    assert tr.num_update == 2                  # slow but not stuck
+
+
+def test_trainer_versioned_checkpoint_auto_resume(tmp_path):
+    ckdir = str(tmp_path / "ckpts")
+    tr, params, opt_state, aux, batch = _trainer()
+    for _ in range(2):
+        params, opt_state, aux, _ = tr.step(params, opt_state, aux, batch)
+    tr.save_checkpoint_versioned(ckdir, params, opt_state, aux, keep=3)
+    params, opt_state, aux, _ = tr.step(params, opt_state, aux, batch)
+    tr.save_checkpoint_versioned(ckdir, params, opt_state, aux, keep=3)
+    assert tr.latest_step(ckdir) == 3
+    want = _host(params)
+
+    tr2, _, _, _, _ = _trainer()
+    resumed = tr2.auto_resume(ckdir, {"data": (16, 8)},
+                              label_shapes={"softmax_label": (16,)})
+    assert resumed is not None
+    p2, o2, a2, step = resumed
+    assert step == 3 and tr2.num_update == 3
+    got = _host(p2)
+    for name in want:
+        assert np.allclose(want[name], got[name]), name
+
+    # fresh directory -> None (the "first boot" branch)
+    tr3, _, _, _, _ = _trainer()
+    assert tr3.auto_resume(str(tmp_path / "fresh"), {"data": (16, 8)},
+                           label_shapes={"softmax_label": (16,)}) is None
+
+
+# ----------------------------------------------------------------------
+# host training loops: sentinel + poisoned grads
+# ----------------------------------------------------------------------
+def test_feedforward_sentinel_survives_injected_nan(monkeypatch,
+                                                    tmp_path):
+    """The classic fit loop keeps training through an injected NaN
+    batch when MXTPU_SENTINEL=1 (grad-norm gate skips the update)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(60, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+
+    monkeypatch.setenv("MXTPU_SENTINEL", "1")
+    _arm(monkeypatch, "step=2:kind=nan")
+    model = mx.FeedForward(net, ctx=mx.context.cpu(), num_epoch=8,
+                           optimizer="sgd", learning_rate=0.3,
+                           initializer=mx.init.Uniform(0.1))
+    model.fit(mx.io.NDArrayIter(X, y, batch_size=20))
+    # params survived the poisoned step: finite and usable
+    for name, arr in model.arg_params.items():
+        assert np.isfinite(arr.asnumpy()).all(), name
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=20))
+    assert acc > 0.65
+
+
+def test_feedforward_without_sentinel_is_poisoned(monkeypatch):
+    """Control for the test above: the same injected NaN without the
+    sentinel propagates into the parameters — the failure the sentinel
+    exists to stop."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(60, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+    _arm(monkeypatch, "step=2:kind=nan")
+    model = mx.FeedForward(net, ctx=mx.context.cpu(), num_epoch=1,
+                           optimizer="sgd", learning_rate=0.3,
+                           initializer=mx.init.Uniform(0.1))
+    model.fit(mx.io.NDArrayIter(X, y, batch_size=20))
+    assert any(not np.isfinite(a.asnumpy()).all()
+               for a in model.arg_params.values())
+
+
+def test_feedforward_fit_checkpoint_and_auto_resume(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(40, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    net = mx.models.get_mlp(num_classes=2, hidden=(8,))
+    prefix = str(tmp_path / "ff")
+
+    model = mx.FeedForward(net, ctx=mx.context.cpu(), num_epoch=2,
+                           optimizer="sgd", learning_rate=0.1,
+                           initializer=mx.init.Uniform(0.1))
+    model.fit(mx.io.NDArrayIter(X, y, batch_size=20),
+              checkpoint_prefix=prefix)
+    assert latest_classic_epoch(prefix) == 2   # do_checkpoint auto-wired
+
+    resumed = mx.FeedForward(net, ctx=mx.context.cpu(), num_epoch=3,
+                             optimizer="sgd", learning_rate=0.1,
+                             initializer=mx.init.Uniform(0.1))
+    resumed.fit(mx.io.NDArrayIter(X, y, batch_size=20),
+                checkpoint_prefix=prefix, resume="auto")
+    assert resumed.begin_epoch == 2            # picked up where A stopped
+    assert latest_classic_epoch(prefix) == 3
+
+    with pytest.raises(mx.base.MXNetError):
+        mx.FeedForward(net, ctx=mx.context.cpu(), num_epoch=1).fit(
+            mx.io.NDArrayIter(X, y, batch_size=20), resume="auto")
+
+
+# ----------------------------------------------------------------------
+# kvstore fault surface
+# ----------------------------------------------------------------------
+class _FakeClient(object):
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self.kv[key] = value
+
+    def key_value_dir_get(self, prefix):
+        return [(k, v) for k, v in self.kv.items()
+                if k.startswith(prefix)]
+
+
+def test_num_dead_nodes_timeout_and_expiry(monkeypatch):
+    from mxnet_tpu import kvstore as kvs
+    clock = {"now": 1000.0}
+    fake = _FakeClient()
+    monkeypatch.setattr(kvs, "_now", lambda: clock["now"])
+    monkeypatch.setattr(kvs, "_dist_client", lambda: fake)
+
+    kv = kvs.KVStore("dist_sync")              # _created = 1000.0
+    fake.kv["mxtpu_hb/0"] = repr(1000.0)
+    assert kv.num_dead_nodes(node_id=0, timeout=10.0) == 0
+    clock["now"] = 1011.0                      # stamp is now stale
+    assert kv.num_dead_nodes(node_id=0, timeout=10.0) == 1
+    fake.kv["mxtpu_hb/0"] = repr(1010.5)       # peer beat again: alive
+    assert kv.num_dead_nodes(node_id=0, timeout=10.0) == 0
+
+    # missing stamp: grace until `timeout` after store creation
+    assert kv.num_dead_nodes(node_id=1, timeout=20.0) == 0
+    clock["now"] = 1030.0
+    assert kv.num_dead_nodes(node_id=1, timeout=20.0) == 1
+    # non-dist stores never report deaths
+    assert kvs.KVStore("local").num_dead_nodes() == 0
+
+
+def test_num_dead_nodes_injected_dead_node(monkeypatch):
+    from mxnet_tpu import kvstore as kvs
+    _arm(monkeypatch, "kind=dead_node:n=2")
+    kv = kvs.KVStore("dist_sync")
+    assert kv.num_dead_nodes() == 2
+    assert kv.num_dead_nodes() == 0            # spec consumed
+
+
+def test_heartbeat_idempotent_and_stoppable(monkeypatch):
+    from mxnet_tpu import kvstore as kvs
+    fake = _FakeClient()
+    monkeypatch.setattr(kvs, "_dist_client", lambda: fake)
+    try:
+        kvs._start_heartbeat()
+        t = kvs._HB_STATE["thread"]
+        assert t is not None and t.is_alive()
+        kvs._start_heartbeat()                 # idempotent: same thread
+        assert kvs._HB_STATE["thread"] is t
+        assert t.daemon, "heartbeat must never block interpreter exit"
+        deadline = time.time() + 5
+        while not fake.kv and time.time() < deadline:
+            time.sleep(0.01)
+        assert any(k.startswith("mxtpu_hb/") for k in fake.kv)
+    finally:
+        kvs._stop_heartbeat()
+    assert not t.is_alive()
+    assert kvs._HB_STATE["thread"] is None
+    # restartable after a stop (fresh store in the same process)
+    kvs._start_heartbeat()
+    assert kvs._HB_STATE["thread"].is_alive()
+    kvs._stop_heartbeat()
+
+
+def test_kvstore_barrier_watchdog_single_process(monkeypatch):
+    """With one process the barrier is a no-op even when armed."""
+    monkeypatch.setenv("MXTPU_STEP_TIMEOUT_S", "1.0")
+    kv = mx.kvstore.KVStore("dist_sync")
+    kv.barrier()                               # must not raise or hang
+
+
+# ----------------------------------------------------------------------
+# monitor nonfinite alarm
+# ----------------------------------------------------------------------
+def test_monitor_alarm_nonfinite():
+    mon = mx.monitor.Monitor(interval=1, alarm_nonfinite=True)
+    mon.activated = True
+    mon._record("clean", mx.nd.array(np.ones(4, np.float32)))
+    assert mon.nonfinite_records == []
+    mon._record("poisoned",
+                mx.nd.array(np.array([1.0, np.inf], np.float32)))
+    assert len(mon.nonfinite_records) == 1
+    step, name, _stat = mon.nonfinite_records[0]
+    assert name == "poisoned"
+
+
+# ----------------------------------------------------------------------
+# 2-worker kill-and-resume smoke (tier-1 promotion of the nightly
+# drill: phases A+B of tests/nightly/dist_resume.py)
+# ----------------------------------------------------------------------
+def _launch(script, n=2, port=9899, extra_env=None, expect_rc=0):
+    cmd = [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+           "-n", str(n), "--launcher", "local", "--workdir", _ROOT,
+           "--port", str(port),
+           sys.executable, os.path.join("tests", "nightly", script)]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env.update(extra_env or {})
+    proc = subprocess.run(cmd, cwd=_ROOT, env=env, timeout=420,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                          text=True)
+    assert proc.returncode == expect_rc, (proc.returncode,
+                                          proc.stdout[-2000:])
+    return proc.stdout
+
+
+def test_kill_and_resume_smoke(tmp_path):
+    """Acceptance (d): kill one worker; the survivor detects it and
+    exits with the restart signal (launcher propagates 3); the
+    restarted job resumes from the checkpoint, replays the identical
+    batch order, and the loss keeps improving."""
+    prefix = str(tmp_path / "resume")
+    out = _launch("dist_resume.py", port=9899,
+                  extra_env={"MXTPU_FAULT_RANK": "1",
+                             "MXTPU_RESUME_PREFIX": prefix},
+                  expect_rc=3)
+    assert "detected 1 dead node" in out, out[-1500:]
+    assert os.path.exists(prefix + "-0001.params")
+    out = _launch("dist_resume.py", port=9900,
+                  extra_env={"MXTPU_RESUME": "1",
+                             "MXTPU_RESUME_PREFIX": prefix})
+    assert out.count("resume OK") == 2, out[-1500:]

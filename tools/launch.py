@@ -74,9 +74,36 @@ def launch_local(args, command):
 
     signal.signal(signal.SIGINT, _kill)
     signal.signal(signal.SIGTERM, _kill)
+    # Poll instead of serially wait()ing: when any worker exits with
+    # EXIT_RESTART (3, the resilience restart signal — see
+    # docs/resilience.md) the siblings are torn down promptly and the
+    # launcher itself exits 3, so the pod restarts bounded rather than
+    # draining whatever hang/fault triggered the abort.  Other nonzero
+    # codes keep the legacy drain-then-OR behavior.
+    import time as _time
     rc = 0
-    for p in procs:
-        rc |= p.wait()
+    live = list(procs)
+    while live:
+        still = []
+        for p in live:
+            code = p.poll()
+            if code is None:
+                still.append(p)
+            elif code == 3:
+                for q in procs:
+                    if q.poll() is None:
+                        q.terminate()
+                for q in procs:
+                    try:
+                        q.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        q.kill()
+                return 3
+            else:
+                rc |= code
+        live = still
+        if live:
+            _time.sleep(0.1)
     return rc
 
 
