@@ -408,29 +408,68 @@ def _collective_timeout_s():
 _BARRIER_STATE = {"xla_ok": None, "seq": {}}
 
 
+def _decide_barrier_path():
+    """Cluster-wide XLA-vs-RPC barrier decision, mirroring
+    ``_decide_csum_path``: rank 0 compile-probes the cross-process
+    collective (local, no execution) and publishes the verdict in the
+    coordination KV; every rank acts on that one answer.  A local
+    run-and-see probe is banned here: a transient first-call failure
+    (e.g. a timeout caused by one dead or slow peer) would flip only
+    the probing rank to the RPC barrier while its peers keep fencing
+    on XLA — a permanent pod deadlock."""
+    import logging
+    client = _dist_client()
+    key = "mxtpu_barrier/xla_ok"
+    if client is not None and jax.process_index() != 0:
+        last_exc = None
+        for timeout_ms in (60_000, 240_000):
+            try:
+                return client.blocking_key_value_get(key, timeout_ms) == "1"
+            except Exception as exc:  # noqa: BLE001
+                last_exc = exc
+        raise MXNetError(
+            "kvstore: could not read rank-0's barrier-path verdict (%r); "
+            "refusing to guess (a wrong guess deadlocks the pod)"
+            % (last_exc,))
+    try:
+        # the backends that reject sync_global_devices are exactly the
+        # ones that cannot compile cross-process XLA programs at all
+        # (multi-process CPU, where the resilience drills run)
+        _compile_collective_sum_probe()
+        ok = True
+    except Exception as exc:  # noqa: BLE001
+        logging.warning(
+            "kvstore: XLA device barrier unavailable (%r); the cluster "
+            "will fence via the coordination-service barrier RPC", exc)
+        ok = False
+    if client is not None:
+        try:
+            client.key_value_set(key, "1" if ok else "0",
+                                 allow_overwrite=True)
+        except Exception:
+            pass
+    return ok
+
+
 def global_barrier(tag, timeout_s=None):
     """Cross-process barrier that works on any backend.
 
-    Prefers ``sync_global_devices`` (a device-level fence).  Backends
-    that cannot run multi-process XLA programs at all — multi-process
-    CPU, where the resilience drills run — reject it, so the first such
-    failure flips this process to the coordination-service
-    ``wait_at_barrier`` RPC for good.  The probe outcome is a property
-    of the backend, identical on every rank, so no rank can end up in a
-    different barrier implementation than its peers.
+    Prefers ``sync_global_devices`` (a device-level fence); backends
+    that cannot run multi-process XLA programs fall back to the
+    coordination-service ``wait_at_barrier`` RPC.  The choice is made
+    ONCE, cluster-wide (rank 0 probes and publishes), so no rank can
+    end up in a different barrier implementation than its peers — and
+    once made, failures of the chosen barrier propagate to the caller
+    instead of silently switching paths.
     """
     if jax.process_count() <= 1:
         return
-    if _BARRIER_STATE["xla_ok"] is not False:
-        try:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("mxtpu_" + tag)
-            _BARRIER_STATE["xla_ok"] = True
-            return
-        except Exception:
-            if _BARRIER_STATE["xla_ok"] is True:
-                raise  # it worked before: a real failure, not a backend gap
-            _BARRIER_STATE["xla_ok"] = False
+    if _BARRIER_STATE["xla_ok"] is None:
+        _BARRIER_STATE["xla_ok"] = _decide_barrier_path()
+    if _BARRIER_STATE["xla_ok"]:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("mxtpu_" + tag)
+        return
     client = _dist_client()
     if client is None:
         return

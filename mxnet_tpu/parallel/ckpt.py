@@ -10,8 +10,8 @@ Saves are **atomic with respect to preemption** (docs/resilience.md):
 the payload is written to a sibling scratch path, made durable, and
 swapped into place — a crash at any instant leaves the previous
 checkpoint at ``path`` readable (or, in the instant between the two
-commit renames, intact under ``path.old.*`` with the complete new one
-under ``path.tmp.*``).  The naive protocol this replaces
+commit renames, intact under ``path.old`` with the complete new one
+under ``path.tmp``).  The naive protocol this replaces
 (``StandardCheckpointer.save(force=True)``) deleted the existing
 checkpoint *before* writing the new one, so a preemption mid-save lost
 both.
@@ -86,11 +86,19 @@ def ocp_save(path, tree, step, atomic=True):
         return path
 
     maybe_fault("ckpt_write", step=step)
-    tmp = "%s.tmp.%d" % (path, _os.getpid())
-    old = "%s.old.%d" % (path, _os.getpid())
-    for stale in (tmp, old):
-        if _os.path.isdir(stale):
-            _shutil.rmtree(stale)
+    # pid-free scratch names, identical on every rank: orbax's
+    # coordinated sharded save needs all processes to hand it the SAME
+    # directory (a per-pid name would strand non-coordinator shards in
+    # directories the commit rename never touches — a silently
+    # incomplete checkpoint).  Stale-scratch cleanup therefore runs on
+    # the coordinator only, fenced before any rank starts writing.
+    tmp = path + ".tmp"
+    old = path + ".old"
+    if _is_coordinator():
+        for stale in (tmp, old):
+            if _os.path.isdir(stale):
+                _shutil.rmtree(stale)
+    _barrier("mxtpu_ocp_clean")
     ckptr.save(tmp, payload, force=True)
     ckptr.wait_until_finished()
     _fsync_dir(_os.path.dirname(tmp))

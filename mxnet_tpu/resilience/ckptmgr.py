@@ -5,8 +5,9 @@ at ANY instruction, including halfway through writing a checkpoint.
 The invariant this module maintains is therefore single: **the newest
 readable checkpoint is never clobbered or corrupted**.  Mechanics:
 
-- every save writes to ``<dir>/tmp.<step>.<pid>``, is made durable
-  (orbax wait + directory fsync), and only then renamed to
+- every save writes to ``<dir>/tmp.<step>`` (the SAME path on every
+  rank — orbax's coordinated sharded write requires it), is made
+  durable (orbax wait + directory fsync), and only then renamed to
   ``<dir>/step_<NNNNNNNN>`` — the rename is the commit point, so a
   crash at any moment leaves either the old set intact (tmp garbage
   ignored) or the old set plus one complete new checkpoint;
@@ -16,9 +17,10 @@ readable checkpoint is never clobbered or corrupted**.  Mechanics:
 - stale ``tmp.*`` from a previous incarnation is swept on save.
 
 Multi-host: every process calls :meth:`CheckpointManager.save` (orbax
-coordinates the sharded write); the commit rename and pruning run on
-process 0 only, fenced by global barriers so no rank can observe a
-half-committed state.
+coordinates the sharded write); the stale-tmp sweep, commit rename,
+and pruning run on process 0 only, fenced by global barriers so no
+rank can observe a half-committed state or delete a peer's
+in-progress scratch.
 """
 from __future__ import annotations
 
@@ -126,10 +128,19 @@ class CheckpointManager(object):
             raise ValueError("checkpoint for step %d already exists at %s"
                              % (step, final))
         _os.makedirs(self.directory, exist_ok=True)
-        self._sweep_tmp()
+        # sweep stale scratch on the coordinator only, fenced BEFORE any
+        # rank starts writing: an unfenced every-rank sweep on shared
+        # storage lets a late-arriving rank rmtree a peer's in-progress
+        # tmp of the current round
+        if _is_coordinator():
+            self._sweep_tmp(current_step=step)
+        _barrier("mxtpu_ckpt_sweep_%d" % step)
         maybe_fault("ckpt_write", step=step)
-        tmp = _os.path.join(self.directory,
-                            "tmp.%d.%d" % (step, _os.getpid()))
+        # pid-free scratch name, identical on every rank — orbax's
+        # coordinated sharded save needs all processes to target the
+        # SAME directory, else non-coordinator shards land in dirs the
+        # commit rename never touches
+        tmp = _os.path.join(self.directory, "tmp.%d" % step)
         # ocp_save's own commit protocol is redundant under the manager
         # (tmp IS the scratch name); atomic=False writes tmp directly
         ocp_save(tmp, tree, step, atomic=False)
@@ -181,15 +192,19 @@ class CheckpointManager(object):
             except OSError:
                 self.logger.warning("could not prune %s", path)
 
-    def _sweep_tmp(self):
+    def _sweep_tmp(self, current_step=None):
         """Remove tmp leftovers from crashed predecessors (they are by
-        definition uncommitted; a restart never resumes a tmp)."""
+        definition uncommitted; a restart never resumes a tmp).  The
+        current round's own scratch (``tmp.<current_step>``) is spared
+        so a sweep can never eat the save that triggered it."""
+        spare = None if current_step is None \
+            else "tmp.%d" % int(current_step)
         try:
             names = _os.listdir(self.directory)
         except OSError:
             return
         for name in names:
-            if _TMP_RE.match(name):
+            if _TMP_RE.match(name) and name != spare:
                 try:
                     _shutil.rmtree(_os.path.join(self.directory, name))
                 except OSError:

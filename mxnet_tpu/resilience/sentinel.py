@@ -134,17 +134,22 @@ class Sentinel(object):
     def grad_norm(grad_arrays):
         """Global L2 norm over a Module-style grads structure: a list
         (per param) of lists (per device) of NDArray/arrays, any of
-        which may be None.  Cheap helper for check(grad_norm=...)."""
+        which may be None.  EVERY device's shard is accumulated — a
+        non-finite gradient on any one device must trip the sentinel
+        before the cross-device aggregation folds it into the update,
+        not just one on device 0.  Cheap helper for
+        check(grad_norm=...)."""
         total = 0.0
         for per_param in grad_arrays:
             devs = per_param if isinstance(per_param, (list, tuple)) \
                 else [per_param]
-            g = devs[0]
-            if g is None:
-                continue
-            a = _np.asarray(g.asnumpy() if hasattr(g, "asnumpy") else g)
-            sq = float(_np.sum(a.astype(_np.float64) ** 2))
-            if not _np.isfinite(sq):
-                return float("nan")
-            total += sq
+            for g in devs:
+                if g is None:
+                    continue
+                a = _np.asarray(g.asnumpy() if hasattr(g, "asnumpy")
+                                else g)
+                sq = float(_np.sum(a.astype(_np.float64) ** 2))
+                if not _np.isfinite(sq):
+                    return float("nan")
+                total += sq
         return float(_np.sqrt(total))

@@ -79,7 +79,9 @@ def launch_local(args, command):
     # docs/resilience.md) the siblings are torn down promptly and the
     # launcher itself exits 3, so the pod restarts bounded rather than
     # draining whatever hang/fault triggered the abort.  Other nonzero
-    # codes keep the legacy drain-then-OR behavior.
+    # codes drain, and the FIRST one is reported — never OR-merged,
+    # which could fabricate 3 (workers exiting 1 and 2 OR to 3) and
+    # trick a supervisor into restarting a non-restartable failure.
     import time as _time
     rc = 0
     live = list(procs)
@@ -100,7 +102,7 @@ def launch_local(args, command):
                         q.kill()
                 return 3
             else:
-                rc |= code
+                rc = rc or code
         live = still
         if live:
             _time.sleep(0.1)
@@ -127,7 +129,8 @@ def launch_ssh(args, command):
                                        hosts[rank], remote]))
     rc = 0
     for p in procs:
-        rc |= p.wait()
+        code = p.wait()
+        rc = rc or code              # first nonzero; OR could fabricate 3
     return rc
 
 
