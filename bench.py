@@ -115,6 +115,32 @@ def _parse_utc_ts(text):
 
 def _emit(payload):
     sys.stdout.write(json.dumps(payload) + "\n")
+    _emit_telemetry_summary(payload)
+
+
+def _stamp_run_id(payload):
+    """Stamp the payload with the telemetry run_id so a BENCH_*.json
+    row can be joined against its event log (no-op when telemetry is
+    off — the key is simply absent)."""
+    try:
+        from mxnet_tpu import observability as obs
+        if obs.enabled():
+            payload["run_id"] = obs.run_id()
+    except Exception:
+        pass
+    return payload
+
+
+def _emit_telemetry_summary(payload):
+    """Mirror the bench result into the event log as a ``summary``
+    record and flush, so the telemetry dir is self-contained."""
+    try:
+        from mxnet_tpu import observability as obs
+        if obs.enabled():
+            obs.emit("summary", source="bench", **payload)
+            obs.flush()
+    except Exception:
+        pass
     sys.stdout.flush()
 
 
@@ -535,6 +561,7 @@ def measure():
         payload["batch_sweep"] = {str(k): v for k, v in sweep.items()}
     if os.environ.get("BENCH_FALLBACK"):
         payload["fallback"] = os.environ["BENCH_FALLBACK"]
+    _stamp_run_id(payload)
 
     # Emit the primary metric NOW: a hang in the optional secondary
     # measurements below must not cost the number already in hand (the

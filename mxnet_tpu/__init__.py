@@ -55,6 +55,7 @@ from .module import Module
 from . import monitor
 from .monitor import Monitor
 from . import profiler
+from . import observability
 from . import predictor
 from .predictor import Predictor
 from . import visualization

@@ -81,14 +81,25 @@ def log_train_metric(period, auto_reset=False):
 
 class Speedometer(object):
     """Log samples/sec every ``frequent`` batches (parity: callback.py
-    Speedometer; THE throughput readout in every reference example)."""
+    Speedometer; THE throughput readout in every reference example).
 
-    def __init__(self, batch_size, frequent=50):
+    Speed is computed over the ACTUAL number of batches seen since the
+    last report, not ``frequent`` — after a resume or a mid-epoch
+    re-init the first window is short and assuming ``frequent`` would
+    overstate throughput.  ``auto_reset=False`` keeps the running
+    metric across reports (reference behavior is reset-per-window).
+    When telemetry is on, each report also lands in the event log as a
+    ``step`` record so mxtop sees the same numbers the console does.
+    """
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
+        self.auto_reset = auto_reset
         self.init = False
         self.tic = 0
         self.last_count = 0
+        self._tic_count = 0
 
     def __call__(self, param):
         count = param.nbatch
@@ -98,20 +109,41 @@ class Speedometer(object):
 
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                batches = count - self._tic_count
+                elapsed = time.time() - self.tic
+                if batches <= 0 or elapsed <= 0:
+                    self.tic = time.time()
+                    self._tic_count = count
+                    return
+                speed = batches * self.batch_size / elapsed
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
-                    param.eval_metric.reset()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
                     for name, value in name_value:
                         logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\tTrain-%s=%f",
                                      param.epoch, count, speed, name, value)
                 else:
                     logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                                  param.epoch, count, speed)
+                self._emit_telemetry(param, count, speed)
                 self.tic = time.time()
+                self._tic_count = count
         else:
             self.init = True
             self.tic = time.time()
+            self._tic_count = count
+
+    def _emit_telemetry(self, param, count, speed):
+        try:
+            from . import observability as obs
+            if obs.enabled():
+                obs.emit("step", step=count, epoch=param.epoch,
+                         batch_size=self.batch_size,
+                         samples_per_sec=round(speed, 2),
+                         source="speedometer")
+        except Exception:
+            pass
 
 
 class ProgressBar(object):

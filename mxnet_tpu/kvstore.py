@@ -151,16 +151,23 @@ class KVStore(object):
         """
         if not (self.type.startswith("dist") and jax.process_count() > 1):
             return merged
+        from .observability import spans as _spans, events as _events
+        nbytes = getattr(merged, "nbytes", None)
         timeout = _collective_timeout_s()
-        if timeout:
-            # a peer that died mid-push leaves everyone else wedged in
-            # the collective forever; the watchdog bounds that to a
-            # structured abort + restart (docs/resilience.md)
-            from .resilience import run_with_timeout
-            return run_with_timeout(
-                lambda: self._allreduce_dist(merged), timeout,
-                phase="kvstore_push", rank=self.rank)
-        return self._allreduce_dist(merged)
+        with _spans.span("allreduce"):
+            if timeout:
+                # a peer that died mid-push leaves everyone else wedged
+                # in the collective forever; the watchdog bounds that to
+                # a structured abort + restart (docs/resilience.md)
+                from .resilience import run_with_timeout
+                out = run_with_timeout(
+                    lambda: self._allreduce_dist(merged), timeout,
+                    phase="kvstore_push", rank=self.rank)
+            else:
+                out = self._allreduce_dist(merged)
+        _events.emit("collective", op="allreduce", bytes=nbytes,
+                     num_workers=self.num_workers)
+        return out
 
     def _allreduce_dist(self, merged):
         # Pick the path ONCE, cluster-wide.  A per-process probe could
@@ -340,12 +347,15 @@ class KVStore(object):
             def _sync():
                 global_barrier("kv_barrier", timeout_s=timeout)
 
-            if timeout:
-                from .resilience import run_with_timeout
-                run_with_timeout(_sync, timeout, phase="kvstore_barrier",
-                                 rank=self.rank)
-            else:
-                _sync()
+            from .observability import spans as _spans
+            with _spans.span("kv_barrier"):
+                if timeout:
+                    from .resilience import run_with_timeout
+                    run_with_timeout(_sync, timeout,
+                                     phase="kvstore_barrier",
+                                     rank=self.rank)
+                else:
+                    _sync()
 
     def _barrier(self):
         self.barrier()

@@ -256,13 +256,19 @@ class BaseModule(object):
         # skips the update instead of poisoning the parameters
         from ..resilience import Sentinel
         from ..resilience import sentinel as _sentinel_mod
+        from .. import observability as _obs
+        from ..observability import timed_iter
         sentinel = Sentinel.from_env(logger=self.logger)
         num_step = 0
+        telemetry = _obs.enabled()
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
+            batches = timed_iter(train_data, name="data_wait",
+                                 step_from=lambda: num_step)
+            for nbatch, data_batch in enumerate(batches):
+                t0 = time.perf_counter() if telemetry else None
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
@@ -276,6 +282,11 @@ class BaseModule(object):
                         num_step, grad_norm=gnorm) != _sentinel_mod.OK
                 if not skip:
                     self.update()
+                if t0 is not None:
+                    _obs.record_step(
+                        num_step, time.perf_counter() - t0, epoch=epoch,
+                        batch_size=_batch_num_samples(data_batch),
+                        skipped=skip or None)
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
@@ -303,6 +314,16 @@ class BaseModule(object):
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)
             train_data.reset()
+
+
+def _batch_num_samples(batch):
+    """Leading-dim sample count of a DataBatch (telemetry only)."""
+    try:
+        data = batch.data[0] if isinstance(batch.data, (list, tuple)) \
+            else batch.data
+        return int(data.shape[0])
+    except Exception:
+        return None
 
 
 def _call(callbacks, *args):

@@ -1,0 +1,68 @@
+"""Pod-wide telemetry: structured event log, phase spans, counters,
+cross-rank aggregation.
+
+Off by default.  Set ``MXTPU_TELEMETRY=1`` (and optionally
+``MXTPU_TELEMETRY_DIR=/some/scratch``) and every rank appends typed
+JSONL records — step timings, phase spans, derived counters, faults,
+checkpoint lifecycle, collective traffic — to its own
+``events-rank*.jsonl``.  ``tools/mxtop.py`` renders the merged pod
+report; :mod:`.aggregate` publishes live per-rank summaries over the
+coordination-service KV.  Schema and usage: docs/observability.md.
+
+The fit loops / trainer / kvstore / resilience seams call
+:func:`record_step` and :func:`spans.span`; both are cheap no-ops when
+telemetry is off, so the default path pays one cached boolean check.
+"""
+from __future__ import annotations
+
+from . import events, spans, counters, aggregate
+from .events import (enabled, emit, flush, refresh, run_id, last_fault,
+                     EventLog)
+from .spans import span, timed_iter, SPAN_NAMES
+from .counters import (StepStats, percentile, global_stats,
+                       emit_trainer_counters, emit_sentinel_counters)
+from .aggregate import (publish_summary, collect_summaries,
+                        heartbeat_ages, pod_view, read_events,
+                        build_report)
+
+__all__ = [
+    "events", "spans", "counters", "aggregate",
+    "enabled", "emit", "flush", "refresh", "run_id", "last_fault",
+    "EventLog",
+    "span", "timed_iter", "SPAN_NAMES",
+    "StepStats", "percentile", "global_stats",
+    "emit_trainer_counters", "emit_sentinel_counters",
+    "publish_summary", "collect_summaries", "heartbeat_ages",
+    "pod_view", "read_events", "build_report",
+    "record_step",
+]
+
+#: publish a KV summary every N recorded steps (override via env)
+_PUBLISH_EVERY = 10
+
+
+def record_step(step, dur_s, batch_size=None, epoch=None, **fields):
+    """The one call a training loop makes per step when telemetry is
+    on: emits the ``step`` record, folds the timing into the process
+    :class:`StepStats`, and every ``_PUBLISH_EVERY`` steps pushes the
+    compact summary to the coordination KV for the live pod view.
+    No-op when telemetry is off; never raises."""
+    log = events.get()
+    if log is None:
+        return
+    try:
+        stats = counters.global_stats()
+        stats.observe(dur_s, step=step, batch_size=batch_size)
+        rec = {"dur_ms": round(float(dur_s) * 1e3, 3)}
+        if batch_size:
+            rec["batch_size"] = batch_size
+            if dur_s > 0:
+                rec["samples_per_sec"] = round(batch_size / dur_s, 2)
+        if epoch is not None:
+            rec["epoch"] = epoch
+        rec.update(fields)
+        log.emit("step", step=step, **rec)
+        if step is not None and step % _PUBLISH_EVERY == 0:
+            aggregate.publish_summary(step=step)
+    except Exception:
+        pass

@@ -1,0 +1,304 @@
+"""Pod-wide aggregation: per-rank summaries -> one coherent pod view.
+
+Two data paths, one report shape:
+
+- **Live** (a running pod): every rank publishes its compact per-step
+  summary through the jax coordination-service KV — the SAME channel
+  the PR-3 heartbeats and barrier/collective verdicts ride, so there is
+  no second RPC fabric to configure or fail independently.  The
+  coordinator merges them with :func:`pod_view`.  Heartbeat ages come
+  from the existing ``mxtpu_hb/<rank>`` liveness stamps
+  (:func:`heartbeat_ages` — the `kvstore.num_dead_nodes` data, exposed
+  as ages instead of a dead count; deliberately NOT a second heartbeat).
+- **Post-hoc** (a telemetry dir, live-tailed or after the job):
+  :func:`read_events` merges the per-rank JSONL files and
+  :func:`build_report` derives the same pod view from the records —
+  what ``tools/mxtop.py`` renders.
+
+Report fields (docs/observability.md): step-time p50/p95 pod-wide,
+samples/sec (summed over ranks), MFU, straggler gap (max − median of
+per-rank mean step time), per-rank heartbeat age, last fault per rank,
+slowest phase, and the ordered fault/ckpt incident timeline.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import time
+
+from . import events, counters
+
+__all__ = ["publish_summary", "collect_summaries", "heartbeat_ages",
+           "pod_view", "read_events", "build_report", "timeline_around",
+           "TEL_PREFIX"]
+
+#: coordination-KV prefix for published per-rank summaries
+TEL_PREFIX = "mxtpu_tel/"
+
+
+def _client():
+    from ..kvstore import _dist_client
+    return _dist_client()
+
+
+# ----------------------------------------------------------------------
+# live path (coordination-service KV)
+# ----------------------------------------------------------------------
+def publish_summary(step=None, extra=None):
+    """Publish this rank's compact summary under ``mxtpu_tel/<rank>``
+    (overwrite-in-place: one key per rank, O(ranks) total KV state).
+    No-op without telemetry or a coordination client; never raises."""
+    if not events.enabled():
+        return False
+    client = _client()
+    if client is None:
+        return False
+    summary = counters.global_stats().snapshot()
+    summary["rank"] = events.rank()
+    summary["run_id"] = events.run_id()
+    summary["published_at"] = time.time()
+    if step is not None:
+        summary["last_step"] = step
+    fault = events.last_fault()
+    if fault is not None:
+        summary["last_fault"] = fault
+    if extra:
+        summary.update(extra)
+    try:
+        client.key_value_set(TEL_PREFIX + str(events.rank()),
+                             json.dumps(summary, default=str),
+                             allow_overwrite=True)
+        return True
+    except Exception:
+        return False
+
+
+def collect_summaries():
+    """All published rank summaries: {rank: summary dict}.  Empty when
+    no coordination service is up (single process)."""
+    client = _client()
+    if client is None:
+        return {}
+    try:
+        entries = dict(client.key_value_dir_get(TEL_PREFIX))
+    except Exception:
+        return {}
+    out = {}
+    for key, val in entries.items():
+        try:
+            rank = int(key[len(TEL_PREFIX):]) if key.startswith(TEL_PREFIX) \
+                else int(key.rsplit("/", 1)[-1])
+            out[rank] = json.loads(val)
+        except (ValueError, TypeError):
+            continue
+    return out
+
+
+def heartbeat_ages(num_workers=None, now=None):
+    """{rank: seconds since last liveness stamp} from the EXISTING
+    kvstore heartbeat keys (``mxtpu_hb/<rank>``) — the same stamps
+    ``num_dead_nodes`` thresholds, surfaced as ages so an operator sees
+    "rank 3 last breathed 47s ago" before the dead-count trips.
+    Ranks with no stamp yet map to None."""
+    from ..kvstore import _HB_PREFIX, _now
+    client = _client()
+    if client is None:
+        return {}
+    try:
+        entries = dict(client.key_value_dir_get(_HB_PREFIX))
+    except Exception:
+        return {}
+    now = _now() if now is None else now
+    ages = {}
+    for key, stamp in entries.items():
+        try:
+            rank = int(key[len(_HB_PREFIX):]) if key.startswith(_HB_PREFIX) \
+                else int(key.rsplit("/", 1)[-1])
+            ages[rank] = round(now - float(stamp), 3)
+        except (ValueError, TypeError):
+            continue
+    if num_workers:
+        for rank in range(int(num_workers)):
+            ages.setdefault(rank, None)
+    return ages
+
+
+def pod_view(num_workers=None):
+    """Merge the live published summaries + heartbeat ages into the pod
+    report (coordinator-side; any rank may call it)."""
+    summaries = collect_summaries()
+    ages = heartbeat_ages(num_workers)
+    per_rank = {str(r): s for r, s in sorted(summaries.items())}
+    for rank, age in ages.items():
+        per_rank.setdefault(str(rank), {})["heartbeat_age_s"] = age
+    pod = _pod_rollup(per_rank)
+    return {"per_rank": per_rank, "pod": pod,
+            "ranks": sorted(int(r) for r in per_rank)}
+
+
+def _median(vals):
+    vals = sorted(vals)
+    if not vals:
+        return None
+    mid = len(vals) // 2
+    if len(vals) % 2:
+        return vals[mid]
+    return 0.5 * (vals[mid - 1] + vals[mid])
+
+
+def _pod_rollup(per_rank):
+    """Pod-level figures from per-rank summary dicts (shared by the
+    live and post-hoc paths)."""
+    means = [s["step_ms_mean"] for s in per_rank.values()
+             if s.get("step_ms_mean") is not None]
+    pod = {
+        "ranks": len(per_rank),
+        "steps": max([s.get("last_step") or 0
+                      for s in per_rank.values()] or [0]),
+        "step_ms_p50": _median([s.get("step_ms_p50") for s in
+                                per_rank.values()
+                                if s.get("step_ms_p50") is not None]),
+        "step_ms_p95": max([s.get("step_ms_p95") for s in
+                            per_rank.values()
+                            if s.get("step_ms_p95") is not None] or
+                           [None], key=lambda v: v or 0),
+        "samples_per_sec": round(sum(
+            s.get("samples_per_sec") or 0 for s in per_rank.values()), 2)
+        or None,
+        "mfu": None,
+        "straggler_gap_ms": None,
+        "slowest_phase": None,
+        "heartbeat_age_s": {r: s.get("heartbeat_age_s")
+                            for r, s in per_rank.items()},
+    }
+    if means:
+        pod["straggler_gap_ms"] = round(max(means) - _median(means), 3)
+    mfus = [s.get("mfu") for s in per_rank.values()
+            if s.get("mfu") is not None]
+    if mfus:
+        pod["mfu"] = round(sum(mfus) / len(mfus), 4)
+    return pod
+
+
+# ----------------------------------------------------------------------
+# post-hoc path (telemetry dir -> merged records -> report)
+# ----------------------------------------------------------------------
+def read_events(directory):
+    """Merge every ``events-rank*.jsonl`` (rotated ``.1`` predecessors
+    included) under ``directory`` into one wall-clock-ordered list of
+    record dicts.  Unparseable lines (torn final write of a killed
+    rank) are skipped, not fatal."""
+    paths = sorted(_glob.glob(os.path.join(directory,
+                                           "events-rank*.jsonl.1")))
+    paths += sorted(_glob.glob(os.path.join(directory,
+                                            "events-rank*.jsonl")))
+    records = []
+    for path in paths:
+        try:
+            with open(path) as fin:
+                for line in fin:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        records.append(rec)
+        except OSError:
+            continue
+    records.sort(key=lambda r: (r.get("wall_ms") or 0,
+                                r.get("rank") or 0))
+    return records
+
+
+def build_report(records, now=None):
+    """The pod report from merged event records (what ``mxtop`` shows).
+
+    Heartbeat ages: a live-published ``heartbeat_ages`` counter record
+    (the drill/coordinator emits one from the KV liveness stamps) wins;
+    otherwise each rank's age is derived from its LAST event — an
+    honest "this rank last told us anything N seconds ago".
+    """
+    now_ms = (time.time() if now is None else now) * 1000.0
+    ranks = sorted({r.get("rank") for r in records
+                    if r.get("rank") is not None})
+    run_ids = sorted({r.get("run_id") for r in records
+                      if r.get("run_id")})
+    per_rank = {}
+    phase_totals = {}
+    incidents = []
+    kv_hb_ages = None
+    for rec in records:
+        kind = rec.get("kind")
+        rank = rec.get("rank")
+        state = per_rank.setdefault(str(rank), {
+            "_durs": [], "_sps": [], "steps": 0, "last_step": None,
+            "last_wall_ms": None, "last_fault": None})
+        state["last_wall_ms"] = rec.get("wall_ms")
+        if kind == "step":
+            state["steps"] += 1
+            if rec.get("step") is not None:
+                state["last_step"] = rec["step"]
+            if rec.get("dur_ms") is not None:
+                state["_durs"].append(float(rec["dur_ms"]))
+            if rec.get("samples_per_sec") is not None:
+                state["_sps"].append(float(rec["samples_per_sec"]))
+        elif kind == "span":
+            name = rec.get("name") or "?"
+            phase_totals[name] = phase_totals.get(name, 0.0) \
+                + float(rec.get("dur_ms") or 0.0)
+        elif kind == "fault":
+            state["last_fault"] = {k: v for k, v in rec.items()
+                                   if k not in ("run_id",)}
+            incidents.append(rec)
+        elif kind == "ckpt":
+            incidents.append(rec)
+        elif kind == "counter" and rec.get("name") == "heartbeat_ages":
+            kv_hb_ages = rec.get("ages")
+        elif kind == "counter" and rec.get("name") == "trainer_cost":
+            if rec.get("mfu") is not None:
+                state.setdefault("_mfus", []).append(float(rec["mfu"]))
+
+    summaries = {}
+    for rank, state in per_rank.items():
+        durs = state.pop("_durs")
+        sps = state.pop("_sps")
+        mfus = state.pop("_mfus", [])
+        s = dict(state)
+        if durs:
+            s["step_ms_mean"] = round(sum(durs) / len(durs), 3)
+            s["step_ms_p50"] = round(counters.percentile(durs, 50), 3)
+            s["step_ms_p95"] = round(counters.percentile(durs, 95), 3)
+        if sps:
+            s["samples_per_sec"] = round(sps[-1], 2)
+        elif durs and s.get("step_ms_mean"):
+            pass                        # no batch size known: omit
+        if mfus:
+            s["mfu"] = round(sum(mfus) / len(mfus), 4)
+        if kv_hb_ages and str(rank) in {str(k) for k in kv_hb_ages}:
+            age = kv_hb_ages.get(rank, kv_hb_ages.get(str(rank)))
+            s["heartbeat_age_s"] = age
+        elif state.get("last_wall_ms"):
+            s["heartbeat_age_s"] = round(
+                (now_ms - state["last_wall_ms"]) / 1e3, 3)
+        summaries[rank] = s
+
+    pod = _pod_rollup(summaries)
+    if phase_totals:
+        pod["slowest_phase"] = max(phase_totals, key=phase_totals.get)
+        pod["phase_totals_ms"] = {k: round(v, 3)
+                                  for k, v in sorted(phase_totals.items())}
+    return {"run_ids": run_ids, "ranks": ranks, "events": len(records),
+            "pod": pod, "per_rank": summaries, "incidents": incidents}
+
+
+def timeline_around(records, index, before=5, after=5):
+    """The event window around ``records[index]`` (an incident) — what
+    ``mxtop --fault`` prints so "what happened before the restart" is
+    one command, not eight grepped logs."""
+    lo = max(0, index - before)
+    hi = min(len(records), index + after + 1)
+    return records[lo:hi]

@@ -1,0 +1,193 @@
+"""Derived training counters: throughput, step-time EMA + percentiles,
+MFU, HBM bytes, collective bytes, loss-scale/sentinel state.
+
+One :class:`StepStats` per process folds host step timings into the
+numbers an operator actually reads (EMA, p50/p95, samples/sec); the
+helpers below pull the heavier figures from machinery that already
+exists — XLA cost analysis via
+``ShardedTrainer.compiled_step_cost_analysis()`` (the hook bench.py
+uses for its MFU figure), the analysis ICI cost model
+(``analysis.propagation.comm_report``) for collective bytes, and
+``ShardedTrainer.sentinel_stats()`` for loss-scale/skip counts.
+"""
+from __future__ import annotations
+
+import os
+
+from . import events
+
+__all__ = ["percentile", "StepStats", "global_stats", "reset",
+           "peak_tflops", "mfu", "collective_bytes",
+           "emit_trainer_counters", "emit_sentinel_counters"]
+
+
+def percentile(values, pct):
+    """Nearest-rank percentile of a sequence (no numpy on the hot
+    path); None for an empty input."""
+    vals = sorted(values)
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return vals[0]
+    idx = max(0, min(len(vals) - 1,
+                     int(round(pct / 100.0 * (len(vals) - 1)))))
+    return vals[idx]
+
+
+class StepStats(object):
+    """Step-time EMA + bounded-window percentiles + throughput.
+
+    ``observe`` is the hot call: one deque append + one multiply-add.
+    ``snapshot`` derives the report fields (percentiles sort the
+    window — call it at logging cadence, not per step).
+    """
+
+    def __init__(self, batch_size=None, window=512, ema_decay=0.9):
+        from collections import deque
+        self.batch_size = batch_size
+        self.window = deque(maxlen=int(window))
+        self.ema_decay = float(ema_decay)
+        self.ema_s = None
+        self.steps = 0
+        self.last_step = None
+
+    def observe(self, dur_s, step=None, batch_size=None):
+        dur_s = float(dur_s)
+        self.window.append(dur_s)
+        self.ema_s = dur_s if self.ema_s is None else (
+            self.ema_decay * self.ema_s + (1.0 - self.ema_decay) * dur_s)
+        self.steps += 1
+        if step is not None:
+            self.last_step = step
+        if batch_size is not None:
+            self.batch_size = batch_size
+
+    def snapshot(self):
+        """Dict of derived figures (the compact per-rank summary the
+        aggregator publishes)."""
+        out = {"steps": self.steps, "last_step": self.last_step}
+        if self.ema_s is not None:
+            out["step_ms_ema"] = round(self.ema_s * 1e3, 3)
+        if self.window:
+            vals = list(self.window)
+            out["step_ms_p50"] = round(percentile(vals, 50) * 1e3, 3)
+            out["step_ms_p95"] = round(percentile(vals, 95) * 1e3, 3)
+            mean = sum(vals) / len(vals)
+            out["step_ms_mean"] = round(mean * 1e3, 3)
+            if self.batch_size and mean > 0:
+                out["samples_per_sec"] = round(self.batch_size / mean, 2)
+        return out
+
+
+_GLOBAL = {"stats": None}
+
+
+def global_stats():
+    """The process-wide StepStats the built-in wiring feeds."""
+    if _GLOBAL["stats"] is None:
+        _GLOBAL["stats"] = StepStats()
+    return _GLOBAL["stats"]
+
+
+def reset():
+    _GLOBAL["stats"] = None
+
+
+# ----------------------------------------------------------------------
+# hardware-derived figures
+# ----------------------------------------------------------------------
+def peak_tflops(device_kind=None):
+    """Per-chip peak TFLOPs: ``BENCH_PEAK_TFLOPS`` override, else the
+    bench.py spec-sheet table (shared so bench and telemetry can never
+    disagree on a peak), else None."""
+    raw = os.environ.get("BENCH_PEAK_TFLOPS")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = getattr(jax.devices()[0], "device_kind", None)
+        except Exception:
+            return None
+    try:
+        import bench
+        peak, _note = bench._lookup_peak_tflops(device_kind)
+        return peak
+    except Exception:
+        return None
+
+
+def mfu(flops_per_step, step_time_s, n_devices=1, device_kind=None):
+    """Model-FLOPs utilization, or None when the peak is unknown."""
+    peak = peak_tflops(device_kind)
+    if not peak or not step_time_s:
+        return None
+    return float(flops_per_step) / float(step_time_s) / (
+        peak * 1e12 * max(1, int(n_devices)))
+
+
+def collective_bytes(symbol, mesh, shapes=None, **analyze_kwargs):
+    """Per-device ICI bytes of one step of ``symbol`` under ``mesh``,
+    from the analysis cost model (MXL-P transfer rules) — the figure
+    the collective audit already computes at lint time, exposed as a
+    telemetry counter.  Returns the ``comm_report`` dict or None."""
+    try:
+        from .. import analysis
+        from ..analysis.propagation import comm_report
+        ctx_out = []
+        analysis.analyze(symbol, shapes=shapes, mesh=mesh,
+                         _ctx_out=ctx_out, **analyze_kwargs)
+        return comm_report(ctx_out[0])
+    except Exception:
+        return None
+
+
+# ----------------------------------------------------------------------
+# emit helpers (each one guarded: no-ops when telemetry is off)
+# ----------------------------------------------------------------------
+def emit_trainer_counters(trainer, step_time_s=None):
+    """Emit MFU/flops/HBM-bytes counters for a ShardedTrainer from the
+    compiled step's XLA cost analysis (needs one executed step).
+    Returns the fields emitted (or {})."""
+    if not events.enabled():
+        return {}
+    fields = {}
+    try:
+        cost = trainer.compiled_step_cost_analysis()
+    except Exception:
+        cost = None
+    if cost:
+        if cost.get("flops"):
+            fields["flops_per_step"] = float(cost["flops"])
+        if cost.get("bytes accessed"):
+            fields["hbm_bytes_per_step"] = float(cost["bytes accessed"])
+    if step_time_s and fields.get("flops_per_step"):
+        try:
+            import jax
+            n_dev = len(jax.devices())
+            kind = getattr(jax.devices()[0], "device_kind", None)
+        except Exception:
+            n_dev, kind = 1, None
+        util = mfu(fields["flops_per_step"], step_time_s, n_dev, kind)
+        if util is not None:
+            fields["mfu"] = round(util, 4)
+        fields["step_time_s"] = round(float(step_time_s), 6)
+    if fields:
+        events.emit("counter", step=getattr(trainer, "num_update", None),
+                    name="trainer_cost", **fields)
+    return fields
+
+
+def emit_sentinel_counters(stats, step=None):
+    """Emit loss-scale / skip-count counters from a sentinel-stats dict
+    (``ShardedTrainer.sentinel_stats()`` or a host ``Sentinel``)."""
+    if not events.enabled() or not stats:
+        return
+    events.emit("counter", step=step, name="sentinel",
+                loss_scale=stats.get("scale"),
+                skipped=stats.get("skipped"),
+                good_steps=stats.get("good_steps"),
+                last_good=stats.get("last_good"))

@@ -1,0 +1,103 @@
+"""Phase spans: one name shared by the event log and the xprof trace.
+
+``span("data_wait")`` / ``span("h2d")`` / ``span("step")`` /
+``span("allreduce")`` / ``span("ckpt_save")`` time a phase on the host
+and (a) append a ``span`` record to the event log, (b) forward the same
+name to :class:`mxnet_tpu.profiler.annotate` so a captured xprof trace
+carries identical region names — the operator reads "allreduce is the
+slow phase" off either surface without a translation table.
+
+When telemetry is off and no profiler trace is running, ``span()``
+returns a shared null context: zero allocation, zero timing.
+"""
+from __future__ import annotations
+
+import time
+
+from . import events
+
+__all__ = ["span", "SPAN_NAMES", "timed_iter"]
+
+#: canonical phase names (free-form names are allowed; these are the
+#: ones the built-in wiring emits and mxtop groups by)
+SPAN_NAMES = ("data_wait", "h2d", "step", "allreduce", "kv_barrier",
+              "ckpt_save", "eval")
+
+
+class _NullSpan(object):
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span(object):
+    __slots__ = ("name", "step", "fields", "_t0", "_ann")
+
+    def __init__(self, name, step, fields):
+        self.name = name
+        self.step = step
+        self.fields = fields
+        self._t0 = None
+        self._ann = None
+
+    def __enter__(self):
+        try:
+            from ..profiler import annotate
+            self._ann = annotate(self.name)
+            self._ann.__enter__()
+        except Exception:               # no jax / exotic backend: host
+            self._ann = None            # timing still works
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur_ms = (time.perf_counter() - self._t0) * 1e3
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:
+                pass
+        events.emit("span", step=self.step, name=self.name,
+                    dur_ms=round(dur_ms, 3), **self.fields)
+        return False
+
+
+def span(name, step=None, **fields):
+    """Context manager timing one phase.  Null (free) when telemetry is
+    off; otherwise records a ``span`` event and annotates the trace."""
+    if events.get() is None:
+        return _NULL
+    return _Span(name, step, fields)
+
+
+def timed_iter(iterable, name="data_wait", step_from=None):
+    """Pass-through generator that times each ``next()`` under ``span``
+    — the input-pipeline wait the fit loops can't see otherwise.  Plain
+    iteration (no timing) when telemetry is off.
+
+    ``step_from``: optional zero-arg callable giving the step to tag
+    each span with (called per batch, AFTER the fetch).
+    """
+    if events.get() is None:
+        for item in iterable:
+            yield item
+        return
+    it = iter(iterable)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        events.emit("span", name=name,
+                    step=step_from() if step_from is not None else None,
+                    dur_ms=round(dur_ms, 3))
+        yield item

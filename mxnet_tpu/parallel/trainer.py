@@ -488,7 +488,9 @@ class ShardedTrainer(object):
         Multi-process: each process passes its PROCESS-LOCAL portion
         (the reference's num_parts/part_index shard); the global batch
         is their concatenation over the dp axis."""
-        return _place_batch(batch, self.batch_sharding)
+        from ..observability import spans as _spans
+        with _spans.span("h2d", step=self.num_update):
+            return _place_batch(batch, self.batch_sharding)
 
     # ------------------------------------------------------------------
     # steps
@@ -563,11 +565,55 @@ class ShardedTrainer(object):
         timeout = self.step_timeout_s
         if timeout is None:
             timeout = _resilience.step_timeout_s()
+
+        from .. import observability as _obs
+        if _obs.events.get() is not None:
+            # host dispatch wall only: XLA execution is async, so this
+            # understates device time unless the caller syncs (the
+            # Module path does via update(); docs/observability.md)
+            import time as _time
+            t0 = _time.perf_counter()
+            try:
+                if timeout:
+                    return _resilience.run_with_timeout(
+                        dispatch, timeout, phase="train_step",
+                        step=self.num_update)
+                return dispatch()
+            finally:
+                _obs.record_step(self.num_update,
+                                 _time.perf_counter() - t0,
+                                 batch_size=self._batch_samples(batch),
+                                 timing="dispatch")
         if timeout:
             return _resilience.run_with_timeout(
                 dispatch, timeout, phase="train_step",
                 step=self.num_update)
         return dispatch()
+
+    @staticmethod
+    def _batch_samples(batch):
+        """Leading-dim sample count of the first batch array (telemetry
+        throughput only)."""
+        try:
+            first = next(iter(batch.values())) if isinstance(batch, dict) \
+                else batch[0]
+            return int(first.shape[0])
+        except Exception:
+            return None
+
+    def emit_telemetry_counters(self, step_time_s=None):
+        """Emit MFU / flops / HBM-bytes / sentinel counters for this
+        trainer to the event log (needs one executed step for the cost
+        analysis; polls sentinel_stats, which syncs the device — call
+        at logging cadence).  Returns the cost fields emitted."""
+        from .. import observability as _obs
+        if not _obs.enabled():
+            return {}
+        fields = _obs.emit_trainer_counters(self, step_time_s)
+        if self._sentinel_state is not None:
+            _obs.emit_sentinel_counters(self.sentinel_stats(),
+                                        step=self.num_update)
+        return fields
 
     def eval(self, params, aux, batch, rng=None):
         if rng is None:

@@ -90,6 +90,9 @@ class StepTimer(object):
         mean = sum(ts) / len(ts)
         out = {"steps": len(ts), "mean_s": mean,
                "min_s": min(ts), "max_s": max(ts)}
+        from .observability.counters import percentile
+        out["p50_s"] = percentile(ts, 50)
+        out["p95_s"] = percentile(ts, 95)
         if self.batch_size:
             out["samples_per_sec"] = self.batch_size / mean
         return out

@@ -80,6 +80,16 @@ def exit_for_restart(err):
     forever on — the exact hang this package exists to bound.
     """
     print("RESILIENCE ABORT: %s" % err, file=_sys.stderr, flush=True)
+    # os._exit skips atexit, so the telemetry buffer must be drained
+    # here or the abort is the one event the log is missing
+    try:
+        from .. import observability as _obs
+        _obs.emit("fault", step=getattr(err, "step", None),
+                  fault="exit_restart", phase=getattr(err, "phase", None),
+                  error_kind=getattr(err, "kind", None), error=str(err))
+        _obs.flush()
+    except Exception:
+        pass
     _os._exit(getattr(err, "exit_code", EXIT_RESTART))
 
 

@@ -68,6 +68,14 @@ def _barrier(tag):
         pass
 
 
+def _emit_ckpt(phase, step, path):
+    try:
+        from .. import observability as obs
+        obs.emit("ckpt", step=step, phase=phase, path=path)
+    except Exception:
+        pass
+
+
 class CheckpointManager(object):
     """Versioned checkpoints for one training run under ``directory``.
 
@@ -122,35 +130,40 @@ class CheckpointManager(object):
         """
         from ..parallel.ckpt import ocp_save
         from .faultinject import maybe_fault
+        from ..observability import spans as _spans
         step = int(step)
         final = self.step_path(step)
         if _os.path.isdir(final):
             raise ValueError("checkpoint for step %d already exists at %s"
                              % (step, final))
-        _os.makedirs(self.directory, exist_ok=True)
-        # sweep stale scratch on the coordinator only, fenced BEFORE any
-        # rank starts writing: an unfenced every-rank sweep on shared
-        # storage lets a late-arriving rank rmtree a peer's in-progress
-        # tmp of the current round
-        if _is_coordinator():
-            self._sweep_tmp(current_step=step)
-        _barrier("mxtpu_ckpt_sweep_%d" % step)
-        maybe_fault("ckpt_write", step=step)
-        # pid-free scratch name, identical on every rank — orbax's
-        # coordinated sharded save needs all processes to target the
-        # SAME directory, else non-coordinator shards land in dirs the
-        # commit rename never touches
-        tmp = _os.path.join(self.directory, "tmp.%d" % step)
-        # ocp_save's own commit protocol is redundant under the manager
-        # (tmp IS the scratch name); atomic=False writes tmp directly
-        ocp_save(tmp, tree, step, atomic=False)
-        maybe_fault("ckpt_commit", step=step)
-        _barrier("mxtpu_ckpt_commit_%d" % step)
-        if _is_coordinator():
-            _os.rename(tmp, final)               # the commit point
-            _fsync_dir(self.directory)
-            self.prune()
-        _barrier("mxtpu_ckpt_done_%d" % step)
+        _emit_ckpt("save_begin", step, final)
+        with _spans.span("ckpt_save", step=step):
+            _os.makedirs(self.directory, exist_ok=True)
+            # sweep stale scratch on the coordinator only, fenced BEFORE
+            # any rank starts writing: an unfenced every-rank sweep on
+            # shared storage lets a late-arriving rank rmtree a peer's
+            # in-progress tmp of the current round
+            if _is_coordinator():
+                self._sweep_tmp(current_step=step)
+            _barrier("mxtpu_ckpt_sweep_%d" % step)
+            maybe_fault("ckpt_write", step=step)
+            # pid-free scratch name, identical on every rank — orbax's
+            # coordinated sharded save needs all processes to target the
+            # SAME directory, else non-coordinator shards land in dirs
+            # the commit rename never touches
+            tmp = _os.path.join(self.directory, "tmp.%d" % step)
+            # ocp_save's own commit protocol is redundant under the
+            # manager (tmp IS the scratch name); atomic=False writes
+            # tmp directly
+            ocp_save(tmp, tree, step, atomic=False)
+            maybe_fault("ckpt_commit", step=step)
+            _barrier("mxtpu_ckpt_commit_%d" % step)
+            if _is_coordinator():
+                _os.rename(tmp, final)               # the commit point
+                _fsync_dir(self.directory)
+                self.prune()
+            _barrier("mxtpu_ckpt_done_%d" % step)
+        _emit_ckpt("commit", step, final)
         self.logger.info("checkpoint committed: %s", final)
         return final
 
@@ -166,6 +179,7 @@ class CheckpointManager(object):
                     "no committed checkpoint under %s" % self.directory)
         from ..parallel.ckpt import ocp_restore
         tree, saved_step = ocp_restore(self.step_path(step), abstract_tree)
+        _emit_ckpt("resume", saved_step, self.step_path(step))
         return tree, saved_step
 
     def auto_resume(self, abstract_tree):

@@ -76,5 +76,12 @@ def retry_call(fn, policy=None, phase="retry", logger=None, sleep=None):
             logger.warning(
                 "%s: attempt %d/%d failed (%r); retrying in %.1fs",
                 phase, attempt, policy.max_tries, exc, delay)
+            try:
+                from .. import observability as obs
+                obs.emit("fault", fault="retry", phase=phase,
+                         attempt=attempt, max_tries=policy.max_tries,
+                         delay_s=delay, error=repr(exc))
+            except Exception:
+                pass
             sleep(delay)
     raise last_exc  # pragma: no cover - loop always returns or raises

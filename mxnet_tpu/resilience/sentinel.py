@@ -121,13 +121,27 @@ class Sentinel(object):
             "sentinel: step %s %s (signal=%r); update skipped, loss scale "
             "-> %g, last good step %s", step, verdict, signal,
             self.loss_scale.scale, self.last_good_step)
+        self._emit_fault(step, verdict, signal)
         if self.consecutive_skips >= self.max_consecutive_skips:
             from . import ResilienceError
+            self._emit_fault(step, verdict, signal,
+                             fault="sentinel_escalate")
             raise ResilienceError(
                 "sentinel: %d consecutive skipped steps — numerics are "
                 "not recovering" % self.consecutive_skips,
                 phase="sentinel", step=step, kind="numeric")
         return verdict
+
+    def _emit_fault(self, step, verdict, signal, fault="sentinel_skip"):
+        try:
+            from .. import observability as obs
+            obs.emit("fault", step=step, fault=fault, verdict=verdict,
+                     signal=None if signal is None else float(signal),
+                     loss_scale=self.loss_scale.scale,
+                     consecutive=self.consecutive_skips,
+                     last_good_step=self.last_good_step, phase="sentinel")
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -39,6 +39,15 @@ def _rank():
         return 0
 
 
+def _emit_fault(fault, phase, step, timeout_s):
+    try:
+        from .. import observability as obs
+        obs.emit("fault", step=step, fault=fault, phase=phase,
+                 timeout_s=timeout_s)
+    except Exception:
+        pass
+
+
 def run_with_timeout(fn, timeout_s, phase, step=None, rank=None,
                      on_timeout="raise"):
     """Run ``fn()`` in a watched daemon thread; bound its duration.
@@ -68,6 +77,7 @@ def run_with_timeout(fn, timeout_s, phase, step=None, rank=None,
             "watchdog: %r exceeded %.1fs" % (phase, timeout_s),
             phase=phase, rank=rank if rank is not None else _rank(),
             step=step, kind="timeout", timeout_s=timeout_s)
+        _emit_fault("watchdog_timeout", phase, step, timeout_s)
         if on_timeout == "exit":
             exit_for_restart(err)
         raise err
@@ -154,6 +164,8 @@ class Watchdog(object):
                     % (self.phase, elapsed),
                     phase=self.phase, rank=self.rank, step=step,
                     kind="stall", timeout_s=self.timeout_s)
+                _emit_fault("watchdog_stall", self.phase, step,
+                            self.timeout_s)
                 try:
                     self.on_timeout(err)
                 finally:

@@ -13,6 +13,8 @@
 #   TASK=capi        C ABI consumers (needs python headers)
 #   TASK=nightly     multi-process distributed suite (slow)
 #   TASK=resilience  fault-injection recovery matrix + graph lint
+#   TASK=observability  telemetry unit tests + the 2-process drill +
+#                    an mxtop --json smoke over the drill's event dir
 set -e
 cd "$(dirname "$0")/../.."
 
@@ -58,6 +60,28 @@ case "${TASK:-python}" in
     # output so findings land on the PR diff)
     JAX_PLATFORMS=cpu python tools/mxlint.py --all-models \
       --format=github --fail-on=error
+    ;;
+  observability)
+    # telemetry suite (docs/observability.md): event-log semantics, the
+    # <2% enabled-overhead bound, and the 2-process acceptance drill
+    # (sentinel -> watchdog -> ckpt must land in the merged report)
+    JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py -q
+    # end-to-end CLI smoke: a real 2-worker run's event dir must render
+    # through mxtop --json with a nonempty pod rollup
+    TELDIR="$(mktemp -d)"
+    MXTPU_TELEMETRY=1 MXTPU_TELEMETRY_DIR="$TELDIR" MXTPU_RUN_ID=ci \
+      MXTPU_SENTINEL=1 MXTPU_FAULT_SPEC="step=2:kind=nan" \
+      MXTPU_TEL_PREFIX="$TELDIR/ckpt" \
+      python tools/launch.py -n 2 --launcher local --port 9899 \
+      python tests/nightly/dist_telemetry.py
+    python tools/mxtop.py "$TELDIR" --json | python -c '
+import json, sys
+rep = json.load(sys.stdin)
+assert len(rep["per_rank"]) == 2, rep
+assert rep["pod"]["step_ms_p50"] is not None, rep
+print("mxtop --json smoke OK")
+'
+    rm -rf "$TELDIR"
     ;;
   *)
     echo "unknown TASK=${TASK}" >&2

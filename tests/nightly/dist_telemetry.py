@@ -1,0 +1,131 @@
+"""2-worker telemetry drill: one faulty run -> one coherent event log.
+
+Acceptance (ISSUE 4): a 2-process CPU run with ``MXTPU_TELEMETRY=1``
+must leave per-rank JSONL whose merged ``mxtop --json`` report contains
+step-time p50/p95, samples/sec, straggler gap, per-rank heartbeat age,
+and the injected fault's sentinel -> watchdog -> ckpt events in order.
+
+The script stages exactly that incident sequence on every rank:
+
+1. ``FeedForward.fit`` over a dist_sync kvstore with the sentinel armed
+   and ``MXTPU_FAULT_SPEC=step=2:kind=nan`` (set by the wrapper test):
+   the injected NaN gradients trip a ``sentinel_skip`` fault event
+   mid-epoch, while the fit loop emits step records and data_wait
+   spans and the kvstore push emits collective events.
+2. A deliberately-too-slow call under ``run_with_timeout`` raises the
+   watchdog's ResilienceError -> ``watchdog_timeout`` fault event.
+3. Rank 0 writes a classic checkpoint -> ``ckpt`` commit event.
+
+Afterwards every rank publishes its live summary through the
+coordination KV; rank 0 merges the pod view and emits a
+``heartbeat_ages`` counter derived from the EXISTING ``mxtpu_hb/``
+liveness stamps so the offline report carries true heartbeat ages.
+
+Exit codes: 0 OK, 4 = a telemetry expectation failed.
+
+Run (tests/test_observability.py wraps this):
+    python tools/launch.py -n 2 --launcher local \
+        python tests/nightly/dist_telemetry.py
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import observability as obs
+
+PREFIX = os.environ.get("MXTPU_TEL_PREFIX", "/tmp/mxtpu_dist_telemetry")
+
+
+def fail(rank, msg):
+    print("rank %d FAILED: %s" % (rank, msg), flush=True)
+    os._exit(4)
+
+
+def build_data(rank, nw):
+    rng = np.random.RandomState(7)
+    X = rng.randn(160, 16).astype(np.float32)
+    w = rng.randn(16)
+    y = (X @ w > 0).astype(np.float32)
+    shard = slice(rank * len(X) // nw, (rank + 1) * len(X) // nw)
+    return X[shard], y[shard]
+
+
+def main():
+    if not obs.enabled():
+        fail(0, "telemetry not enabled in drill env")
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+
+    # ---- incident 1: sentinel skip inside a real fit loop ------------
+    X, y = build_data(rank, nw)
+    train = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=True, seed=11)
+    net = mx.models.get_mlp(num_classes=2, hidden=(16,))
+    model = mx.FeedForward(net, ctx=mx.context.cpu(), num_epoch=2,
+                           optimizer="sgd", learning_rate=0.1)
+    if rank != 0:
+        # a manufactured straggler: rank>0 pays a small per-batch tax so
+        # the pod report's straggler gap is visibly nonzero
+        _orig = mx.io.NDArrayIter.next
+
+        def _slow_next(self):
+            time.sleep(0.02)
+            return _orig(self)
+        mx.io.NDArrayIter.next = _slow_next
+    model.fit(X=train, kvstore=kv,
+              batch_end_callback=mx.callback.Speedometer(20, frequent=2))
+    sentinel_wall = time.time()
+
+    # ---- incident 2: watchdog timeout --------------------------------
+    from mxnet_tpu.resilience import run_with_timeout, ResilienceError
+    try:
+        run_with_timeout(lambda: time.sleep(5.0), 0.2,
+                         phase="drill_stall", step=99)
+        fail(rank, "watchdog did not fire")
+    except ResilienceError:
+        pass
+    watchdog_wall = time.time()
+
+    # ---- incident 3: checkpoint commit -------------------------------
+    kv.barrier()
+    if rank == 0:
+        mx.model.save_checkpoint(PREFIX, 1, model.symbol,
+                                 model.arg_params, model.aux_params)
+    kv.barrier()
+
+    # ---- live aggregation over the coordination KV -------------------
+    if not obs.publish_summary(step=99):
+        fail(rank, "publish_summary did not reach the coordination KV")
+    kv.barrier()
+    if rank == 0:
+        view = obs.pod_view(num_workers=nw)
+        if len(view["per_rank"]) != nw:
+            fail(rank, "pod view has %d ranks, want %d"
+                 % (len(view["per_rank"]), nw))
+        ages = obs.heartbeat_ages(num_workers=nw)
+        if any(a is None or a > 60 for a in ages.values()):
+            fail(rank, "stale/missing heartbeat ages: %r" % (ages,))
+        # land the true KV-derived ages in the event log so the offline
+        # mxtop report shows heartbeat age per rank even after exit
+        obs.emit("counter", name="heartbeat_ages",
+                 ages={str(r): a for r, a in ages.items()})
+        print("rank 0 pod view: ranks=%s straggler_gap_ms=%s"
+              % (view["ranks"], view["pod"]["straggler_gap_ms"]),
+              flush=True)
+
+    # ---- self-check: this rank's own log tells the story in order ----
+    obs.flush()
+    fault = obs.last_fault()
+    if fault is None or fault.get("fault") != "watchdog_timeout":
+        fail(rank, "last fault is %r, want watchdog_timeout" % (fault,))
+    del sentinel_wall, watchdog_wall
+    kv.barrier()
+    print("rank %d TELEMETRY DRILL OK" % rank, flush=True)
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

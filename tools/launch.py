@@ -31,9 +31,15 @@ import shlex
 import signal
 import subprocess
 import sys
+import time
 
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one telemetry correlation id for the whole pod launch, so every rank's
+# events-rank*.jsonl carries the same run_id (docs/observability.md)
+_POD_RUN_ID = os.environ.get("MXTPU_RUN_ID") or \
+    "%s-%d" % (time.strftime("%Y%m%d%H%M%S"), os.getpid())
 
 
 def build_env(rank, args):
@@ -41,6 +47,7 @@ def build_env(rank, args):
     env["MXTPU_COORDINATOR"] = "%s:%d" % (args.coordinator, args.port)
     env["MXTPU_NUM_WORKERS"] = str(args.num_workers)
     env["MXTPU_WORKER_RANK"] = str(rank)
+    env["MXTPU_RUN_ID"] = _POD_RUN_ID
     # reference-compat aliases (kvstore.py reads these too)
     env["DMLC_NUM_WORKER"] = str(args.num_workers)
     env["DMLC_ROLE"] = "worker"

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""mxtop — a training ``top`` for mxnet_tpu telemetry dirs.
+
+Reads the per-rank ``events-rank*.jsonl`` files a run produced with
+``MXTPU_TELEMETRY=1`` and renders the pod report: step-time
+percentiles, samples/sec, MFU, straggler gap, slowest phase, per-rank
+heartbeat ages, and the fault/checkpoint incident timeline.
+
+    python tools/mxtop.py /scratch/telemetry            # one-shot report
+    python tools/mxtop.py /scratch/telemetry --follow   # live, top-style
+    python tools/mxtop.py /scratch/telemetry --json     # machine-readable
+    python tools/mxtop.py /scratch/telemetry --fault    # timeline around
+                                                        # each incident
+
+``--json`` prints exactly one JSON document (the aggregate.build_report
+dict) so CI can assert on it.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from mxnet_tpu.observability import aggregate  # noqa: E402
+
+
+def _fmt(val, suffix="", width=10):
+    if val is None:
+        return "-".rjust(width)
+    if isinstance(val, float):
+        return ("%.2f%s" % (val, suffix)).rjust(width)
+    return ("%s%s" % (val, suffix)).rjust(width)
+
+
+def render(report, stream=sys.stdout):
+    pod = report["pod"]
+    w = stream.write
+    w("mxtop — run %s — %d rank(s), %d events\n" % (
+        ",".join(report["run_ids"]) or "?", len(report["ranks"]),
+        report["events"]))
+    w("pod   step p50 %s ms   p95 %s ms   samples/sec %s   mfu %s\n" % (
+        _fmt(pod.get("step_ms_p50"), width=8),
+        _fmt(pod.get("step_ms_p95"), width=8),
+        _fmt(pod.get("samples_per_sec"), width=10),
+        _fmt(pod.get("mfu"), width=7)))
+    w("      straggler gap %s ms   slowest phase %s\n" % (
+        _fmt(pod.get("straggler_gap_ms"), width=8),
+        pod.get("slowest_phase") or "-"))
+    if pod.get("phase_totals_ms"):
+        w("      phase totals: %s\n" % "  ".join(
+            "%s=%.1fms" % (k, v)
+            for k, v in pod["phase_totals_ms"].items()))
+    w("%-6s %8s %10s %10s %12s %8s  %s\n" % (
+        "rank", "steps", "p50 ms", "p95 ms", "samples/s", "hb age",
+        "last fault"))
+    for rank, s in sorted(report["per_rank"].items(),
+                          key=lambda kv: int(kv[0]) if kv[0].isdigit()
+                          else 1 << 30):
+        fault = s.get("last_fault")
+        fault_txt = "-"
+        if fault:
+            fault_txt = "%s@step %s" % (fault.get("fault", "?"),
+                                        fault.get("step", "?"))
+        w("%-6s %8s %10s %10s %12s %8s  %s\n" % (
+            rank, s.get("steps", 0),
+            _fmt(s.get("step_ms_p50"), width=10).strip(),
+            _fmt(s.get("step_ms_p95"), width=10).strip(),
+            _fmt(s.get("samples_per_sec"), width=12).strip(),
+            _fmt(s.get("heartbeat_age_s"), "s", width=8).strip(),
+            fault_txt))
+    if report["incidents"]:
+        w("incidents (%d):\n" % len(report["incidents"]))
+        for rec in report["incidents"]:
+            w("  [%s] rank %s step %s %s %s\n" % (
+                rec.get("wall_ms"), rec.get("rank"), rec.get("step"),
+                rec.get("kind"),
+                rec.get("fault") or rec.get("phase") or rec.get("path")
+                or ""))
+
+
+def render_fault_timelines(records, before, after, stream=sys.stdout):
+    w = stream.write
+    hits = [i for i, r in enumerate(records) if r.get("kind") == "fault"]
+    if not hits:
+        w("no fault events.\n")
+        return
+    for idx in hits:
+        rec = records[idx]
+        w("--- fault %r at rank %s step %s ---\n" % (
+            rec.get("fault"), rec.get("rank"), rec.get("step")))
+        for ev in aggregate.timeline_around(records, idx, before, after):
+            mark = ">>" if ev is rec else "  "
+            w("%s [%s] r%s %-6s %s\n" % (
+                mark, ev.get("wall_ms"), ev.get("rank"),
+                ev.get("kind"),
+                json.dumps({k: v for k, v in ev.items()
+                            if k not in ("run_id", "rank", "kind",
+                                         "wall_ms")},
+                           default=str, separators=(",", ":"))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxtop", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("directory", help="telemetry dir (MXTPU_TELEMETRY_DIR)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as one JSON document")
+    ap.add_argument("--follow", action="store_true",
+                    help="re-render every --interval seconds")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--fault", action="store_true",
+                    help="print the event timeline around each fault")
+    ap.add_argument("--window", type=int, default=5,
+                    help="events before/after each fault (--fault)")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.directory):
+        sys.stderr.write("mxtop: no such directory: %s\n" % args.directory)
+        return 2
+
+    while True:
+        records = aggregate.read_events(args.directory)
+        report = aggregate.build_report(records)
+        if args.json:
+            json.dump(report, sys.stdout, indent=2, default=str)
+            sys.stdout.write("\n")
+        elif args.fault:
+            render_fault_timelines(records, args.window, args.window)
+        else:
+            if args.follow:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            render(report)
+        if not args.follow:
+            break
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            break
+    if not records:
+        sys.stderr.write("mxtop: no events under %s\n" % args.directory)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

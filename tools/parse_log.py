@@ -5,8 +5,16 @@ Parity: tools/parse_log.py — extracts per-epoch train/validation metrics
 and time cost from the logging format produced by Module.fit /
 FeedForward.fit (``Epoch[N] Train-accuracy=...``, ``Validation-...``,
 ``Time cost=...``).
+
+Also reads telemetry event logs (docs/observability.md): pass an
+``events-rank*.jsonl`` file or a telemetry directory and the per-epoch
+table is derived from the ``step`` records instead (epoch, steps, mean
+step ms, samples/sec).  Detection is automatic; ``--telemetry`` forces
+it.
 """
 import argparse
+import json
+import os
 import re
 import sys
 
@@ -34,13 +42,81 @@ def parse(path):
     return rows
 
 
+def _looks_like_telemetry(path):
+    if os.path.isdir(path):
+        return True
+    if path.endswith(".jsonl") or path.endswith(".jsonl.1"):
+        return True
+    try:
+        with open(path) as fin:
+            first = fin.readline().strip()
+        rec = json.loads(first)
+        return isinstance(rec, dict) and "kind" in rec
+    except (OSError, ValueError):
+        return False
+
+
+def _iter_telemetry_records(path):
+    if os.path.isdir(path):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".."))
+        from mxnet_tpu.observability import aggregate
+        for rec in aggregate.read_events(path):
+            yield rec
+        return
+    with open(path) as fin:
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                yield rec
+
+
+def parse_telemetry(path):
+    """Per-epoch rows from telemetry ``step`` records.  Records with no
+    epoch tag (e.g. raw trainer steps) land in epoch 0."""
+    acc = {}
+    for rec in _iter_telemetry_records(path):
+        if rec.get("kind") != "step":
+            continue
+        ep = int(rec.get("epoch") or 0)
+        row = acc.setdefault(ep, {"steps": 0, "dur_ms": [], "sps": []})
+        row["steps"] += 1
+        if rec.get("dur_ms") is not None:
+            row["dur_ms"].append(float(rec["dur_ms"]))
+        if rec.get("samples_per_sec") is not None:
+            row["sps"].append(float(rec["samples_per_sec"]))
+    rows = {}
+    for ep, row in acc.items():
+        out = {"steps": row["steps"]}
+        if row["dur_ms"]:
+            out["step-ms"] = sum(row["dur_ms"]) / len(row["dur_ms"])
+            out["time"] = sum(row["dur_ms"]) / 1e3
+        if row["sps"]:
+            out["samples-per-sec"] = row["sps"][-1]
+        rows[ep] = out
+    return rows
+
+
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("logfile")
+    parser.add_argument("logfile",
+                        help="text log, events-rank*.jsonl, or a "
+                             "telemetry directory")
     parser.add_argument("--format", choices=("table", "markdown", "csv"),
                         default="table")
+    parser.add_argument("--telemetry", action="store_true",
+                        help="force telemetry-JSONL parsing")
     args = parser.parse_args()
-    rows = parse(args.logfile)
+    if args.telemetry or _looks_like_telemetry(args.logfile):
+        rows = parse_telemetry(args.logfile)
+    else:
+        rows = parse(args.logfile)
     if not rows:
         print("no epochs found", file=sys.stderr)
         return
