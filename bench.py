@@ -525,6 +525,23 @@ def measure():
     except Exception:
         pass
 
+    # chip-free MXL-R cross-check: the analyzer's static roofline for
+    # the same graph, printed next to the measured MFU and mirrored to
+    # the event log so the measured-vs-ceiling gap is trackable
+    static_ceiling = None
+    try:
+        from mxnet_tpu.analysis import static_mfu_ceiling
+        from mxnet_tpu.observability import counters as _counters
+        srep = static_mfu_ceiling(
+            sym, {"data": (global_batch, 3, 224, 224)},
+            device_kind=str(device_kind), compute_dtype=dtype or None)
+        static_ceiling = srep["mfu_ceiling"]
+        _counters.emit_static_roofline(
+            sym, {"data": (global_batch, 3, 224, 224)},
+            device_kind=str(device_kind), compute_dtype=dtype or None)
+    except Exception as exc:  # noqa: BLE001
+        notes.append("static roofline failed: %r" % exc)
+
     payload = {
         "metric": "resnet%d_train_images_per_sec" % num_layers,
         "value": round(images_per_sec, 2),
@@ -538,6 +555,8 @@ def measure():
         "compute_dtype": dtype or "float32",
         "measured_at_utc": _utc_ts(),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "static_mfu_ceiling": (round(static_ceiling, 4)
+                               if static_ceiling is not None else None),
         "model_tflops_per_step": round(flops_per_step / 1e12, 3),
         "flops_source": flops_src,
         "donation_ok": donated,

@@ -44,6 +44,15 @@ MXL-M002  warning   replicated parameter dominates the HBM budget
 MXL-C001  error     kvstore scope does not match the mesh scope
 MXL-C002  error     collective crosses a pipeline-stage boundary
 MXL-C003  warning   tp-sharded matmul missing its matching reduction
+MXL-K001  error     pallas block violates the Mosaic dtype minimum tile
+MXL-K002  error     pallas block lane dim not 128-aligned
+MXL-K003  warning   pallas grid padding (array dim not divisible)
+MXL-K004  error     pallas block exceeds its array / malformed spec
+MXL-R001  info      MXU op is bandwidth-bound at this batch size
+MXL-R002  warning   MXU tile padding wastes a large op fraction
+MXL-R003  warning   fp32 dot/conv on TPU (MXU peak rate needs bf16)
+MXL-R004  warning   long bf16 accumulation chain (reduction hazard)
+MXL-R005  info      whole-graph static roofline / MFU-ceiling summary
 ========  ========  ==================================================
 
 The MXL-P/M/C families only activate with SPMD context: pass ``mesh``
@@ -51,6 +60,15 @@ The MXL-P/M/C families only activate with SPMD context: pass ``mesh``
 to enable propagation, plus ``hbm_bytes``/``MXTPU_HBM_GB`` for the
 memory budget and ``kvstore`` for the scope audit.  ``select``/``skip``
 accept fnmatch wildcards (``MXL-P*``).
+
+The MXL-K family (tiling.py) validates every Pallas kernel spec in the
+``register_kernel_spec`` registry against Mosaic's tile rules — tpu
+target only, graph-independent.  MXL-R (roofline.py) prices the graph's
+FLOPs and HBM traffic at ``compute_dtype`` (default bf16 on tpu)
+against ``device_kind`` peaks (default v5e,
+``MXTPU_LINT_DEVICE_KIND``); per-op findings gate on a significance
+floor (``MXTPU_LINT_ROOFLINE_MIN_FLOPS``, default 5e10) so toy graphs
+stay clean.
 
 Suppress per node with the ``__lint_ignore__`` attr (comma-separated
 rule ids, or ``all``).
@@ -71,14 +89,19 @@ from . import lowering as _lowering  # noqa: F401
 from . import propagation as _propagation  # noqa: F401
 from . import memory as _memory      # noqa: F401
 from . import collectives as _collectives  # noqa: F401
+from . import tiling as _tiling      # noqa: F401
+from . import roofline as _roofline  # noqa: F401
 from .propagation import comm_report
 from .memory import peak_hbm_report, hbm_capacity_bytes
+from .tiling import register_kernel_spec, kernel_spec_issues
+from .roofline import roofline_report, static_mfu_ceiling
 
 __all__ = ["GraphIssue", "AnalysisContext", "Rule", "RULE_REGISTRY",
            "register_rule", "run_rules", "format_issues", "SEVERITIES",
            "SEVERITY_RANK", "analyze", "analyze_json", "max_severity",
            "GraphLintWarning", "comm_report", "peak_hbm_report",
-           "hbm_capacity_bytes"]
+           "hbm_capacity_bytes", "register_kernel_spec",
+           "kernel_spec_issues", "roofline_report", "static_mfu_ceiling"]
 
 
 class GraphLintWarning(UserWarning):
@@ -89,7 +112,8 @@ def analyze(symbol, shapes=None, type_dict=None, args=None, args_grad=None,
             grad_req=None, aux_states=None, group2ctx=None, mesh=None,
             sharding_rules=None, target="tpu", json_graph=None,
             kvstore=None, hbm_bytes=None, data_names=None,
-            label_names=None, select=None, skip=None, _ctx_out=None):
+            label_names=None, compute_dtype=None, device_kind=None,
+            select=None, skip=None, _ctx_out=None):
     """Run the lint passes over ``symbol``; returns issues, errors first.
 
     Parameters mirror what the call surfaces know: ``Symbol.validate``
@@ -108,7 +132,9 @@ def analyze(symbol, shapes=None, type_dict=None, args=None, args_grad=None,
                           mesh=mesh, sharding_rules=sharding_rules,
                           target=target, json_graph=json_graph,
                           kvstore=kvstore, hbm_bytes=hbm_bytes,
-                          data_names=data_names, label_names=label_names)
+                          data_names=data_names, label_names=label_names,
+                          compute_dtype=compute_dtype,
+                          device_kind=device_kind)
     if _ctx_out is not None:
         _ctx_out.append(ctx)
     return run_rules(ctx, select=select, skip=skip)

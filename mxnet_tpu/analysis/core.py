@@ -120,7 +120,8 @@ class AnalysisContext(object):
                  args_grad=None, grad_req=None, aux_states=None,
                  group2ctx=None, mesh=None, sharding_rules=None,
                  target="tpu", json_graph=None, kvstore=None,
-                 hbm_bytes=None, data_names=None, label_names=None):
+                 hbm_bytes=None, data_names=None, label_names=None,
+                 compute_dtype=None, device_kind=None):
         self.symbol = symbol
         self.shapes = dict(shapes or {})        # arg name -> shape tuple
         self.type_dict = dict(type_dict or {})  # arg name -> dtype
@@ -135,6 +136,10 @@ class AnalysisContext(object):
         self.json_graph = json_graph            # raw dict of a saved symbol
         self.kvstore = kvstore                  # kvstore type str (MXL-C001)
         self.hbm_bytes = hbm_bytes              # per-device budget (MXL-M001)
+        # roofline context (MXL-R): the dtype matmuls run at (None ->
+        # bf16 on tpu) and the device kind whose peaks set the ridge
+        self.compute_dtype = compute_dtype
+        self.device_kind = device_kind
         # which variables are batch tensors (batch_pspec) vs parameters
         # (param_pspec) when seeding the SPMD propagation — mirrors the
         # ShardedTrainer's data/label split

@@ -18,7 +18,8 @@ from . import events
 
 __all__ = ["percentile", "StepStats", "global_stats", "reset",
            "peak_tflops", "mfu", "collective_bytes",
-           "emit_trainer_counters", "emit_sentinel_counters"]
+           "emit_trainer_counters", "emit_sentinel_counters",
+           "emit_static_roofline"]
 
 
 def percentile(values, pct):
@@ -179,6 +180,31 @@ def emit_trainer_counters(trainer, step_time_s=None):
         events.emit("counter", step=getattr(trainer, "num_update", None),
                     name="trainer_cost", **fields)
     return fields
+
+
+def emit_static_roofline(symbol, shapes, device_kind=None,
+                         compute_dtype=None):
+    """Emit the analyzer's chip-free MXL-R roofline for ``symbol`` as a
+    ``static_roofline`` counter (flops/bytes/intensity/MFU ceiling), so
+    the measured-vs-ceiling gap is trackable in the event log next to
+    ``trainer_cost``.  Returns the report dict (or {})."""
+    if not events.enabled():
+        return {}
+    try:
+        from ..analysis import static_mfu_ceiling
+        rep = static_mfu_ceiling(symbol, shapes, device_kind=device_kind,
+                                 compute_dtype=compute_dtype)
+    except Exception:
+        return {}
+    events.emit("counter", name="static_roofline",
+                flops_per_step=rep["flops_per_step"],
+                hbm_bytes_per_step=rep["hbm_bytes_per_step"],
+                intensity=rep["intensity"],
+                mfu_ceiling=rep["mfu_ceiling"],
+                bound=rep["bound"],
+                device_kind=rep["device_kind"],
+                compute_dtype=rep["compute_dtype"])
+    return rep
 
 
 def emit_sentinel_counters(stats, step=None):

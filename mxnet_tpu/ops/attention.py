@@ -73,6 +73,7 @@ class MultiHeadAttention(OperatorProperty):
     """
     param_cls = _MHAParam
     need_rng = True
+    mxu = True
 
     def list_arguments(self):
         return ["data", "qkv_weight", "qkv_bias", "out_weight", "out_bias"]
@@ -89,6 +90,25 @@ class MultiHeadAttention(OperatorProperty):
                              % (E, self.param.num_heads))
         return ([data, (3 * E, E), (3 * E,), (E, E), (E,)],
                 [data], [])
+
+    def cost_mxu_dims(self, in_shapes, out_shapes):
+        B, S, E = in_shapes[0]
+        H = self.param.num_heads
+        D = E // H
+        # qkv proj, out proj, then per-(batch, head): q@k.T and p@v
+        return [(B * S, E, 3 * E), (B * S, E, E),
+                (S, D, S), (S, S, D)]
+
+    def cost_flops(self, in_shapes, out_shapes):
+        B, S, E = in_shapes[0]
+        H = self.param.num_heads
+        D = E // H
+        proj = 2 * B * S * E * (3 * E + E)
+        attn = 2 * B * H * (S * D * S + S * S * D)
+        return float(proj + attn)
+
+    def cost_reduce_len(self, in_shapes, out_shapes):
+        return int(in_shapes[0][1])     # softmax over the key axis
 
     def forward(self, inputs, aux, is_train, rng):
         x, wqkv, bqkv, wo, bo = inputs

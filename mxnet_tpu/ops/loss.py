@@ -44,6 +44,11 @@ class SoftmaxOutput(OperatorProperty):
             label = (data[0],)
         return [data, label], [data], []
 
+    def cost_reduce_len(self, in_shapes, out_shapes):
+        # softmax denominator accumulates over the class axis
+        data = in_shapes[0]
+        return int(data[1] if len(data) > 1 else data[-1])
+
     def forward(self, inputs, aux, is_train, rng):
         use_out_grad = self.param.out_grad
 

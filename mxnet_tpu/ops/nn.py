@@ -120,6 +120,7 @@ class _FCParam(ParamStruct):
 class FullyConnected(OperatorProperty):
     """fully_connected-inl.h:46: y = x_2d · Wᵀ + b, weight (num_hidden, D)."""
     param_cls = _FCParam
+    mxu = True
 
     def list_arguments(self):
         return ["data", "weight"] if self.param.no_bias else ["data", "weight", "bias"]
@@ -142,6 +143,16 @@ class FullyConnected(OperatorProperty):
         if not self.param.no_bias:
             y = y + inputs[2]
         return [y], None
+
+    def cost_mxu_dims(self, in_shapes, out_shapes):
+        data = in_shapes[0]
+        num_in = int(_np.prod(data[1:], dtype=_np.int64))
+        return [(int(data[0]), num_in, int(self.param.num_hidden))]
+
+    def cost_flops(self, in_shapes, out_shapes):
+        (m, k, n), = self.cost_mxu_dims(in_shapes, out_shapes)
+        bias = m * n if not self.param.no_bias else 0
+        return float(2 * m * k * n + bias)
 
     def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
         data, weight = in_specs[0], in_specs[1]
@@ -209,6 +220,7 @@ class Convolution(OperatorProperty):
     reference so checkpoints interchange.
     """
     param_cls = _ConvParam
+    mxu = True
 
     def list_arguments(self):
         return ["data", "weight"] if self.param.no_bias else ["data", "weight", "bias"]
@@ -275,6 +287,23 @@ class Convolution(OperatorProperty):
             out["notes"] = notes
         return out
 
+    def cost_mxu_dims(self, in_shapes, out_shapes):
+        # XLA lowers the conv as an im2col matmul per group:
+        # (batch*out_spatial) x (C/g * prod(kernel)) x (filters/g)
+        p = self.param
+        data, out = in_shapes[0], out_shapes[0]
+        k, _, _, _ = p.spatial()
+        m = int(data[0] * _np.prod(out[2:], dtype=_np.int64))
+        kk = int((data[1] // p.num_group) * _np.prod(k, dtype=_np.int64))
+        return [(m, kk, p.num_filter // p.num_group)] * p.num_group
+
+    def cost_flops(self, in_shapes, out_shapes):
+        flops = sum(2 * m * k * n for m, k, n in
+                    self.cost_mxu_dims(in_shapes, out_shapes))
+        if not self.param.no_bias:
+            flops += int(_np.prod(out_shapes[0], dtype=_np.int64))
+        return float(flops)
+
 
 class _DeconvParam(_ConvParam):
     adj = Field(tuple, default=None)
@@ -285,6 +314,7 @@ class _DeconvParam(_ConvParam):
 class Deconvolution(OperatorProperty):
     """deconvolution-inl.h: transposed conv. Weight (C, num_filter/group, *k)."""
     param_cls = _DeconvParam
+    mxu = True
 
     def list_arguments(self):
         return ["data", "weight"] if self.param.no_bias else ["data", "weight", "bias"]
@@ -331,6 +361,23 @@ class Deconvolution(OperatorProperty):
         if not p.no_bias:
             y = y + inputs[2].reshape((1, -1) + (1,) * nd)
         return [y], None
+
+    def cost_mxu_dims(self, in_shapes, out_shapes):
+        # transposed conv: one MAC per input element per (filter, tap)
+        p = self.param
+        data = in_shapes[0]
+        k, _, _, _ = p.spatial()
+        m = int(data[0] * _np.prod(data[2:], dtype=_np.int64))
+        g = p.num_group
+        return [(m, data[1] // g,
+                 int((p.num_filter // g) * _np.prod(k, dtype=_np.int64)))] * g
+
+    def cost_flops(self, in_shapes, out_shapes):
+        flops = sum(2 * m * k * n for m, k, n in
+                    self.cost_mxu_dims(in_shapes, out_shapes))
+        if not self.param.no_bias:
+            flops += int(_np.prod(out_shapes[0], dtype=_np.int64))
+        return float(flops)
 
 
 # ----------------------------------------------------------------------
@@ -401,6 +448,17 @@ class Pooling(OperatorProperty):
             if pt == "avg":
                 out = out / float(_np.prod(k))
         return [out.astype(x.dtype)], None
+
+    def cost_flops(self, in_shapes, out_shapes):
+        k, _s, _p = self._conf(in_shapes[0][2:])
+        return float(_np.prod(out_shapes[0], dtype=_np.int64)
+                     * _np.prod(k, dtype=_np.int64))
+
+    def cost_reduce_len(self, in_shapes, out_shapes):
+        if self.param.pool_type == "max":
+            return None     # max accumulation is exact in any dtype
+        k, _s, _p = self._conf(in_shapes[0][2:])
+        return int(_np.prod(k, dtype=_np.int64))
 
 
 # ----------------------------------------------------------------------
@@ -708,6 +766,11 @@ class Embedding(OperatorProperty):
     def forward(self, inputs, aux, is_train, rng):
         ids = inputs[0].astype(jnp.int32)
         return [jnp.take(inputs[1], ids, axis=0)], None
+
+    def cost_bytes_elements(self, in_shapes, out_shapes):
+        # gather: ids + the gathered rows in and out, not the full table
+        return float(_np.prod(in_shapes[0], dtype=_np.int64)
+                     + 2 * _np.prod(out_shapes[0], dtype=_np.int64))
 
     def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
         data, weight = in_specs[0], in_specs[1]

@@ -20,7 +20,7 @@ from ..registry import Registry
 __all__ = ["OperatorProperty", "register_op", "create_operator", "OP_REGISTRY",
            "require_known", "SHARDING_XFER", "register_sharding_rule",
            "sharding_transfer", "contract_sharding", "dedup_axes",
-           "reshape_carry"]
+           "reshape_carry", "COST_FLOPS", "register_cost_rule", "op_cost"]
 
 OP_REGISTRY = Registry("operator")
 
@@ -78,6 +78,11 @@ class OperatorProperty:
     # lists target platforms the op cannot lower for at all.
     host_callback = False
     unsupported_platforms = ()
+    # roofline cost metadata (analysis/roofline.py): ``mxu`` marks ops
+    # whose FLOPs run on the 128x128 matrix unit (dot/conv class) — the
+    # roofline pass prices their backward as two extra matmul passes
+    # (dgrad + wgrad) where elementwise ops get one.
+    mxu = False
 
     # graph-level attrs that ride on nodes but are not op params
     _SYSTEM_ATTRS = frozenset(
@@ -170,6 +175,46 @@ class OperatorProperty:
             outs.append(tuple(spec))
         return {"out": outs}
 
+    # -- roofline cost hooks (analysis/roofline.py) ------------------------
+    def cost_flops(self, in_shapes, out_shapes):
+        """Forward-pass FLOP estimate, default one VPU flop per output
+        element (the elementwise class).  MXU ops override with their
+        matmul arithmetic (2 FLOPs per MAC)."""
+        total = 0
+        for s in out_shapes:
+            n = 1
+            for d in s:
+                n *= int(d)
+            total += n
+        return float(total)
+
+    def cost_mxu_dims(self, in_shapes, out_shapes):
+        """``(m, k, n)`` triples of the op's matmul(s) as XLA lowers
+        them (conv via im2col), or None for non-MXU ops.  The roofline
+        pass derives MXU tile padding waste and contraction length
+        (bf16 accumulation hazard) from these."""
+        return None
+
+    def cost_bytes_elements(self, in_shapes, out_shapes):
+        """Elements moved through HBM by one forward pass, default
+        sum(inputs) + sum(outputs).  Gather-class ops override (an
+        Embedding reads the gathered rows, not the whole table)."""
+        total = 0
+        for s in list(in_shapes) + list(out_shapes):
+            if s is None:
+                continue
+            n = 1
+            for d in s:
+                n *= int(d)
+            total += n
+        return float(total)
+
+    def cost_reduce_len(self, in_shapes, out_shapes):
+        """Length of the op's longest sum-accumulation chain (softmax
+        denominator, avg-pool window, reduce over an axis), or None.
+        Matmul contractions are covered by ``cost_mxu_dims`` ``k``."""
+        return None
+
     # -- compute -----------------------------------------------------------
     def forward(self, inputs, aux, is_train, rng):
         raise NotImplementedError(self.op_name)
@@ -200,6 +245,44 @@ def sharding_transfer(op, in_specs, in_shapes, out_shapes, mesh_shape):
     if fn is not None:
         return fn(op, in_specs, in_shapes, out_shapes, mesh_shape)
     return op.infer_sharding(in_specs, in_shapes, out_shapes, mesh_shape)
+
+
+# ----------------------------------------------------------------------
+# roofline cost registry: name-keyed overrides for ops whose classes are
+# factory-generated, mirroring SHARDING_XFER — the analyzer resolves
+# COST_FLOPS first, then the class hooks.
+# ----------------------------------------------------------------------
+COST_FLOPS = {}     # op_name -> fn(op, in_shapes, out_shapes) -> cost dict
+
+
+def register_cost_rule(*op_names):
+    """Function decorator: register a roofline cost rule under one or
+    more op names.  The rule returns a dict with any of the ``op_cost``
+    keys below; missing keys fall back to the class hooks."""
+    def _wrap(fn):
+        for n in op_names:
+            COST_FLOPS[n] = fn
+        return fn
+    return _wrap
+
+
+def op_cost(op, in_shapes, out_shapes):
+    """Resolve one op node's roofline cost facts.
+
+    Returns ``{"flops", "bytes_elements", "mxu", "mxu_dims",
+    "reduce_len"}`` — forward-pass figures; the roofline pass applies
+    the training multipliers."""
+    out = {
+        "flops": op.cost_flops(in_shapes, out_shapes),
+        "bytes_elements": op.cost_bytes_elements(in_shapes, out_shapes),
+        "mxu": bool(type(op).mxu),
+        "mxu_dims": op.cost_mxu_dims(in_shapes, out_shapes),
+        "reduce_len": op.cost_reduce_len(in_shapes, out_shapes),
+    }
+    fn = COST_FLOPS.get(type(op).op_name)
+    if fn is not None:
+        out.update(fn(op, in_shapes, out_shapes) or {})
+    return out
 
 
 def contract_sharding(d_axes, w_axes, d_arg=0, w_arg=1, what="matmul"):

@@ -236,6 +236,13 @@ def _make_reduce(op_name, fn, aliases=()):
                 out = out.reshape((1,))
             return [out], None
 
+        def cost_reduce_len(self, in_shapes, out_shapes):
+            if op_name != "sum":    # max/min accumulate exactly
+                return None
+            nin = int(_np.prod(in_shapes[0], dtype=_np.int64))
+            nout = int(_np.prod(out_shapes[0], dtype=_np.int64))
+            return max(1, nin // max(1, nout))
+
     _Reduce.__name__ = "Op" + op_name
     return _Reduce
 
@@ -253,6 +260,9 @@ class Norm(OperatorProperty):
 
     def forward(self, inputs, aux, is_train, rng):
         return [jnp.sqrt(jnp.sum(jnp.square(inputs[0]))).reshape((1,))], None
+
+    def cost_reduce_len(self, in_shapes, out_shapes):
+        return int(_np.prod(in_shapes[0], dtype=_np.int64))
 
 
 @register_op("argmax_channel")
@@ -277,6 +287,7 @@ class _DotParam(ParamStruct):
 class Dot(OperatorProperty):
     """Matrix product; hits the MXU — keep operands large & bf16-friendly."""
     param_cls = _DotParam
+    mxu = True
 
     def list_arguments(self):
         return ["lhs", "rhs"]
@@ -300,10 +311,21 @@ class Dot(OperatorProperty):
             b = b.T
         return [jnp.dot(a, b, preferred_element_type=a.dtype)], None
 
+    def cost_mxu_dims(self, in_shapes, out_shapes):
+        a = in_shapes[0]
+        m, n = out_shapes[0]
+        k = a[0] if self.param.transpose_a else a[1]
+        return [(int(m), int(k), int(n))]
+
+    def cost_flops(self, in_shapes, out_shapes):
+        (m, k, n), = self.cost_mxu_dims(in_shapes, out_shapes)
+        return float(2 * m * k * n)
+
 
 @register_op("batch_dot")
 class BatchDot(OperatorProperty):
     param_cls = _DotParam
+    mxu = True
 
     def list_arguments(self):
         return ["lhs", "rhs"]
@@ -322,6 +344,17 @@ class BatchDot(OperatorProperty):
         if self.param.transpose_b:
             b = jnp.swapaxes(b, 1, 2)
         return [jnp.matmul(a, b)], None
+
+    def cost_mxu_dims(self, in_shapes, out_shapes):
+        a = in_shapes[0]
+        _batch, m, n = out_shapes[0]
+        k = a[1] if self.param.transpose_a else a[2]
+        return [(int(m), int(k), int(n))]
+
+    def cost_flops(self, in_shapes, out_shapes):
+        batch = out_shapes[0][0]
+        (m, k, n), = self.cost_mxu_dims(in_shapes, out_shapes)
+        return float(2 * batch * m * k * n)
 
 
 class _TransposeParam(ParamStruct):

@@ -173,6 +173,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
     lse_ref[...] = jnp.broadcast_to(lse[:, None], (block_q, _LSE_LANES))
 
 
+def _flash_block_layout(bh, sq, sk, d, block_q):
+    """(block, array) pairs of the forward pallas_call, in q/k/v then
+    o/lse order — the ONE place the kernel's block shapes live, shared
+    by the call below and the registered MXL-K kernel spec
+    (``flash_kernel_spec``) so the static tile validator always checks
+    what actually runs."""
+    in_blocks = [
+        ((None, block_q, d), (bh, sq, d)),              # q
+        ((None, sk, d), (bh, sk, d)),                   # k
+        ((None, sk, d), (bh, sk, d)),                   # v
+    ]
+    out_blocks = [
+        ((None, block_q, d), (bh, sq, d)),              # o
+        ((None, block_q, _LSE_LANES), (bh, sq, _LSE_LANES)),  # lse
+    ]
+    return in_blocks, out_blocks
+
+
 def _flash_forward_kernel_call(q, k, v, causal, scale, block_q, block_k,
                                interpret):
     import jax.experimental.pallas as pl
@@ -183,23 +201,25 @@ def _flash_forward_kernel_call(q, k, v, causal, scale, block_q, block_k,
     k3 = k.reshape(B * H, sk, D)
     v3 = v.reshape(B * H, sk, D)
 
+    (qb, kb, vb), (ob, lseb) = _flash_block_layout(B * H, Sq, sk, D,
+                                                   block_q)
     kernel = functools.partial(_flash_kernel, block_k=block_k,
                                causal=causal, scale=scale, seq_k=sk)
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Sq // block_q),
         in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec(qb[0], lambda b, i: (b, i, 0)),
+            pl.BlockSpec(kb[0], lambda b, i: (b, 0, 0)),
+            pl.BlockSpec(vb[0], lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, _LSE_LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec(ob[0], lambda b, i: (b, i, 0)),
+            pl.BlockSpec(lseb[0], lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Sq, _LSE_LANES), jnp.float32),
+            jax.ShapeDtypeStruct(ob[1], q.dtype),
+            jax.ShapeDtypeStruct(lseb[1], jnp.float32),
         ],
         interpret=interpret,
     )(q3, k3, v3)
@@ -416,3 +436,38 @@ def sharded_self_attention(q, k, v, causal=False):
 
     return shard_map(att, mesh=ctx.mesh, in_specs=(spec,) * 3,
                      out_specs=spec)(q, k, v)
+
+
+def flash_kernel_spec(batch_heads=8, seq_q=512, seq_k=512, head_dim=64,
+                      block_q=128, dtype="bfloat16"):
+    """MXL-K kernel spec for the flash forward pallas_call.
+
+    Built from the same :func:`_flash_block_layout` the kernel itself
+    uses, at a representative training shape, so the static tile
+    validator (analysis/tiling.py) checks the blocks that actually run.
+    The lse output deliberately carries ``_LSE_LANES`` lanes: a 1-D
+    ``(block_q,)`` stats row is exactly the historical bug Mosaic
+    rejected (no lane dimension to tile).
+    """
+    in_blocks, out_blocks = _flash_block_layout(batch_heads, seq_q, seq_k,
+                                                head_dim, block_q)
+    blocks = []
+    for name, (blk, arr) in zip(("q", "k", "v"), in_blocks):
+        blocks.append({"role": "in", "name": name, "block": blk,
+                       "array": arr, "dtype": dtype})
+    for name, (blk, arr) in zip(("o", "lse"), out_blocks):
+        blocks.append({"role": "out", "name": name, "block": blk,
+                       "array": arr,
+                       "dtype": "float32" if name == "lse" else dtype})
+    return {"name": "flash_forward",
+            "origin": "mxnet_tpu/parallel/ring_attention.py",
+            "grid": (batch_heads, seq_q // block_q),
+            "blocks": blocks}
+
+
+try:
+    from ..analysis.tiling import register_kernel_spec as _register_spec
+    _register_spec("parallel.ring_attention.flash_forward",
+                   flash_kernel_spec)
+except Exception:            # analysis package optional at import time
+    pass

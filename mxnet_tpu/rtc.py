@@ -18,12 +18,38 @@ grid/block become the Pallas grid spec, owned by the kernel itself here.
 """
 from __future__ import annotations
 
+import os
+import warnings
+
 import jax
 
 from .base import MXNetError
 from .ndarray import NDArray
 
 __all__ = ["Rtc"]
+
+
+def _tile_lint(in_shapes, in_dtypes, out_shapes, out_dtypes, mode):
+    """Static Mosaic tile check of the whole-array blocks this wrapper
+    hands to pallas_call — catches doomed layouts (1-D refs, odd last
+    dims on partial tiles) before XLA ever sees the kernel.  ``mode``:
+    "warn" emits GraphLintWarning, "error" raises, "off" skips."""
+    if mode == "off":
+        return
+    from .analysis.tiling import block_findings
+    from .analysis import GraphLintWarning
+    findings = []
+    for i, (shp, dt) in enumerate(zip(in_shapes, in_dtypes)):
+        findings += block_findings(tuple(shp), tuple(shp), str(dt),
+                                   "in%d" % i)
+    for i, (shp, dt) in enumerate(zip(out_shapes, out_dtypes)):
+        findings += block_findings(tuple(shp), tuple(shp), str(dt),
+                                   "out%d" % i)
+    for rule_id, severity, message in findings:
+        text = "[%s] rtc pallas kernel: %s" % (rule_id, message)
+        if mode == "error" and severity == "error":
+            raise MXNetError(text)
+        warnings.warn(text, GraphLintWarning, stacklevel=3)
 
 
 class Rtc(object):
@@ -70,6 +96,14 @@ class Rtc(object):
                                 for d in jax.devices())
         out_spec = tuple(jax.ShapeDtypeStruct(tuple(s), d)
                          for s, d in zip(out_shapes, out_dtypes))
+
+        # MXTPU_RTC_LINT: warn|error|off.  Default lints only the real-
+        # Mosaic path — interpret mode has no tile rules to violate, and
+        # CPU test runs stay quiet.
+        lint_mode = os.environ.get("MXTPU_RTC_LINT",
+                                   "off" if interpret else "warn")
+        _tile_lint(in_shapes, in_dtypes, out_shapes, out_dtypes,
+                   lint_mode)
 
         call = pl.pallas_call(self._fn, out_shape=out_spec,
                               interpret=interpret)

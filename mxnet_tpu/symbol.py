@@ -321,7 +321,8 @@ class Symbol:
     def validate(self, shapes=None, type_dict=None, mesh=None,
                  sharding_rules=None, target="tpu", select=None, skip=None,
                  kvstore=None, hbm_bytes=None, grad_req=None,
-                 data_names=None, label_names=None, **shape_kwargs):
+                 data_names=None, label_names=None, compute_dtype=None,
+                 device_kind=None, **shape_kwargs):
         """Run the static lint passes over this graph; returns
         ``list[analysis.GraphIssue]``, most severe first.
 
@@ -333,7 +334,9 @@ class Symbol:
         propagation; ``mesh``/``sharding_rules`` enable the SPMD passes
         (sharding propagation MXL-P, peak-HBM MXL-M, collective audit
         MXL-C) with ``kvstore``/``hbm_bytes``/``grad_req`` refining their
-        context; ``select``/``skip`` filter rule ids (wildcards work).
+        context; ``compute_dtype``/``device_kind`` steer the static
+        roofline (MXL-R); ``select``/``skip`` filter rule ids
+        (wildcards work).
         """
         from .analysis import analyze
         known = dict(shapes or {})
@@ -342,7 +345,9 @@ class Symbol:
                        sharding_rules=sharding_rules, target=target,
                        kvstore=kvstore, hbm_bytes=hbm_bytes,
                        grad_req=grad_req, data_names=data_names,
-                       label_names=label_names, select=select, skip=skip)
+                       label_names=label_names,
+                       compute_dtype=compute_dtype,
+                       device_kind=device_kind, select=select, skip=skip)
 
     # -- binding (implemented in executor.py) ------------------------------
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,

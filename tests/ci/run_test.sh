@@ -33,6 +33,19 @@ case "${TASK:-python}" in
     # implicit reshard (MXL-P001) may appear at error severity
     JAX_PLATFORMS=cpu python tools/mxlint.py --model transformer \
       --mesh dp=2,tp=2 --fail-on=error
+    # kernel + roofline sweep (docs/graph_lint.md MXL-K/MXL-R): every
+    # registered Pallas kernel spec must satisfy Mosaic's tile rules,
+    # and the static roofline must price resnet at training batch
+    # sizes without an error-severity finding — all chip-free
+    JAX_PLATFORMS=cpu python tools/mxlint.py --model resnet \
+      --select 'MXL-K*,MXL-R*' --shapes "data=(64,3,224,224)" \
+      --fail-on=error --format=github
+    JAX_PLATFORMS=cpu python tools/mxlint.py --model resnet \
+      --select 'MXL-K*,MXL-R*' --shapes "data=(256,3,224,224)" \
+      --fail-on=error --format=github
+    JAX_PLATFORMS=cpu python tools/mxlint.py --model transformer \
+      --mesh dp=2,tp=2 --select 'MXL-K*,MXL-R*' \
+      --fail-on=error --format=github
     ;;
   python)
     make -s all || echo "native build unavailable; python fallback"

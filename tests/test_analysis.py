@@ -641,3 +641,165 @@ def test_wildcard_select_isolates_spmd_family():
     skipped = analyze(net, shapes=shapes, mesh=_mesh22(),
                       sharding_rules=rules, skip={"MXL-P*"})
     assert not any(i.rule_id.startswith("MXL-P") for i in skipped)
+
+
+# ----------------------------------------------------------------------
+# MXL-K: static Mosaic tile-rule validation of Pallas kernel specs
+# ----------------------------------------------------------------------
+def test_k_min_tile_table():
+    from mxnet_tpu.analysis.tiling import min_tile
+    assert min_tile("float32") == (8, 128)
+    assert min_tile("bfloat16") == (16, 128)
+    assert min_tile("int8") == (32, 128)
+
+
+def test_k_registered_flash_spec_is_clean():
+    """The FIXED flash kernel (lse broadcast across _LSE_LANES) must
+    lint clean — including its head_dim=64 lane dims, legal because the
+    blocks cover the whole array dim (Mosaic pads the single tile)."""
+    from mxnet_tpu.analysis.tiling import (KERNEL_SPECS,
+                                           _ensure_builtin_specs,
+                                           kernel_spec_issues)
+    _ensure_builtin_specs()
+    assert "parallel.ring_attention.flash_forward" in KERNEL_SPECS
+    assert kernel_spec_issues() == []
+
+
+def test_k_flash_lse_regression_fixture():
+    """Regression fixture for the round-5 flash bug: the lse stats row
+    was written through a 1-D ``(block_q,)`` block, which Mosaic rejects
+    (no lane dim to tile).  MXL-K001 must report a spec with that
+    layout; the registered (fixed) spec stays clean (test above)."""
+    from mxnet_tpu.analysis.tiling import (register_kernel_spec,
+                                           unregister_kernel_spec)
+    from mxnet_tpu.parallel.ring_attention import flash_kernel_spec
+    bad = flash_kernel_spec()
+    for blk in bad["blocks"]:
+        if blk["name"] == "lse":          # regress to the pre-fix layout
+            blk["block"] = (None, 128)    # (block_q,) after squeezing
+            blk["array"] = (8, 512)
+    register_kernel_spec("test.flash_forward_prefix_bug", bad)
+    try:
+        issues = analyze(None, select={"MXL-K001"})
+        hits = _only(issues, "MXL-K001")
+        assert hits and all(i.severity == "error" for i in hits)
+        assert any("lse" in i.message for i in hits), hits
+    finally:
+        unregister_kernel_spec("test.flash_forward_prefix_bug")
+    assert not analyze(None, select={"MXL-K*"})   # registry clean again
+
+
+def test_k_rules_silent_off_tpu_target():
+    from mxnet_tpu.analysis.tiling import (register_kernel_spec,
+                                           unregister_kernel_spec)
+    register_kernel_spec("test.bad_rank1", {
+        "name": "bad_rank1", "grid": (4,),
+        "blocks": [{"role": "out", "name": "o", "block": (128,),
+                    "array": (512,), "dtype": "float32"}]})
+    try:
+        assert analyze(None, select={"MXL-K*"}, target="cpu") == []
+        assert _only(analyze(None, select={"MXL-K*"}), "MXL-K001")
+    finally:
+        unregister_kernel_spec("test.bad_rank1")
+
+
+def test_k002_partial_lane_tiling_off_granule():
+    from mxnet_tpu.analysis.tiling import block_findings
+    rules = {r for r, _s, _m in block_findings((8, 64), (8, 256),
+                                               "float32")}
+    assert rules == {"MXL-K002"}
+
+
+def test_k003_grid_padding_is_warning_only():
+    from mxnet_tpu.analysis.tiling import block_findings
+    out = block_findings((40, 128), (250, 128), "float32")
+    assert [(r, s) for r, s, _m in out] == [("MXL-K003", "warning")]
+
+
+def test_k004_block_exceeds_array():
+    from mxnet_tpu.analysis.tiling import block_findings
+    out = block_findings((16, 256), (8, 128), "float32")
+    assert {r for r, _s, _m in out} == {"MXL-K004"}
+
+
+def test_k_whole_array_blocks_legal_at_any_size():
+    from mxnet_tpu.analysis.tiling import block_findings
+    # flash kernel shape: full-array lane dim of 64 (< 128) is fine
+    assert block_findings((None, 128, 64), (8, 512, 64),
+                          "bfloat16") == []
+    # and rtc-style whole-array 2-D blocks of any shape are fine
+    assert block_findings(None, (3, 5), "float32") == []
+
+
+# ----------------------------------------------------------------------
+# MXL-R: static roofline / MFU ceiling
+# ----------------------------------------------------------------------
+def _big_fc(num_hidden=4096, k=4096, batch=1024):
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=num_hidden,
+                               name="fc")
+    return fc, {"data": (batch, k)}
+
+
+def test_r_static_resnet50_b256_ceiling_matches_measured_table():
+    """docs/mfu_gap.md, b256 row: XLA cost analysis says 6.28 TF/step
+    and the v5e roofline caps MFU at 0.293.  The chip-free static model
+    must reproduce both without lowering anything."""
+    from mxnet_tpu.models.resnet import get_symbol
+    rep = analysis.static_mfu_ceiling(
+        get_symbol(num_classes=1000, num_layers=50),
+        {"data": (256, 3, 224, 224)})
+    assert rep["complete"], rep
+    assert rep["bound"] == "bandwidth"
+    assert abs(rep["flops_per_step"] / 1e12 - 6.28) < 0.1, rep
+    assert abs(rep["mfu_ceiling"] - 0.293) <= 0.03, rep
+
+
+def test_r_mxu_padding_waste():
+    from mxnet_tpu.analysis.roofline import mxu_padding_waste
+    assert mxu_padding_waste([(256, 256, 256)], "bfloat16") == 0.0
+    # k and n each pad 64 -> 128: the MXU does 4x the useful work
+    assert mxu_padding_waste([(256, 64, 64)], "bfloat16") == 0.75
+
+
+def test_r002_padding_waste_flagged():
+    sym, shapes = _big_fc(num_hidden=192, k=4096, batch=32768)
+    issues = analyze(sym, shapes=shapes, select={"MXL-R002"})
+    hits = _only(issues, "MXL-R002")
+    assert hits and "pads" in hits[0].message
+
+
+def test_r003_fp32_dot_only_fires_at_fp32():
+    sym, shapes = _big_fc()
+    at32 = analyze(sym, shapes=shapes, select={"MXL-R003"},
+                   compute_dtype="float32")
+    assert _only(at32, "MXL-R003")
+    at16 = analyze(sym, shapes=shapes, select={"MXL-R003"})  # bf16 dflt
+    assert not at16
+
+
+def test_r004_long_bf16_reduction():
+    sym, shapes = _big_fc(num_hidden=1024, k=8192, batch=2048)
+    issues = analyze(sym, shapes=shapes, select={"MXL-R004"})
+    hits = _only(issues, "MXL-R004")
+    assert hits and "accumulates over 8192" in hits[0].message
+    # the same contraction at f32 accumulation is safe
+    assert not analyze(sym, shapes=shapes, select={"MXL-R004"},
+                       compute_dtype="float32")
+
+
+def test_r005_graph_summary_and_significance_floor():
+    sym, shapes = _big_fc()
+    issues = analyze(sym, shapes=shapes, select={"MXL-R005"})
+    hits = _only(issues, "MXL-R005")
+    assert hits and hits[0].severity == "info"
+    assert "MFU ceiling" in hits[0].message
+    # a toy graph stays below the 1e10-flops floor: no findings at all
+    tiny, tiny_shapes = _big_fc(num_hidden=8, k=16, batch=4)
+    assert analyze(tiny, shapes=tiny_shapes, select={"MXL-R*"}) == []
+
+
+def test_r_rules_silent_off_tpu_target():
+    sym, shapes = _big_fc()
+    assert analyze(sym, shapes=shapes, select={"MXL-R*"},
+                   target="cpu") == []

@@ -29,6 +29,8 @@ def _run(args, timeout):
 
 
 def test_topology_mesh_compile_only_devices():
+    if os.environ.get("MXTPU_AOT_TOPOLOGY", "1") in ("0", "off", "no"):
+        pytest.skip("topology probe disabled (MXTPU_AOT_TOPOLOGY=0)")
     code = ("import jax, sys\n"
             "jax.config.update('jax_platforms', 'cpu')\n"
             "sys.path.insert(0, %r)\n"
@@ -38,7 +40,14 @@ def test_topology_mesh_compile_only_devices():
             "    mesh.devices.flat[0], 'device_kind', ''))\n"
             "print('NONE' if mesh is None else 'OK')\n"
             % os.path.join(_ROOT, "tools"))
-    p = _run(["-c", code], timeout=300)
+    # a half-installed libtpu can HANG inside get_topology_desc rather
+    # than fail — bound the probe and treat a timeout like "unavailable"
+    # (set MXTPU_AOT_TOPOLOGY=0 to skip the spawn entirely)
+    try:
+        p = _run(["-c", code], timeout=60)
+    except subprocess.TimeoutExpired:
+        pytest.skip("local TPU PJRT topology probe hung (no usable "
+                    "libtpu); set MXTPU_AOT_TOPOLOGY=0 to skip the probe")
     assert p.returncode == 0, p.stderr[-1500:]
     if "NONE" in p.stdout:
         pytest.skip("local TPU PJRT topology unavailable (no libtpu)")

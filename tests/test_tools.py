@@ -559,3 +559,35 @@ def test_parse_shapes_edge_cases():
             mxlint.parse_shapes(["data=(a,b)"])
     finally:
         sys.path.pop(0)
+
+
+def test_mxlint_kernel_roofline_sweep():
+    """The CI leg: chip-free MXL-K + MXL-R over resnet at a training
+    batch size — comma-joined wildcard select, roofline report, and no
+    errors (the registered flash kernel spec must lint clean)."""
+    p = _mxlint("--model", "resnet", "--select", "MXL-K*,MXL-R*",
+                "--shapes", "data=(256,3,224,224)", "--roofline")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "static roofline" in p.stdout
+    assert "MFU ceiling" in p.stdout
+    assert "MXL-R005" in p.stdout
+
+
+def test_mxlint_baseline_suppression(tmp_path):
+    base = str(tmp_path / "lint_baseline.json")
+    args = ("--model", "resnet", "--select", "MXL-R*",
+            "--shapes", "data=(256,3,224,224)", "--fail-on=info")
+    p = _mxlint(*args)
+    assert p.returncode == 1, p.stdout + p.stderr     # findings exist
+    p = _mxlint(*args, "--baseline", base, "--update-baseline")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "recorded" in p.stdout
+    # same sweep against the baseline: all findings suppressed
+    p = _mxlint(*args, "--baseline", base)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "suppressed" in p.stdout and "clean" in p.stdout
+    # a NEW finding (different batch -> different messages) still fails
+    p = _mxlint("--model", "resnet", "--select", "MXL-R*",
+                "--shapes", "data=(512,3,224,224)", "--fail-on=info",
+                "--baseline", base)
+    assert p.returncode == 1, p.stdout + p.stderr

@@ -47,7 +47,16 @@ def topology_devices(name):
     """Compile-only devices from the local TPU compiler, or None if the
     plugin can't provide them (no libtpu / bad name / already in use —
     libtpu serves ONE process at a time).  Shared by this tool and
-    aot_longcontext_check.py; both exit 2 on None (callers SKIP)."""
+    aot_longcontext_check.py; both exit 2 on None (callers SKIP).
+
+    MXTPU_AOT_TOPOLOGY=0 skips the probe entirely: on boxes with a
+    half-installed libtpu the get_topology_desc call can HANG inside the
+    plugin instead of failing, and no subprocess timeout can make that
+    cheap."""
+    if os.environ.get("MXTPU_AOT_TOPOLOGY", "1") in ("0", "off", "no"):
+        print("topology probe disabled (MXTPU_AOT_TOPOLOGY=0)",
+              file=sys.stderr)
+        return None
     from jax.experimental import topologies
     try:
         topo = topologies.get_topology_desc(name, platform="tpu")

@@ -108,6 +108,21 @@ def audit(batch, layers, dtype):
         ridge = 197e12 / 819e9   # ≈ 240 flops/byte
         out["v5e_roofline_mfu_ceiling"] = round(min(1.0, intensity / ridge),
                                                 3)
+    # Chip-free cross-check: the analyzer's MXL-R roofline prices the
+    # same graph without lowering anything — agreement with the compiled
+    # cost analysis above validates the static model (docs/mfu_gap.md).
+    try:
+        from mxnet_tpu.analysis import static_mfu_ceiling
+        rep = static_mfu_ceiling(sym, {"data": (batch, 3, 224, 224)},
+                                 compute_dtype=dtype)
+        out["static_tflops_per_step"] = round(
+            rep["flops_per_step"] / 1e12, 3)
+        out["static_mfu_ceiling"] = (round(rep["mfu_ceiling"], 3)
+                                     if rep["mfu_ceiling"] is not None
+                                     else None)
+        out["static_bound"] = rep["bound"]
+    except Exception as exc:          # audit must not die on analyzer bugs
+        out["static_mfu_ceiling_error"] = str(exc)
     return out
 
 
@@ -132,6 +147,12 @@ def main():
                  r["arith_intensity_flops_per_byte"],
                  r.get("v5e_roofline_mfu_ceiling"),
                  bool(r["donation_alias_bytes"])))
+        if "static_mfu_ceiling" in r:
+            print("batch %d: static MXL-R roofline: %.2f TF/step, "
+                  "ceiling=%s (%s-bound) — chip-free cross-check of the "
+                  "compiled numbers above"
+                  % (b, r["static_tflops_per_step"],
+                     r["static_mfu_ceiling"], r["static_bound"]))
     print(json.dumps({"audit": results}))
 
 

@@ -18,11 +18,20 @@ gets a communication/memory cost report:
   python tools/mxlint.py --model mlp --mesh dp=8 --hbm-gb 16 \\
       --sharding ".*embed.*_weight=(tp,None);.*_bias=-"
 
+The kernel/roofline families run chip-free too: MXL-K validates every
+registered Pallas kernel spec against Mosaic's tile rules, MXL-R prices
+the graph against device peaks and prints a static MFU ceiling:
+
+  python tools/mxlint.py --model resnet --select 'MXL-K*,MXL-R*' \\
+      --shapes "data=(256,3,224,224)" --roofline
+
 Exit codes: 0 = nothing at/above --fail-on severity, 1 = findings at or
 above it, 2 = usage/load failure.  --fail-on=never always exits 0 (report
-only).  --select/--skip accept fnmatch wildcards ("MXL-P*").
---format=github emits workflow-command annotations for CI logs.
-Rule catalog and suppression attrs: docs/graph_lint.md.
+only).  --select/--skip accept fnmatch wildcards ("MXL-P*") and
+comma-separated lists.  --format=github emits workflow-command
+annotations for CI logs.  --baseline FILE suppresses previously recorded
+findings (write the record with --update-baseline) so a sweep fails only
+on NEW findings.  Rule catalog and suppression attrs: docs/graph_lint.md.
 """
 import argparse
 import ast
@@ -228,6 +237,60 @@ def cost_report_dict(ctx):
             "memory": peak_hbm_report(ctx)}
 
 
+def roofline_report_lines(ctx):
+    """The static MXU roofline / MFU-ceiling section (text mode)."""
+    from mxnet_tpu.analysis import roofline_report
+    from mxnet_tpu.analysis.propagation import fmt_bytes
+    rep = roofline_report(ctx)
+    lines = ["-- static roofline (%s mode, %s @ %s):"
+             % (rep["mode"], rep["compute_dtype"], rep["device_kind"])]
+    lines.append("   %.3f TF/step, %s/step HBM -> %.1f fl/B "
+                 "(ridge %.1f)%s"
+                 % (rep["flops_per_step"] / 1e12,
+                    fmt_bytes(rep["hbm_bytes_per_step"]),
+                    rep["intensity"] or 0.0, rep["ridge"] or 0.0,
+                    "" if rep["complete"]
+                    else "  (partial: some shapes unknown)"))
+    if rep["mfu_ceiling"] is not None:
+        lines.append("   %s-bound: static MFU ceiling %.3f"
+                     % (rep["bound"], rep["mfu_ceiling"]))
+    for row in rep["per_op"]:
+        lines.append("   %-28s %8.2f GF  %9s  %s"
+                     % (row["node"], row["flops"] / 1e9,
+                        fmt_bytes(row["bytes"]),
+                        "MXU" if row["mxu"] else "vec"))
+    return lines
+
+
+def _baseline_key(label, rule_id, node, message):
+    return "%s|%s|%s|%s" % (label, rule_id, node or "", message)
+
+
+def load_baseline(path):
+    """Baseline file -> set of finding keys (empty when absent)."""
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        doc = json.load(f)
+    return {_baseline_key(e["target"], e["rule_id"], e.get("node"),
+                          e["message"])
+            for e in doc.get("findings", [])}
+
+
+def write_baseline(path, targets):
+    """Record every current finding so later runs fail only on NEW ones."""
+    doc = {"version": 1,
+           "findings": [{"target": label, "rule_id": i.rule_id,
+                         "severity": i.severity, "node": i.node,
+                         "message": i.message}
+                        for label, issues, _ in targets
+                        for i in issues]}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return len(doc["findings"])
+
+
 def _gh_escape(text):
     return (str(text).replace("%", "%25").replace("\r", "%0D")
             .replace("\n", "%0A"))
@@ -276,6 +339,22 @@ def main(argv=None):
                     help="per-device HBM budget in GiB for MXL-M001 "
                          "(default: the MXTPU_HBM_GB env var, else no "
                          "budget check)")
+    ap.add_argument("--compute-dtype", default=None,
+                    help="dtype matmuls run at for the MXL-R roofline "
+                         "(default: bfloat16 on tpu targets)")
+    ap.add_argument("--device-kind", default=None,
+                    help="device whose peaks set the roofline ridge "
+                         "(v5e/v4/..., default MXTPU_LINT_DEVICE_KIND "
+                         "else v5e)")
+    ap.add_argument("--roofline", action="store_true",
+                    help="print the static roofline / MFU-ceiling report "
+                         "per graph (text mode; implied by --mesh)")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="suppress findings recorded in FILE; fail only "
+                         "on new ones (create it with --update-baseline)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write all current findings to --baseline FILE "
+                         "and exit 0")
     ap.add_argument("--fail-on", default="error",
                     choices=("error", "warning", "info", "never"),
                     help="exit 1 when findings at/above this severity "
@@ -325,9 +404,18 @@ def main(argv=None):
         spmd["grad_req"] = args.grad_req
     if args.hbm_gb is not None:
         spmd["hbm_bytes"] = int(args.hbm_gb * (1 << 30))
+    if args.compute_dtype:
+        spmd["compute_dtype"] = args.compute_dtype
+    if args.device_kind:
+        spmd["device_kind"] = args.device_kind
+    if args.update_baseline and not args.baseline:
+        ap.error("--update-baseline needs --baseline FILE")
 
-    select = set(args.select) or None
-    skip = set(args.skip) or None
+    # each --select/--skip may itself be comma-separated
+    select = {p.strip() for s in args.select for p in s.split(",")
+              if p.strip()} or None
+    skip = {p.strip() for s in args.skip for p in s.split(",")
+            if p.strip()} or None
     targets = []    # (label, issues, ctx|None)
     try:
         for path in args.files:
@@ -347,6 +435,26 @@ def main(argv=None):
         print("mxlint: %s" % exc, file=sys.stderr)
         return 2
 
+    if args.update_baseline:
+        n = write_baseline(args.baseline, targets)
+        print("mxlint: recorded %d finding(s) to %s" % (n, args.baseline))
+        return 0
+    known = load_baseline(args.baseline) if args.baseline else set()
+    if known or args.baseline:
+        filtered = []
+        suppressed = 0
+        for label, issues, ctx in targets:
+            new = [i for i in issues
+                   if _baseline_key(label, i.rule_id, i.node, i.message)
+                   not in known]
+            suppressed += len(issues) - len(new)
+            filtered.append((label, new, ctx))
+        targets = filtered
+        if suppressed and args.fmt == "text":
+            print("mxlint: %d baselined finding(s) suppressed (%s)"
+                  % (suppressed, args.baseline))
+
+    roofline = args.roofline or mesh is not None
     worst = None
     if args.fmt == "json":
         doc = []
@@ -356,6 +464,10 @@ def main(argv=None):
             if mesh is not None and ctx is not None and \
                     ctx.symbol is not None:
                 entry["cost"] = cost_report_dict(ctx)
+            if roofline and ctx is not None and ctx.symbol is not None \
+                    and ctx.target == "tpu":
+                from mxnet_tpu.analysis import roofline_report
+                entry["roofline"] = roofline_report(ctx)
             doc.append(entry)
         print(json.dumps(doc, indent=2))
     for label, issues, ctx in targets:
@@ -368,6 +480,10 @@ def main(argv=None):
             if mesh is not None and ctx is not None and \
                     ctx.symbol is not None:
                 for line in cost_report_lines(ctx):
+                    print(line)
+            if roofline and ctx is not None and ctx.symbol is not None \
+                    and ctx.target == "tpu":
+                for line in roofline_report_lines(ctx):
                     print(line)
         elif args.fmt == "github":
             for i in issues:
