@@ -53,6 +53,12 @@ MXL-R002  warning   MXU tile padding wastes a large op fraction
 MXL-R003  warning   fp32 dot/conv on TPU (MXU peak rate needs bf16)
 MXL-R004  warning   long bf16 accumulation chain (reduction hazard)
 MXL-R005  info      whole-graph static roofline / MFU-ceiling summary
+MXL-D001  error     collective order mismatch across ranks
+MXL-D002  error     collective signature mismatch across ranks
+MXL-D003  error     collective under rank-conditional control flow
+MXL-D004  error     rank-divergent value flows into a coordinated path
+MXL-D005  error     collective gated on rank-divergent control flow
+MXL-D006  warning   unbalanced collective on an exception edge
 ========  ========  ==================================================
 
 The MXL-P/M/C families only activate with SPMD context: pass ``mesh``
@@ -69,6 +75,15 @@ against ``device_kind`` peaks (default v5e,
 ``MXTPU_LINT_DEVICE_KIND``); per-op findings gate on a significance
 floor (``MXTPU_LINT_ROOFLINE_MIN_FLOPS``, default 5e10) so toy graphs
 stay clean.
+
+The MXL-D family is the distributed lint (docs/graph_lint.md):
+D001..D003 simulate the per-rank collective trace (gated on
+``world_size > 1`` — or ``MXTPU_LINT_DISTRIBUTED=1`` +
+``MXTPU_LINT_WORLD_SIZE``); D004..D006 are a rank-divergence dataflow
+pass over Python source, activated by ``source_paths`` (the CLI's
+``--distributed`` / ``.py`` targets).  Mark runtime rendezvous
+functions with ``base.collective_seam``; suppress intentional
+divergence with ``# mxl: rank-divergent-ok (MXL-D00x)``.
 
 Suppress per node with the ``__lint_ignore__`` attr (comma-separated
 rule ids, or ``all``).
@@ -91,17 +106,22 @@ from . import memory as _memory      # noqa: F401
 from . import collectives as _collectives  # noqa: F401
 from . import tiling as _tiling      # noqa: F401
 from . import roofline as _roofline  # noqa: F401
+from . import distributed as _distributed  # noqa: F401
+from . import divergence as _divergence    # noqa: F401
 from .propagation import comm_report
 from .memory import peak_hbm_report, hbm_capacity_bytes
 from .tiling import register_kernel_spec, kernel_spec_issues
 from .roofline import roofline_report, static_mfu_ceiling
+from .distributed import collective_trace
+from .divergence import analyze_source_paths, collective_seam
 
 __all__ = ["GraphIssue", "AnalysisContext", "Rule", "RULE_REGISTRY",
            "register_rule", "run_rules", "format_issues", "SEVERITIES",
            "SEVERITY_RANK", "analyze", "analyze_json", "max_severity",
            "GraphLintWarning", "comm_report", "peak_hbm_report",
            "hbm_capacity_bytes", "register_kernel_spec",
-           "kernel_spec_issues", "roofline_report", "static_mfu_ceiling"]
+           "kernel_spec_issues", "roofline_report", "static_mfu_ceiling",
+           "collective_trace", "analyze_source_paths", "collective_seam"]
 
 
 class GraphLintWarning(UserWarning):
@@ -113,6 +133,7 @@ def analyze(symbol, shapes=None, type_dict=None, args=None, args_grad=None,
             sharding_rules=None, target="tpu", json_graph=None,
             kvstore=None, hbm_bytes=None, data_names=None,
             label_names=None, compute_dtype=None, device_kind=None,
+            world_size=None, source_paths=None,
             select=None, skip=None, _ctx_out=None):
     """Run the lint passes over ``symbol``; returns issues, errors first.
 
@@ -134,7 +155,8 @@ def analyze(symbol, shapes=None, type_dict=None, args=None, args_grad=None,
                           kvstore=kvstore, hbm_bytes=hbm_bytes,
                           data_names=data_names, label_names=label_names,
                           compute_dtype=compute_dtype,
-                          device_kind=device_kind)
+                          device_kind=device_kind, world_size=world_size,
+                          source_paths=source_paths)
     if _ctx_out is not None:
         _ctx_out.append(ctx)
     return run_rules(ctx, select=select, skip=skip)

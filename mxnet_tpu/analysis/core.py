@@ -42,12 +42,18 @@ class GraphIssue(object):
     """One analyzer finding.
 
     ``node`` is the node *name* (issues outlive the graph object: the CLI
-    serializes them) or None for graph-level findings.
+    serializes them) or None for graph-level findings.  ``anchor`` is an
+    optional stable source location, ``file:qualname`` (never a raw line
+    number, so ``mxlint --baseline`` records survive unrelated edits);
+    ``line`` is the volatile line number kept OUT of the identity-ish
+    fields — display/CI-annotation data only.
     """
 
-    __slots__ = ("rule_id", "severity", "node", "message")
+    __slots__ = ("rule_id", "severity", "node", "message", "anchor",
+                 "line")
 
-    def __init__(self, rule_id, severity, node, message):
+    def __init__(self, rule_id, severity, node, message, anchor=None,
+                 line=None):
         if severity not in SEVERITY_RANK:
             raise ValueError("bad severity %r (valid: %s)"
                              % (severity, SEVERITIES))
@@ -55,13 +61,21 @@ class GraphIssue(object):
         self.severity = severity
         self.node = node
         self.message = message
+        self.anchor = anchor
+        self.line = line
 
     def as_dict(self):
-        return {"rule_id": self.rule_id, "severity": self.severity,
-                "node": self.node, "message": self.message}
+        out = {"rule_id": self.rule_id, "severity": self.severity,
+               "node": self.node, "message": self.message}
+        if self.anchor is not None:
+            out["anchor"] = self.anchor
+        if self.line is not None:
+            out["line"] = self.line
+        return out
 
     def __repr__(self):
-        where = ("@%s" % self.node) if self.node else "@graph"
+        where = ("@%s" % (self.anchor or self.node)) if \
+            (self.anchor or self.node) else "@graph"
         return "[%s] %s %s: %s" % (self.rule_id, self.severity, where,
                                    self.message)
 
@@ -69,11 +83,14 @@ class GraphIssue(object):
 
     def __eq__(self, other):
         return isinstance(other, GraphIssue) and \
-            (self.rule_id, self.severity, self.node, self.message) == \
-            (other.rule_id, other.severity, other.node, other.message)
+            (self.rule_id, self.severity, self.node, self.message,
+             self.anchor) == \
+            (other.rule_id, other.severity, other.node, other.message,
+             other.anchor)
 
     def __hash__(self):
-        return hash((self.rule_id, self.severity, self.node, self.message))
+        return hash((self.rule_id, self.severity, self.node, self.message,
+                     self.anchor))
 
 
 class Rule(object):
@@ -121,7 +138,8 @@ class AnalysisContext(object):
                  group2ctx=None, mesh=None, sharding_rules=None,
                  target="tpu", json_graph=None, kvstore=None,
                  hbm_bytes=None, data_names=None, label_names=None,
-                 compute_dtype=None, device_kind=None):
+                 compute_dtype=None, device_kind=None, world_size=None,
+                 source_paths=None):
         self.symbol = symbol
         self.shapes = dict(shapes or {})        # arg name -> shape tuple
         self.type_dict = dict(type_dict or {})  # arg name -> dtype
@@ -146,21 +164,44 @@ class AnalysisContext(object):
         self.data_names = tuple(data_names) if data_names else ("data",)
         self.label_names = (tuple(label_names) if label_names
                             else ("softmax_label",))
+        # distributed-lint context (MXL-D): the pod size the per-rank
+        # collective-trace simulation runs at (None/<=1 disables
+        # MXL-D001..003), and the .py files the rank-divergence
+        # dataflow pass (MXL-D004..006) scans.  MXTPU_LINT_DISTRIBUTED
+        # turns the family on for whole runs (bind-time included);
+        # MXTPU_LINT_WORLD_SIZE sets the simulated pod size (default 4).
+        if world_size is None:
+            import os as _os
+            if _os.environ.get("MXTPU_LINT_DISTRIBUTED", "").lower() in \
+                    ("1", "true", "yes", "on"):
+                try:
+                    world_size = int(
+                        _os.environ.get("MXTPU_LINT_WORLD_SIZE") or 4)
+                except ValueError:
+                    world_size = 4
+        self.world_size = world_size
+        self.source_paths = list(source_paths) if source_paths else []
         self.topo = symbol._topo() if symbol is not None else []
         self.cache = {}                         # cross-pass memo (propagation)
         self._rule = None                       # set by run_rules
         self._issues = []
 
     # -- reporting ---------------------------------------------------------
-    def report(self, node, message, severity=None, rule_id=None):
-        """Record one issue against ``node`` (a _Node, a name, or None)."""
+    def report(self, node, message, severity=None, rule_id=None,
+               anchor=None, line=None):
+        """Record one issue against ``node`` (a _Node, a name, or None).
+
+        ``anchor``/``line`` attach a stable ``file:qualname`` source
+        location (plus the volatile line, for display/CI annotations) —
+        used by the source-level MXL-D passes."""
         rule = RULE_REGISTRY.get(rule_id or self._rule)
         rid = rule.rule_id if rule else (rule_id or self._rule)
         sev = severity or (rule.severity if rule else "warning")
         name = getattr(node, "name", node)
         if node is not None and self._suppressed(node, rid):
             return None
-        issue = GraphIssue(rid, sev, name, message)
+        issue = GraphIssue(rid, sev, name, message, anchor=anchor,
+                           line=line)
         self._issues.append(issue)
         return issue
 
@@ -222,7 +263,7 @@ def run_rules(ctx, select=None, skip=None):
             ctx._rule = None
     issues = ctx._issues
     issues.sort(key=lambda i: (-SEVERITY_RANK[i.severity], i.rule_id,
-                               i.node or ""))
+                               i.anchor or "", i.node or "", i.line or 0))
     return issues
 
 

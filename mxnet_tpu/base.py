@@ -19,6 +19,7 @@ import numpy as _np
 __all__ = [
     "MXNetError", "NotSupportedForTPU", "mx_real_t", "mx_uint",
     "dtype_np_to_mx", "dtype_mx_to_np", "string_types", "numeric_types",
+    "collective_seam",
 ]
 
 
@@ -28,6 +29,27 @@ class MXNetError(Exception):
 
 class NotSupportedForTPU(MXNetError):
     """Raised for reference features with no TPU analog (e.g. dist_async)."""
+
+
+def collective_seam(fn=None, **_meta):
+    """Runtime no-op marker: this function implements a cluster-wide
+    rendezvous or agreement protocol (every rank must reach it together,
+    and its result is coordinated so it is identical on every rank).
+
+    The MXL-D distributed lint (``analysis/divergence.py``) reads the
+    decorator from the source: calls to a seam-decorated function count
+    as collective sinks (calling one under rank-divergent control flow
+    is MXL-D005), its return value is certified rank-uniform (so
+    verdicts like ``_decide_csum_path``'s don't taint their callers),
+    and intentional rank-asymmetry *inside* its body — the protocol
+    itself, e.g. "rank 0 probes and publishes, everyone else reads" —
+    is exempt from MXL-D005.  Lives in base.py (a leaf module) so
+    kvstore/parallel/resilience can mark their seams without importing
+    the analysis package.  See docs/graph_lint.md (MXL-D).
+    """
+    if fn is None:
+        return lambda f: f
+    return fn
 
 
 # mx_real_t: the reference's default real type (real_t = float, fp32).

@@ -447,6 +447,10 @@ class Executor:
         error-severity finding exists (refuse-to-bind, the reference
         GraphExecutor contract)."""
         from .analysis import analyze, format_issues, GraphLintWarning
+        # no world_size= here: AnalysisContext reads
+        # MXTPU_LINT_DISTRIBUTED / MXTPU_LINT_WORLD_SIZE itself, so the
+        # per-rank collective-trace diff (MXL-D001..003) joins bind-time
+        # validation whenever the env knob is on
         issues = analyze(
             self._symbol,
             shapes={n: tuple(a.shape) for n, a in self.arg_dict.items()},

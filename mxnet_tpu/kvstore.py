@@ -29,7 +29,7 @@ import pickle
 import jax
 import jax.numpy as jnp
 
-from .base import MXNetError
+from .base import MXNetError, collective_seam
 from .ndarray import NDArray
 
 __all__ = ["KVStore", "create"]
@@ -169,6 +169,7 @@ class KVStore(object):
                      num_workers=self.num_workers)
         return out
 
+    @collective_seam
     def _allreduce_dist(self, merged):
         # Pick the path ONCE, cluster-wide.  A per-process probe could
         # split workers between two different collectives and deadlock the
@@ -184,6 +185,7 @@ class KVStore(object):
             return _collective_sum(merged)
         return self._kv_allreduce(merged)
 
+    @collective_seam
     def _kv_allreduce(self, merged):
         """Backend-free gradient sum through the coordination-service KV.
 
@@ -223,6 +225,7 @@ class KVStore(object):
         return jnp.asarray(total)
 
     @staticmethod
+    @collective_seam
     def _decide_csum_path():
         """Cluster-wide collective-vs-allgather decision: rank 0 probes the
         XLA collective and publishes the verdict in the coordination KV;
@@ -418,6 +421,7 @@ def _collective_timeout_s():
 _BARRIER_STATE = {"xla_ok": None, "seq": {}}
 
 
+@collective_seam
 def _decide_barrier_path():
     """Cluster-wide XLA-vs-RPC barrier decision, mirroring
     ``_decide_csum_path``: rank 0 compile-probes the cross-process
@@ -461,6 +465,7 @@ def _decide_barrier_path():
     return ok
 
 
+@collective_seam
 def global_barrier(tag, timeout_s=None):
     """Cross-process barrier that works on any backend.
 
@@ -508,6 +513,7 @@ def _decode_array(text):
     return _onp.frombuffer(buf, dtype=_onp.dtype(dtype)).reshape(shape)
 
 
+@collective_seam
 def _collective_sum(value):
     """Sum ``value`` across processes with an XLA collective: each
     process's tensor is one shard of a (n_proc, ...) global array; a

@@ -322,7 +322,7 @@ class Symbol:
                  sharding_rules=None, target="tpu", select=None, skip=None,
                  kvstore=None, hbm_bytes=None, grad_req=None,
                  data_names=None, label_names=None, compute_dtype=None,
-                 device_kind=None, **shape_kwargs):
+                 device_kind=None, world_size=None, **shape_kwargs):
         """Run the static lint passes over this graph; returns
         ``list[analysis.GraphIssue]``, most severe first.
 
@@ -335,8 +335,11 @@ class Symbol:
         (sharding propagation MXL-P, peak-HBM MXL-M, collective audit
         MXL-C) with ``kvstore``/``hbm_bytes``/``grad_req`` refining their
         context; ``compute_dtype``/``device_kind`` steer the static
-        roofline (MXL-R); ``select``/``skip`` filter rule ids
-        (wildcards work).
+        roofline (MXL-R); ``world_size`` (or
+        ``MXTPU_LINT_DISTRIBUTED=1`` + ``MXTPU_LINT_WORLD_SIZE``)
+        enables the distributed trace diff (MXL-D001..003) over
+        ``__rank_cond__``/``__collective__`` attrs; ``select``/``skip``
+        filter rule ids (wildcards work).
         """
         from .analysis import analyze
         known = dict(shapes or {})
@@ -347,7 +350,8 @@ class Symbol:
                        grad_req=grad_req, data_names=data_names,
                        label_names=label_names,
                        compute_dtype=compute_dtype,
-                       device_kind=device_kind, select=select, skip=skip)
+                       device_kind=device_kind, world_size=world_size,
+                       select=select, skip=skip)
 
     # -- binding (implemented in executor.py) ------------------------------
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,

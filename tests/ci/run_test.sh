@@ -25,6 +25,14 @@ case "${TASK:-python}" in
     else
       python -m compileall -q mxnet_tpu tools bench.py __graft_entry__.py
     fi
+    # fast pre-merge step: lint only what this change touches (changed
+    # symbol JSONs, models whose builders changed, changed framework
+    # .py through the MXL-D rank-divergence pass) before the full
+    # sweeps below — a quick early exit for broken changes
+    if git rev-parse --verify -q HEAD~1 >/dev/null; then
+      JAX_PLATFORMS=cpu python tools/mxlint.py --diff HEAD~1 \
+        --fail-on=error --format=github
+    fi
     # graph lint sweep over the bundled model zoo (docs/graph_lint.md):
     # every model must carry zero error-severity findings
     JAX_PLATFORMS=cpu python tools/mxlint.py --all-models --fail-on=error
@@ -46,6 +54,31 @@ case "${TASK:-python}" in
     JAX_PLATFORMS=cpu python tools/mxlint.py --model transformer \
       --mesh dp=2,tp=2 --select 'MXL-K*,MXL-R*' \
       --fail-on=error --format=github
+    # distributed sweep (docs/graph_lint.md MXL-D): the per-rank
+    # collective-trace diff over the zoo at a simulated 4-rank pod,
+    # plus the rank-divergence dataflow self-lint over mxnet_tpu/ —
+    # the framework's own source must carry zero error-severity
+    # divergence findings (intentional seams are @collective_seam /
+    # rank-divergent-ok annotated)
+    JAX_PLATFORMS=cpu python tools/mxlint.py --all-models \
+      --distributed --world-size 4 --fail-on=error --format=github
+    JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+      --world-size 4 mxnet_tpu --fail-on=error --format=github
+    # the pre-fix PR-3 regression fixtures are expected-FAIL inputs:
+    # MXL-D must keep flagging each with its documented rule id
+    fx=tests/fixtures/divergence
+    for f in "$fx/pid_scratch_path.py:MXL-D004" \
+             "$fx/per_rank_barrier_probe.py:MXL-D005" \
+             "$fx/device0_sentinel.py:MXL-D005"; do
+      file="${f%:*}"; rule="${f##*:}"
+      if out=$(JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+          "$file" --fail-on=error --format=github); then
+        echo "FIXTURE NOT FLAGGED: $file"; exit 1
+      fi
+      echo "$out" | grep -q "$rule" || {
+        echo "FIXTURE $file missing $rule:"; echo "$out"; exit 1; }
+      echo "fixture $file flagged with $rule (expected-fail OK)"
+    done
     ;;
   python)
     make -s all || echo "native build unavailable; python fallback"

@@ -1,0 +1,30 @@
+"""Regression fixture: the pre-fix PR-3 device-0-only grad-norm
+sentinel (resilience/sentinel.py before the review fix).
+
+The NaN/overflow sentinel inspected only ``addressable_data(0)`` —
+this rank's first local shard — and skipped the optimizer step when
+ITS shard looked bad.  Whether a NaN lands in a given shard is
+rank-local, so one rank could skip the update (and the gradient
+allreduce behind it) while its peers entered the collective: a pod
+deadlock on real faults, and a silently-diverged model when the skip
+raced the reduce.  The fix accumulates the norm across every local
+shard and folds the skip-verdict into the globally-reduced scalar.
+
+MXL-D must flag this with **MXL-D005** (rank-divergent early exit
+ahead of a collective).  Lint input only — never imported.
+"""
+
+
+def _allreduce(kv, grads):             # stand-in for the real seam
+    raise NotImplementedError
+
+
+def sentinel_step(kv, grads, apply_update):
+    # BUG: .addressable_data(0) is this rank's local shard; the
+    # skip-verdict below is therefore a rank-local decision
+    shard = grads.addressable_data(0)
+    norm = float(abs(shard).sum())
+    if norm != norm or norm > 1e6:     # NaN or overflow in MY shard
+        return None                    # ...skips the collective below
+    reduced = _allreduce(kv, grads)
+    return apply_update(reduced)

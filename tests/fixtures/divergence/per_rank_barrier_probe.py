@@ -1,0 +1,42 @@
+"""Regression fixture: the pre-fix PR-3 per-rank barrier-implementation
+probe (kvstore.py before the review fix).
+
+Each process probed locally whether ``sync_global_devices`` worked and
+chose its barrier implementation from its OWN probe result.  A probe
+failing on a subset of ranks split the pod between two different
+barrier implementations — half waiting in the XLA device fence, half
+in the coordination-service RPC — and the pod deadlocked.  The fix
+(``kvstore._decide_barrier_path``) has rank 0 probe once and publish
+the verdict through the coordination KV.
+
+MXL-D must flag this with **MXL-D005** (collective gated on
+rank-divergent control flow); the probe's try/except also earns
+**MXL-D006** (a swallowed collective failure is itself a rank-local
+event).  Lint input only — never imported.
+"""
+
+_STATE = {"xla_ok": None}
+
+
+def sync_global_devices(tag):          # stand-ins for the real seams
+    raise NotImplementedError
+
+
+class _Client(object):
+    def wait_at_barrier(self, tag, timeout_ms):
+        raise NotImplementedError
+
+
+def global_barrier(tag, client):
+    if _STATE["xla_ok"] is None:
+        # BUG: every rank probes locally; whether the probe throws is a
+        # rank-local fact, so ranks can disagree on the verdict
+        try:
+            sync_global_devices("mxtpu_probe")
+            _STATE["xla_ok"] = True
+        except Exception:
+            _STATE["xla_ok"] = False
+    if _STATE["xla_ok"]:
+        sync_global_devices("mxtpu_" + tag)
+    else:
+        client.wait_at_barrier("mxtpu_" + tag, 600000)

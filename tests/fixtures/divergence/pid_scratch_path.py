@@ -1,0 +1,28 @@
+"""Regression fixture: the pre-fix PR-3 pid-divergent checkpoint
+scratch path (parallel/ckpt.py before the review fix).
+
+Every process derived its own scratch directory from ``os.getpid()``
+and handed it to the coordinated multi-host save: each rank wrote its
+shards into a DIFFERENT directory, so the commit rename only ever saw
+rank 0's shards and restores failed on every other host.  The fix
+made the scratch path a pure function of the target path + step, the
+same string on every rank.
+
+MXL-D must flag this with **MXL-D004** (rank-divergent value flows
+into a coordinated path).  This file is lint input only — never
+imported by the framework or the tests (``ocp_save`` here is a stand-in
+for ``mxnet_tpu.parallel.ckpt.ocp_save``).
+"""
+import os
+
+
+def ocp_save(path, tree, step):        # stand-in for the real writer
+    raise NotImplementedError
+
+
+def save_checkpoint_atomic(path, tree, step):
+    # BUG: getpid() differs on every rank, so every rank builds a
+    # different scratch directory for what must be ONE coordinated save
+    scratch = "%s.tmp.%d" % (path, os.getpid())
+    ocp_save(scratch, tree, step)
+    os.replace(scratch, path)

@@ -1,0 +1,88 @@
+"""2-worker cluster-wide-decision drill (ISSUE 6 satellite).
+
+The kvstore makes two pod-wide protocol choices through
+``@collective_seam`` functions — ``_decide_csum_path`` (XLA collective
+sum vs coordination-KV fallback) and ``_decide_barrier_path`` (XLA
+device fence vs ``wait_at_barrier`` RPC).  Each is decided ONCE by
+rank 0 and published through the coordination KV; a per-rank decision
+is exactly the pre-fix PR-3 bug snapshotted in
+``tests/fixtures/divergence/per_rank_barrier_probe.py``.
+
+This drill runs 2 real processes and asserts the contract end to end:
+
+1. a gradient allreduce returns the true cross-worker sum on both
+   ranks (so the chosen path actually works);
+2. every rank's adopted ``_CSUM_CACHE`` verdict equals the one rank 0
+   published under ``mxtpu_csum/enabled``;
+3. both ranks adopted the SAME barrier implementation
+   (``_BARRIER_STATE['xla_ok']``) and pass a ``global_barrier``;
+4. the verdict pair is cross-published per rank and compared, so a
+   divergence fails loudly instead of deadlocking.
+
+Exit codes: 0 OK, 4 = a verdict/value expectation failed.
+
+Run (tests/test_kvstore.py wraps this):
+    python tools/launch.py -n 2 --launcher local \
+        python tests/nightly/dist_csum.py
+"""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kvmod
+
+
+def fail(rank, msg):
+    print("rank %d FAILED: %s" % (rank, msg), flush=True)
+    os._exit(4)
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+
+    # 1. the allreduce works: each rank contributes ones*(rank+1)
+    out = np.asarray(kv._allreduce(np.ones((4, 3), np.float32)
+                                   * (rank + 1)))
+    want = sum(range(1, nw + 1))
+    if not np.allclose(out, want):
+        fail(rank, "allreduce sum %r != %r" % (out.ravel()[0], want))
+
+    # 2. the adopted verdict is the published one
+    verdict = kvmod._CSUM_CACHE.get("enabled")
+    if verdict is None:
+        fail(rank, "no csum verdict cached after an allreduce")
+    client = kvmod._dist_client()
+    if client is None:
+        fail(rank, "no coordination client in a 2-process run")
+    published = client.blocking_key_value_get("mxtpu_csum/enabled",
+                                              60_000)
+    if published != ("1" if verdict else "0"):
+        fail(rank, "adopted csum verdict %r but rank 0 published %r"
+             % (verdict, published))
+
+    # 3. one barrier implementation pod-wide, and it actually fences
+    kvmod.global_barrier("csum_drill")
+    bar = kvmod._BARRIER_STATE.get("xla_ok")
+    if bar is None:
+        fail(rank, "no barrier-path verdict after global_barrier")
+
+    # 4. cross-check the (csum, barrier) verdict pair across ranks
+    mine = "%d/%d" % (int(verdict), int(bar))
+    client.key_value_set("mxtpu_csum_drill/%d" % rank, mine,
+                         allow_overwrite=True)
+    for peer in range(nw):
+        theirs = client.blocking_key_value_get(
+            "mxtpu_csum_drill/%d" % peer, 60_000)
+        if theirs != mine:
+            fail(rank, "rank %d adopted %s but rank %d adopted %s"
+                 % (rank, mine, peer, theirs))
+
+    print("rank %d verdicts csum=%s barrier=%s OK"
+          % (rank, published, int(bar)), flush=True)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,396 @@
+"""MXL-D distributed-correctness lint (analysis/distributed.py +
+analysis/divergence.py): per-rank collective-trace diff (D001..003),
+rank-divergence source dataflow (D004..006), the marker vocabulary,
+stable anchors, and the clean bill on the fixed framework code."""
+import os
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.analysis import (GraphIssue, analyze, analyze_source_paths,
+                                collective_seam)
+from mxnet_tpu.analysis.distributed import parse_rank_cond
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "divergence")
+
+
+def _rules(issues):
+    return sorted({i.rule_id for i in issues})
+
+
+# ----------------------------------------------------------------------
+# __rank_cond__ grammar
+# ----------------------------------------------------------------------
+def test_rank_cond_grammar():
+    assert [p(0) for p in parse_rank_cond("coordinator")] == [True]
+    assert [p(3) for p in parse_rank_cond("coordinator")] == [False]
+    assert [p(3) for p in parse_rank_cond("noncoordinator")] == [True]
+    assert [p(2) for p in parse_rank_cond("rank==2")] == [True]
+    assert [p(2) for p in parse_rank_cond("rank!=2")] == [False]
+    assert [p(1) for p in parse_rank_cond("rank<2")] == [True]
+    assert [p(2) for p in parse_rank_cond("rank<=2")] == [True]
+    assert [p(3) for p in parse_rank_cond("rank>2")] == [True]
+    assert [p(2) for p in parse_rank_cond("rank>=3")] == [False]
+    assert [p(5) for p in parse_rank_cond("rank%2==1")] == [True]
+    both = parse_rank_cond("rank>0; rank<3")
+    assert [all(p(r) for p in both) for r in (0, 1, 2, 3)] == \
+        [False, True, True, False]
+    for bad in ("rank**2", "rank=1", "rank%0==0", "pid==0"):
+        with pytest.raises(ValueError):
+            parse_rank_cond(bad)
+    assert parse_rank_cond("") == []     # no constraints
+
+
+# ----------------------------------------------------------------------
+# D001..D003: the graph-level trace diff
+# ----------------------------------------------------------------------
+def _coordinator_barrier_graph():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=8, name="fc")
+    fc._set_attr(__rank_cond__="coordinator", __collective__="barrier")
+    return sym.SoftmaxOutput(data=fc, name="softmax")
+
+
+def test_d003_rank_conditional_collective():
+    out = _coordinator_barrier_graph()
+    issues = out.validate(data=(4, 8), world_size=4, select=["MXL-D*"])
+    assert _rules(issues) == ["MXL-D003"]
+    assert issues[0].severity == "error"
+    assert "only rank 0 of 4" in issues[0].message
+    assert "coordinator" in issues[0].message
+
+
+def test_d003_inherited_condition():
+    """A collective DOWNSTREAM of a rank-conditioned node inherits the
+    condition: its inputs only exist on the conditioned ranks."""
+    v = sym.Variable("data")
+    gate = sym.FullyConnected(data=v, num_hidden=8, name="gate")
+    gate._set_attr(__rank_cond__="rank==0")
+    act = sym.Activation(data=gate, act_type="relu", name="act")
+    act._set_attr(__collective__="allreduce:dp")
+    issues = sym.SoftmaxOutput(data=act, name="s").validate(
+        data=(4, 8), world_size=4, select=["MXL-D*"])
+    assert _rules(issues) == ["MXL-D003"]
+    assert "from node gate" in issues[0].message
+
+
+def test_d001_order_mismatch():
+    """Rank 0 issues a barrier where every other rank issues an
+    allreduce: same trace length, different collective — deadlock."""
+    v = sym.Variable("data")
+    a = sym.FullyConnected(data=v, num_hidden=8, name="a")
+    a._set_attr(__rank_cond__="rank==0", __collective__="barrier")
+    b = sym.Activation(data=v, act_type="relu", name="b")
+    b._set_attr(__rank_cond__="rank!=0", __collective__="allreduce:dp")
+    g = sym.Group([sym.SoftmaxOutput(data=a, name="s1"),
+                   sym.SoftmaxOutput(data=b, name="s2")])
+    issues = g.validate(data=(4, 8), world_size=4, select=["MXL-D*"])
+    assert _rules(issues) == ["MXL-D001"]
+    assert len(issues) == 1          # deduped per program position
+    assert "rank 0 issues barrier" in issues[0].message
+
+
+def test_d002_signature_mismatch():
+    """Same kind at the same position but different mesh axes."""
+    v = sym.Variable("data")
+    a = sym.FullyConnected(data=v, num_hidden=8, name="a")
+    a._set_attr(__rank_cond__="rank%2==0", __collective__="allreduce:dp")
+    b = sym.Activation(data=v, act_type="relu", name="b")
+    b._set_attr(__rank_cond__="rank%2==1", __collective__="allreduce:tp")
+    g = sym.Group([sym.SoftmaxOutput(data=a, name="s1"),
+                   sym.SoftmaxOutput(data=b, name="s2")])
+    issues = g.validate(data=(4, 8), world_size=4, select=["MXL-D*"])
+    assert _rules(issues) == ["MXL-D002"]
+
+
+def test_d003_unparseable_cond_is_warning_not_crash():
+    v = sym.Variable("data")
+    fc = sym.FullyConnected(data=v, num_hidden=8, name="fc")
+    fc._set_attr(__rank_cond__="rank**2", __collective__="barrier")
+    issues = sym.SoftmaxOutput(data=fc, name="s").validate(
+        data=(4, 8), world_size=2, select=["MXL-D*"])
+    assert _rules(issues) == ["MXL-D003"]
+    assert issues[0].severity == "warning"
+    assert "unparseable" in issues[0].message
+
+
+def test_unconditional_collectives_are_clean():
+    v = sym.Variable("data")
+    fc = sym.FullyConnected(data=v, num_hidden=8, name="fc")
+    fc._set_attr(__collective__="allreduce:dp")
+    issues = sym.SoftmaxOutput(data=fc, name="s").validate(
+        data=(4, 8), world_size=4, select=["MXL-D*"])
+    assert issues == []
+
+
+def test_world_size_gates_the_family():
+    out = _coordinator_barrier_graph()
+    assert out.validate(data=(4, 8), select=["MXL-D*"]) == []
+    assert out.validate(data=(4, 8), world_size=1,
+                        select=["MXL-D*"]) == []
+
+
+def test_env_knobs_enable_the_family(monkeypatch):
+    monkeypatch.setenv("MXTPU_LINT_DISTRIBUTED", "1")
+    monkeypatch.setenv("MXTPU_LINT_WORLD_SIZE", "8")
+    out = _coordinator_barrier_graph()
+    issues = out.validate(data=(4, 8), select=["MXL-D*"])
+    assert _rules(issues) == ["MXL-D003"]
+    assert "of 8" in issues[0].message
+
+
+def test_lint_ignore_attr_suppresses():
+    out = _coordinator_barrier_graph()
+    list(out._topo())  # noqa: F841 — attrs live on the graph nodes
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=8, name="fc")
+    fc._set_attr(__rank_cond__="coordinator", __collective__="barrier",
+                 __lint_ignore__="MXL-D003")
+    issues = sym.SoftmaxOutput(data=fc, name="s").validate(
+        data=(4, 8), world_size=4, select=["MXL-D*"])
+    assert issues == []
+
+
+# ----------------------------------------------------------------------
+# D004..D006: the source dataflow pass over the regression fixtures
+# ----------------------------------------------------------------------
+def test_fixture_pid_scratch_path_is_d004():
+    fs = analyze_source_paths(
+        [os.path.join(FIXTURES, "pid_scratch_path.py")], root=ROOT)
+    assert sorted({f["rule"] for f in fs}) == ["MXL-D004"]
+    f = fs[0]
+    assert f["anchor"].endswith(
+        "pid_scratch_path.py:save_checkpoint_atomic")
+    assert "getpid" in f["message"] and "ocp_save" in f["message"]
+
+
+def test_fixture_barrier_probe_is_d005():
+    fs = analyze_source_paths(
+        [os.path.join(FIXTURES, "per_rank_barrier_probe.py")], root=ROOT)
+    rules = sorted({f["rule"] for f in fs})
+    assert "MXL-D005" in rules          # the documented rule id
+    assert "MXL-D006" in rules          # the swallowed probe failure
+    assert all(f["anchor"].endswith(":global_barrier") for f in fs)
+
+
+def test_fixture_device0_sentinel_is_d005():
+    fs = analyze_source_paths(
+        [os.path.join(FIXTURES, "device0_sentinel.py")], root=ROOT)
+    assert sorted({f["rule"] for f in fs}) == ["MXL-D005"]
+    assert "addressable_data" in fs[0]["message"]
+
+
+def test_fixtures_through_analyze_entrypoint():
+    """source_paths= on analyze() routes to the dataflow rules and
+    yields GraphIssues with anchors + lines."""
+    issues = analyze(None, source_paths=[FIXTURES], select=["MXL-D*"])
+    assert set(_rules(issues)) == {"MXL-D004", "MXL-D005", "MXL-D006"}
+    for i in issues:
+        assert i.anchor and ":" in i.anchor
+        assert isinstance(i.line, int) and i.line > 0
+
+
+# ----------------------------------------------------------------------
+# taint sources/sinks and the marker vocabulary
+# ----------------------------------------------------------------------
+def _lint_snippet(tmp_path, code, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(code)
+    return analyze_source_paths([str(p)], root=str(tmp_path))
+
+
+def test_suppression_marker_on_line(tmp_path):
+    fs = _lint_snippet(tmp_path, (
+        "import os\n"
+        "def f(kv, g):\n"
+        "    if os.getpid() % 2:\n"
+        "        kv.all_reduce(g)  # mxl: rank-divergent-ok\n"))
+    assert fs == []
+
+
+def test_suppression_marker_with_rule_filter(tmp_path):
+    code = ("import os\n"
+            "def f(kv, g):\n"
+            "    if os.getpid() % 2:\n"
+            "        # mxl: rank-divergent-ok (MXL-D004)\n"
+            "        kv.all_reduce(g)\n")
+    fs = _lint_snippet(tmp_path, code)
+    assert sorted({f["rule"] for f in fs}) == ["MXL-D005"]  # wrong id
+
+
+def test_suppression_marker_on_def_line(tmp_path):
+    fs = _lint_snippet(tmp_path, (
+        "import os\n"
+        "def f(kv, g):  # mxl: rank-divergent-ok (MXL-D005)\n"
+        "    if os.getpid() % 2:\n"
+        "        kv.all_reduce(g)\n"))
+    assert fs == []
+
+
+def test_collective_seam_certifies_return(tmp_path):
+    """A seam-decorated decision function's verdict is rank-uniform:
+    gating a collective on it is the FIXED protocol, not a bug."""
+    buggy = ("import jax\n"
+             "def decide():\n"
+             "    return jax.process_index() == 0\n"
+             "def run(kv, g):\n"
+             "    if decide():\n"
+             "        kv.all_reduce(g)\n")
+    fs = _lint_snippet(tmp_path, buggy)
+    assert sorted({f["rule"] for f in fs}) == ["MXL-D005"]
+    fixed = buggy.replace(
+        "import jax\n",
+        "import jax\nfrom mxnet_tpu.base import collective_seam\n"
+    ).replace("def decide():", "@collective_seam\ndef decide():")
+    assert _lint_snippet(tmp_path, fixed, "fixed.py") == []
+
+
+def test_seam_body_exempt_from_d005(tmp_path):
+    """Rank-asymmetry INSIDE a seam body is the protocol itself."""
+    fs = _lint_snippet(tmp_path, (
+        "import jax\n"
+        "from mxnet_tpu.base import collective_seam\n"
+        "@collective_seam\n"
+        "def rendezvous(client, g):\n"
+        "    if jax.process_index() == 0:\n"
+        "        client.sync_global_devices('probe')\n"))
+    assert fs == []
+
+
+def test_divergent_returner_one_hop(tmp_path):
+    """_is_coordinator-style helpers spread taint to their callers."""
+    fs = _lint_snippet(tmp_path, (
+        "import jax\n"
+        "def _is_coordinator():\n"
+        "    return jax.process_index() == 0\n"
+        "def save(mgr, tree, step):\n"
+        "    if _is_coordinator():\n"
+        "        mgr.global_barrier('pre')\n"))
+    assert sorted({f["rule"] for f in fs}) == ["MXL-D005"]
+
+
+def test_common_names_do_not_poison(tmp_path):
+    """One divergent `def get` must not taint unrelated .get() calls
+    (consensus rule: every definition of the name must be divergent)."""
+    fs = _lint_snippet(tmp_path, (
+        "import time, os\n"
+        "class Clock(object):\n"
+        "    def get(self):\n"
+        "        return time.monotonic()\n"
+        "class Config(object):\n"
+        "    def get(self, key):\n"
+        "        return key\n"
+        "def run(kv, g, cfg):\n"
+        "    if cfg.get('enabled'):\n"
+        "        kv.all_reduce(g)\n"))
+    assert fs == []
+
+
+def test_seeded_rng_is_uniform(tmp_path):
+    fs = _lint_snippet(tmp_path, (
+        "import numpy as np\n"
+        "def run(kv, g):\n"
+        "    r = np.random.RandomState(7)\n"
+        "    if r.rand() > 0.5:\n"
+        "        kv.all_reduce(g)\n"))
+    assert fs == []
+
+
+def test_unseeded_rng_is_divergent(tmp_path):
+    fs = _lint_snippet(tmp_path, (
+        "import random\n"
+        "def run(kv, g):\n"
+        "    if random.random() > 0.5:\n"
+        "        kv.all_reduce(g)\n"))
+    assert sorted({f["rule"] for f in fs}) == ["MXL-D005"]
+
+
+def test_d006_exit_between_paired_collectives(tmp_path):
+    fs = _lint_snippet(tmp_path, (
+        "import os\n"
+        "def run(kv, g):\n"
+        "    kv.all_reduce(g)\n"
+        "    if os.getpid() % 2:\n"
+        "        return None\n"
+        "    kv.all_reduce(g)\n"))
+    assert sorted({f["rule"] for f in fs}) == ["MXL-D006"]
+    assert "between paired collectives" in fs[0]["message"]
+
+
+def test_d004_coordinated_kwarg(tmp_path):
+    fs = _lint_snippet(tmp_path, (
+        "import os, tempfile\n"
+        "def save(tree, step):\n"
+        "    d = tempfile.mkdtemp()\n"
+        "    ocp_save(path=d, tree=tree, step=step)\n"))
+    assert sorted({f["rule"] for f in fs}) == ["MXL-D004"]
+
+
+def test_filesystem_reads_not_tainted(tmp_path):
+    """Shared-filesystem listings are how ranks legitimately agree
+    (latest_step): they must not count as divergence sources."""
+    fs = _lint_snippet(tmp_path, (
+        "import os\n"
+        "def resume(kv, g, path):\n"
+        "    if os.path.exists(path) and os.listdir(path):\n"
+        "        kv.all_reduce(g)\n"))
+    assert fs == []
+
+
+# ----------------------------------------------------------------------
+# the clean bill: the fixed framework self-lints clean
+# ----------------------------------------------------------------------
+def test_framework_self_lint_clean():
+    """kvstore/parallel/resilience — the subsystems whose pre-fix bugs
+    the fixtures snapshot — produce zero MXL-D findings now that the
+    seams are marked and the intentional divergence is annotated."""
+    fs = analyze_source_paths(
+        [os.path.join(ROOT, "mxnet_tpu")], root=ROOT)
+    assert fs == [], "\n".join(
+        "%s %s L%s: %s" % (f["rule"], f["anchor"], f["line"],
+                           f["message"]) for f in fs)
+
+
+def test_collective_seam_is_runtime_noop():
+    @collective_seam
+    def f(x):
+        return x + 1
+
+    @collective_seam(protocol="kv")
+    def g(x):
+        return x + 2
+
+    assert f(1) == 2 and g(1) == 3
+    assert mx.base.collective_seam is collective_seam
+
+
+# ----------------------------------------------------------------------
+# anchors: stable identity, volatile line
+# ----------------------------------------------------------------------
+def test_anchor_identity_excludes_line():
+    a = GraphIssue("MXL-D004", "error", None, "m", anchor="f.py:g",
+                   line=10)
+    b = GraphIssue("MXL-D004", "error", None, "m", anchor="f.py:g",
+                   line=99)
+    c = GraphIssue("MXL-D004", "error", None, "m", anchor="f.py:h",
+                   line=10)
+    assert a == b and hash(a) == hash(b)
+    assert a != c
+    d = a.as_dict()
+    assert d["anchor"] == "f.py:g" and d["line"] == 10
+    assert "anchor" not in GraphIssue("X", "error", "n", "m").as_dict()
+
+
+def test_anchor_survives_unrelated_edit(tmp_path):
+    """The same finding keeps the same anchor when lines shift — the
+    property mxlint --baseline keys on."""
+    code = ("import os\n"
+            "def save(tree, step):\n"
+            "    ocp_save('%d' % os.getpid(), tree, step)\n")
+    before = _lint_snippet(tmp_path, code, "v1.py")
+    shifted = "# header comment\n\n\n" + code
+    after = _lint_snippet(tmp_path, shifted, "v1.py")
+    assert before[0]["anchor"] == after[0]["anchor"]
+    assert before[0]["line"] != after[0]["line"]

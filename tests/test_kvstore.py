@@ -115,3 +115,34 @@ def test_optimizer_states_roundtrip(tmp_path):
 def test_invalid_type():
     with pytest.raises(mx.MXNetError):
         mx.kv.create("nosuchstore")
+
+
+# ----------------------------------------------------------------------
+# 2-worker cluster-wide-decision smoke (tier-1 wrapper around
+# tests/nightly/dist_csum.py): both ranks must adopt the verdicts rank 0
+# published for the collective-sum and barrier paths — the protocol the
+# @collective_seam markers certify for the MXL-D lint, and the fix for
+# the pre-fix bug snapshotted in tests/fixtures/divergence/
+# per_rank_barrier_probe.py.
+# ----------------------------------------------------------------------
+def test_cluster_wide_decision_smoke():
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, os.path.join(root, "tools", "launch.py"),
+           "-n", "2", "--launcher", "local", "--workdir", root,
+           "--port", "9901",
+           sys.executable, os.path.join("tests", "nightly",
+                                        "dist_csum.py")]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    proc = subprocess.run(cmd, cwd=root, env=env, timeout=420,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True)
+    assert proc.returncode == 0, (proc.returncode, proc.stdout[-2000:])
+    oks = [l for l in proc.stdout.splitlines()
+           if l.strip().endswith("OK") and "verdicts" in l]
+    assert len(oks) == 2, proc.stdout[-1500:]
+    # the published verdict both ranks report must be identical
+    assert len({l.split("csum=")[1] for l in oks}) == 1, oks

@@ -591,3 +591,109 @@ def test_mxlint_baseline_suppression(tmp_path):
                 "--shapes", "data=(512,3,224,224)", "--fail-on=info",
                 "--baseline", base)
     assert p.returncode == 1, p.stdout + p.stderr
+
+
+# ----------------------------------------------------------------------
+# mxlint --distributed: the MXL-D family through the CLI
+# ----------------------------------------------------------------------
+FIXDIR = os.path.join(ROOT, "tests", "fixtures", "divergence")
+
+
+def test_mxlint_distributed_fixtures_fail():
+    """The three pre-fix PR-3 regression fixtures must flag with their
+    documented rule ids and fail the sweep at --fail-on=error."""
+    p = _mxlint("--distributed", FIXDIR, "--fail-on=error",
+                "--format=github")
+    assert p.returncode == 1, p.stdout + p.stderr
+    out = p.stdout
+    assert "MXL-D004" in out and "pid_scratch_path.py" in out
+    assert "MXL-D005" in out and "per_rank_barrier_probe.py" in out
+    assert "device0_sentinel.py" in out
+    # annotations carry file=/line= params from the anchors
+    assert "::error file=" in out and ",line=" in out
+
+
+def test_mxlint_distributed_self_lint_clean():
+    """The fixed framework source is the clean bill the ISSUE demands."""
+    p = _mxlint("--distributed", os.path.join(ROOT, "mxnet_tpu"),
+                "--fail-on=error")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "sources: clean" in p.stdout
+
+
+def test_mxlint_distributed_model_graph():
+    """--world-size activates the graph-level trace diff on models
+    (clean: the zoo has no rank-conditional collectives)."""
+    p = _mxlint("--model", "mlp", "--distributed", "--world-size", "4",
+                "--fail-on=error")
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def _mxlint_module():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_mxlint_under_test", os.path.join(ROOT, "tools", "mxlint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_mxlint_diff_targets_mapping():
+    m = _mxlint_module()
+    picked = m.diff_targets([
+        "graphs/saved.json",
+        "mxnet_tpu/models/resnet.py",
+        "mxnet_tpu/kvstore.py",
+        "mxnet_tpu/models/nosuchmodel.py",
+        "tools/mxlint.py",            # outside mxnet_tpu: not source-linted
+        "docs/graph_lint.md",
+    ])
+    assert picked["files"] == ["graphs/saved.json"]
+    assert picked["models"] == ["resnet"]
+    assert "mxnet_tpu/kvstore.py" in picked["sources"]
+    assert "mxnet_tpu/models/resnet.py" in picked["sources"]
+    assert "tools/mxlint.py" not in picked["sources"]
+
+
+def test_mxlint_diff_no_changes_exits_zero(tmp_path):
+    """--diff in a repo with an empty diff reports nothing to lint."""
+    import subprocess
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    env = {**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+    for cmd in (["git", "init", "-q"],
+                ["git", "commit", "-q", "--allow-empty", "-m", "x"]):
+        subprocess.run(cmd, cwd=str(repo), env=env, check=True)
+    p = _run(str(repo), os.path.join(ROOT, "tools", "mxlint.py"),
+             "--diff", "HEAD", "--fail-on=error")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "no lintable changes" in p.stdout
+
+
+def test_mxlint_baseline_anchor_keys(tmp_path):
+    """Divergence findings baseline on file:qualname anchors — and a
+    legacy record without anchor fields still loads."""
+    m = _mxlint_module()
+    base = str(tmp_path / "base.json")
+    fx = os.path.join(FIXDIR, "pid_scratch_path.py")
+    p = _mxlint("--distributed", fx, "--baseline", base,
+                "--update-baseline")
+    assert p.returncode == 0, p.stdout + p.stderr
+    import json as _json
+    with open(base) as f:
+        doc = _json.load(f)
+    assert any((e.get("anchor") or "").endswith(
+        "pid_scratch_path.py:save_checkpoint_atomic")
+        for e in doc["findings"])
+    # baselined: the same lint now passes
+    p = _mxlint("--distributed", fx, "--baseline", base,
+                "--fail-on=error")
+    assert p.returncode == 0, p.stdout + p.stderr
+    # legacy record shape (node only, no anchor) must still load
+    with open(base, "w") as f:
+        _json.dump({"version": 1, "findings": [
+            {"target": "model:x", "rule_id": "MXL-R001",
+             "severity": "info", "node": "fc1", "message": "m"}]}, f)
+    keys = m.load_baseline(base)
+    assert m._baseline_key("model:x", "MXL-R001", "fc1", "m") in keys

@@ -25,13 +25,31 @@ the graph against device peaks and prints a static MFU ceiling:
   python tools/mxlint.py --model resnet --select 'MXL-K*,MXL-R*' \\
       --shapes "data=(256,3,224,224)" --roofline
 
+The distributed family (MXL-D) diffs the per-rank collective trace of
+each graph (D001..D003) and runs the rank-divergence dataflow pass
+over Python source (D004..D006).  ``--distributed`` turns both on
+(``--world-size`` sets the simulated pod size, default 4); ``.py``
+files and directories among the positional targets are source-linted:
+
+  python tools/mxlint.py --all-models --distributed --world-size 4
+  python tools/mxlint.py --distributed mxnet_tpu --fail-on=error
+
+``--diff [REV]`` lints only what a change touches — changed symbol
+JSONs, the models whose builders changed, and changed framework .py
+files (rank-divergence pass) — the fast pre-merge step ahead of the
+full sweep (REV defaults to HEAD):
+
+  python tools/mxlint.py --diff origin/main --fail-on=error
+
 Exit codes: 0 = nothing at/above --fail-on severity, 1 = findings at or
 above it, 2 = usage/load failure.  --fail-on=never always exits 0 (report
 only).  --select/--skip accept fnmatch wildcards ("MXL-P*") and
 comma-separated lists.  --format=github emits workflow-command
 annotations for CI logs.  --baseline FILE suppresses previously recorded
-findings (write the record with --update-baseline) so a sweep fails only
-on NEW findings.  Rule catalog and suppression attrs: docs/graph_lint.md.
+findings (keyed on stable file:qualname anchors where available, so
+records survive unrelated edits; write it with --update-baseline) so a
+sweep fails only on NEW findings.  Rule catalog and suppression attrs:
+docs/graph_lint.md.
 """
 import argparse
 import ast
@@ -192,6 +210,55 @@ def lint_model(name, kwargs, shapes, target, select, skip, **spmd):
     return "model:%s" % name, issues, (ctx_out[0] if ctx_out else None)
 
 
+def lint_sources(paths, select, skip, world_size=None):
+    """Run the rank-divergence pass (MXL-D004..006) over .py files and
+    directories; returns the same (label, issues, ctx) triple shape.
+    Defaults to the MXL-D family — the only rules that read source."""
+    from mxnet_tpu.analysis import analyze
+    issues = analyze(None, source_paths=list(paths),
+                     world_size=world_size,
+                     select=(select or ["MXL-D*"]), skip=skip)
+    return "sources", issues, None
+
+
+def git_changed_paths(rev, cwd=None):
+    """Paths changed vs ``rev`` (committed + staged + worktree)."""
+    import subprocess
+    out = subprocess.run(
+        ["git", "diff", "--name-only", rev, "--"],
+        capture_output=True, text=True, cwd=cwd)
+    if out.returncode != 0:
+        raise ValueError("git diff %s failed: %s"
+                         % (rev, out.stderr.strip()))
+    return [l.strip() for l in out.stdout.splitlines() if l.strip()]
+
+
+def diff_targets(changed, sweep=None):
+    """Map changed paths -> lint targets (pure; unit-tested).
+
+    Returns ``{"files", "models", "sources"}``: changed symbol JSONs
+    lint directly, a changed ``models/<name>.py`` re-lints that zoo
+    entry (when it has a sweep row), and every changed framework .py
+    goes through the rank-divergence source pass.  Existence filtering
+    (deleted files show up in diffs) is the caller's job.
+    """
+    sweep = MODEL_SWEEP if sweep is None else sweep
+    names = {row[0] for row in sweep}
+    files, models, sources = [], [], []
+    for p in changed:
+        q = p.replace("\\", "/")
+        if q.endswith(".json"):
+            files.append(p)
+        elif q.endswith(".py") and "mxnet_tpu" in q.split("/"):
+            parts = q.split("/")
+            if "models" in parts:
+                stem = parts[-1][:-len(".py")]
+                if stem in names and stem not in models:
+                    models.append(stem)
+            sources.append(p)
+    return {"files": files, "models": models, "sources": sources}
+
+
 def cost_report_lines(ctx):
     """The per-graph communication + memory cost report (text mode)."""
     from mxnet_tpu.analysis import comm_report, peak_hbm_report
@@ -262,17 +329,24 @@ def roofline_report_lines(ctx):
     return lines
 
 
-def _baseline_key(label, rule_id, node, message):
-    return "%s|%s|%s|%s" % (label, rule_id, node or "", message)
+def _baseline_key(label, rule_id, where, message):
+    """``where`` is the stable location: the file:qualname anchor when
+    the finding has one, else the node name — never a line number, so
+    baselines survive unrelated edits."""
+    return "%s|%s|%s|%s" % (label, rule_id, where or "", message)
 
 
 def load_baseline(path):
-    """Baseline file -> set of finding keys (empty when absent)."""
+    """Baseline file -> set of finding keys (empty when absent).
+
+    Older records have no ``anchor`` field; ``anchor or node`` keeps
+    them loading (and matching node-located findings) unchanged."""
     if not os.path.exists(path):
         return set()
     with open(path) as f:
         doc = json.load(f)
-    return {_baseline_key(e["target"], e["rule_id"], e.get("node"),
+    return {_baseline_key(e["target"], e["rule_id"],
+                          e.get("anchor") or e.get("node"),
                           e["message"])
             for e in doc.get("findings", [])}
 
@@ -282,7 +356,7 @@ def write_baseline(path, targets):
     doc = {"version": 1,
            "findings": [{"target": label, "rule_id": i.rule_id,
                          "severity": i.severity, "node": i.node,
-                         "message": i.message}
+                         "anchor": i.anchor, "message": i.message}
                         for label, issues, _ in targets
                         for i in issues]}
     with open(path, "w") as f:
@@ -300,10 +374,20 @@ _GH_LEVEL = {"error": "error", "warning": "warning", "info": "notice"}
 
 
 def gh_annotation(label, issue):
-    """One GitHub Actions workflow-command line per finding."""
-    where = issue.node or "graph"
-    return "::%s title=%s [%s] %s::%s" % (
-        _GH_LEVEL.get(issue.severity, "notice"), issue.rule_id,
+    """One GitHub Actions workflow-command line per finding.
+
+    Findings with a ``file:qualname`` anchor also carry ``file=`` and
+    ``line=`` params so the annotation lands on the source line in the
+    PR view (the line is display-only; identity stays on the anchor)."""
+    where = issue.anchor or issue.node or "graph"
+    params = ""
+    if issue.anchor and ":" in issue.anchor:
+        fpath = issue.anchor.rsplit(":", 1)[0]
+        params = "file=%s," % _gh_escape(fpath)
+        if issue.line:
+            params += "line=%d," % issue.line
+    return "::%s %stitle=%s [%s] %s::%s" % (
+        _GH_LEVEL.get(issue.severity, "notice"), params, issue.rule_id,
         _gh_escape(label), _gh_escape(where), _gh_escape(issue.message))
 
 
@@ -311,7 +395,10 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="mxlint", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("files", nargs="*", help="saved symbol JSON files")
+    ap.add_argument("files", nargs="*",
+                    help="saved symbol JSON files; .py files and "
+                         "directories go through the MXL-D "
+                         "rank-divergence source pass")
     ap.add_argument("--model", action="append", default=[],
                     help="lint a bundled mxnet_tpu/models/<name> network "
                          "(repeatable)")
@@ -349,6 +436,22 @@ def main(argv=None):
     ap.add_argument("--roofline", action="store_true",
                     help="print the static roofline / MFU-ceiling report "
                          "per graph (text mode; implied by --mesh)")
+    ap.add_argument("--distributed", action="store_true",
+                    help="enable the MXL-D distributed family: per-rank "
+                         "collective-trace diff on graphs (D001..003) "
+                         "and the rank-divergence source pass "
+                         "(D004..006) on .py targets")
+    ap.add_argument("--world-size", type=int, default=None,
+                    metavar="N",
+                    help="simulated pod size for the trace diff "
+                         "(implies --distributed; default 4 when "
+                         "--distributed is set)")
+    ap.add_argument("--diff", nargs="?", const="HEAD", default=None,
+                    metavar="REV",
+                    help="lint only targets reachable from paths changed "
+                         "vs REV (default HEAD): changed symbol JSONs, "
+                         "models whose builders changed, and changed "
+                         "framework .py files (fast pre-merge mode)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help="suppress findings recorded in FILE; fail only "
                          "on new ones (create it with --update-baseline)")
@@ -381,9 +484,28 @@ def main(argv=None):
             print("%-9s %-8s %s" % (rule.rule_id, rule.severity, rule.doc))
         return 0
 
+    if args.world_size is not None:
+        args.distributed = True
+    world_size = (args.world_size or 4) if args.distributed else None
+
+    if args.diff is not None:
+        try:
+            changed = git_changed_paths(args.diff)
+        except (ValueError, OSError) as exc:
+            print("mxlint: %s" % exc, file=sys.stderr)
+            return 2
+        picked = diff_targets(changed)
+        args.files += [p for p in picked["files"] + picked["sources"]
+                       if os.path.exists(p)]
+        args.model += [m for m in picked["models"]
+                       if m not in args.model]
+        if not args.files and not args.model and not args.all_models:
+            print("mxlint: --diff %s: no lintable changes" % args.diff)
+            return 0
+
     if not args.files and not args.model and not args.all_models:
-        ap.error("nothing to lint: pass JSON files, --model, or "
-                 "--all-models")
+        ap.error("nothing to lint: pass JSON files / .py sources, "
+                 "--model, --all-models, or --diff")
 
     try:
         shapes = parse_shapes(args.shapes)
@@ -408,6 +530,8 @@ def main(argv=None):
         spmd["compute_dtype"] = args.compute_dtype
     if args.device_kind:
         spmd["device_kind"] = args.device_kind
+    if world_size is not None:
+        spmd["world_size"] = world_size
     if args.update_baseline and not args.baseline:
         ap.error("--update-baseline needs --baseline FILE")
 
@@ -416,11 +540,23 @@ def main(argv=None):
               if p.strip()} or None
     skip = {p.strip() for s in args.skip for p in s.split(",")
             if p.strip()} or None
+    json_files = [p for p in args.files if p.endswith(".json")]
+    source_paths = [p for p in args.files if not p.endswith(".json")]
+    bad = [p for p in source_paths
+           if not (os.path.isdir(p) or p.endswith(".py"))]
+    if bad:
+        print("mxlint: not a symbol JSON, .py file, or directory: %s"
+              % ", ".join(bad), file=sys.stderr)
+        return 2
+
     targets = []    # (label, issues, ctx|None)
     try:
-        for path in args.files:
+        for path in json_files:
             targets.append(lint_file(path, shapes, args.target, select,
                                      skip, **spmd))
+        if source_paths:
+            targets.append(lint_sources(source_paths, select, skip,
+                                        world_size=world_size))
         sweep = list(MODEL_SWEEP) if args.all_models else []
         for name in args.model:
             row = next((r for r in MODEL_SWEEP if r[0] == name),
@@ -445,7 +581,8 @@ def main(argv=None):
         suppressed = 0
         for label, issues, ctx in targets:
             new = [i for i in issues
-                   if _baseline_key(label, i.rule_id, i.node, i.message)
+                   if _baseline_key(label, i.rule_id,
+                                    i.anchor or i.node, i.message)
                    not in known]
             suppressed += len(issues) - len(new)
             filtered.append((label, new, ctx))
