@@ -181,24 +181,50 @@ class NDArrayIter(DataIter):
     order it would have seen uninterrupted.  With ``seed=None`` the
     legacy semantics hold: one global-RNG shuffle at construction, same
     order every epoch.
+
+    ``num_parts``/``part_index`` (the reference's distributed-iterator
+    knobs, io.py kPartition) shard the SAME global order across
+    workers: every part computes the identical (seed, epoch)
+    permutation over the full dataset and takes a disjoint stride of
+    it, so the parts' union is exactly the dataset — no sample dropped
+    or duplicated — **for any number of parts**.  That world-size
+    independence is what elastic re-meshing leans on: after a
+    shrink/grow the survivors rebuild the iterator with the new
+    ``num_parts`` at the resumed epoch and the pod as a whole still
+    visits each sample exactly once per epoch (docs/resilience.md
+    "Elasticity").
     """
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label", seed=None):
+                 label_name="softmax_label", seed=None,
+                 num_parts=1, part_index=0):
         super().__init__()
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
 
         self.shuffle = bool(shuffle)
         self.seed = seed
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
+        if self.num_parts < 1:
+            raise MXNetError("num_parts must be >= 1, got %d"
+                             % self.num_parts)
+        if not 0 <= self.part_index < self.num_parts:
+            raise MXNetError("part_index must be in [0, %d), got %d"
+                             % (self.num_parts, self.part_index))
+        if self.num_parts > 1 and self.shuffle and self.seed is None:
+            raise MXNetError(
+                "NDArrayIter(num_parts>1) needs seed= when shuffle=True: "
+                "the parts must agree on one global order to partition "
+                "(an unseeded shuffle diverges per process)")
         self.epoch = 0
         self._total = self.data[0][1].shape[0]
         if last_batch_handle == "discard":
             self._kept = self._total - self._total % batch_size
         else:
             self._kept = self._total
-        self.idx = _np.arange(self._kept)
+        self.idx = self._partition(_np.arange(self._kept))
         if self.shuffle:
             self._reshuffle()
 
@@ -211,6 +237,16 @@ class NDArrayIter(DataIter):
         self.batch_size = batch_size
         self.last_batch_handle = last_batch_handle
 
+    def _partition(self, order):
+        """This part's disjoint stride of the global order.  Every part
+        computes the same ``order`` (seeded permutation or arange) and
+        takes ``order[part_index::num_parts]``, so for ANY num_parts
+        the parts tile the kept samples exactly once — the invariant
+        elastic resume leans on when the world size changes."""
+        if self.num_parts <= 1:
+            return order
+        return order[self.part_index::self.num_parts]
+
     def _reshuffle(self):
         """Rebuild the permutation for the current epoch."""
         order = _np.arange(self._total)
@@ -220,7 +256,7 @@ class NDArrayIter(DataIter):
             rng.shuffle(order)
         else:
             _np.random.shuffle(order)     # legacy: ambient global RNG
-        self.idx = order[:self._kept]
+        self.idx = self._partition(order[:self._kept])
 
     # -- resumable iteration state (docs/resilience.md) ----------------
     def state(self):

@@ -287,42 +287,54 @@ class KVStore(object):
         self.set_updater(get_updater(optimizer))
 
     # -- fault surface (kvstore.h:242 get_num_dead_node parity) ------------
-    def num_dead_nodes(self, node_id=None, timeout=None):
-        """Count workers whose liveness heartbeat is stale/missing.
+    def dead_nodes(self, node_id=None, timeout=None):
+        """Sorted ranks whose liveness heartbeat is stale/missing.
 
-        Parity: ``KVStore::get_num_dead_node(node_id, timeout)``
-        (include/mxnet/kvstore.h:242, impl kvstore_dist.h:149-158 over
-        ps-lite heartbeats).  Here every dist worker runs a heartbeat
-        thread stamping ``mxtpu_hb/<rank>`` in the jax coordination
-        service (started by create('dist_*')); the check is a
-        non-blocking key scan, safe to call while peers are down.
+        The identity-bearing form of :meth:`num_dead_nodes`: the
+        elastic re-mesh protocol (``resilience.elastic``) needs to know
+        WHICH workers died to propose the survivor membership, and
+        ``mxtop`` wants names, not a count.  Every dist worker runs a
+        heartbeat thread stamping ``mxtpu_hb/<rank>`` in the jax
+        coordination service (started by ``create('dist_*')``); this is
+        a non-blocking key scan, safe to call while peers are down.
 
         ``node_id`` narrows the check to one rank (None = all workers).
-        ``timeout`` defaults to 5 heartbeat intervals — enough slack for
-        RPC jitter and modest cross-host clock skew.  Returns 0 for
-        non-dist stores.
+        ``timeout`` defaults to 5 heartbeat intervals — enough slack
+        for RPC jitter and modest cross-host clock skew.  Returns
+        ``[]`` for non-dist stores; every rank when the coordination
+        service itself is unreachable (the cluster is lost — restart
+        watchdogs must fire rather than read a healthy empty list).
+        Injected ``dead_node`` faults report the highest ``n`` ranks
+        (synthesized identities — the injector knows a count, not
+        names).
         """
         if timeout is None:
             timeout = 5 * _HB_INTERVAL
-        if self.type.startswith("dist"):
-            from .resilience.faultinject import maybe_fault
-            spec = maybe_fault("dead_node")
-            if spec is not None and spec.kind == "dead_node":
-                return int(spec.n)
+        if not self.type.startswith("dist"):
+            return []
+        from .resilience.faultinject import maybe_fault
+        spec = maybe_fault("dead_node")
+        if spec is not None and spec.kind == "dead_node":
+            # synthesize exactly n identities even when the injected
+            # count exceeds the real world (single-process tests assert
+            # the count the spec asked for)
+            world = max(self.num_workers, int(spec.n))
+            fake = list(range(world))[-int(spec.n):] \
+                if int(spec.n) > 0 else []
+            if node_id is not None:
+                return [r for r in fake if r == node_id]
+            return fake
         client = _dist_client()
-        if client is None or not self.type.startswith("dist"):
-            return 0
+        if client is None:
+            return []
+        ranks = [node_id] if node_id is not None \
+            else range(self.num_workers)
         try:
             entries = dict(client.key_value_dir_get(_HB_PREFIX))
         except Exception:
-            # coordination service unreachable (rank-0/coordinator death
-            # included): the cluster is lost — report everyone dead so
-            # restart watchdogs fire rather than report a healthy 0
-            return self.num_workers
+            return sorted(ranks)
         now = _now()
-        ranks = [node_id] if node_id is not None \
-            else range(self.num_workers)
-        dead = 0
+        dead = []
         for r in ranks:
             stamp = entries.get("%s%d" % (_HB_PREFIX, r))
             if stamp is None:
@@ -330,10 +342,18 @@ class KVStore(object):
                 # than `timeout` since this store came up to write one
                 # (avoids a startup race counting slow starters as dead)
                 if now - self._created > timeout:
-                    dead += 1
+                    dead.append(r)
             elif now - float(stamp) > timeout:
-                dead += 1
-        return dead
+                dead.append(r)
+        return sorted(dead)
+
+    def num_dead_nodes(self, node_id=None, timeout=None):
+        """Count of stale workers (parity:
+        ``KVStore::get_num_dead_node(node_id, timeout)``,
+        include/mxnet/kvstore.h:242, impl kvstore_dist.h:149-158 over
+        ps-lite heartbeats).  Thin wrapper over :meth:`dead_nodes` —
+        same liveness scan, identities dropped."""
+        return len(self.dead_nodes(node_id=node_id, timeout=timeout))
 
     get_num_dead_node = num_dead_nodes
 
@@ -643,6 +663,11 @@ def _maybe_init_distributed():
     coord = os.environ.get("MXTPU_COORDINATOR")
     if not coord:
         return
+    # elastic generation fence BEFORE dialing (docs/resilience.md):
+    # a straggler from a superseded incarnation must exit for restart,
+    # not join (or corrupt the rendezvous of) the new pod
+    from .resilience import elastic
+    elastic.check_generation_fence()
     if getattr(_maybe_init_distributed, "_done", False):
         return
     already = False

@@ -231,6 +231,7 @@ def build_report(records, now=None):
     phase_totals = {}
     incidents = []
     kv_hb_ages = None
+    last_elastic = None
     for rec in records:
         kind = rec.get("kind")
         rank = rec.get("rank")
@@ -256,6 +257,14 @@ def build_report(records, now=None):
             incidents.append(rec)
         elif kind == "ckpt":
             incidents.append(rec)
+        elif kind == "elastic":
+            # re-mesh agreement trail: incident-worthy AND the pod's
+            # generation/world_size come from the newest one (records
+            # arrive wall-clock-sorted, so last seen wins)
+            incidents.append(rec)
+            if rec.get("generation") is not None:
+                state["generation"] = rec.get("generation")
+            last_elastic = rec
         elif kind == "counter" and rec.get("name") == "heartbeat_ages":
             kv_hb_ages = rec.get("ages")
         elif kind == "counter" and rec.get("name") == "trainer_cost":
@@ -287,6 +296,15 @@ def build_report(records, now=None):
         summaries[rank] = s
 
     pod = _pod_rollup(summaries)
+    if last_elastic is not None:
+        pod["generation"] = last_elastic.get("generation")
+        if last_elastic.get("world_size") is not None:
+            pod["world_size"] = last_elastic.get("world_size")
+        pod["last_elastic"] = {
+            k: last_elastic.get(k)
+            for k in ("event", "generation", "world_size", "reason",
+                      "from_world", "rank", "step")
+            if last_elastic.get(k) is not None}
     if phase_totals:
         pod["slowest_phase"] = max(phase_totals, key=phase_totals.get)
         pod["phase_totals_ms"] = {k: round(v, 3)

@@ -34,9 +34,11 @@ import time
 __all__ = ["enabled", "telemetry_dir", "run_id", "rank", "get",
            "refresh", "emit", "flush", "last_fault", "EventLog", "KINDS"]
 
-#: the closed set of record kinds (docs/observability.md)
+#: the closed set of record kinds (docs/observability.md); "elastic"
+#: records are the re-mesh agreement trail (propose/adopt/resume with
+#: generation stamps — docs/resilience.md "Elasticity")
 KINDS = ("step", "span", "counter", "fault", "ckpt", "collective",
-         "summary")
+         "summary", "elastic")
 
 _FLUSH_INTERVAL_S = 1.0
 _HIGH_WATER = 256            # buffered records that trigger an early flush

@@ -13,12 +13,14 @@ XLA place collectives.
 Axes (by convention): ``dp`` data, ``tp`` tensor, ``pp`` pipeline,
 ``sp`` sequence (ring attention), ``ep`` expert.
 """
-from .mesh import make_mesh, auto_mesh, local_device_count, LogicalMesh
+from .mesh import (make_mesh, auto_mesh, local_device_count, LogicalMesh,
+                   remesh)
 from .sharding import ShardingRules, param_pspec, batch_pspec, named_pspecs
 from .trainer import ShardedTrainer, ShardedPredictor
 from .pipeline import GPipeTrainer, pipeline_apply
 
 __all__ = ["make_mesh", "auto_mesh", "local_device_count", "LogicalMesh",
+           "remesh",
            "ShardingRules", "param_pspec", "batch_pspec", "named_pspecs",
            "ShardedTrainer", "ShardedPredictor", "GPipeTrainer",
            "pipeline_apply"]

@@ -16,6 +16,7 @@ under ``path.tmp``).  The naive protocol this replaces
 checkpoint *before* writing the new one, so a preemption mid-save lost
 both.
 """
+import json as _json
 import os as _os
 import shutil as _shutil
 
@@ -23,7 +24,9 @@ import numpy as _np
 
 import jax
 
-__all__ = ["ocp_save", "ocp_restore", "abstract_like"]
+__all__ = ["ocp_save", "ocp_restore", "abstract_like",
+           "host_save", "host_restore", "is_host_format",
+           "describe_restore_mismatch"]
 
 
 def abstract_like(tree):
@@ -131,3 +134,159 @@ def ocp_restore(path, abstract_tree):
     restored = ckptr.restore(_os.path.abspath(str(path)), target)
     step = int(restored.pop("step"))
     return restored, step
+
+
+# ----------------------------------------------------------------------
+# host payload format: the backend-free fallback writer
+# ----------------------------------------------------------------------
+# orbax's multi-host coordination fences through sync_global_devices —
+# an XLA collective the multi-process CPU backend (where the elastic /
+# resilience drills run) cannot compile at all.  For replicated host
+# state, CheckpointManager(payload_format="host") swaps the payload
+# writer for this one: rank 0 writes the whole tree as one .npz + a
+# JSON manifest, non-coordinators contribute nothing (the manager's
+# own RPC barriers still fence the commit).  Same directory contract
+# as ocp_save(atomic=False): the caller owns atomicity.
+
+_HOST_MANIFEST = "host_ckpt.json"
+_HOST_ARRAYS = "host_ckpt.npz"
+
+
+def _flatten_tree(tree, prefix=""):
+    """Nested dict-of-arrays -> {'a/b': array} (host format is for
+    replicated host pytrees, which are nested dicts here)."""
+    flat = {}
+    for key, val in tree.items():
+        name = "%s%s" % (prefix, key)
+        if isinstance(val, dict):
+            flat.update(_flatten_tree(val, name + "/"))
+        else:
+            flat[name] = _np.asarray(val)
+    return flat
+
+
+def _unflatten_like(abstract_tree, flat, prefix=""):
+    out = {}
+    for key, val in abstract_tree.items():
+        name = "%s%s" % (prefix, key)
+        if isinstance(val, dict):
+            out[key] = _unflatten_like(val, flat, name + "/")
+        else:
+            out[key] = flat[name]
+    return out
+
+
+def is_host_format(path):
+    """Was the checkpoint at ``path`` written by :func:`host_save`?"""
+    return _os.path.isfile(_os.path.join(str(path), _HOST_MANIFEST))
+
+
+def host_save(path, tree, step):
+    """Write ``tree`` + ``step`` as one host-side .npz under ``path``.
+
+    Replicated-state single-writer protocol: only the coordinator
+    writes (every rank holds the same bytes after the gradient
+    allreduce, so one copy is the checkpoint); peers return
+    immediately and rely on the caller's barriers for ordering.  NOT
+    for sharded device state — that is ocp_save's job on backends
+    that can run it.
+    """
+    path = _os.path.abspath(str(path))
+    if not _is_coordinator():
+        return path
+    flat = _flatten_tree(dict(tree))
+    _os.makedirs(path, exist_ok=True)
+    with open(_os.path.join(path, _HOST_ARRAYS), "wb") as fout:
+        _np.savez(fout, **flat)
+        fout.flush()
+        _os.fsync(fout.fileno())
+    manifest = {
+        "step": int(step),
+        "keys": {k: {"shape": list(a.shape), "dtype": a.dtype.str}
+                 for k, a in flat.items()},
+    }
+    with open(_os.path.join(path, _HOST_MANIFEST), "w") as fout:
+        _json.dump(manifest, fout, sort_keys=True)
+        fout.flush()
+        _os.fsync(fout.fileno())
+    _fsync_dir(path)
+    return path
+
+
+def host_restore(path, abstract_tree):
+    """Restore a :func:`host_save` checkpoint; returns (tree, step).
+    Every rank may call this (read-only)."""
+    path = _os.path.abspath(str(path))
+    with open(_os.path.join(path, _HOST_MANIFEST)) as fin:
+        manifest = _json.load(fin)
+    with _np.load(_os.path.join(path, _HOST_ARRAYS)) as npz:
+        flat = {k: npz[k] for k in npz.files}
+    return (_unflatten_like(dict(abstract_tree), flat),
+            int(manifest["step"]))
+
+
+# ----------------------------------------------------------------------
+# restore-target introspection
+# ----------------------------------------------------------------------
+def _describe(shape, dtype):
+    return "shape=%s dtype=%s" % (tuple(shape), _np.dtype(dtype).name)
+
+
+def _leaf_specs(tree, prefix=""):
+    """{'a/b': (shape, dtype)} for a pytree of arrays /
+    ShapeDtypeStructs (anything with .shape/.dtype)."""
+    out = {}
+    for key, val in dict(tree).items():
+        name = "%s%s" % (prefix, key)
+        if isinstance(val, dict):
+            out.update(_leaf_specs(val, name + "/"))
+        else:
+            out[name] = (tuple(val.shape), _np.dtype(val.dtype))
+    return out
+
+
+def describe_restore_mismatch(path, abstract_tree):
+    """Leaf-level disagreements between the checkpoint at ``path`` and
+    an abstract restore target: ``[(leaf, saved, requested), ...]``.
+
+    ``saved``/``requested`` are human strings (``shape=... dtype=...``
+    or ``absent``).  Empty list = the structures agree (shardings are
+    NOT compared: resharding on restore is exactly what elastic resume
+    relies on).  Returns ``[]`` too when the checkpoint's metadata
+    cannot be read at all — the caller should let the underlying
+    restore error speak then.
+
+    This exists because orbax's failure modes here are hostile: a
+    structure mismatch raises an opaque key-diff ValueError, and a
+    shape/dtype disagreement on an unsharded target doesn't raise at
+    all — it silently restores the SAVED shape, which a resumed
+    training loop then feeds to a step compiled for the requested one.
+    """
+    path = _os.path.abspath(str(path))
+    try:
+        if is_host_format(path):
+            with open(_os.path.join(path, _HOST_MANIFEST)) as fin:
+                manifest = _json.load(fin)
+            saved = {k: (tuple(v["shape"]), _np.dtype(v["dtype"]))
+                     for k, v in manifest["keys"].items()}
+        else:
+            import orbax.checkpoint as ocp
+            meta = ocp.StandardCheckpointer().metadata(path)
+            saved = _leaf_specs(meta)
+    except Exception:
+        return []
+    want = _leaf_specs(abstract_tree)
+    # the step counter rides along implicitly (ocp_restore adds it to
+    # the target; host manifests keep it out of `keys`)
+    saved.pop("step", None)
+    want.pop("step", None)
+    mismatches = []
+    for leaf in sorted(set(saved) | set(want)):
+        s, w = saved.get(leaf), want.get(leaf)
+        if s is None:
+            mismatches.append((leaf, "absent", _describe(*w)))
+        elif w is None:
+            mismatches.append((leaf, _describe(*s), "absent"))
+        elif s != w:
+            mismatches.append((leaf, _describe(*s), _describe(*w)))
+    return mismatches

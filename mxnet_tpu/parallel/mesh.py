@@ -14,7 +14,8 @@ import numpy as _np
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "auto_mesh", "local_device_count", "LogicalMesh"]
+__all__ = ["make_mesh", "auto_mesh", "local_device_count", "LogicalMesh",
+           "remesh"]
 
 AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")  # outer→inner; tp innermost so
 # its collectives ride the fastest ICI links (scaling-book layout rule)
@@ -89,6 +90,50 @@ class LogicalMesh(object):
     def __repr__(self):
         return "LogicalMesh(%s)" % ", ".join(
             "%s=%d" % kv for kv in self.shape.items())
+
+
+def remesh(mesh, devices=None, total=None):
+    """Rebuild ``mesh``'s named layout over a new device population —
+    the resharded-resume half of elastic training (docs/resilience.md
+    "Elasticity"): after the pod shrinks or grows, the model axes
+    (tp/sp/pp/ep) keep their sizes and **dp absorbs the device-count
+    change**, so every ``named_pspecs`` sharding re-derives against
+    the same axis names and orbax reshards the checkpoint on restore.
+
+    ``mesh`` may be a live ``jax.sharding.Mesh`` (returns one over
+    ``devices``, default ``jax.devices()`` — the post-restart global
+    view) or a :class:`LogicalMesh` (returns a LogicalMesh sized for
+    ``total`` devices — the chip-free planning/lint path).  Raises
+    ``ValueError`` when the non-dp axes don't divide the new device
+    count, or when the mesh has no dp axis to absorb a changed count.
+    """
+    sizes = OrderedDict(mesh.shape)
+    fixed = 1
+    for name, size in sizes.items():
+        if name != "dp":
+            fixed *= int(size)
+    if isinstance(mesh, LogicalMesh):
+        if total is None:
+            raise ValueError("remesh(LogicalMesh) needs total=<devices>")
+        n = int(total)
+    else:
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+    if n % fixed:
+        raise ValueError(
+            "cannot re-mesh %s onto %d devices: non-dp axes need "
+            "multiples of %d" % (dict(sizes), n, fixed))
+    if "dp" not in sizes and n != fixed:
+        raise ValueError(
+            "cannot re-mesh %s onto %d devices: no dp axis to absorb "
+            "the change" % (dict(sizes), n))
+    new_sizes = OrderedDict(sizes)
+    if "dp" in sizes:
+        new_sizes["dp"] = n // fixed
+    if isinstance(mesh, LogicalMesh):
+        return LogicalMesh(**new_sizes)
+    return make_mesh(devices, **new_sizes)
 
 
 def auto_mesh(n_devices=None, tp=1, sp=1, pp=1, ep=1):

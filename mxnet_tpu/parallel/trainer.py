@@ -481,6 +481,34 @@ class ShardedTrainer(object):
         return (restored["params"], restored["opt_state"],
                 restored["aux"], step)
 
+    def elastic_resume(self, directory, data_shapes, label_shapes=None,
+                       dtype=_np.float32):
+        """:meth:`auto_resume` for a re-meshed incarnation — the
+        resharded-resume seam of elastic training.
+
+        Identical restore mechanics (``abstract_state`` supplies
+        ShapeDtypeStruct+sharding targets for THIS trainer's mesh, and
+        orbax reshards the saved leaves into the new layout on
+        restore — a checkpoint written under the old world size comes
+        back placed for the new one), plus the ``elastic`` telemetry
+        record every transition must leave: an ``event="resume"``
+        stamped with the incarnation's generation and world size, so
+        ``mxtop`` and the ``--fault`` timelines show where the
+        topology changed and what step training picked back up from.
+        """
+        got = self.auto_resume(directory, data_shapes, label_shapes,
+                               dtype)
+        from ..resilience import elastic as _elastic
+        try:
+            world = jax.process_count()
+        except Exception:
+            world = 1
+        _elastic.emit_transition(
+            "resume", step=None if got is None else got[3],
+            world_size=world, fresh=got is None,
+            mesh={a: int(s) for a, s in self.mesh.shape.items()})
+        return got
+
     def shard_batch(self, batch):
         """Place host batch arrays onto the mesh with dp/sp sharding —
         the analog of executor_manager.load_data_batch slicing.

@@ -20,6 +20,10 @@ silent hang inside a collective.  This package supplies the pieces:
   with skip-step, dynamic loss-scale backoff, and a rolling
   last-good-step record (host-side here; the compiled in-step gate
   lives in ``parallel.trainer.ShardedTrainer(sentinel=True)``).
+- **topology change** → :mod:`.elastic`: the agreed re-mesh protocol
+  (generation-stamped verdicts over the coordination KV, ledger-backed
+  generation fencing) that lets ``tools/launch.py --elastic`` shrink a
+  pod onto its survivors and grow it back when capacity returns.
 - **testability** → :mod:`.faultinject`: a deterministic fault
   injector (env ``MXTPU_FAULT_SPEC``) that plants NaN grads,
   checkpoint-write crashes, slow/hung steps, and dead-node reports at
@@ -151,8 +155,10 @@ from .watchdog import Watchdog, run_with_timeout  # noqa: E402
 from .retry import RetryPolicy, retry_call  # noqa: E402
 from .sentinel import Sentinel  # noqa: E402
 from .ckptmgr import CheckpointManager, latest_classic_epoch  # noqa: E402
+from . import elastic  # noqa: E402
 
 __all__ = [
+    "elastic",
     "EXIT_RESTART", "ResilienceError", "exit_for_restart",
     "install_excepthook",
     "step_timeout_s", "retry_max", "ckpt_keep", "sentinel_enabled",

@@ -89,11 +89,26 @@ class CheckpointManager(object):
     keep : int, optional
         Checkpoints retained (keep-last-K); defaults to
         ``MXTPU_CKPT_KEEP`` (3).  ``keep <= 0`` disables pruning.
+    payload_format : str, optional
+        ``"orbax"`` (default): coordinated sharded writes via
+        ``ocp_save`` — every rank contributes its shards.  ``"host"``:
+        the backend-free replicated-host writer (``host_save``) —
+        rank 0 writes the whole tree, for backends that cannot run
+        orbax's cross-process coordination at all (multi-process CPU,
+        where the elastic drills live).  The commit protocol
+        (tmp + rename, barriers, pruning) is identical; restore sniffs
+        the format from the checkpoint itself, so the two interoperate
+        at the directory level.
     """
 
-    def __init__(self, directory, keep=None, logger=None):
+    def __init__(self, directory, keep=None, logger=None,
+                 payload_format="orbax"):
+        if payload_format not in ("orbax", "host"):
+            raise ValueError("payload_format must be 'orbax' or 'host', "
+                             "got %r" % (payload_format,))
         self.directory = _os.path.abspath(str(directory))
         self.keep = ckpt_keep() if keep is None else int(keep)
+        self.payload_format = payload_format
         self.logger = logger or logging
 
     # ------------------------------------------------------------------
@@ -130,7 +145,7 @@ class CheckpointManager(object):
         checkpoint is durable AND committed.  Returns the committed
         path.
         """
-        from ..parallel.ckpt import ocp_save
+        from ..parallel.ckpt import host_save, ocp_save
         from .faultinject import maybe_fault
         from ..observability import spans as _spans
         step = int(step)
@@ -157,7 +172,10 @@ class CheckpointManager(object):
             # ocp_save's own commit protocol is redundant under the
             # manager (tmp IS the scratch name); atomic=False writes
             # tmp directly
-            ocp_save(tmp, tree, step, atomic=False)
+            if self.payload_format == "host":
+                host_save(tmp, tree, step)
+            else:
+                ocp_save(tmp, tree, step, atomic=False)
             maybe_fault("ckpt_commit", step=step)
             _barrier("mxtpu_ckpt_commit_%d" % step)
             if _is_coordinator():
@@ -172,16 +190,46 @@ class CheckpointManager(object):
     def restore(self, abstract_tree, step=None):
         """Restore ``step`` (default: latest committed).
 
-        Returns ``(tree, step)``; raises if nothing is committed.
+        Returns ``(tree, step)``; raises if nothing is committed, and
+        raises a structured :class:`~mxnet_tpu.resilience
+        .ResilienceError` (kind=``restore_mismatch``) naming every
+        disagreeing leaf when the abstract target's shapes/dtypes or
+        tree structure do not match the saved checkpoint.  The check
+        runs BEFORE the restore because orbax would otherwise either
+        surface an opaque key-diff stack or — worse, for unsharded
+        targets — silently hand back the saved shapes.  This is the
+        first error a mis-wired resharded resume hits; shardings are
+        deliberately NOT compared (resharding on restore is the point).
         """
         if step is None:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(
                     "no committed checkpoint under %s" % self.directory)
-        from ..parallel.ckpt import ocp_restore
-        tree, saved_step = ocp_restore(self.step_path(step), abstract_tree)
-        _emit_ckpt("resume", saved_step, self.step_path(step))
+        from ..parallel.ckpt import (describe_restore_mismatch,
+                                     host_restore, is_host_format,
+                                     ocp_restore)
+        path = self.step_path(step)
+        mismatches = describe_restore_mismatch(path, abstract_tree)
+        if mismatches:
+            from . import ResilienceError
+            detail = "; ".join(
+                "%s: checkpoint has %s, restore target wants %s"
+                % (leaf, saved, want)
+                for leaf, saved, want in mismatches[:8])
+            if len(mismatches) > 8:
+                detail += "; ... %d more" % (len(mismatches) - 8)
+            raise ResilienceError(
+                "checkpoint %s does not match the restore target "
+                "(%d leaf mismatch%s): %s"
+                % (path, len(mismatches),
+                   "" if len(mismatches) == 1 else "es", detail),
+                phase="ckpt_restore", step=step, kind="restore_mismatch")
+        if is_host_format(path):
+            tree, saved_step = host_restore(path, abstract_tree)
+        else:
+            tree, saved_step = ocp_restore(path, abstract_tree)
+        _emit_ckpt("resume", saved_step, path)
         return tree, saved_step
 
     def auto_resume(self, abstract_tree):

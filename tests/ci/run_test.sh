@@ -64,6 +64,12 @@ case "${TASK:-python}" in
       --distributed --world-size 4 --fail-on=error --format=github
     JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
       --world-size 4 mxnet_tpu --fail-on=error --format=github
+    # the elastic re-mesh protocol is the most divergence-sensitive
+    # code in the tree (rank 0 proposes, everyone else adopts): pin
+    # its self-lint as an explicit leg so a sweep-config change can
+    # never silently drop it
+    JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+      mxnet_tpu/resilience/elastic.py --fail-on=error --format=github
     # the pre-fix PR-3 regression fixtures are expected-FAIL inputs:
     # MXL-D must keep flagging each with its documented rule id
     fx=tests/fixtures/divergence
@@ -101,7 +107,15 @@ case "${TASK:-python}" in
     # fault-injection matrix (docs/resilience.md): injected NaN/hang/
     # ckpt-crash/dead-node faults must each hit their recovery path,
     # plus the kill-one-worker resume smoke
-    JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
+    JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+      --deselect tests/test_resilience.py::test_elastic_shrink_grow_drill
+    # elasticity acceptance (docs/resilience.md "Elasticity"): its own
+    # leg so a skip/deselect upstream can never silently drop it —
+    # kill one of three workers, agree a generation-stamped shrink
+    # verdict, resume resharded, grow back, and match the fixed-world
+    # reference losses bit-for-bit
+    JAX_PLATFORMS=cpu python -m pytest -q \
+      tests/test_resilience.py::test_elastic_shrink_grow_drill
     # lint must stay clean under the resilience wiring (github-annotated
     # output so findings land on the PR diff)
     JAX_PLATFORMS=cpu python tools/mxlint.py --all-models \

@@ -1,0 +1,246 @@
+"""Shrink/grow elasticity drill: kill one worker -> agreed re-mesh ->
+resharded resume -> grow back (docs/resilience.md "Elasticity").
+
+Run under the elastic supervise loop (the wrapper in
+tests/test_resilience.py does this):
+
+    python tools/launch.py -n 3 --elastic --min-world 2 \
+        --elastic-dir <dir> --max-restarts 4 \
+        python tests/nightly/dist_elastic.py
+
+One launch covers the whole timeline; the script keys its behavior off
+the generation the launcher stamped into the environment:
+
+  generation 0 (world 3): epochs 0,1 checkpoint as steps 1,2.  After
+      step 2 commits the victim (MXTPU_DRILL_KILL, default rank 2 at
+      epoch 1) drops the capacity file to 2 and dies without goodbye.
+      The post-epoch agreement round sees a still-fresh heartbeat and
+      publishes "no verdict"; epoch 2's first allreduce then wedges on
+      the dead peer, the kvstore watchdog aborts it within
+      MXTPU_STEP_TIMEOUT_S, and the survivors confirm the death in a
+      ``recover-2`` agreement round -> shrink verdict (generation 1,
+      world 2) -> EXIT_RESTART.
+  generation 1 (world 2): resumes from step 2, re-partitions the SAME
+      seeded epoch-2 batch permutation across 2 parts, trains epoch 2
+      (step 3).  MXTPU_DRILL_GROW (default: capacity back to 3 at
+      epoch 2) raises the capacity signal; the post-epoch round
+      proposes the grow verdict (generation 2, world 3) -> restart.
+  generation 2 (world 3): resumes from step 3, trains epochs 3,4
+      (steps 4,5), polls find nothing to change, exits 0 -- which ends
+      the supervise loop.
+
+Reference mode (MXTPU_ELASTIC_REFERENCE=1 + MXTPU_RESUME_STEP=N +
+MXTPU_STOP_EPOCH=M): restore exactly step N, train epochs N..M-1 with
+no polls/kills/checkpoint writes and record the loss trajectory --
+the wrapper launches one per transition and asserts the elastic run's
+post-transition losses are identical (the agreement protocol must not
+perturb the math; training is deterministic end-to-end: seeded init,
+seeded per-epoch partition, rank-ordered KV allreduce).
+
+Artifacts under MXTPU_ELASTIC_DIR: ``losses-elastic.jsonl`` (rank 0,
+one line per finished epoch, appended across incarnations),
+``losses-ref-w<W>-s<N>.jsonl`` (reference runs), and
+``part-g<G>-e<E>-r<R>.json`` (the sample indices each rank actually
+drew -- the wrapper asserts each completed epoch's parts tile the
+dataset exactly).
+
+Exit codes: 0 done, 3 restart signal (re-mesh agreed), 4 drill
+assertion failure.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.resilience import elastic
+
+TOTAL_EPOCHS = 5
+BATCH = 20
+DATA_SEED = 11          # seeded shuffle: batch order = f(seed, epoch)
+INIT_SEED = 5           # rank-uniform init (np global RNG feeds Uniform)
+DEAD_TIMEOUT = 6.0
+
+
+def build_data():
+    rng = np.random.RandomState(7)     # every rank builds the full set;
+    X = rng.randn(240, 16).astype(np.float32)   # the iterator partitions
+    w = rng.randn(16)
+    y = (X @ w > 0).astype(np.float32)
+    return X, y
+
+
+def tree_of(mod):
+    args, auxs = mod.get_params()
+    return {"args": {k: v.asnumpy() for k, v in args.items()},
+            "aux": {k: v.asnumpy() for k, v in auxs.items()}}
+
+
+def abstract_tree_of(mod):
+    args, auxs = mod.get_params()
+    return {"args": {k: np.zeros_like(v.asnumpy()) for k, v in args.items()},
+            "aux": {k: np.zeros_like(v.asnumpy()) for k, v in auxs.items()}}
+
+
+def load_tree(mod, tree):
+    mod.set_params({k: mx.nd.array(v) for k, v in tree["args"].items()},
+                   {k: mx.nd.array(v) for k, v in tree["aux"].items()})
+
+
+def eval_loss(mod, eval_it):
+    losses = []
+    for batch in eval_it:
+        mod.forward(batch, is_train=False)
+        p = mod.get_outputs()[0].asnumpy()
+        lbl = batch.label[0].asnumpy().astype(int)
+        losses.append(-np.log(p[np.arange(len(lbl)), lbl] + 1e-8).mean())
+    eval_it.reset()
+    return float(np.mean(losses))
+
+
+def _spec(name, default):
+    """'a:b:c' -> (a, b, c) ints, or None when set to empty."""
+    raw = os.environ.get(name, default)
+    if not raw:
+        return None
+    return tuple(int(p) for p in raw.split(":"))
+
+
+def _write_capacity(value):
+    with open(elastic.capacity_path(), "w") as f:
+        f.write("%d\n" % value)
+
+
+def _record_loss(path, gen, world, epoch, step, loss):
+    with open(path, "a") as f:
+        f.write(json.dumps({"generation": gen, "world": world,
+                            "epoch": epoch, "step": step,
+                            "loss": loss}, sort_keys=True) + "\n")
+
+
+def _record_partition(edir, gen, epoch, rank, world, idx):
+    path = os.path.join(edir, "part-g%d-e%03d-r%02d.json" % (gen, epoch,
+                                                             rank))
+    with open(path, "w") as f:
+        json.dump({"generation": gen, "epoch": epoch, "rank": rank,
+                   "world": world,
+                   "indices": sorted(int(i) for i in idx)}, f)
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    gen = elastic.generation()
+    reference = os.environ.get("MXTPU_ELASTIC_REFERENCE") == "1"
+    edir = elastic.elastic_dir()
+    os.makedirs(edir, exist_ok=True)
+    ckptdir = os.path.join(edir, "ckpt")
+
+    X, y = build_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=BATCH, shuffle=True,
+                              seed=DATA_SEED, num_parts=nw,
+                              part_index=rank)
+    # same batch size as the bound training shapes (Module binds once)
+    eval_it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+
+    net = mx.models.get_mlp(num_classes=2, hidden=(16,))
+    mod = mx.mod.Module(net, context=mx.context.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    np.random.seed(INIT_SEED)            # rank-uniform starting params
+    mod.init_params(mx.init.Uniform(0.1))
+
+    # keep=0: the reference runs restore intermediate steps later
+    mgr = mx.resilience.CheckpointManager(ckptdir, keep=0,
+                                          payload_format="host")
+    abstract = abstract_tree_of(mod)
+    if reference:
+        step = int(os.environ["MXTPU_RESUME_STEP"])
+        tree, step = mgr.restore(abstract, step=step)
+        load_tree(mod, tree)
+        start_epoch, stop_epoch = step, int(os.environ["MXTPU_STOP_EPOCH"])
+        loss_path = os.path.join(edir,
+                                 "losses-ref-w%d-s%d.jsonl" % (nw, step))
+    else:
+        got = mgr.auto_resume(abstract)
+        if got is not None:
+            load_tree(mod, got[0])
+        start_epoch = 0 if got is None else got[1]
+        stop_epoch = TOTAL_EPOCHS
+        loss_path = os.path.join(edir, "losses-elastic.jsonl")
+        elastic.emit_transition("resume", step=start_epoch, world_size=nw,
+                                fresh=got is None)
+        print("rank %d gen %d world %d: %s at epoch %d" % (
+            rank, gen, nw,
+            "fresh start" if got is None else "resumed step %d" % got[1],
+            start_epoch), flush=True)
+
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.3})
+
+    kill = _spec("MXTPU_DRILL_KILL", "0:1:2")    # gen:epoch:rank
+    grow = _spec("MXTPU_DRILL_GROW", "1:2:3")    # gen:epoch:capacity
+
+    for epoch in range(start_epoch, stop_epoch):
+        train.set_state({"epoch": epoch, "cursor": -train.batch_size})
+        if not reference:
+            _record_partition(edir, gen, epoch, rank, nw, train.idx)
+        try:
+            for batch in train:
+                mod.forward_backward(batch)
+                mod.update()
+                if not reference and kv.dead_nodes(timeout=DEAD_TIMEOUT):
+                    raise mx.resilience.ResilienceError(
+                        "dead peer detected mid-epoch",
+                        phase="drill_liveness", rank=rank)
+        except Exception as exc:  # noqa: BLE001 - fault path by design
+            if reference:
+                raise
+            print("rank %d gen %d epoch %d failed (%s); recovery round"
+                  % (rank, gen, epoch, exc), flush=True)
+            try:
+                verdict = elastic.poll_remesh(
+                    kv, elastic.recover_round(epoch),
+                    dead_timeout=DEAD_TIMEOUT)
+            except mx.resilience.ResilienceError as orphan:
+                mx.resilience.exit_for_restart(orphan)
+            if verdict is not None:
+                elastic.exit_for_remesh(verdict)
+            print("rank %d FAILED: epoch blew up with all peers live"
+                  % rank, flush=True)
+            os._exit(4)
+        loss = eval_loss(mod, eval_it)
+        print("rank %d gen %d epoch %d loss %.6f" % (rank, gen, epoch,
+                                                     loss), flush=True)
+        if rank == 0:
+            _record_loss(loss_path, gen, nw, epoch, epoch + 1, loss)
+        if reference:
+            continue
+        kv.barrier()
+        mgr.save(tree_of(mod), epoch + 1)
+        if kill is not None and (gen, epoch, rank) == kill:
+            _write_capacity(nw - 1)      # capacity drops WITH the node
+            print("rank %d: simulated preemption (capacity -> %d)"
+                  % (rank, nw - 1), flush=True)
+            sys.stdout.flush()
+            os._exit(1)                  # dies without saying goodbye
+        if grow is not None and gen == grow[0] and epoch == grow[1] \
+                and rank == 0:
+            _write_capacity(grow[2])     # capacity came back
+        try:
+            verdict = elastic.poll_remesh(kv, epoch,
+                                          dead_timeout=DEAD_TIMEOUT)
+        except mx.resilience.ResilienceError as orphan:
+            # coordinator died before publishing: restart and let the
+            # launcher bump the generation itself
+            mx.resilience.exit_for_restart(orphan)
+        if verdict is not None:
+            elastic.exit_for_remesh(verdict)
+
+    print("rank %d done at gen %d (world %d)" % (rank, gen, nw),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

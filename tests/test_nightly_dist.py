@@ -88,3 +88,37 @@ def test_dist_sharded_checkpoint(tmp_path):
     out = _launch("dist_sharded_ckpt.py", port=9897,
                   extra_env={"MXTPU_SHCKPT_DIR": str(tmp_path)})
     assert "OK sharded checkpoint across processes" in out, out[-1500:]
+
+
+def test_elastic_coordinator_loss_orphan_path(tmp_path):
+    """Elasticity's worst case: the COORDINATOR dies, so no shrink
+    verdict is ever published.  Survivors take the orphan path (exit
+    for restart without an agreement), the supervise loop bumps the
+    generation itself and clamps to the dropped capacity, and the run
+    still finishes: world 3 -> 2 -> grown back to 3.  (The clean
+    agreed shrink/grow drill runs in tier-1, tests/test_resilience.py
+    ::test_elastic_shrink_grow_drill.)"""
+    edir = str(tmp_path / "elastic")
+    cmd = [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+           "-n", "3", "--launcher", "local", "--workdir", _ROOT,
+           "--port", "9898", "--elastic", "--min-world", "2",
+           "--elastic-dir", edir, "--max-restarts", "4",
+           sys.executable,
+           os.path.join("tests", "nightly", "dist_elastic.py")]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env.update({"MXTPU_STEP_TIMEOUT_S": "12",
+                "MXTPU_DRILL_KILL": "0:1:0"})     # rank 0 is the victim
+    proc = subprocess.run(cmd, cwd=_ROOT, env=env, timeout=600,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True)
+    assert proc.returncode == 0, (proc.returncode, proc.stdout[-3000:])
+    assert "no newer verdict in ledger" in proc.stdout
+    import json
+    with open(os.path.join(edir, "losses-elastic.jsonl")) as f:
+        rows = [json.loads(line) for line in f]
+    assert [r["epoch"] for r in rows] == [0, 1, 2, 3, 4]
+    assert [r["world"] for r in rows] == [3, 3, 2, 3, 3]
+    with open(os.path.join(edir, "LEDGER.json")) as f:
+        led = json.load(f)
+    assert led["generation"] == 2 and led["world_size"] == 3

@@ -207,6 +207,29 @@ def test_build_report_straggler_and_faults():
     assert kinds == ["fault", "ckpt"]
 
 
+def test_build_report_elastic_generation_rollup():
+    """Elastic records are incidents AND set the pod's current
+    generation/world (newest wins) plus each rank's adopted
+    generation (docs/resilience.md "Elasticity")."""
+    recs = [
+        _mk("step", 0, 1000, step=0, dur_ms=10.0),
+        _mk("elastic", 0, 1001, event="propose", generation=1,
+            world_size=2, reason="dead_node", from_world=3),
+        _mk("elastic", 1, 1002, event="adopt", generation=1,
+            world_size=2, reason="dead_node", from_world=3),
+        _mk("elastic", 0, 1003, event="resume", generation=2,
+            world_size=3),
+    ]
+    rep = aggregate.build_report(recs)
+    pod = rep["pod"]
+    assert pod["generation"] == 2
+    assert pod["world_size"] == 3
+    assert pod["last_elastic"]["event"] == "resume"
+    assert rep["per_rank"]["0"]["generation"] == 2
+    assert rep["per_rank"]["1"]["generation"] == 1
+    assert [r["kind"] for r in rep["incidents"]] == ["elastic"] * 3
+
+
 def test_read_events_skips_torn_lines(tmp_path):
     p = tmp_path / "events-rank00000.jsonl"
     p.write_text('{"kind":"step","rank":0,"wall_ms":2}\n'
@@ -242,6 +265,33 @@ def test_mxtop_json(monkeypatch, tmp_path):
     assert "mfu" in rep["pod"]
     assert rep["per_rank"]["0"]["last_fault"]["fault"] == \
         "watchdog_timeout"
+
+
+def test_mxtop_surfaces_elastic_generation(monkeypatch, tmp_path):
+    """The pod report shows the current generation/world and --fault
+    timelines anchor on elastic transitions too."""
+    d = _enable(monkeypatch, tmp_path)
+    obs.record_step(0, 0.01, batch_size=8)
+    events.emit("elastic", event="propose", generation=1, world_size=2,
+                reason="dead_node", from_world=3)
+    events.emit("elastic", event="resume", generation=1, world_size=2)
+    events.flush()
+    env = dict(os.environ)
+    env.pop("MXTPU_TELEMETRY", None)
+    mxtop = os.path.join(_ROOT, "tools", "mxtop.py")
+    out = subprocess.run([sys.executable, mxtop, d],
+                         capture_output=True, text=True, env=env,
+                         timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "elastic generation 1" in out.stdout, out.stdout
+    assert "world size 2" in out.stdout
+    out = subprocess.run([sys.executable, mxtop, d, "--fault"],
+                         capture_output=True, text=True, env=env,
+                         timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "elastic propose generation 1 (world 2)" in out.stdout, \
+        out.stdout
+    assert "elastic resume generation 1 (world 2)" in out.stdout
 
 
 # ----------------------------------------------------------------------

@@ -7,6 +7,8 @@ reports, plus the 2-worker kill-and-resume smoke (the full drill stays
 in tests/nightly/dist_resume.py; phases A+B run here too, promoted to
 tier-1).
 """
+import glob
+import json
 import os
 import subprocess
 import sys
@@ -697,3 +699,381 @@ def test_kill_and_resume_smoke(tmp_path):
                   extra_env={"MXTPU_RESUME": "1",
                              "MXTPU_RESUME_PREFIX": prefix})
     assert out.count("resume OK") == 2, out[-1500:]
+
+
+# ----------------------------------------------------------------------
+# elastic re-mesh: liveness identities, ledger/fence, decision protocol
+# ----------------------------------------------------------------------
+from mxnet_tpu.resilience import elastic  # noqa: E402
+
+
+def test_dead_nodes_returns_sorted_identities(monkeypatch):
+    from mxnet_tpu import kvstore as kvs
+    clock = {"now": 1000.0}
+    fake = _FakeClient()
+    monkeypatch.setattr(kvs, "_now", lambda: clock["now"])
+    monkeypatch.setattr(kvs, "_dist_client", lambda: fake)
+    monkeypatch.setattr(kvs.jax, "process_count", lambda: 3)
+    kv = kvs.KVStore("dist_sync")
+    fake.kv["mxtpu_hb/0"] = repr(1000.0)
+    fake.kv["mxtpu_hb/1"] = repr(1000.0)
+    fake.kv["mxtpu_hb/2"] = repr(1000.0)
+    assert kv.dead_nodes(timeout=10.0) == []
+    clock["now"] = 1011.0
+    fake.kv["mxtpu_hb/1"] = repr(1010.0)       # only 1 kept beating
+    assert kv.dead_nodes(timeout=10.0) == [0, 2]
+    assert kv.dead_nodes(node_id=2, timeout=10.0) == [2]
+    assert kv.dead_nodes(node_id=1, timeout=10.0) == []
+    assert kv.num_dead_nodes(timeout=10.0) == 2
+    assert kvs.KVStore("local").dead_nodes() == []
+
+
+def test_elastic_ledger_round_trip_and_knobs(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_ELASTIC_DIR", str(tmp_path))
+    assert elastic.read_ledger() is None       # fresh: unreadable = None
+    verdict = {"generation": 3, "world_size": 2, "members": [0, 1],
+               "reason": "dead_node", "from_world": 3}
+    elastic.write_ledger(verdict)
+    assert elastic.read_ledger() == verdict
+    assert not os.path.exists(elastic.ledger_path() + ".tmp")
+    # generation(): env stamp wins, ledger is the fallback
+    monkeypatch.delenv("MXTPU_ELASTIC_GENERATION", raising=False)
+    assert elastic.generation() == 3
+    monkeypatch.setenv("MXTPU_ELASTIC_GENERATION", "5")
+    assert elastic.generation() == 5
+    # capacity file: absent -> default, garbage -> default
+    assert elastic.capacity() is None
+    with open(elastic.capacity_path(), "w") as f:
+        f.write("2\n")
+    assert elastic.capacity() == 2
+    monkeypatch.setenv("MXTPU_ELASTIC_MIN_WORLD", "2")
+    assert elastic.min_world() == 2
+    monkeypatch.setenv("MXTPU_ELASTIC_TARGET_WORLD", "4")
+    assert elastic.target_world() == 4
+
+
+def test_generation_fence_stale_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_ELASTIC_DIR", str(tmp_path))
+    monkeypatch.setenv("MXTPU_ELASTIC_GENERATION", "0")
+    elastic.write_ledger({"generation": 1, "world_size": 2})
+    # not elastic -> never fences (plain jobs must be unaffected)
+    monkeypatch.delenv("MXTPU_ELASTIC", raising=False)
+    elastic.check_generation_fence()
+    monkeypatch.setenv("MXTPU_ELASTIC", "1")
+    with pytest.raises(ResilienceError) as ei:
+        elastic.check_generation_fence()
+    assert ei.value.kind == "stale_generation"
+    # at or past the agreed generation: clean
+    monkeypatch.setenv("MXTPU_ELASTIC_GENERATION", "1")
+    elastic.check_generation_fence()
+
+
+class _FakeElasticKV(object):
+    def __init__(self, rank, num_workers, dead=()):
+        self.rank = rank
+        self.num_workers = num_workers
+        self._dead = sorted(dead)
+
+    def dead_nodes(self, node_id=None, timeout=None):
+        return list(self._dead)
+
+
+class _FakePollClient(object):
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self.kv[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key in self.kv:
+            return self.kv[key]
+        raise RuntimeError("DEADLINE_EXCEEDED waiting for %s" % key)
+
+    def key_value_delete(self, key):
+        self.kv.pop(key, None)
+
+
+@pytest.fixture
+def _elastic_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_ELASTIC", "1")
+    monkeypatch.setenv("MXTPU_ELASTIC_DIR", str(tmp_path))
+    monkeypatch.setenv("MXTPU_ELASTIC_GENERATION", "0")
+    monkeypatch.setenv("MXTPU_ELASTIC_TARGET_WORLD", "3")
+    client = _FakePollClient()
+    monkeypatch.setattr(elastic, "_kv_client", lambda: client)
+    return client
+
+
+def test_poll_remesh_shrink_verdict(_elastic_env, monkeypatch):
+    client = _elastic_env
+    kv = _FakeElasticKV(0, 3, dead=[2])
+    verdict = elastic.poll_remesh(kv, elastic.recover_round(2),
+                                  dead_timeout=6.0)
+    assert verdict["generation"] == 1
+    assert verdict["world_size"] == 2
+    assert verdict["members"] == [0, 1]
+    assert verdict["reason"] == "dead_node"
+    assert verdict["from_world"] == 3
+    # ledger persisted BEFORE publication; key carries generation+round
+    assert elastic.read_ledger() == verdict
+    key = "mxtpu_elastic/poll/0/recover-2"
+    assert json.loads(client.kv[key]) == verdict
+    # a survivor adopting the same round reads the identical verdict
+    kv1 = _FakeElasticKV(1, 3)
+    assert elastic.poll_remesh(kv1, elastic.recover_round(2),
+                               timeout_s=1.0) == verdict
+
+
+def test_poll_remesh_grow_toward_capacity_capped_at_target(
+        _elastic_env, tmp_path):
+    with open(elastic.capacity_path(), "w") as f:
+        f.write("5")                           # more than we ever want
+    kv = _FakeElasticKV(0, 2)
+    verdict = elastic.poll_remesh(kv, 7)
+    assert verdict["reason"] == "grow"
+    assert verdict["world_size"] == 3          # capped at target, not 5
+    assert verdict["members"] == [0, 1, 2]
+
+
+def test_poll_remesh_no_verdict_publishes_marker(_elastic_env):
+    client = _elastic_env
+    kv = _FakeElasticKV(0, 3)
+    assert elastic.poll_remesh(kv, 4) is None
+    assert client.kv["mxtpu_elastic/poll/0/4"] == "none"
+    # the no-op marker is what non-coordinators read: no race, no guess
+    assert elastic.poll_remesh(_FakeElasticKV(1, 3), 4,
+                               timeout_s=1.0) is None
+
+
+def test_poll_remesh_orphan_raises_for_restart(_elastic_env):
+    kv = _FakeElasticKV(1, 3)                  # coordinator never writes
+    with pytest.raises(ResilienceError) as ei:
+        elastic.poll_remesh(kv, 9, timeout_s=0.1)
+    assert ei.value.kind == "remesh_orphan"
+
+
+def test_restore_mismatch_names_every_leaf_host_format(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=0, payload_format="host")
+    tree = {"w": np.ones((4, 4), np.float32),
+            "b": np.zeros((4,), np.float32)}
+    mgr.save(tree, 1)
+    got, step = mgr.restore({"w": np.zeros((4, 4), np.float32),
+                             "b": np.zeros((4,), np.float32)})
+    assert step == 1
+    assert np.array_equal(got["w"], tree["w"])
+    with pytest.raises(ResilienceError) as ei:
+        mgr.restore({"w": np.zeros((2, 4), np.float32),
+                     "b": np.zeros((4,), np.float64)})
+    err = ei.value
+    assert err.kind == "restore_mismatch"
+    msg = str(err)
+    assert "w" in msg and "(2, 4)" in msg      # the mismatched leaf,
+    assert "b" in msg and "float64" in msg     # named with its want/got
+
+
+def test_restore_mismatch_orbax_format(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=0)
+    mgr.save({"w": np.arange(8, dtype=np.float32)}, 2)
+    with pytest.raises(ResilienceError) as ei:
+        mgr.restore({"w": np.zeros((3,), np.float32)})
+    assert ei.value.kind == "restore_mismatch"
+    assert "w" in str(ei.value)
+    # structure mismatch (absent leaf) is named too, not an opaque diff
+    with pytest.raises(ResilienceError) as ei:
+        mgr.restore({"w": np.zeros((8,), np.float32),
+                     "extra": np.zeros((1,), np.float32)})
+    assert "extra" in str(ei.value)
+
+
+def test_checkpoint_world_size_round_trip(tmp_path):
+    """Satellite: save under dp=2, restore under dp=1, re-save, restore
+    under dp=2 — orbax reshards on restore and every leaf survives
+    bit-identical through both world-size changes."""
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    tr, params, opt_state, aux, batch = _trainer()
+    for _ in range(2):
+        params, opt_state, aux, _ = tr.step(params, opt_state, aux, batch)
+    tr.save_checkpoint_versioned(d1, params, opt_state, aux, keep=0)
+    want = _host(params)
+
+    mesh1 = parallel.make_mesh(jax.devices()[:1], dp=1)
+    opt = mx.optimizer.create("sgd", learning_rate=0.5, momentum=0.9,
+                              rescale_grad=1.0 / 16)
+    tr1 = parallel.ShardedTrainer(_mlp(), opt, mesh1)
+    resumed = tr1.auto_resume(d1, {"data": (16, 8)},
+                              label_shapes={"softmax_label": (16,)})
+    assert resumed is not None
+    p1, o1, a1, step = resumed
+    assert step == 2
+    mid = _host(p1)
+    for name in want:
+        assert np.array_equal(want[name], mid[name]), name
+    tr1.save_checkpoint_versioned(d2, p1, o1, a1, keep=0)
+
+    tr2, _, _, _, _ = _trainer()               # back to dp=2
+    p2, o2, a2, step2 = tr2.auto_resume(
+        d2, {"data": (16, 8)}, label_shapes={"softmax_label": (16,)})
+    assert step2 == 2
+    got = _host(p2)
+    for name in want:
+        assert np.array_equal(want[name], got[name]), name
+    # and the re-grown trainer still steps under the restored layout
+    tr2.step(p2, o2, a2, batch)
+
+
+def test_ndarrayiter_partition_tiles_dataset():
+    X = np.arange(100, dtype=np.float32).reshape(100, 1)
+    for shuffle in (False, True):
+        for nw in (1, 2, 3, 5):
+            for epoch in (0, 1, 4):
+                parts = []
+                for r in range(nw):
+                    it = mx.io.NDArrayIter(X, batch_size=10,
+                                           shuffle=shuffle, seed=11,
+                                           num_parts=nw, part_index=r)
+                    it.set_state({"epoch": epoch, "cursor": -10})
+                    parts.append([int(i) for i in it.idx])
+                flat = sorted(i for p in parts for i in p)
+                assert flat == list(range(100)), (shuffle, nw, epoch)
+    # stride partition of the SAME global permutation: a world-size
+    # change reassigns samples but never changes the epoch's order
+    a = mx.io.NDArrayIter(X, batch_size=10, shuffle=True, seed=11,
+                          num_parts=2, part_index=0).idx
+    b = mx.io.NDArrayIter(X, batch_size=10, shuffle=True, seed=11,
+                          num_parts=2, part_index=1).idx
+    full = mx.io.NDArrayIter(X, batch_size=10, shuffle=True, seed=11).idx
+    order = np.empty(100, dtype=full.dtype)
+    order[0::2], order[1::2] = a, b
+    assert np.array_equal(order, full)
+
+
+def test_ndarrayiter_partition_validation():
+    X = np.zeros((20, 1), np.float32)
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.NDArrayIter(X, batch_size=5, num_parts=2, part_index=2)
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.NDArrayIter(X, batch_size=5, num_parts=0)
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.NDArrayIter(X, batch_size=5, shuffle=True,
+                          num_parts=2, part_index=0)   # needs seed
+
+
+def test_remesh_axis_math():
+    lm = parallel.LogicalMesh(dp=4, tp=2)
+    assert dict(parallel.remesh(lm, total=6).shape) == {"dp": 3, "tp": 2}
+    with pytest.raises(ValueError):
+        parallel.remesh(lm, total=5)           # tp=2 doesn't divide 5
+    with pytest.raises(ValueError):
+        parallel.remesh(parallel.LogicalMesh(tp=2), total=4)  # no dp
+    with pytest.raises(ValueError):
+        parallel.remesh(lm)                    # LogicalMesh needs total=
+    m = parallel.make_mesh(jax.devices()[:4], dp=2, tp=2)
+    m2 = parallel.remesh(m, devices=jax.devices()[:6])
+    assert dict(m2.shape) == {"dp": 3, "tp": 2}
+    assert m2.devices is not None              # a live mesh, bindable
+
+
+# ----------------------------------------------------------------------
+# 3-worker shrink/grow drill (tier-1 promotion of
+# tests/nightly/dist_elastic.py under the elastic supervise loop)
+# ----------------------------------------------------------------------
+def _launch_raw(cmd_args, extra_env=None, expect_rc=0, timeout=420):
+    cmd = [sys.executable, os.path.join(_ROOT, "tools", "launch.py")] \
+        + cmd_args
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env.update(extra_env or {})
+    proc = subprocess.run(cmd, cwd=_ROOT, env=env, timeout=timeout,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                          text=True)
+    assert proc.returncode == expect_rc, (proc.returncode,
+                                          proc.stdout[-3000:])
+    return proc.stdout
+
+
+def test_elastic_shrink_grow_drill(tmp_path):
+    """The ISSUE 7 acceptance drill: 3 workers, one dies mid-training ->
+    survivors agree one generation-stamped shrink verdict, re-mesh to
+    world 2 and resume from the latest checkpoint; capacity returns ->
+    grow verdict back to world 3; every transition leaves the agreed
+    generation in the ledger and propose/adopt/resume telemetry with
+    matching generations on all ranks; post-transition loss
+    trajectories are bit-identical to fresh fixed-world runs from the
+    same checkpoints."""
+    edir = str(tmp_path / "elastic")
+    tdir = os.path.join(edir, "telemetry")
+    drill = os.path.join("tests", "nightly", "dist_elastic.py")
+    _launch_raw(["-n", "3", "--launcher", "local", "--workdir", _ROOT,
+                 "--port", "9906", "--elastic", "--min-world", "2",
+                 "--elastic-dir", edir, "--max-restarts", "4",
+                 sys.executable, drill],
+                extra_env={"MXTPU_STEP_TIMEOUT_S": "12",
+                           "MXTPU_TELEMETRY_DIR": tdir})
+
+    # final agreement: generation 2, grown back to world 3
+    with open(os.path.join(edir, "LEDGER.json")) as f:
+        led = json.load(f)
+    assert led["generation"] == 2 and led["world_size"] == 3
+    assert led["reason"] == "grow"
+
+    # one loss row per epoch, worlds 3,3 -> 2 -> 3,3 across generations
+    with open(os.path.join(edir, "losses-elastic.jsonl")) as f:
+        rows = [json.loads(line) for line in f]
+    assert [r["epoch"] for r in rows] == [0, 1, 2, 3, 4]
+    assert [r["world"] for r in rows] == [3, 3, 2, 3, 3]
+    assert [r["generation"] for r in rows] == [0, 0, 1, 2, 2]
+
+    # every completed epoch's partitions tile the dataset exactly:
+    # no sample dropped or duplicated through either transition
+    for gen, epoch, world in ((0, 0, 3), (0, 1, 3), (1, 2, 2),
+                              (2, 3, 3), (2, 4, 3)):
+        idx = []
+        for r in range(world):
+            p = os.path.join(edir, "part-g%d-e%03d-r%02d.json"
+                             % (gen, epoch, r))
+            with open(p) as f:
+                d = json.load(f)
+            assert d["world"] == world
+            idx += d["indices"]
+        assert sorted(idx) == list(range(240)), (gen, epoch)
+
+    # telemetry: propose/adopt pairs agree on generation+world+reason,
+    # and each incarnation emitted a resume for its whole world
+    recs = []
+    for path in glob.glob(os.path.join(tdir, "events-rank*.jsonl*")):
+        with open(path) as f:
+            recs += [json.loads(line) for line in f if line.strip()]
+    el = [r for r in recs if r.get("kind") == "elastic"]
+    props = {(r["generation"], r["world_size"], r["reason"])
+             for r in el if r["event"] == "propose"}
+    adopts = {(r["generation"], r["world_size"], r["reason"])
+              for r in el if r["event"] == "adopt"}
+    assert props == {(1, 2, "dead_node"), (2, 3, "grow")}
+    assert adopts == props
+    resumes = [(r["generation"], r["world_size"])
+               for r in el if r["event"] == "resume"]
+    assert resumes.count((0, 3)) == 3
+    assert resumes.count((1, 2)) == 2
+    assert resumes.count((2, 3)) == 3
+
+    # loss trajectory after each transition == a fresh fixed-world run
+    # resumed from the same checkpoint (the agreement protocol must not
+    # perturb the math)
+    for world, step, stop, port in ((2, 2, 3, "9912"), (3, 3, 5, "9913")):
+        _launch_raw(["-n", str(world), "--launcher", "local",
+                     "--workdir", _ROOT, "--port", port,
+                     sys.executable, drill],
+                    extra_env={"MXTPU_ELASTIC_DIR": edir,
+                               "MXTPU_ELASTIC_REFERENCE": "1",
+                               "MXTPU_RESUME_STEP": str(step),
+                               "MXTPU_STOP_EPOCH": str(stop)})
+        ref = os.path.join(edir, "losses-ref-w%d-s%d.jsonl" % (world,
+                                                               step))
+        with open(ref) as f:
+            ref_rows = [json.loads(line) for line in f]
+        assert ref_rows, "reference run recorded no losses"
+        by_epoch = {r["epoch"]: r for r in rows}
+        for r in ref_rows:
+            assert r["loss"] == by_epoch[r["epoch"]]["loss"], \
+                (world, r["epoch"])

@@ -24,8 +24,18 @@ IMPORTANT: worker scripts must call mx.kvstore.create('dist_*') BEFORE
 creating NDArrays or touching jax — jax.distributed.initialize has to run
 before the backend comes up (same rule as the reference, where the
 kvstore/ps rendezvous happens at import/create time, kvstore.py:360).
+
+Elastic mode (--elastic, docs/resilience.md "Elasticity"): the local
+launcher becomes a supervise loop.  Each incarnation runs at an agreed
+world size; when the workers exit EXIT_RESTART (3) after adopting a
+re-mesh verdict, the launcher reads the generation ledger the
+coordinator wrote (<elastic-dir>/LEDGER.json), respawns at the agreed
+world size with MXTPU_ELASTIC_GENERATION stamped one higher, and keeps
+going until the workers exit cleanly, fail hard, or the agreed world
+would dip below --min-world.
 """
 import argparse
+import json
 import os
 import shlex
 import signal
@@ -91,6 +101,7 @@ def launch_local(args, command):
     # trick a supervisor into restarting a non-restartable failure.
     import time as _time
     rc = 0
+    saw_signal = False
     live = list(procs)
     while live:
         still = []
@@ -99,6 +110,15 @@ def launch_local(args, command):
             if code is None:
                 still.append(p)
             elif code == 3:
+                # grace before the teardown: peers of an agreed re-mesh
+                # all exit 3 on their own within moments, and a SIGTERM
+                # mid-exit can tear away un-flushed telemetry (the
+                # elastic adopt trail); only genuinely hung siblings
+                # ride out the full window
+                deadline = _time.time() + 5.0
+                while _time.time() < deadline and \
+                        any(q.poll() is None for q in procs):
+                    _time.sleep(0.1)
                 for q in procs:
                     if q.poll() is None:
                         q.terminate()
@@ -110,10 +130,130 @@ def launch_local(args, command):
                 return 3
             else:
                 rc = rc or code
+                saw_signal = saw_signal or code < 0
         live = still
         if live:
             _time.sleep(0.1)
+    if saw_signal and getattr(args, "elastic", False):
+        # Elastic contract: a worker that died BY SIGNAL was preempted
+        # or torn down by the runtime, not failed by its own code — in
+        # particular, losing the jax coordinator process SIGABRTs every
+        # survivor from a C++ thread (xla client.h LOG(QFATAL)) before
+        # any Python orphan path can run.  Report the restart signal so
+        # the supervise loop can bump the generation and respawn at the
+        # surviving capacity; deliberate failures exit with positive
+        # codes and still end the loop above.
+        return 3
     return rc
+
+
+# ----------------------------------------------------------------------
+# elastic supervise loop (--elastic)
+# ----------------------------------------------------------------------
+# NOTE: the ledger/capacity readers are duplicated from
+# mxnet_tpu/resilience/elastic.py on purpose — the launcher must stay
+# importable without jax/mxnet_tpu (it is the thing that sets up the
+# environment those imports need).  Format contract: LEDGER.json is one
+# JSON object {"generation": int, "world_size": int, ...}; capacity is
+# a bare int in <elastic-dir>/capacity (or MXTPU_ELASTIC_CAPACITY_FILE).
+
+def _elastic_log(msg, *fmt):
+    sys.stderr.write("[launch.elastic] " + (msg % fmt if fmt else msg)
+                     + "\n")
+    sys.stderr.flush()
+
+
+def _read_ledger(path):
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def _read_capacity(elastic_dir):
+    path = os.environ.get("MXTPU_ELASTIC_CAPACITY_FILE") or \
+        os.path.join(elastic_dir, "capacity")
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def supervise_elastic(args, command):
+    """Run --launcher local under the elastic restart contract.
+
+    Incarnation k runs at the agreed world size with
+    MXTPU_ELASTIC_GENERATION=k's generation in the environment.  When
+    the pod exits EXIT_RESTART (3) the loop adopts the newer verdict
+    from the generation ledger if the coordinator committed one
+    (normal re-mesh), else bumps the generation itself (the orphan
+    path: coordinator died before publishing — the respawned pod
+    re-ranks from scratch, so same-world respawn is safe locally).
+    Any other exit code ends the loop and is returned as-is.
+    """
+    target = args.num_workers
+    min_world = max(int(args.min_world), 1)
+    elastic_dir = os.path.abspath(
+        args.elastic_dir or os.path.join(os.getcwd(), "mxtpu_elastic"))
+    os.makedirs(elastic_dir, exist_ok=True)
+    ledger_path = os.path.join(elastic_dir, "LEDGER.json")
+    base_port = args.port
+
+    gen, world = 0, target
+    led = _read_ledger(ledger_path)
+    if led is not None:      # resuming a supervised run mid-agreement
+        gen = int(led.get("generation", 0))
+        world = int(led.get("world_size", target))
+        _elastic_log("resuming from ledger: generation=%d world=%d",
+                     gen, world)
+
+    restarts = 0
+    while True:
+        cap = _read_capacity(elastic_dir)
+        if cap is not None and cap < world:
+            _elastic_log("capacity %d below agreed world %d; clamping",
+                         cap, world)
+            world = cap
+        if world < min_world:
+            _elastic_log("agreed world %d below --min-world %d; refusing "
+                         "to spawn (waiting for capacity is the "
+                         "operator's call)", world, min_world)
+            return 3
+        # inherited by build_env via os.environ — every rank of this
+        # incarnation sees the same generation stamp
+        os.environ["MXTPU_ELASTIC"] = "1"
+        os.environ["MXTPU_ELASTIC_DIR"] = elastic_dir
+        os.environ["MXTPU_ELASTIC_MIN_WORLD"] = str(min_world)
+        os.environ["MXTPU_ELASTIC_GENERATION"] = str(gen)
+        os.environ["MXTPU_ELASTIC_TARGET_WORLD"] = str(target)
+        args.num_workers = world
+        # fresh port per incarnation: the previous coordinator's socket
+        # may linger in TIME_WAIT past the respawn
+        args.port = base_port + (restarts % 32)
+        _elastic_log("incarnation %d: generation=%d world=%d port=%d",
+                     restarts, gen, world, args.port)
+        rc = launch_local(args, command)
+        if rc != 3:
+            _elastic_log("pod exited rc=%d after %d restart(s); done",
+                         rc, restarts)
+            return rc
+        restarts += 1
+        if args.max_restarts is not None and restarts > args.max_restarts:
+            _elastic_log("restart budget (%d) exhausted", args.max_restarts)
+            return 3
+        led = _read_ledger(ledger_path)
+        if led is not None and int(led.get("generation", -1)) > gen:
+            gen = int(led.get("generation"))
+            world = int(led.get("world_size", world))
+            _elastic_log("adopting verdict: generation=%d world=%d "
+                         "reason=%s", gen, world, led.get("reason"))
+        else:
+            gen += 1
+            _elastic_log("no newer verdict in ledger (coordinator lost?) "
+                         "— bumping generation to %d, same world", gen)
 
 
 def launch_ssh(args, command):
@@ -163,12 +303,28 @@ def main():
     parser.add_argument("--workdir", type=str, default=None)
     parser.add_argument("--devices-per-worker", type=int, default=2,
                         help="fake devices per process for --launcher local")
+    parser.add_argument("--elastic", action="store_true",
+                        help="supervise loop: respawn at the ledger-agreed "
+                             "world size on EXIT_RESTART (local only)")
+    parser.add_argument("--min-world", type=int, default=1,
+                        help="--elastic: refuse to spawn below this world "
+                             "size (MXTPU_ELASTIC_MIN_WORLD)")
+    parser.add_argument("--elastic-dir", type=str, default=None,
+                        help="--elastic: ledger/capacity directory "
+                             "(default ./mxtpu_elastic)")
+    parser.add_argument("--max-restarts", type=int, default=None,
+                        help="--elastic: give up after this many respawns")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.command:
         raise SystemExit("no command given")
 
-    if args.launcher == "local":
+    if args.elastic and args.launcher != "local":
+        raise SystemExit("--elastic is only supported with "
+                         "--launcher local")
+    if args.elastic:
+        rc = supervise_elastic(args, args.command)
+    elif args.launcher == "local":
         rc = launch_local(args, args.command)
     elif args.launcher == "ssh":
         rc = launch_ssh(args, args.command)

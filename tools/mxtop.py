@@ -51,6 +51,12 @@ def render(report, stream=sys.stdout):
     w("      straggler gap %s ms   slowest phase %s\n" % (
         _fmt(pod.get("straggler_gap_ms"), width=8),
         pod.get("slowest_phase") or "-"))
+    if pod.get("generation") is not None:
+        last = pod.get("last_elastic") or {}
+        w("      elastic generation %s   world size %s   last %s\n" % (
+            pod["generation"],
+            pod.get("world_size", "?"),
+            last.get("event") or "-"))
     if pod.get("phase_totals_ms"):
         w("      phase totals: %s\n" % "  ".join(
             "%s=%.1fms" % (k, v)
@@ -79,20 +85,26 @@ def render(report, stream=sys.stdout):
             w("  [%s] rank %s step %s %s %s\n" % (
                 rec.get("wall_ms"), rec.get("rank"), rec.get("step"),
                 rec.get("kind"),
-                rec.get("fault") or rec.get("phase") or rec.get("path")
-                or ""))
+                rec.get("fault") or rec.get("event") or rec.get("phase")
+                or rec.get("path") or ""))
 
 
 def render_fault_timelines(records, before, after, stream=sys.stdout):
     w = stream.write
-    hits = [i for i, r in enumerate(records) if r.get("kind") == "fault"]
+    hits = [i for i, r in enumerate(records)
+            if r.get("kind") in ("fault", "elastic")]
     if not hits:
         w("no fault events.\n")
         return
     for idx in hits:
         rec = records[idx]
-        w("--- fault %r at rank %s step %s ---\n" % (
-            rec.get("fault"), rec.get("rank"), rec.get("step")))
+        if rec.get("kind") == "elastic":
+            w("--- elastic %s generation %s (world %s) at rank %s ---\n"
+              % (rec.get("event", "?"), rec.get("generation", "?"),
+                 rec.get("world_size", "?"), rec.get("rank")))
+        else:
+            w("--- fault %r at rank %s step %s ---\n" % (
+                rec.get("fault"), rec.get("rank"), rec.get("step")))
         for ev in aggregate.timeline_around(records, idx, before, after):
             mark = ">>" if ev is rec else "  "
             w("%s [%s] r%s %-6s %s\n" % (
