@@ -610,6 +610,10 @@ def measure():
             payload.update(_measure_allreduce(jax))
         except Exception as exc:  # noqa: BLE001
             payload["allreduce_error"] = repr(exc)
+        try:
+            payload.update(_measure_overlap(jax))
+        except Exception as exc:  # noqa: BLE001
+            payload["overlap_error"] = repr(exc)
         if os.environ.get("BENCH_TRANSFORMER", "1") != "0":
             try:
                 payload.update(_measure_transformer(jax, platform))
@@ -884,6 +888,71 @@ def _measure_transformer(jax, platform):
     if notes:
         out["transformer_mfu_notes"] = "; ".join(notes)
     return out
+
+
+def _measure_overlap(jax):
+    """Input-pipeline overlap proof (docs/perf.md "Overlap"): a slow
+    synthetic feed behind DevicePrefetcher with telemetry routed to a
+    scratch dir, then :func:`overlap_report` over the recorded events.
+    ``overlap_ratio`` > 1 means the fetch/h2d host time ran UNDER the
+    step; the ``data_wait``/``h2d`` p50s show where per-batch host time
+    goes.  Wall-clock bounded: ~n_batches × (fetch + step) seconds."""
+    import shutil
+    import tempfile
+    import numpy as np
+    from mxnet_tpu import observability as obs
+    from mxnet_tpu.observability import events as _ev
+    from mxnet_tpu.observability.aggregate import read_events
+    from mxnet_tpu.observability.spans import overlap_report
+    from mxnet_tpu.parallel.overlap import DevicePrefetcher
+
+    n_batches = int(os.environ.get("BENCH_OVERLAP_BATCHES", "10"))
+    fetch_s = float(os.environ.get("BENCH_OVERLAP_FETCH_S", "0.03"))
+    tmp = tempfile.mkdtemp(prefix="mxtpu_bench_overlap_")
+    saved = {k: os.environ.get(k)
+             for k in ("MXTPU_TELEMETRY", "MXTPU_TELEMETRY_DIR")}
+    os.environ["MXTPU_TELEMETRY"] = "1"
+    os.environ["MXTPU_TELEMETRY_DIR"] = tmp
+    try:
+        _ev.refresh()
+        rng = np.random.RandomState(0)
+
+        def slow_feed():
+            while True:
+                time.sleep(fetch_s)     # stands in for decode/augment
+                yield rng.rand(64, 64).astype(np.float32)
+
+        compute = jax.jit(lambda x: jax.numpy.tanh(x @ x))
+        pf = DevicePrefetcher(slow_feed(), place_fn=jax.device_put,
+                              name="bench-overlap")
+        try:
+            # +1: the first step record only bounds the steady-state
+            # window (compile exclusion) — it is not counted
+            for i in range(n_batches + 1):
+                batch = next(pf)
+                t0 = time.perf_counter()
+                compute(batch).block_until_ready()
+                time.sleep(fetch_s)     # stands in for device compute
+                obs.record_step(i, time.perf_counter() - t0)
+        finally:
+            pf.close()
+        obs.flush()
+        rep = overlap_report(read_events(tmp))
+        out = {"overlap_ratio": rep["overlap_ratio"]}
+        p50 = rep.get("phase_p50_ms") or {}
+        if "data_wait" in p50:
+            out["data_wait_ms_p50"] = p50["data_wait"]
+        if "h2d" in p50:
+            out["h2d_ms_p50"] = p50["h2d"]
+        return out
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _ev.refresh()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _measure_allreduce(jax):

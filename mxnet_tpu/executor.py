@@ -176,6 +176,33 @@ def _mirror_segments(op_nodes):
     return [(m, nodes) for m, nodes, _stage in segments]
 
 
+# Cross-symbol program registry (docs/perf.md "Overlap", compile cache):
+# the per-symbol _jit_cache only helps when the SAME Symbol object is
+# rebound, but common flows (module rebind after a bucketing change,
+# Executor.reshape, rebuilding the net from the same script) produce a
+# *fresh* Symbol with an identical graph.  Keying on the graph JSON hash
+# lets those reuse the traced program instead of re-tracing + re-jitting.
+_PROGRAM_REGISTRY = {}
+
+
+def _lookup_program(symbol, ctx_key, group2ctx):
+    import os
+    from .parallel import overlap as _overlap
+    try:
+        gkey = (_overlap.graph_fingerprint(symbol), ctx_key,
+                os.environ.get("MXNET_COMPUTE_DTYPE", ""))
+    except Exception:
+        _overlap.note_lowering()
+        return _build_program(symbol, group2ctx)
+    prog = _PROGRAM_REGISTRY.get(gkey)
+    if prog is None:
+        _overlap.note_lowering()
+        prog = _PROGRAM_REGISTRY[gkey] = _build_program(symbol, group2ctx)
+    else:
+        _overlap.note_hit()
+    return prog
+
+
 def _build_program(symbol, group2ctx):
     """Flatten the symbol into an executable schedule and jit it.
 
@@ -427,7 +454,8 @@ class Executor:
         if cache is None:
             cache = symbol._jit_cache = {}
         if cache_key not in cache:
-            cache[cache_key] = _build_program(symbol, self._group2ctx)
+            cache[cache_key] = _lookup_program(symbol, cache_key,
+                                               self._group2ctx)
         self._program = cache[cache_key]
         self._needs_rng = self._program.needs_rng
         self._jit_forward = self._program.jit_forward

@@ -398,35 +398,65 @@ class PrefetchingIter(DataIter):
         for e in self.data_taken:
             e.set()
         self.started = True
+        self._closed = False
         self.current_batch = [None] * self.n_iter
         self.next_batch = [None] * self.n_iter
-
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started or _SHUTTING_DOWN:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
-            for i in range(self.n_iter)]
         _register_prefetcher(self)
+        self.prefetch_threads = []
+        self._start_threads()
+
+    def _prefetch_func(self, i):
+        while True:
+            self.data_taken[i].wait()
+            if not self.started or _SHUTTING_DOWN:
+                break
+            try:
+                self.next_batch[i] = self.iters[i].next()
+            except StopIteration:
+                self.next_batch[i] = None
+            self.data_taken[i].clear()
+            self.data_ready[i].set()
+
+    def _start_threads(self):
+        if _SHUTTING_DOWN or self._closed:
+            return
+        self.started = True
+        self.prefetch_threads = [
+            threading.Thread(target=self._prefetch_func, args=[i], daemon=True)
+            for i in range(self.n_iter)]
         for thread in self.prefetch_threads:
             _register_producer(thread)
             thread.start()
 
+    def _join_threads(self, timeout=1.0):
+        """Stop + join the producer threads; safe to call repeatedly and
+        with threads already dead."""
+        self.started = False
+        for e in self.data_taken:
+            e.set()
+        for thread in self.prefetch_threads:
+            if thread.is_alive():
+                thread.join(timeout=timeout)
+        self.prefetch_threads = []
+
+    def close(self):
+        """Permanently stop the prefetch threads and release the inner
+        iterators.  Idempotent; the iterator is unusable afterwards."""
+        if self._closed:
+            return
+        self._closed = True
+        self._join_threads()
+        for it in self.iters:
+            close_fn = getattr(it, "close", None)
+            if callable(close_fn):
+                try:
+                    close_fn()
+                except Exception:
+                    pass
+
     def __del__(self):
         try:
-            self.started = False
-            for e in self.data_taken:
-                e.set()
-            for thread in self.prefetch_threads:
-                thread.join(timeout=1.0)
+            self.close()
         except Exception:
             pass
 
@@ -447,14 +477,38 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
+        """Drain any in-flight batch, rewind the inner iterators, and
+        re-arm the producers.  Idempotent, and safe after the producer
+        threads have died (shutdown race / prior close): dead threads
+        are re-joined and fresh ones started so reset never hangs on a
+        ``data_ready`` event nobody will set."""
+        if self._closed:
+            raise RuntimeError("PrefetchingIter.reset() after close()")
+        alive = bool(self.prefetch_threads) and \
+            all(t.is_alive() for t in self.prefetch_threads)
+        if alive:
+            # Drain: wait for the in-flight fetch so the inner iterators
+            # are quiescent before rewinding them under the producers.
+            for e in self.data_ready:
+                while not e.wait(timeout=0.1):
+                    if _SHUTTING_DOWN or \
+                            not all(t.is_alive()
+                                    for t in self.prefetch_threads):
+                        alive = False
+                        break
+                if not alive:
+                    break
+        if not alive:
+            self._join_threads()
+        self.next_batch = [None] * self.n_iter
         for i in self.iters:
             i.reset()
         for e in self.data_ready:
             e.clear()
         for e in self.data_taken:
             e.set()
+        if not alive:
+            self._start_threads()
 
     def iter_next(self):
         for e in self.data_ready:

@@ -90,6 +90,9 @@ class KVStore(object):
         self._barrier_before_exit = True
         self._created = _now()
         self._ar_seq = 0         # kv-fallback allreduce round counter
+        self._async = None       # lazy overlap.AsyncLauncher (push_async)
+        self._bucket = []        # pending (key, merged) grads
+        self._bucket_nbytes = 0
 
     # -- identity (include/mxnet/kvstore.h:222-241) -----------------------
     @property
@@ -137,6 +140,89 @@ class KVStore(object):
             src = self._store[k].data
             for o in outs:
                 o._set_data(src)
+
+    # -- async + bucketed push (docs/perf.md "Overlap") --------------------
+    def push_async(self, key, value, priority=0):
+        """:meth:`push` that returns before the cross-worker reduce.
+
+        The per-device merge runs inline (cheap, and it frees the
+        caller's grad buffers for donation), then the merged gradient
+        joins the pending BUCKET.  A bucket flushes — one fused
+        allreduce + the per-key updater, on a single background worker
+        — as soon as its size crosses ``MXTPU_BUCKET_MB``, so early
+        keys' collectives run while the caller is still merging later
+        keys.  Call :meth:`wait_all` before reading the store back
+        (``pull``).  Push order, bucket layout, and flush order are
+        functions of (key order, shapes, dtypes) only — identical on
+        every rank, so the collective schedule cannot diverge."""
+        keys, single = _key_list(key)
+        groups = _group_values(keys, value, single)
+        for k, vals in zip(keys, groups):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            merged = vals[0].data if len(vals) == 1 else \
+                _tree_sum([v.data for v in vals])
+            self._bucket_add(k, merged)
+
+    def wait_all(self, timeout=None):
+        """Barrier for every outstanding :meth:`push_async`: flush the
+        partial tail bucket, then block until the worker drained the
+        queue (re-raising the first failure).  The store is only
+        guaranteed consistent for ``pull`` after this returns."""
+        self._flush_bucket()
+        if self._async is not None:
+            self._async.wait_all(
+                timeout if timeout is not None else _collective_timeout_s())
+
+    def _bucket_add(self, k, merged):
+        from .parallel.overlap import bucket_bytes
+        target = bucket_bytes()
+        nbytes = int(getattr(merged, "nbytes", 0) or 0)
+        # only same-dtype grads fuse into one flat collective
+        if self._bucket and (target <= 0
+                             or self._bucket[-1][1].dtype != merged.dtype
+                             or self._bucket_nbytes + nbytes > target):
+            self._flush_bucket()
+        self._bucket.append((k, merged))
+        self._bucket_nbytes += nbytes
+        if target <= 0 or self._bucket_nbytes >= target:
+            self._flush_bucket()
+
+    def _flush_bucket(self):
+        items, self._bucket, self._bucket_nbytes = self._bucket, [], 0
+        if not items:
+            return
+        if self._async is None:
+            from .parallel.overlap import AsyncLauncher
+            self._async = AsyncLauncher(name="kv-async")
+        self._async.submit(lambda: self._bucket_allreduce(items))
+
+    @collective_seam
+    def _bucket_allreduce(self, items):
+        """One bucket's worth of work, on the async worker: fuse the
+        merged grads into a single flat tensor, allreduce ONCE, split
+        back, apply the updater per key.  Elementwise sums are
+        unchanged by the concatenation, so results are bit-identical
+        to the per-key path.  Runs strictly FIFO on one worker thread:
+        every rank executes the same collectives in the same order."""
+        if len(items) == 1:
+            k, merged = items[0]
+            self._apply_merged(k, self._allreduce(merged))
+            return
+        flats = [jnp.ravel(m) for _, m in items]
+        fused = self._allreduce(jnp.concatenate(flats))
+        offset = 0
+        for k, merged in items:
+            size = int(merged.size)
+            part = jax.lax.dynamic_slice_in_dim(fused, offset, size)
+            self._apply_merged(k, jnp.reshape(part, merged.shape))
+            offset += size
+
+    def _apply_merged(self, k, merged):
+        if self._updater is not None:
+            self._updater(k, NDArray(merged), self._store[k])
+        else:
+            self._store[k]._set_data(merged)
 
     def _allreduce(self, merged):
         """Cross-worker gradient sum for dist types.

@@ -229,13 +229,25 @@ class BaseModule(object):
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """Parity: base_module.py:273 — the canonical train loop."""
+            monitor=None, prefetch=None):
+        """Parity: base_module.py:273 — the canonical train loop.
+
+        ``prefetch``: True/False forces the async device feed on/off
+        (:class:`mxnet_tpu.parallel.overlap.DevicePrefetcher`); None
+        defers to ``MXTPU_PREFETCH``.  Batch order and losses are
+        identical either way — only the wait moves off the loop.
+        """
         if num_epoch is None:
             raise MXNetError("please specify number of epochs")
         if initializer is None:
             from ..initializer import Uniform
             initializer = Uniform(0.01)
+
+        from ..parallel.overlap import DevicePrefetcher, prefetch_enabled
+        own_prefetch = None
+        if prefetch_enabled(prefetch):
+            train_data = own_prefetch = DevicePrefetcher(
+                train_data, name="fit-feed")
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -262,6 +274,25 @@ class BaseModule(object):
         num_step = 0
         telemetry = _obs.enabled()
 
+        try:
+            self._fit_epochs(
+                train_data, eval_data, eval_metric, validation_metric,
+                epoch_end_callback, batch_end_callback, eval_end_callback,
+                eval_batch_end_callback, monitor, sentinel, _sentinel_mod,
+                _obs, timed_iter, telemetry, num_step, begin_epoch,
+                num_epoch)
+        finally:
+            if own_prefetch is not None:
+                own_prefetch.close()
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, epoch_end_callback,
+                    batch_end_callback, eval_end_callback,
+                    eval_batch_end_callback, monitor, sentinel,
+                    _sentinel_mod, _obs, timed_iter, telemetry, num_step,
+                    begin_epoch, num_epoch):
+        """The epoch loop body of :meth:`fit` (split out so the async
+        feed can be closed in exactly one ``finally``)."""
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()

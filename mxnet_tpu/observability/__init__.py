@@ -18,7 +18,7 @@ from __future__ import annotations
 from . import events, spans, counters, aggregate
 from .events import (enabled, emit, flush, refresh, run_id, last_fault,
                      EventLog)
-from .spans import span, timed_iter, SPAN_NAMES
+from .spans import span, timed_iter, SPAN_NAMES, overlap_report
 from .counters import (StepStats, percentile, global_stats,
                        emit_trainer_counters, emit_sentinel_counters)
 from .aggregate import (publish_summary, collect_summaries,
@@ -29,7 +29,7 @@ __all__ = [
     "events", "spans", "counters", "aggregate",
     "enabled", "emit", "flush", "refresh", "run_id", "last_fault",
     "EventLog",
-    "span", "timed_iter", "SPAN_NAMES",
+    "span", "timed_iter", "SPAN_NAMES", "overlap_report",
     "StepStats", "percentile", "global_stats",
     "emit_trainer_counters", "emit_sentinel_counters",
     "publish_summary", "collect_summaries", "heartbeat_ages",

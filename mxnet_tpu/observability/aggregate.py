@@ -309,6 +309,14 @@ def build_report(records, now=None):
         pod["slowest_phase"] = max(phase_totals, key=phase_totals.get)
         pod["phase_totals_ms"] = {k: round(v, 3)
                                   for k, v in sorted(phase_totals.items())}
+    # input-pipeline overlap proof (docs/perf.md "Overlap"): serial
+    # phase time vs step wall — >1 means data_wait/h2d hid under compute
+    from .spans import overlap_report
+    ov = overlap_report(records)
+    if ov["overlap_ratio"] is not None:
+        pod["overlap_ratio"] = ov["overlap_ratio"]
+        if ov["phase_p50_ms"]:
+            pod["phase_p50_ms"] = ov["phase_p50_ms"]
     return {"run_ids": run_ids, "ranks": ranks, "events": len(records),
             "pod": pod, "per_rank": summaries, "incidents": incidents}
 

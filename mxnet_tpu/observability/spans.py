@@ -16,7 +16,7 @@ import time
 
 from . import events
 
-__all__ = ["span", "SPAN_NAMES", "timed_iter"]
+__all__ = ["span", "SPAN_NAMES", "timed_iter", "overlap_report"]
 
 #: canonical phase names (free-form names are allowed; these are the
 #: ones the built-in wiring emits and mxtop groups by)
@@ -101,3 +101,101 @@ def timed_iter(iterable, name="data_wait", step_from=None):
                     step=step_from() if step_from is not None else None,
                     dur_ms=round(dur_ms, 3))
         yield item
+
+
+def overlap_report(records, phases=("data_wait", "h2d")):
+    """Did the async machinery actually overlap?  From merged event
+    records (:func:`..aggregate.read_events` output, or any list of
+    record dicts), compute per-rank and pod-wide::
+
+        overlap_ratio = serial_ms / wall_ms
+
+    where ``serial_ms`` sums every ``phases`` span PLUS every ``step``
+    record's duration inside the steady-state window, and ``wall_ms``
+    is the elapsed wall clock between the rank's first and last
+    ``step`` record.  The first step record bounds the window but is
+    excluded from the sums, so compile time never pollutes the ratio.
+
+    Serial execution: phases and steps tile the wall exactly, ratio
+    ≈ 1.0 (slightly below — metric/callback time belongs to no phase).
+    With the async feed on, the producer thread's ``data_wait``/``h2d``
+    spans run DURING device compute, the same host time is counted in
+    two phases, and the ratio rises above 1 — "wall < Σ phases" is the
+    proof the dead time went under the step.  ``phases`` deliberately
+    excludes ``allreduce``/``kv_barrier``: those spans nest inside the
+    ``step`` record's window and would double-count serially.
+
+    Returns ``{"overlap_ratio", "wall_ms", "serial_ms", "steps",
+    "phase_ms": {phase: total}, "phase_p50_ms": {phase: p50},
+    "per_rank": {rank: {...same shape...}}}``; ratios are None when a
+    rank has fewer than two step records.
+    """
+    per_rank_events = {}
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        kind = rec.get("kind")
+        if kind not in ("span", "step"):
+            continue
+        per_rank_events.setdefault(rec.get("rank") or 0, []).append(rec)
+
+    def _p50(vals):
+        vals = sorted(vals)
+        n = len(vals)
+        if not n:
+            return None
+        mid = n // 2
+        return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+    per_rank = {}
+    tot_wall = tot_serial = tot_steps = 0.0
+    pod_phase = {}
+    pod_phase_durs = {}
+    for rank, recs in sorted(per_rank_events.items()):
+        steps = [r for r in recs if r.get("kind") == "step"
+                 and r.get("wall_ms") is not None
+                 and r.get("dur_ms") is not None]
+        steps.sort(key=lambda r: r["wall_ms"])
+        entry = {"overlap_ratio": None, "wall_ms": None, "serial_ms": None,
+                 "steps": len(steps), "phase_ms": {}, "phase_p50_ms": {}}
+        per_rank[rank] = entry
+        if len(steps) < 2:
+            continue
+        t0, t1 = steps[0]["wall_ms"], steps[-1]["wall_ms"]
+        wall = float(t1) - float(t0)
+        if wall <= 0:
+            continue
+        serial = sum(float(r["dur_ms"]) for r in steps[1:])
+        phase_durs = {}
+        for r in recs:
+            if r.get("kind") != "span" or r.get("name") not in phases:
+                continue
+            w = r.get("wall_ms")
+            if w is None or not (t0 < w <= t1):
+                continue
+            d = float(r.get("dur_ms") or 0.0)
+            serial += d
+            phase_durs.setdefault(r["name"], []).append(d)
+        entry.update(
+            wall_ms=round(wall, 3), serial_ms=round(serial, 3),
+            overlap_ratio=round(serial / wall, 4),
+            phase_ms={k: round(sum(v), 3)
+                      for k, v in sorted(phase_durs.items())},
+            phase_p50_ms={k: round(_p50(v), 3)
+                          for k, v in sorted(phase_durs.items())})
+        tot_wall += wall
+        tot_serial += serial
+        tot_steps += len(steps)
+        for k, v in phase_durs.items():
+            pod_phase[k] = pod_phase.get(k, 0.0) + sum(v)
+            pod_phase_durs.setdefault(k, []).extend(v)
+    return {
+        "overlap_ratio": round(tot_serial / tot_wall, 4) if tot_wall else None,
+        "wall_ms": round(tot_wall, 3) if tot_wall else None,
+        "serial_ms": round(tot_serial, 3) if tot_wall else None,
+        "steps": int(tot_steps),
+        "phase_ms": {k: round(v, 3) for k, v in sorted(pod_phase.items())},
+        "phase_p50_ms": {k: round(_p50(v), 3)
+                         for k, v in sorted(pod_phase_durs.items())},
+        "per_rank": per_rank,
+    }

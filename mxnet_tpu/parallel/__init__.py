@@ -18,9 +18,17 @@ from .mesh import (make_mesh, auto_mesh, local_device_count, LogicalMesh,
 from .sharding import ShardingRules, param_pspec, batch_pspec, named_pspecs
 from .trainer import ShardedTrainer, ShardedPredictor
 from .pipeline import GPipeTrainer, pipeline_apply
+from .overlap import (DevicePrefetcher, AsyncLauncher, partition_buckets,
+                      interleave_grad_buckets, prefetch_enabled,
+                      prefetch_depth, bucket_bytes, compile_cache_stats,
+                      compile_cache_clear, enable_persistent_cache)
 
 __all__ = ["make_mesh", "auto_mesh", "local_device_count", "LogicalMesh",
            "remesh",
            "ShardingRules", "param_pspec", "batch_pspec", "named_pspecs",
            "ShardedTrainer", "ShardedPredictor", "GPipeTrainer",
-           "pipeline_apply"]
+           "pipeline_apply",
+           "DevicePrefetcher", "AsyncLauncher", "partition_buckets",
+           "interleave_grad_buckets", "prefetch_enabled", "prefetch_depth",
+           "bucket_bytes", "compile_cache_stats", "compile_cache_clear",
+           "enable_persistent_cache"]

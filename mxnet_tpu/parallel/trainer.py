@@ -25,6 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from .sharding import param_pspec, batch_pspec
+from . import overlap as _overlap
 
 __all__ = ["ShardedTrainer", "ShardedPredictor"]
 
@@ -118,6 +119,14 @@ class ShardedTrainer(object):
         # step watchdog timeout (None = env MXTPU_STEP_TIMEOUT_S at call
         # time, so a launcher can arm it without touching user code)
         self.step_timeout_s = step_timeout_s
+        self._donate = bool(donate)
+        # allreduce-over-backward: chain per-bucket optimization
+        # barriers through the traced grads (reverse-topo, ~MXTPU_
+        # BUCKET_MB each) so XLA emits one collective per bucket as its
+        # grads finish instead of one tail-end fused collective.
+        # Identity math; pointless on a single device.
+        self._bucket_grads = _overlap.bucket_bytes() > 0 \
+            and self.mesh.size > 1
 
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
@@ -183,6 +192,8 @@ class ShardedTrainer(object):
             ones = [jnp.ones_like(o) for o in outs]
             zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_out)
             grads = vjp_fn((ones, zero_aux))[0]
+            if self._bucket_grads:
+                grads = _overlap.interleave_grad_buckets(grads)
 
             new_params = {}
             new_opt_state = {}
@@ -239,6 +250,8 @@ class ShardedTrainer(object):
             ones = [jnp.ones_like(o) for o in outs]
             zero_aux = jax.tree_util.tree_map(jnp.zeros_like, aux_out)
             grads = vjp_fn((ones, zero_aux))[0]
+            if self._bucket_grads:
+                grads = _overlap.interleave_grad_buckets(grads)
 
             gs = {name: preprocess(grads[name]) for name in params}
             finite = jnp.bool_(True)
@@ -297,6 +310,9 @@ class ShardedTrainer(object):
                                      donate_argnums=donate_argnums)
         self._abstract_args = None   # ShapeDtypeStructs of the step args
         self._lowered = None         # cached jax.stages.Lowered
+        self._cache_entry = None     # overlap compile-cache slot
+        # on-disk XLA cache (MXTPU_COMPILE_CACHE_DIR), idempotent
+        _overlap.enable_persistent_cache()
 
         def eval_step(params, aux, batch, rng):
             args = dict(_to_compute(params))
@@ -578,6 +594,7 @@ class ShardedTrainer(object):
         if self._abstract_args is None:
             self._abstract_args = jax.tree_util.tree_map(
                 _abstractify, step_args)
+            self._adopt_cached_step()
 
         def dispatch():
             # inside the guarded region so injected hangs are caught
@@ -649,15 +666,74 @@ class ShardedTrainer(object):
         with self._sp_scope():
             return self._jit_eval(params, aux, batch, rng)
 
+    def _step_cache_key(self):
+        """Compile-cache key for this trainer's step: every input that
+        shapes the traced program (docs/perf.md "Overlap").  Two
+        trainers agreeing on all of it produce byte-identical traces,
+        so sharing the jitted step (and its Lowered) is sound — the
+        closures bake optimizer hypers and shardings as constants,
+        which is exactly why those are in the key."""
+        return _overlap.cache_key(
+            _overlap.graph_fingerprint(self.symbol),
+            _overlap.abstract_fingerprint(self._abstract_args),
+            tuple(sorted((str(a), int(s))
+                         for a, s in self.mesh.shape.items())),
+            tuple(repr(d) for d in self.mesh.devices.flat),
+            _overlap.rules_fingerprint(self.rules),
+            str(self.compute_dtype), self.seq_axis, self.remat,
+            self.zero1, self.fsdp, self.sentinel, self._donate,
+            self._bucket_grads, sorted(self._cast_exempt),
+            _overlap.optimizer_fingerprint(self.optimizer),
+            jax.__version__)
+
+    def _adopt_cached_step(self):
+        """First-step seam: look this trainer's key up in the process-
+        global compile cache.  Hit → adopt the cached jitted step and
+        Lowered (zero new tracing/lowering — a rebind, a bucketing
+        module's second trainer, or an elastic re-mesh resume at a
+        previously-seen world size skips straight to the compiled
+        executable).  Miss → register ours for the next bind."""
+        key = self._step_cache_key()
+        entry = _overlap.compile_cache_get(key)
+        if entry is not None:
+            self._jit_step = entry["jit_step"]
+            self._lowered = entry.get("lowered")
+            self._cache_entry = entry
+            return
+        _overlap.note_lowering()
+        self._cache_entry = {"jit_step": self._jit_step, "lowered": None}
+        _overlap.compile_cache_put(key, self._cache_entry)
+
+    # ------------------------------------------------------------------
+    # async input feed (docs/perf.md "Overlap")
+    # ------------------------------------------------------------------
+    def prefetch_feed(self, batches, depth=None, prefetch=True):
+        """Wrap an iterator of host batch dicts in a
+        :class:`~mxnet_tpu.parallel.overlap.DevicePrefetcher` that runs
+        :func:`_place_batch` (the ``shard_batch`` placement, timed as
+        ``h2d``) on a background thread — batch N+1 transfers while
+        step N runs.  Feed ``step()`` its output directly; do not call
+        ``shard_batch`` again.  ``prefetch=None`` defers to
+        ``MXTPU_PREFETCH``; returns ``batches`` unchanged when off."""
+        if not _overlap.prefetch_enabled(prefetch):
+            return batches
+        return _overlap.DevicePrefetcher(
+            batches, place_fn=lambda b: _place_batch(b, self.batch_sharding),
+            depth=depth, name="trainer-feed")
+
     # ------------------------------------------------------------------
     # introspection (bench/MFU support)
     # ------------------------------------------------------------------
     def _lower(self):
         """Lowered form of the step at the shapes/shardings of the first
-        executed step (needs one step() call first)."""
+        executed step (needs one step() call first).  Stored into the
+        compile-cache entry so later binds with the same key skip
+        lowering entirely."""
         if self._lowered is None and self._abstract_args is not None:
             with self._sp_scope():
                 self._lowered = self._jit_step.lower(*self._abstract_args)
+            if self._cache_entry is not None:
+                self._cache_entry["lowered"] = self._lowered
         return self._lowered
 
     def compiled_step_cost_analysis(self):

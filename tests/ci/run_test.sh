@@ -15,6 +15,8 @@
 #   TASK=resilience  fault-injection recovery matrix + graph lint
 #   TASK=observability  telemetry unit tests + the 2-process drill +
 #                    an mxtop --json smoke over the drill's event dir
+#   TASK=perf        overlap unit suite + the 2-process overlap drill
+#                    (asserts overlap_ratio > 1.05, bit-identical math)
 set -e
 cd "$(dirname "$0")/../.."
 
@@ -70,6 +72,10 @@ case "${TASK:-python}" in
     # never silently drop it
     JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
       mxnet_tpu/resilience/elastic.py --fail-on=error --format=github
+    # the async-collective machinery (bucketed push, FIFO launcher) is
+    # the newest divergence-sensitive seam — pinned for the same reason
+    JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+      mxnet_tpu/parallel/overlap.py --fail-on=error --format=github
     # the pre-fix PR-3 regression fixtures are expected-FAIL inputs:
     # MXL-D must keep flagging each with its documented rule id
     fx=tests/fixtures/divergence
@@ -140,6 +146,27 @@ rep = json.load(sys.stdin)
 assert len(rep["per_rank"]) == 2, rep
 assert rep["pod"]["step_ms_p50"] is not None, rep
 print("mxtop --json smoke OK")
+'
+    rm -rf "$TELDIR"
+    ;;
+  perf)
+    # overlap machinery (docs/perf.md "Overlap"): prefetcher/bucketing/
+    # compile-cache unit suite, then the 2-process acceptance drill —
+    # the async feed must yield overlap_ratio > 1.05 with parameters
+    # bit-identical to the serial run (asserted inside the drill)
+    JAX_PLATFORMS=cpu python -m pytest tests/test_overlap.py -q
+    TELDIR="$(mktemp -d)"
+    JAX_PLATFORMS=cpu MXTPU_TELEMETRY=1 MXTPU_TELEMETRY_DIR="$TELDIR" \
+      MXTPU_RUN_ID=ci-perf MXTPU_PREFETCH=1 MXTPU_BUCKET_MB=0.001 \
+      python tools/launch.py -n 2 --launcher local --port 9899 \
+      python tests/nightly/dist_overlap.py
+    # the same events must surface through the operator CLI
+    python tools/mxtop.py "$TELDIR" --json | python -c '
+import json, sys
+rep = json.load(sys.stdin)
+ratio = rep["pod"].get("overlap_ratio")
+assert ratio is not None and ratio > 1.05, rep["pod"]
+print("mxtop overlap_ratio %.3f OK" % ratio)
 '
     rm -rf "$TELDIR"
     ;;

@@ -1,0 +1,185 @@
+"""2-worker overlap drill: the async feed must provably hide the input
+pipeline, without changing the math (docs/perf.md "Overlap").
+
+Staged on every rank over a dist_sync kvstore (so the bucketed
+push_async path runs real cross-worker collectives):
+
+1. A *serial* reference fit — prefetch forced off, telemetry off — over
+   a deliberately slow iterator (per-``next`` sleep) and a sleep-padded
+   ``forward_backward`` (stands in for device compute long enough to
+   hide the fetch under).
+2. The same fit — fresh module, same seeds — with ``prefetch=True`` and
+   telemetry ON.  The DevicePrefetcher's producer thread emits the
+   ``data_wait`` spans that now run during the step.
+3. Both fits must produce BIT-IDENTICAL parameters: the overlap
+   machinery moves the wait, never the numbers.
+4. A compile-cache probe: two identical ShardedTrainer binds — the
+   second must perform zero new lowerings.
+5. Rank 0 merges the event log and asserts
+   ``overlap_report().overlap_ratio > 1.05`` with ``data_wait`` phase
+   time recorded — wall < serial is the proof the wait went under the
+   step.
+
+Exit codes: 0 OK, 4 = an overlap expectation failed.
+
+Run (tests/ci/run_test.sh TASK=perf wraps this):
+    MXTPU_TELEMETRY=1 MXTPU_TELEMETRY_DIR=<dir> MXTPU_BUCKET_MB=0.001 \
+        python tools/launch.py -n 2 --launcher local --port 9899 \
+        python tests/nightly/dist_overlap.py
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import observability as obs
+from mxnet_tpu.observability import events as _events
+
+FETCH_S = 0.02      # per-batch synthetic decode/augment cost
+STEP_S = 0.03       # per-batch synthetic device-compute cost
+
+
+def fail(rank, msg):
+    print("rank %d FAILED: %s" % (rank, msg), flush=True)
+    os._exit(4)
+
+
+class SlowIter(mx.io.NDArrayIter):
+    """NDArrayIter that pays a fixed host tax per batch — the stand-in
+    for decode/augment the prefetcher is supposed to hide."""
+
+    def next(self):
+        time.sleep(FETCH_S)
+        return super(SlowIter, self).next()
+
+
+def build_data(rank, nw):
+    rng = np.random.RandomState(7)
+    X = rng.randn(160, 16).astype(np.float32)
+    w = rng.randn(16)
+    y = (X @ w > 0).astype(np.float32)
+    shard = slice(rank * len(X) // nw, (rank + 1) * len(X) // nw)
+    return X[shard], y[shard]
+
+
+def run_fit(kv, X, y, prefetch):
+    """One deterministic 2-epoch fit; returns the trained arg params."""
+    # Both fits share one dist kv (a second dist_sync store would reuse
+    # the coordination-KV round keys).  kv.init is rank-local, so
+    # clearing the store between runs is safe — and both runs seed
+    # identical initial weights anyway.
+    kv._store.clear()
+    mx.random.seed(0)
+    train = SlowIter(X, y, batch_size=10)
+    net = mx.models.get_mlp(num_classes=2, hidden=(16,))
+    mod = mx.mod.Module(net, context=mx.context.cpu())
+
+    orig_fb = mod.forward_backward
+
+    def slow_fb(batch):
+        orig_fb(batch)
+        time.sleep(STEP_S)      # stands in for waiting on the device
+    mod.forward_backward = slow_fb
+
+    mod.fit(train, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1}, num_epoch=2,
+            prefetch=prefetch)
+    arg_params, _aux = mod.get_params()
+    return {k: v.asnumpy().copy() for k, v in arg_params.items()}
+
+
+def main():
+    teldir = os.environ.get("MXTPU_TELEMETRY_DIR")
+    if not teldir:
+        fail(0, "drill needs MXTPU_TELEMETRY_DIR")
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    X, y = build_data(rank, nw)
+
+    # ---- 1: serial reference, telemetry off so its (ratio ~1.0) steps
+    # don't dilute the overlapped run's event window -------------------
+    saved = os.environ.pop("MXTPU_TELEMETRY", None)
+    os.environ["MXTPU_TELEMETRY"] = "0"
+    _events.refresh()
+    serial_params = run_fit(kv, X, y, prefetch=False)
+
+    # ---- 2: overlapped run under full telemetry ----------------------
+    if saved is None:
+        os.environ.pop("MXTPU_TELEMETRY", None)
+    else:
+        os.environ["MXTPU_TELEMETRY"] = saved
+    _events.refresh()
+    if not obs.enabled():
+        fail(rank, "telemetry not enabled in drill env")
+    overlap_params = run_fit(kv, X, y, prefetch=True)
+
+    # ---- 3: bit-identical math ---------------------------------------
+    if sorted(serial_params) != sorted(overlap_params):
+        fail(rank, "param sets differ: %s vs %s"
+             % (sorted(serial_params), sorted(overlap_params)))
+    for k in serial_params:
+        if not (serial_params[k] == overlap_params[k]).all():
+            fail(rank, "param %s differs between serial and prefetch runs"
+                 % k)
+
+    # ---- 4: compile cache: second identical bind lowers nothing ------
+    from mxnet_tpu import parallel
+    from mxnet_tpu.parallel import overlap as ov
+    ov.compile_cache_clear()
+    import jax
+    net = mx.models.get_mlp(num_classes=2, hidden=(16,))
+    # local devices only: a cross-process mesh is not computable on the
+    # CPU backend, and the cache probe is per-process anyway
+    local = jax.local_devices()
+    mesh = parallel.make_mesh(local, dp=len(local))
+    rng = np.random.RandomState(1)
+    batch_np = {"data": rng.randn(8, 16).astype(np.float32),
+                "softmax_label": (rng.rand(8) > 0.5).astype(np.float32)}
+
+    def bind_and_step():
+        opt = mx.optimizer.create("sgd", learning_rate=0.1)
+        tr = parallel.ShardedTrainer(net, opt, mesh)
+        params, opt_state, aux = tr.init_params(
+            {"data": (8, 16)}, label_shapes={"softmax_label": (8,)})
+        tr.step(params, opt_state, aux, tr.shard_batch(dict(batch_np)))
+    bind_and_step()
+    st1 = ov.compile_cache_stats()
+    bind_and_step()
+    st2 = ov.compile_cache_stats()
+    if st2["lowerings"] != st1["lowerings"]:
+        fail(rank, "second identical bind re-lowered: %s -> %s"
+             % (st1, st2))
+    if st2["hits"] < st1["hits"] + 1:
+        fail(rank, "second bind did not hit the cache: %s -> %s"
+             % (st1, st2))
+
+    # ---- 5: rank 0 proves the overlap from the merged event log ------
+    obs.flush()
+    kv.barrier()
+    if rank == 0:
+        from mxnet_tpu.observability.aggregate import read_events
+        from mxnet_tpu.observability.spans import overlap_report
+        rep = overlap_report(read_events(teldir))
+        if rep["overlap_ratio"] is None:
+            fail(rank, "no overlap ratio from %s (steps=%s)"
+                 % (teldir, rep["steps"]))
+        if rep["overlap_ratio"] <= 1.05:
+            fail(rank, "overlap_ratio %.3f <= 1.05: the wait did not go "
+                 "under the step (report: %r)"
+                 % (rep["overlap_ratio"], rep))
+        if "data_wait" not in rep["phase_ms"]:
+            fail(rank, "no data_wait phase time in %r" % (rep,))
+        print("rank 0 overlap_ratio=%.3f wall=%.0fms serial=%.0fms "
+              "phase_p50=%r"
+              % (rep["overlap_ratio"], rep["wall_ms"], rep["serial_ms"],
+                 rep["phase_p50_ms"]), flush=True)
+    kv.barrier()
+    print("rank %d OVERLAP DRILL OK" % rank, flush=True)
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -61,6 +61,13 @@ def render(report, stream=sys.stdout):
         w("      phase totals: %s\n" % "  ".join(
             "%s=%.1fms" % (k, v)
             for k, v in pod["phase_totals_ms"].items()))
+    if pod.get("overlap_ratio") is not None:
+        p50 = pod.get("phase_p50_ms") or {}
+        w("      overlap ratio %s (serial/wall; >1 = input pipeline "
+          "hidden under compute)%s\n" % (
+              _fmt(pod["overlap_ratio"], width=7).strip(),
+              "".join("   %s p50 %.1fms" % (k, v)
+                      for k, v in sorted(p50.items()))))
     w("%-6s %8s %10s %10s %12s %8s  %s\n" % (
         "rank", "steps", "p50 ms", "p95 ms", "samples/s", "hb age",
         "last fault"))

@@ -79,9 +79,16 @@ def _iter_telemetry_records(path):
 
 def parse_telemetry(path):
     """Per-epoch rows from telemetry ``step`` records.  Records with no
-    epoch tag (e.g. raw trainer steps) land in epoch 0."""
+    epoch tag (e.g. raw trainer steps) land in epoch 0.
+
+    Run-global overlap columns (``overlap-ratio`` and the
+    ``data_wait``/``h2d`` span p50s, docs/perf.md "Overlap") are
+    computed once over the whole event stream and repeated on every
+    row — the ratio needs the full steady-state window, not an epoch
+    slice."""
     acc = {}
-    for rec in _iter_telemetry_records(path):
+    records = list(_iter_telemetry_records(path))
+    for rec in records:
         if rec.get("kind") != "step":
             continue
         ep = int(rec.get("epoch") or 0)
@@ -91,6 +98,18 @@ def parse_telemetry(path):
             row["dur_ms"].append(float(rec["dur_ms"]))
         if rec.get("samples_per_sec") is not None:
             row["sps"].append(float(rec["samples_per_sec"]))
+    overlap_cols = {}
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".."))
+        from mxnet_tpu.observability.spans import overlap_report
+        rep = overlap_report(records)
+        if rep["overlap_ratio"] is not None:
+            overlap_cols["overlap-ratio"] = rep["overlap_ratio"]
+        for name, p50 in (rep.get("phase_p50_ms") or {}).items():
+            overlap_cols["%s-ms-p50" % name.replace("_", "-")] = p50
+    except Exception:
+        pass
     rows = {}
     for ep, row in acc.items():
         out = {"steps": row["steps"]}
@@ -99,6 +118,7 @@ def parse_telemetry(path):
             out["time"] = sum(row["dur_ms"]) / 1e3
         if row["sps"]:
             out["samples-per-sec"] = row["sps"][-1]
+        out.update(overlap_cols)
         rows[ep] = out
     return rows
 
