@@ -58,6 +58,7 @@ from . import profiler
 from . import observability
 from . import predictor
 from .predictor import Predictor
+from . import serving
 from . import visualization
 from . import visualization as viz
 from . import models

@@ -185,6 +185,17 @@ def _mirror_segments(op_nodes):
 _PROGRAM_REGISTRY = {}
 
 
+def program_registry_stats():
+    """Compile-cache counters ({"hits", "misses", "lowerings"}) plus
+    this registry's entry count — the observable contract the serving
+    warmup and the Predictor reuse tests assert on ("zero lowerings
+    after warmup" is a delta of these numbers)."""
+    from .parallel import overlap as _overlap
+    stats = _overlap.compile_cache_stats()
+    stats["programs"] = len(_PROGRAM_REGISTRY)
+    return stats
+
+
 def _lookup_program(symbol, ctx_key, group2ctx):
     import os
     from .parallel import overlap as _overlap

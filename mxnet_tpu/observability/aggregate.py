@@ -317,8 +317,19 @@ def build_report(records, now=None):
         pod["overlap_ratio"] = ov["overlap_ratio"]
         if ov["phase_p50_ms"]:
             pod["phase_p50_ms"] = ov["phase_p50_ms"]
-    return {"run_ids": run_ids, "ranks": ranks, "events": len(records),
-            "pod": pod, "per_rank": summaries, "incidents": incidents}
+    out = {"run_ids": run_ids, "ranks": ranks, "events": len(records),
+           "pod": pod, "per_rank": summaries, "incidents": incidents}
+    # serving rollup (docs/serving.md): per-model QPS/latency/occupancy
+    # from "serve" records, when any exist (lazy import: serving is a
+    # consumer of observability, not a dependency)
+    try:
+        from ..serving.telemetry import serve_report
+        sv = serve_report(records)
+    except Exception:
+        sv = None
+    if sv and sv.get("models"):
+        out["serve"] = sv
+    return out
 
 
 def timeline_around(records, index, before=5, after=5):

@@ -36,9 +36,12 @@ __all__ = ["enabled", "telemetry_dir", "run_id", "rank", "get",
 
 #: the closed set of record kinds (docs/observability.md); "elastic"
 #: records are the re-mesh agreement trail (propose/adopt/resume with
-#: generation stamps — docs/resilience.md "Elasticity")
+#: generation stamps — docs/resilience.md "Elasticity"); "serve"
+#: records are one-per-dispatched-batch serving telemetry
+#: (docs/serving.md — queue_wait/pack/device/unpack phases, occupancy,
+#: padding waste, per-request latencies)
 KINDS = ("step", "span", "counter", "fault", "ckpt", "collective",
-         "summary", "elastic")
+         "summary", "elastic", "serve")
 
 _FLUSH_INTERVAL_S = 1.0
 _HIGH_WATER = 256            # buffered records that trigger an early flush

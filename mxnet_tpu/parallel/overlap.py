@@ -376,6 +376,13 @@ class AsyncLauncher(object):
             return
         self._queue.put(fn)
 
+    def pending(self):
+        """Closures submitted but not yet finished — how the serving
+        scheduler senses pipeline idleness (dispatch eagerly when the
+        worker has nothing in flight) without a second signal path."""
+        with self._lock:
+            return self._pending
+
     def wait_all(self, timeout=None):
         """Block until every submitted closure finished; re-raise the
         first exception any of them hit."""

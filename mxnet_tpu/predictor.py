@@ -21,19 +21,31 @@ __all__ = ["Predictor", "load_ndarray_file"]
 
 def load_ndarray_file(fname_or_bytes):
     """Parity: MXNDListCreate (c_predict_api.cc): load a saved named-array
-    file (the `prefix-0000.params` format) into a dict."""
-    import io as _io
+    file (the `prefix-0000.params` format) into a dict.
+
+    Accepts a path (``str`` or ``os.PathLike``) or the raw file bytes.
+    Bytes spill through a named temp file because ``nd.load`` wants a
+    path; the temp file is created ``delete=False`` so the handle can be
+    closed before reloading (Windows can't reopen a still-open
+    NamedTemporaryFile), and the unlink tolerates the Windows-style
+    failure where the file is still mapped by the reader."""
     import os
     if isinstance(fname_or_bytes, (bytes, bytearray)):
         import tempfile
-        with tempfile.NamedTemporaryFile(delete=False) as f:
-            f.write(fname_or_bytes)
-            tmp = f.name
+        tmp = None
         try:
+            with tempfile.NamedTemporaryFile(delete=False,
+                                             suffix=".params") as f:
+                tmp = f.name
+                f.write(fname_or_bytes)
             return nd.load(tmp)
         finally:
-            os.unlink(tmp)
-    return nd.load(fname_or_bytes)
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass          # Windows: reader may still hold a map
+    return nd.load(os.fspath(fname_or_bytes))
 
 
 class Predictor(object):
@@ -49,6 +61,17 @@ class Predictor(object):
     """
 
     def __init__(self, symbol_json, param_file, input_shapes, ctx=None):
+        import os
+        # compilation rides the PR-8 caches: the cross-symbol program
+        # registry (executor._PROGRAM_REGISTRY, graph-hash keyed) makes
+        # a SECOND Predictor over the same symbol/ctx reuse the traced
+        # program with zero new lowerings, and the persistent on-disk
+        # cache (MXTPU_COMPILE_CACHE_DIR, when set) lets even a fresh
+        # process skip XLA compilation proper
+        from .parallel import overlap as _overlap
+        _overlap.enable_persistent_cache()
+        if isinstance(symbol_json, os.PathLike):
+            symbol_json = os.fspath(symbol_json)
         if isinstance(symbol_json, str) and symbol_json.endswith(".json"):
             self.symbol = sym.load(symbol_json)
         else:
@@ -123,6 +146,40 @@ class Predictor(object):
         for k, v in inputs.items():
             self.set_input(k, v)
         return [o.asnumpy() for o in self._exec.forward(is_train=False)]
+
+    def forward_async(self, **inputs):
+        """Dispatch one forward and return the RAW device arrays without
+        blocking on execution (XLA dispatch is async; conversion — e.g.
+        ``numpy.asarray(out)`` — is what blocks).
+
+        Unlike :meth:`forward`, the returned arrays are NOT the
+        executor's in-place output slots: each call owns its results, so
+        a pipeline may dispatch batch N+1 while batch N's arrays are
+        still being read — the serving batcher's overlap seam."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        ex = self._exec
+        ex._n_forward += 1
+        arg_values = {n: a.data for n, a in ex.arg_dict.items()}
+        aux_values = {n: a.data for n, a in ex.aux_dict.items()}
+        if ex._needs_rng:
+            from . import random as _random
+            rng = _random.next_key()
+        else:
+            from .executor import _zero_key
+            rng = _zero_key()
+        outs, _aux = ex._jit_forward(arg_values, aux_values, rng,
+                                     is_train=False)
+        return list(outs)
+
+    @staticmethod
+    def compile_stats():
+        """Compile-cache counters ({"hits", "misses", "lowerings"} plus
+        the program-registry size) — how tests prove a second Predictor
+        construction (or a warmed serving bucket) performed zero new
+        lowerings."""
+        from .executor import program_registry_stats
+        return program_registry_stats()
 
     def get_output(self, index):
         """Parity MXPredGetOutput."""

@@ -1,0 +1,32 @@
+"""AOT-compiled batching model server (docs/serving.md).
+
+The throughput counterpart to the training-side overlap work: plan
+batch buckets offline against the offered-load histogram (minimizing
+MXL-R MXU padding waste), pre-compile every (model, bucket) pair
+through the executor program registry so steady state performs zero
+lowerings, then continuously batch incoming requests into the smallest
+admissible bucket under SLO knobs (admission timer, bounded queue,
+priorities, structured backpressure) with host pack/unpack overlapping
+device execution.
+
+Entry points: :class:`ModelServer` (in-process), ``tools/mxserve.py``
+(HTTP), ``tools/serve_bench.py`` (load generator),
+``mxtop --serve`` (telemetry view).
+"""
+from __future__ import annotations
+
+from .buckets import (BucketPlan, bucket_for, model_matmul_dims,
+                      parse_buckets, parse_histogram, plan_buckets,
+                      plan_cost, pow2_buckets, request_waste)
+from .batcher import ContinuousBatcher, Future, Request, ServerBusy
+from .server import ModelServer, checkpoint_files
+from .telemetry import emit_batch, serve_report
+
+__all__ = [
+    "BucketPlan", "bucket_for", "model_matmul_dims", "parse_buckets",
+    "parse_histogram", "plan_buckets", "plan_cost", "pow2_buckets",
+    "request_waste",
+    "ContinuousBatcher", "Future", "Request", "ServerBusy",
+    "ModelServer", "checkpoint_files",
+    "emit_batch", "serve_report",
+]

@@ -1,0 +1,292 @@
+"""AOT-compiled multi-model server: planner + warmup + batcher glue.
+
+:class:`ModelServer` is the in-process serving API (``tools/mxserve.py``
+fronts it with HTTP).  ``add_model`` runs the whole AOT story offline:
+
+1. plan buckets from the offered-load histogram (or take explicit
+   ``buckets=``) via :func:`~mxnet_tpu.serving.buckets.plan_buckets`,
+   feeding the planner the model's real per-sample matmul dims from the
+   MXL-R cost rows so the padded-FLOPs objective is the model's own;
+2. bind one :class:`~mxnet_tpu.predictor.Predictor` per bucket — all
+   buckets share ONE traced program through the executor
+   ``_PROGRAM_REGISTRY`` (the graph hash carries no shapes) — and
+   execute one warmup batch per bucket so every (model, bucket) XLA
+   executable exists before the first request;
+3. register the model with the :class:`~mxnet_tpu.serving.batcher.
+   ContinuousBatcher` under its SLO priority.
+
+After warmup the steady state performs **zero lowerings**: the
+program-registry counters are snapshotted at the end of ``add_model``
+and :meth:`ModelServer.stats` reports ``lowerings_since_warmup`` — the
+number the CI smoke asserts is 0 after thousands of requests.
+
+Request/response contract: inputs are numpy arrays with a leading
+sample axis (``n`` samples per request, ``n`` ≤ the largest bucket);
+results are the model's outputs sliced back to ``n`` rows.  Single-
+input models may pass the bare array instead of a dict.
+"""
+from __future__ import annotations
+
+import os as _os
+import time
+
+import numpy as _np
+
+from ..base import MXNetError
+from .batcher import ContinuousBatcher
+from .buckets import (BucketPlan, model_matmul_dims, parse_buckets,
+                      plan_buckets, request_waste)
+
+__all__ = ["ModelServer", "checkpoint_files"]
+
+
+def checkpoint_files(prefix, epoch):
+    """The ``save_checkpoint`` file pair for (prefix, epoch):
+    ``(prefix-symbol.json, prefix-%04d.params)``."""
+    prefix = _os.fspath(prefix)
+    return "%s-symbol.json" % prefix, "%s-%04d.params" % (prefix, epoch)
+
+
+class _ModelEntry(object):
+    """One served model: the batcher's duck-typed pack/launch/unpack
+    protocol over per-bucket Predictors."""
+
+    def __init__(self, name, plan, predictors, input_shapes, dtypes,
+                 priority=0):
+        self.name = name
+        self.plan = plan
+        self.buckets = plan.buckets
+        self.priority = int(priority)
+        self.predictors = predictors          # {bucket: Predictor}
+        self.input_shapes = input_shapes      # {input: per-sample shape}
+        self.dtypes = dtypes                  # {input: numpy dtype}
+
+    # -- batcher protocol --------------------------------------------------
+
+    def pack(self, requests, bucket):
+        """Concatenate request payloads row-wise into zero-padded
+        bucket-shaped host arrays (host work; runs on the scheduler
+        thread, overlapping the previous batch's device time)."""
+        packed = {
+            nm: _np.zeros((bucket,) + tuple(shape), dtype=self.dtypes[nm])
+            for nm, shape in self.input_shapes.items()}
+        row = 0
+        for req in requests:
+            for nm, arr in req.payload.items():
+                packed[nm][row:row + req.n] = arr
+            row += req.n
+        return packed
+
+    def launch(self, payload, bucket):
+        """Async XLA dispatch on the bucket's pre-compiled program;
+        returns (device arrays, dispatch stamp) without blocking."""
+        t0 = time.perf_counter()
+        outs = self.predictors[bucket].forward_async(**payload)
+        return outs, t0
+
+    def unpack(self, handle, requests, bucket):
+        """Block on the device arrays, slice each request's rows back
+        out.  Returns (per-request result lists, phase timings)."""
+        outs, t0 = handle
+        host = [_np.asarray(o) for o in outs]     # blocks: device phase
+        t1 = time.perf_counter()
+        results, row = [], 0
+        for req in requests:
+            results.append([o[row:row + req.n] for o in host])
+            row += req.n
+        t2 = time.perf_counter()
+        return results, {"device_ms": (t1 - t0) * 1e3,
+                         "unpack_ms": (t2 - t1) * 1e3}
+
+    def waste(self, n_samples, bucket):
+        """Padding-waste fraction of one dispatch (planner cost model)."""
+        return request_waste(n_samples, bucket, self.plan.mats,
+                             self.plan.compute_dtype)
+
+    def validate(self, payload, n):
+        """Normalize one request's inputs: bare array → single-input
+        dict; check names, per-sample shapes, and a consistent sample
+        count."""
+        if not isinstance(payload, dict):
+            if len(self.input_shapes) != 1:
+                raise MXNetError(
+                    "model %r has inputs %s; pass a dict"
+                    % (self.name, sorted(self.input_shapes)))
+            payload = {next(iter(self.input_shapes)): payload}
+        out = {}
+        for nm, shape in self.input_shapes.items():
+            if nm not in payload:
+                raise MXNetError("model %r: missing input %r"
+                                 % (self.name, nm))
+            arr = _np.asarray(payload[nm])
+            if arr.ndim == len(shape):      # single sample, no batch axis
+                arr = arr[None]
+            if tuple(arr.shape[1:]) != tuple(shape):
+                raise MXNetError(
+                    "model %r input %r: per-sample shape %s != bound %s"
+                    % (self.name, nm, arr.shape[1:], tuple(shape)))
+            if n is None:
+                n = arr.shape[0]
+            elif arr.shape[0] != n:
+                raise MXNetError(
+                    "model %r: inconsistent sample counts across inputs"
+                    % self.name)
+            out[nm] = arr
+        return out, int(n)
+
+
+class ModelServer(object):
+    """In-process AOT-compiled batching server (see module docstring).
+
+    Parameters mirror the ``MXTPU_SERVE_*`` env knobs; explicit
+    arguments win.  ``close()`` drains gracefully.
+    """
+
+    def __init__(self, max_delay_ms=None, max_queue=None):
+        self._batcher = ContinuousBatcher(max_delay_ms_=max_delay_ms,
+                                          max_queue_=max_queue)
+        self._entries = {}
+        self._warmup = {}        # model -> registry-counter snapshot
+
+    # -- model lifecycle ---------------------------------------------------
+
+    def add_model(self, name, symbol_json, params, input_shapes,
+                  histogram=None, buckets=None, ctx=None, priority=0,
+                  max_buckets=None, compute_dtype="float32",
+                  dtypes=None):
+        """Plan buckets, pre-compile every (model, bucket) pair, and
+        open the model for requests.  Returns the :class:`BucketPlan`.
+
+        ``input_shapes``: ``{input: per-sample shape}`` (no batch axis).
+        ``histogram``: offered request-size load (``{n: weight}`` /
+        ``"1:100,8:20"``) for the planner; ``buckets=`` skips planning.
+        """
+        from ..predictor import Predictor
+        if name in self._entries:
+            raise MXNetError("model %r already added" % name)
+        input_shapes = {nm: tuple(int(d) for d in shape)
+                        for nm, shape in input_shapes.items()}
+        env_buckets = _os.environ.get("MXTPU_SERVE_BUCKETS")
+        if buckets is None and env_buckets:
+            buckets = env_buckets
+
+        first = None
+        predictors = {}
+
+        def bind(batch):
+            shapes = {nm: (batch,) + shape
+                      for nm, shape in input_shapes.items()}
+            src = first.symbol.tojson() if first is not None \
+                else symbol_json
+            return Predictor(src, params, shapes, ctx=ctx)
+
+        if buckets is not None:
+            plan_b = parse_buckets(buckets)
+            first = bind(plan_b[0])
+            mats = model_matmul_dims(
+                first.symbol, {nm: (1,) + shape
+                               for nm, shape in input_shapes.items()})
+            plan = BucketPlan(plan_b, histogram or {b: 1.0
+                                                    for b in plan_b},
+                              mats or ((1, 128, 128),), compute_dtype)
+        else:
+            if histogram is None:
+                raise MXNetError(
+                    "add_model needs a request histogram (to plan "
+                    "buckets) or an explicit buckets= list")
+            # bind the smallest observed size first just to get the
+            # Symbol for the cost rows; planning is pure host math
+            from .buckets import parse_histogram
+            hist = parse_histogram(histogram)
+            first = bind(min(hist))
+            mats = model_matmul_dims(
+                first.symbol, {nm: (1,) + shape
+                               for nm, shape in input_shapes.items()})
+            plan = plan_buckets(hist, mats=mats, max_buckets=max_buckets,
+                                compute_dtype=compute_dtype)
+        # per-bucket binds: all share one traced program through the
+        # graph-hash registry; jit compiles one executable per shape
+        first_batch = first._exec.arg_dict[
+            next(iter(input_shapes))].shape[0]
+        for b in plan.buckets:
+            predictors[b] = first if b == first_batch else bind(b)
+        dtypes = {nm: _np.dtype(dtypes[nm]) if dtypes and nm in dtypes
+                  else _np.dtype("float32") for nm in input_shapes}
+        entry = _ModelEntry(name, plan, predictors, input_shapes, dtypes,
+                            priority=priority)
+        # warmup: one blocking forward per bucket so every executable
+        # exists before the first request — after this, zero lowerings
+        for b in plan.buckets:
+            zeros = {nm: _np.zeros((b,) + shape, dtype=dtypes[nm])
+                     for nm, shape in input_shapes.items()}
+            predictors[b].forward(**zeros)
+        from ..executor import program_registry_stats
+        self._entries[name] = entry
+        self._warmup[name] = program_registry_stats()["lowerings"]
+        self._batcher.register(entry)
+        return plan
+
+    def add_checkpoint(self, name, prefix, epoch, input_shapes, **kwargs):
+        """``add_model`` from a ``save_checkpoint`` (prefix, epoch)."""
+        sym_path, params_path = checkpoint_files(prefix, epoch)
+        return self.add_model(name, sym_path, params_path, input_shapes,
+                              **kwargs)
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, model, inputs, n=None):
+        """Admit one request; returns a Future whose ``result()`` is the
+        list of per-output arrays (``n`` rows each).  Raises
+        :class:`~mxnet_tpu.serving.batcher.ServerBusy` on backpressure."""
+        entry = self._entries.get(model)
+        if entry is None:
+            raise MXNetError("unknown model %r (have: %s)"
+                             % (model, sorted(self._entries)))
+        payload, n = entry.validate(inputs, n)
+        return self._batcher.submit(model, payload, n=n)
+
+    def predict(self, model, inputs, timeout=30.0):
+        """Blocking convenience: submit + wait."""
+        return self.submit(model, inputs).result(timeout=timeout)
+
+    # -- introspection / lifecycle ----------------------------------------
+
+    def models(self):
+        return sorted(self._entries)
+
+    def plan(self, model):
+        return self._entries[model].plan
+
+    def stats(self):
+        """Batcher counters + per-model plans + the AOT proof
+        (``lowerings_since_warmup`` per model, from the program-registry
+        counters snapshotted at the end of each ``add_model``)."""
+        from ..executor import program_registry_stats
+        reg = program_registry_stats()
+        out = self._batcher.stats()
+        out["registry"] = reg
+        out["models"] = {}
+        for name, entry in self._entries.items():
+            out["models"][name] = {
+                "buckets": list(entry.buckets),
+                "priority": entry.priority,
+                "planned_waste": round(entry.plan.waste, 4),
+                "lowerings_since_warmup":
+                    reg["lowerings"] - self._warmup[name]}
+        return out
+
+    def queue_depth(self):
+        return self._batcher.queue_depth()
+
+    def drain(self, timeout=None):
+        self._batcher.drain(timeout=timeout)
+
+    def close(self, drain=True, timeout=None):
+        self._batcher.close(drain=drain, timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

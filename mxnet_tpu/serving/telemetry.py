@@ -1,0 +1,141 @@
+"""Serving telemetry: the ``serve`` event kind and its aggregation.
+
+One record per dispatched batch (not per request — bounded volume even
+at high QPS) carrying the request-visible phases (``queue_wait_ms``
+admission-to-dispatch, ``pack_ms`` host pack, ``device_ms`` execute,
+``unpack_ms`` host slice/complete), the batch-shape economics
+(``bucket``, ``n_samples``, ``occupancy``, ``padding_waste`` from the
+planner's cost model), scheduler state (``queue_depth``), and the
+per-request end-to-end latencies (``lat_ms`` list) so percentiles can
+be computed over requests, not batches.
+
+:func:`serve_report` folds merged event records (the
+``aggregate.read_events`` output) into the per-model view ``mxtop
+--serve`` and ``parse_log.py`` render: QPS, p50/p95/p99 latency,
+mean occupancy and padding waste, phase means, max queue depth.
+"""
+from __future__ import annotations
+
+from ..observability import events
+from ..observability.counters import percentile
+
+__all__ = ["emit_batch", "serve_report"]
+
+
+def emit_batch(model, bucket, n_requests, n_samples, occupancy,
+               padding_waste, queue_depth, queue_wait_ms, pack_ms,
+               device_ms, unpack_ms, lat_ms):
+    """Emit one ``serve`` record for a completed batch (no-op when
+    telemetry is off, like every emit in the tree)."""
+    events.emit(
+        "serve", model=model, bucket=int(bucket),
+        n_requests=int(n_requests), n_samples=int(n_samples),
+        occupancy=round(float(occupancy), 4),
+        padding_waste=round(float(padding_waste), 4),
+        queue_depth=int(queue_depth),
+        queue_wait_ms=_r(queue_wait_ms), pack_ms=_r(pack_ms),
+        device_ms=_r(device_ms), unpack_ms=_r(unpack_ms),
+        lat_ms=[_r(v) for v in lat_ms])
+
+
+def _r(v, nd=3):
+    return None if v is None else round(float(v), nd)
+
+
+def _mean(vals):
+    return round(sum(vals) / len(vals), 3) if vals else None
+
+
+def serve_report(records):
+    """Per-model serving rollup from merged event records.
+
+    Returns ``{"models": {name: {...}}, "total": {...}}`` where each
+    model entry carries ``requests``, ``batches``, ``qps``,
+    ``latency_ms`` {p50, p95, p99, mean}, ``occupancy``,
+    ``padding_waste``, ``queue_depth_max``, per-phase means
+    (``queue_wait_ms``/``pack_ms``/``device_ms``/``unpack_ms``), and
+    the per-bucket dispatch histogram ``buckets`` {size: batches}.
+    ``total`` aggregates across models.  Empty dicts when no ``serve``
+    records exist (mxtop treats that as "no serving view").
+    """
+    per = {}
+    walls = []
+    for rec in records:
+        if rec.get("kind") != "serve":
+            continue
+        model = rec.get("model") or "?"
+        m = per.setdefault(model, {
+            "requests": 0, "samples": 0, "batches": 0, "_lat": [],
+            "_occ": [], "_waste": [], "_qw": [], "_pack": [], "_dev": [],
+            "_unpack": [], "queue_depth_max": 0, "buckets": {}})
+        m["requests"] += int(rec.get("n_requests") or 0)
+        m["samples"] += int(rec.get("n_samples") or 0)
+        m["batches"] += 1
+        m["_lat"].extend(float(v) for v in (rec.get("lat_ms") or ()))
+        for key, field in (("_occ", "occupancy"),
+                           ("_waste", "padding_waste"),
+                           ("_qw", "queue_wait_ms"), ("_pack", "pack_ms"),
+                           ("_dev", "device_ms"), ("_unpack", "unpack_ms")):
+            if rec.get(field) is not None:
+                m[key].append(float(rec[field]))
+        m["queue_depth_max"] = max(m["queue_depth_max"],
+                                   int(rec.get("queue_depth") or 0))
+        b = str(rec.get("bucket"))
+        m["buckets"][b] = m["buckets"].get(b, 0) + 1
+        if rec.get("wall_ms") is not None:
+            walls.append((model, float(rec["wall_ms"])))
+
+    if not per:
+        return {"models": {}, "total": {}}
+
+    spans = {}
+    for model, wall in walls:
+        lo, hi = spans.get(model, (wall, wall))
+        spans[model] = (min(lo, wall), max(hi, wall))
+
+    models = {}
+    all_lat, total = [], {"requests": 0, "samples": 0, "batches": 0}
+    for model, m in sorted(per.items()):
+        lat = m.pop("_lat")
+        out = {"requests": m["requests"], "samples": m["samples"],
+               "batches": m["batches"],
+               "queue_depth_max": m["queue_depth_max"],
+               "buckets": dict(sorted(m["buckets"].items(),
+                                      key=lambda kv: int(kv[0])))}
+        for key, field in (("_occ", "occupancy"),
+                           ("_waste", "padding_waste"),
+                           ("_qw", "queue_wait_ms"), ("_pack", "pack_ms"),
+                           ("_dev", "device_ms"), ("_unpack", "unpack_ms")):
+            out[field] = _mean(m.pop(key))
+        if lat:
+            out["latency_ms"] = {"p50": _r(percentile(lat, 50)),
+                                 "p95": _r(percentile(lat, 95)),
+                                 "p99": _r(percentile(lat, 99)),
+                                 "mean": _mean(lat)}
+        span = spans.get(model)
+        if span and span[1] > span[0]:
+            out["qps"] = round(m["requests"] / ((span[1] - span[0]) / 1e3),
+                               2)
+        else:
+            out["qps"] = None
+        models[model] = out
+        all_lat.extend(lat)
+        for k in ("requests", "samples", "batches"):
+            total[k] += m[k]
+
+    if all_lat:
+        total["latency_ms"] = {"p50": _r(percentile(all_lat, 50)),
+                               "p95": _r(percentile(all_lat, 95)),
+                               "p99": _r(percentile(all_lat, 99)),
+                               "mean": _mean(all_lat)}
+    lo = min(s[0] for s in spans.values()) if spans else None
+    hi = max(s[1] for s in spans.values()) if spans else None
+    if lo is not None and hi > lo:
+        total["qps"] = round(total["requests"] / ((hi - lo) / 1e3), 2)
+    occs = [m["occupancy"] for m in models.values()
+            if m["occupancy"] is not None]
+    wastes = [m["padding_waste"] for m in models.values()
+              if m["padding_waste"] is not None]
+    total["occupancy"] = _mean(occs)
+    total["padding_waste"] = _mean(wastes)
+    return {"models": models, "total": total}

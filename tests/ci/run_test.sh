@@ -17,6 +17,9 @@
 #                    an mxtop --json smoke over the drill's event dir
 #   TASK=perf        overlap unit suite + the 2-process overlap drill
 #                    (asserts overlap_ratio > 1.05, bit-identical math)
+#   TASK=serving     serving unit suite + the serve_load acceptance
+#                    drill (>= 3x serial batch-1, bounded p95, zero
+#                    lowerings after warmup) + serve_bench/mxtop smoke
 set -e
 cd "$(dirname "$0")/../.."
 
@@ -76,6 +79,11 @@ case "${TASK:-python}" in
     # the newest divergence-sensitive seam — pinned for the same reason
     JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
       mxnet_tpu/parallel/overlap.py --fail-on=error --format=github
+    # the serving scheduler rides those same launchers and makes its
+    # own per-process dispatch decisions (queue depth, timers) — pin
+    # its self-lint so the divergence pass always prices it
+    JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+      mxnet_tpu/serving --fail-on=error --format=github
     # the pre-fix PR-3 regression fixtures are expected-FAIL inputs:
     # MXL-D must keep flagging each with its documented rule id
     fx=tests/fixtures/divergence
@@ -167,6 +175,40 @@ rep = json.load(sys.stdin)
 ratio = rep["pod"].get("overlap_ratio")
 assert ratio is not None and ratio > 1.05, rep["pod"]
 print("mxtop overlap_ratio %.3f OK" % ratio)
+'
+    rm -rf "$TELDIR"
+    ;;
+  serving)
+    # serving stack (docs/serving.md): planner/batcher/server unit
+    # suite, then the acceptance drill — continuous batching must beat
+    # the serial batch-1 Predictor >= 3x at bounded p95 with zero
+    # lowerings after warmup (all asserted inside the drill)
+    JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+    JAX_PLATFORMS=cpu python tests/nightly/serve_load.py
+    # bench smoke with telemetry on: the BENCH JSON line must show an
+    # intact AOT contract and carry the latency/occupancy/waste fields
+    # the SLO dashboards read
+    TELDIR="$(mktemp -d)"
+    JAX_PLATFORMS=cpu MXTPU_TELEMETRY=1 MXTPU_TELEMETRY_DIR="$TELDIR" \
+      MXTPU_RUN_ID=ci-serve \
+      python tools/serve_bench.py --requests 200 | python -c '
+import json, sys
+rep = json.loads(sys.stdin.readlines()[-1])
+assert rep["lowerings_after_warmup"] == 0, rep
+assert rep["completed"] == 200 and rep["errors"] == 0, rep
+assert rep["latency_ms"]["p95"] is not None, rep
+assert 0.0 < rep["occupancy"] <= 1.0, rep
+assert rep["padding_waste"] is not None, rep
+print("serve_bench smoke OK: %.0f rps, p95 %.2f ms"
+      % (rep["value"], rep["latency_ms"]["p95"]))
+'
+    # the per-batch serve events must surface through the operator CLI
+    python tools/mxtop.py "$TELDIR" --json --serve | python -c '
+import json, sys
+sv = json.load(sys.stdin)
+assert sv["models"], sv
+assert sv["total"]["requests"] >= 200, sv["total"]
+print("mxtop --serve smoke OK: %d requests" % sv["total"]["requests"])
 '
     rm -rf "$TELDIR"
     ;;

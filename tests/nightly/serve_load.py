@@ -1,0 +1,163 @@
+"""Serving load drill: continuous batching must beat serial batch-1.
+
+The acceptance run for docs/serving.md (wired as the CI smoke in
+tests/ci/run_test.sh TASK=serving), all on the virtual CPU mesh:
+
+1. **Serial baseline** — N batch-1 ``Predictor.forward`` calls in a
+   loop (the pre-serving deployment story): requests/sec.
+2. **Batched server** — the same toy model behind ``ModelServer`` with
+   buckets {1, 32}, N single-sample requests from a closed loop of
+   concurrent clients.  Must sustain **>= 3x** the serial throughput.
+3. **Bounded latency** — server p95 <= ``max_delay_ms`` + 2x the
+   measured single-batch device time (the SLO the admission timer
+   promises: a request waits at most one admission window plus the
+   batch ahead of it and its own).
+4. **AOT proof** — zero lowerings after warmup, from the executor
+   program-registry counters, after every request has completed.
+
+Prints one JSON line with every figure.  Exit codes: 0 OK, 4 = an
+expectation failed.
+
+Run:  JAX_PLATFORMS=cpu python tests/nightly/serve_load.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx                                  # noqa: E402
+from mxnet_tpu.executor import program_registry_stats  # noqa: E402
+from mxnet_tpu.serving import ModelServer              # noqa: E402
+
+N_REQUESTS = int(os.environ.get("SERVE_LOAD_REQUESTS", "800"))
+CONCURRENCY = int(os.environ.get("SERVE_LOAD_CONCURRENCY", "32"))
+MAX_DELAY_MS = float(os.environ.get("SERVE_LOAD_MAX_DELAY_MS", "25"))
+FEATURES = 128
+
+
+def fail(msg, report):
+    report["failed"] = msg
+    print(json.dumps(report), flush=True)
+    print("serve_load FAILED: %s" % msg, file=sys.stderr, flush=True)
+    os._exit(4)
+
+
+def main():
+    net = mx.models.get_mlp(num_classes=10, hidden=(64,) * 20)
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, FEATURES))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    arg_params, aux_params = mod.get_params()
+    params = {"arg:" + k: v for k, v in arg_params.items()}
+    params.update({"aux:" + k: v for k, v in aux_params.items()})
+
+    rng = np.random.RandomState(11)
+    x1 = rng.rand(1, FEATURES).astype("float32")
+
+    # -- 1. serial batch-1 baseline ------------------------------------
+    serial = mx.Predictor(net.tojson(), params, {"data": (1, FEATURES)})
+    serial.forward(data=x1)                             # warm the compile
+    t0 = time.perf_counter()
+    for _ in range(N_REQUESTS):
+        serial.forward(data=x1)
+    serial_s = time.perf_counter() - t0
+    serial_rps = N_REQUESTS / serial_s
+
+    # -- 2. batched server over the same model -------------------------
+    srv = ModelServer(max_delay_ms=MAX_DELAY_MS)
+    plan = srv.add_model("toy", net.tojson(), params,
+                         {"data": (FEATURES,)}, buckets=(1, 32))
+    # measured single-batch device time on the largest bucket (median
+    # of a few warm runs) — the latency bound's second term
+    big = plan.max_batch
+    xb = rng.rand(big, FEATURES).astype("float32")
+    times = []
+    for _ in range(20):
+        t = time.perf_counter()
+        srv._entries["toy"].predictors[big].forward(data=xb)
+        times.append(time.perf_counter() - t)
+    batch_ms = sorted(times)[len(times) // 2] * 1e3
+
+    srv.predict("toy", x1)                              # pipeline warm
+    lowerings_at_warmup = program_registry_stats()["lowerings"]
+
+    import threading
+    cursor, lock, errors = [0], threading.Lock(), []
+    window = max(1, CONCURRENCY // 8)       # outstanding per client
+
+    def worker():
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= N_REQUESTS:
+                    return
+                take = min(window, N_REQUESTS - i)
+                cursor[0] += take
+            try:
+                futs = [srv.submit("toy", x1) for _ in range(take)]
+                for fut in futs:
+                    out = fut.result(timeout=60.0)
+                    assert out[0].shape == (1, 10), out[0].shape
+            except Exception as exc:
+                errors.append(exc)
+                return
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(1, CONCURRENCY // window))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server_s = time.perf_counter() - t0
+    server_rps = N_REQUESTS / server_s
+
+    stats = srv.stats()
+    lowerings_after = program_registry_stats()["lowerings"] \
+        - lowerings_at_warmup
+    srv.close()
+
+    p95 = (stats.get("latency_ms") or {}).get("p95")
+    bound_ms = MAX_DELAY_MS + 2.0 * batch_ms
+    report = {
+        "metric": "serve_load_speedup",
+        "value": round(server_rps / serial_rps, 2),
+        "unit": "x vs serial batch-1",
+        "serial_rps": round(serial_rps, 1),
+        "server_rps": round(server_rps, 1),
+        "requests": N_REQUESTS,
+        "concurrency": CONCURRENCY,
+        "buckets": list(plan.buckets),
+        "occupancy": stats.get("occupancy"),
+        "padding_waste": stats.get("padding_waste"),
+        "latency_ms": stats.get("latency_ms"),
+        "p95_bound_ms": round(bound_ms, 3),
+        "single_batch_ms": round(batch_ms, 3),
+        "lowerings_after_warmup": lowerings_after,
+        "errors": len(errors),
+    }
+    if errors:
+        fail("request errors: %r" % errors[0], report)
+    if server_rps < 3.0 * serial_rps:
+        fail("throughput %.1f rps < 3x serial %.1f rps"
+             % (server_rps, serial_rps), report)
+    if p95 is None or p95 > bound_ms:
+        fail("p95 %.3f ms exceeds bound %.3f ms (max_delay %.1f + 2x "
+             "batch %.3f)" % (p95 or -1, bound_ms, MAX_DELAY_MS,
+                              batch_ms), report)
+    if lowerings_after != 0:
+        fail("%d lowerings after warmup (AOT contract broken)"
+             % lowerings_after, report)
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

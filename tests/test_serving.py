@@ -1,0 +1,460 @@
+"""Serving stack (docs/serving.md): bucket planner, continuous batcher,
+ModelServer, serve telemetry, and the predictor AOT satellites.
+
+All CPU-only: planner tests are pure host math over the MXL-R padding
+cost model, batcher tests run against duck-typed fake model entries (no
+jax on that path), and the end-to-end server tests use a toy MLP on the
+virtual CPU mesh.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.executor import program_registry_stats
+from mxnet_tpu.serving import (BucketPlan, ContinuousBatcher, ModelServer,
+                               ServerBusy, bucket_for, parse_histogram,
+                               plan_buckets, plan_cost, pow2_buckets,
+                               serve_report)
+from mxnet_tpu.serving.batcher import Request
+
+
+# ---------------------------------------------------------------------------
+# bucket planner
+# ---------------------------------------------------------------------------
+
+SKEWED = {3: 100, 5: 40, 65: 10, 70: 2}     # pow2 ceils to {4, 8, 128}
+
+
+def test_planner_every_size_admissible():
+    """Property: every histogram size maps to an admissible bucket."""
+    for hist in (SKEWED, {1: 1}, {7: 3, 9: 2, 130: 1},
+                 {n: n for n in range(1, 40, 3)}):
+        plan = plan_buckets(hist, max_buckets=4)
+        for size in hist:
+            b = plan.bucket_for(size)
+            assert b is not None and b >= size, (hist, size, plan.buckets)
+        assert len(plan.buckets) <= 4
+
+
+def test_planner_deterministic():
+    """Property: output is a pure function of the histogram — input
+    ordering and repeat runs never change the buckets."""
+    items = list(SKEWED.items())
+    a = plan_buckets(dict(items), max_buckets=2).buckets
+    b = plan_buckets(dict(reversed(items)), max_buckets=2).buckets
+    c = plan_buckets("3:100,5:40,65:10,70:2", max_buckets=2).buckets
+    assert a == b == c
+    assert a == plan_buckets(dict(items), max_buckets=2).buckets
+
+
+def test_planner_beats_pow2_on_skewed_histogram():
+    """The acceptance property: on a skewed histogram the planner's
+    buckets cost strictly less total padded MXU work than naive pow-2
+    ceilings — the planner demonstrably consumes mxu_padding_waste."""
+    plan = plan_buckets(SKEWED, max_buckets=3)
+    assert pow2_buckets(SKEWED) == (4, 8, 128)
+    assert plan.cost < plan.pow2_cost, (plan.cost, plan.pow2_cost)
+    assert plan.waste < plan.pow2_waste
+
+
+def test_planner_optimal_vs_brute_force():
+    """The DP must match brute force over all bucket subsets."""
+    import itertools
+    hist = {2: 9, 3: 5, 9: 4, 17: 2, 33: 1}
+    sizes = sorted(hist)
+    best = min(
+        (plan_cost(combo, hist) for k in (1, 2)
+         for combo in itertools.combinations(sizes, k)
+         if combo[-1] == sizes[-1]),
+        default=None)
+    plan = plan_buckets(hist, max_buckets=2)
+    assert plan.cost == pytest.approx(best)
+
+
+def test_planner_few_sizes_get_exact_buckets():
+    plan = plan_buckets({4: 1, 16: 1}, max_buckets=4)
+    assert plan.buckets == (4, 16)
+    assert plan.waste < 1.0
+
+
+def test_parse_histogram_forms_and_errors():
+    assert parse_histogram("1:100, 8:20") == {1: 100.0, 8: 20.0}
+    assert parse_histogram([1, 1, 8]) == {1: 2.0, 8: 1.0}
+    assert parse_histogram([(2, 5.0)]) == {2: 5.0}
+    with pytest.raises(MXNetError):
+        parse_histogram({})
+    with pytest.raises(MXNetError):
+        parse_histogram({0: 1})
+    with pytest.raises(MXNetError):
+        parse_histogram({2: -1})
+
+
+def test_bucket_for_and_inadmissible_cost():
+    assert bucket_for(5, (4, 8, 16)) == 8
+    assert bucket_for(16, (4, 8, 16)) == 16
+    assert bucket_for(17, (4, 8, 16)) is None
+    with pytest.raises(MXNetError):
+        plan_cost((4,), {5: 1})
+
+
+def test_plan_to_dict_round_trips_json():
+    plan = plan_buckets(SKEWED, max_buckets=2)
+    doc = json.loads(json.dumps(plan.to_dict()))
+    assert doc["buckets"] == list(plan.buckets)
+    assert doc["pow2_buckets"] == [4, 8, 128]
+
+
+# ---------------------------------------------------------------------------
+# continuous batcher (duck-typed fake entries; no jax)
+# ---------------------------------------------------------------------------
+
+class FakeEntry(object):
+    """Model entry double: payloads are numbers, results double them."""
+
+    def __init__(self, name, buckets=(8,), priority=0, delay_s=0.0):
+        self.name = name
+        self.buckets = tuple(sorted(buckets))
+        self.priority = priority
+        self.delay_s = delay_s
+        self.launched = []              # (bucket, n_requests) in order
+
+    def pack(self, requests, bucket):
+        return [r.payload for r in requests]
+
+    def launch(self, payload, bucket):
+        self.launched.append((bucket, len(payload)))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return payload
+
+    def unpack(self, handle, requests, bucket):
+        return [p * 2 for p in handle], {"device_ms": self.delay_s * 1e3,
+                                         "unpack_ms": 0.0}
+
+    def waste(self, n, bucket):
+        return 1.0 - n / float(bucket)
+
+
+def test_batcher_round_trip_and_stats():
+    b = ContinuousBatcher(max_delay_ms_=5, max_queue_=64)
+    b.register(FakeEntry("m", buckets=(4,)))
+    futs = [b.submit("m", i) for i in range(10)]
+    assert [f.result(timeout=10) for f in futs] == [2 * i
+                                                    for i in range(10)]
+    st = b.stats()
+    assert st["requests"] == 10 and st["failed"] == 0
+    assert st["latency_ms"]["p95"] is not None
+    assert 0.0 < st["occupancy"] <= 1.0
+    b.close()
+
+
+def test_batcher_packs_up_to_bucket():
+    """A busy pipeline lets companions accumulate; batches never exceed
+    the largest bucket."""
+    entry = FakeEntry("m", buckets=(4,), delay_s=0.02)
+    b = ContinuousBatcher(max_delay_ms_=200, max_queue_=64)
+    b.register(entry)
+    futs = [b.submit("m", i) for i in range(12)]
+    for f in futs:
+        f.result(timeout=10)
+    assert all(n <= 4 for _, n in entry.launched), entry.launched
+    # with the pipeline busy 20ms per batch, later batches fill up
+    assert any(n == 4 for _, n in entry.launched), entry.launched
+    b.close()
+
+
+def test_batcher_priority_selection():
+    """_pick prefers higher priority, then the oldest head request."""
+    b = ContinuousBatcher(max_delay_ms_=1000)
+    lo, hi = FakeEntry("lo", priority=0), FakeEntry("hi", priority=5)
+    b.register(lo)
+    b.register(hi)
+    # no scheduler thread yet: stage requests directly
+    b._pending["lo"].append(Request("lo", 1, 1))
+    time.sleep(0.002)
+    b._pending["hi"].append(Request("hi", 2, 1))
+    entry, _q = b._pick()
+    assert entry.name == "hi"
+    b._pending["hi"].clear()
+    entry, _q = b._pick()
+    assert entry.name == "lo"
+    b.close(drain=False)
+
+
+def test_batcher_backpressure_structured_429():
+    """Beyond max_queue, submit raises a structured ServerBusy carrying
+    machine-readable backpressure fields."""
+    entry = FakeEntry("m", buckets=(8,), delay_s=0.2)
+    b = ContinuousBatcher(max_delay_ms_=10_000, max_queue_=2)
+    b.register(entry)
+    # the idle pipeline dispatches the head eagerly, so the queue only
+    # fills once launch() is busy sleeping: submit until the bound trips
+    busy = None
+    for i in range(5):
+        try:
+            b.submit("m", i)
+        except ServerBusy as exc:
+            busy = exc
+            break
+    assert busy is not None, "queue bound of 2 never tripped in 5 submits"
+    assert isinstance(busy, MXNetError)        # catchable as the base
+    assert busy.code == 429 and busy.limit == 2
+    assert busy.queue_depth >= busy.limit
+    doc = busy.to_dict()
+    assert doc["error"] == "server_busy" and doc["retry_after_ms"] is not None
+    assert b.stats()["rejected"] == 1
+    b.close()
+
+
+def test_batcher_rejects_unknown_and_oversized():
+    b = ContinuousBatcher()
+    b.register(FakeEntry("m", buckets=(4,)))
+    with pytest.raises(MXNetError):
+        b.submit("nope", 1)
+    with pytest.raises(MXNetError):
+        b.submit("m", 0, n=5)          # exceeds largest bucket
+    b.close(drain=False)
+
+
+def test_batcher_drain_flushes_then_refuses():
+    """drain() completes every accepted request; submits after drain
+    fail with the 503-flavored ServerBusy."""
+    entry = FakeEntry("m", buckets=(8,), delay_s=0.01)
+    b = ContinuousBatcher(max_delay_ms_=10_000, max_queue_=64)
+    b.register(entry)
+    futs = [b.submit("m", i) for i in range(5)]
+    b.drain(timeout=10)
+    assert [f.result(timeout=1) for f in futs] == [0, 2, 4, 6, 8]
+    with pytest.raises(ServerBusy) as exc_info:
+        b.submit("m", 9)
+    assert exc_info.value.code == 503
+    b.close()
+
+
+def test_batcher_failure_propagates_to_futures():
+    class Exploding(FakeEntry):
+        def launch(self, payload, bucket):
+            raise RuntimeError("kaboom")
+    b = ContinuousBatcher(max_delay_ms_=5)
+    b.register(Exploding("m", buckets=(4,)))
+    fut = b.submit("m", 1)
+    with pytest.raises(RuntimeError, match="kaboom"):
+        fut.result(timeout=10)
+    assert b.stats()["failed"] == 1
+    b.close(drain=False)
+
+
+def test_batcher_emits_serve_telemetry(tmp_path, monkeypatch):
+    """Each dispatched batch lands one 'serve' record; serve_report
+    derives per-model QPS/latency/occupancy from them."""
+    from mxnet_tpu.observability import events
+    monkeypatch.setenv("MXTPU_TELEMETRY", "1")
+    monkeypatch.setenv("MXTPU_TELEMETRY_DIR", str(tmp_path))
+    events.refresh()
+    try:
+        b = ContinuousBatcher(max_delay_ms_=5)
+        b.register(FakeEntry("m", buckets=(4,)))
+        futs = [b.submit("m", i) for i in range(8)]
+        for f in futs:
+            f.result(timeout=10)
+        b.close()
+        events.flush()
+        from mxnet_tpu.observability import aggregate
+        records = aggregate.read_events(str(tmp_path))
+        serves = [r for r in records if r["kind"] == "serve"]
+        assert serves, records
+        rec = serves[0]
+        for field in ("model", "bucket", "n_requests", "occupancy",
+                      "padding_waste", "queue_wait_ms", "pack_ms",
+                      "device_ms", "unpack_ms", "lat_ms"):
+            assert field in rec, rec
+        rep = serve_report(records)
+        m = rep["models"]["m"]
+        assert m["requests"] == 8
+        assert m["latency_ms"]["p95"] is not None
+        assert rep["total"]["requests"] == 8
+        # the merged pod report carries the same rollup for mxtop
+        full = aggregate.build_report(records)
+        assert full["serve"]["models"]["m"]["requests"] == 8
+    finally:
+        monkeypatch.delenv("MXTPU_TELEMETRY")
+        monkeypatch.delenv("MXTPU_TELEMETRY_DIR")
+        events.refresh()
+
+
+def test_serve_is_a_registered_event_kind():
+    from mxnet_tpu.observability.events import KINDS
+    assert "serve" in KINDS
+
+
+# ---------------------------------------------------------------------------
+# ModelServer end-to-end (toy MLP on the CPU mesh)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def toy_model():
+    net = mx.models.get_mlp(num_classes=3, hidden=(8,))
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, 10))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    arg_params, aux_params = mod.get_params()
+    params = {"arg:" + k: v for k, v in arg_params.items()}
+    params.update({"aux:" + k: v for k, v in aux_params.items()})
+    return net, params
+
+
+def test_server_matches_serial_predictor(toy_model):
+    """Batched results must be numerically identical to what a plain
+    per-request Predictor computes — batching moves requests, never
+    numbers."""
+    net, params = toy_model
+    srv = ModelServer(max_delay_ms=5)
+    srv.add_model("toy", net.tojson(), params, {"data": (10,)},
+                  buckets=(1, 4))
+    rng = np.random.RandomState(3)
+    payloads = [rng.rand(n, 10).astype("float32")
+                for n in (1, 2, 4, 3, 1, 2)]
+    futs = [srv.submit("toy", x) for x in payloads]
+    got = [f.result(timeout=30) for f in futs]
+    srv.close()
+    for x, out in zip(payloads, got):
+        ref = mx.Predictor(net.tojson(), params,
+                           {"data": x.shape}).forward(data=x)
+        assert out[0].shape == (x.shape[0], 3)
+        np.testing.assert_allclose(out[0], ref[0], rtol=1e-5, atol=1e-6)
+
+
+def test_server_zero_lowerings_after_warmup(toy_model):
+    """The AOT contract: add_model pre-compiles every bucket; serving
+    any number of requests afterwards performs zero new lowerings."""
+    net, params = toy_model
+    srv = ModelServer(max_delay_ms=2)
+    srv.add_model("toy", net.tojson(), params, {"data": (10,)},
+                  histogram={1: 10, 3: 5, 4: 1})
+    before = program_registry_stats()["lowerings"]
+    rng = np.random.RandomState(5)
+    futs = [srv.submit("toy", rng.rand(n, 10).astype("float32"))
+            for n in (1, 3, 4) * 10]
+    for f in futs:
+        f.result(timeout=30)
+    stats = srv.stats()
+    srv.close()
+    assert program_registry_stats()["lowerings"] == before
+    assert stats["models"]["toy"]["lowerings_since_warmup"] == 0
+    assert stats["registry"]["programs"] >= 1
+
+
+def test_server_validates_inputs(toy_model):
+    net, params = toy_model
+    srv = ModelServer(max_delay_ms=2)
+    srv.add_model("toy", net.tojson(), params, {"data": (10,)},
+                  buckets=(2,))
+    with pytest.raises(MXNetError):
+        srv.submit("nope", np.zeros((1, 10), "float32"))
+    with pytest.raises(MXNetError):            # bad per-sample shape
+        srv.submit("toy", np.zeros((1, 7), "float32"))
+    with pytest.raises(MXNetError):            # exceeds largest bucket
+        srv.submit("toy", np.zeros((3, 10), "float32"))
+    # a single bare sample (no batch axis) is promoted to n=1
+    out = srv.predict("toy", np.zeros(10, "float32"))
+    assert out[0].shape == (1, 3)
+    srv.close()
+
+
+def test_server_plans_from_histogram(toy_model):
+    """add_model without explicit buckets consults the planner (and the
+    plan beats pow-2 on a skewed histogram, end to end)."""
+    net, params = toy_model
+    srv = ModelServer(max_delay_ms=2)
+    plan = srv.add_model("toy", net.tojson(), params, {"data": (10,)},
+                         histogram=SKEWED, max_buckets=3)
+    srv.close()
+    assert isinstance(plan, BucketPlan)
+    assert len(plan.buckets) <= 3
+    assert plan.cost < plan.pow2_cost
+    for size in SKEWED:
+        assert plan.bucket_for(size) is not None
+
+
+# ---------------------------------------------------------------------------
+# predictor satellites
+# ---------------------------------------------------------------------------
+
+def test_second_predictor_zero_lowerings(toy_model):
+    """Constructing a second Predictor for the same symbol/shape reuses
+    the program registry: zero new lowerings, counted hits."""
+    net, params = toy_model
+    p1 = mx.Predictor(net.tojson(), params, {"data": (2, 10)})
+    stats1 = mx.Predictor.compile_stats()
+    p2 = mx.Predictor(net.tojson(), params, {"data": (2, 10)})
+    stats2 = mx.Predictor.compile_stats()
+    assert stats2["lowerings"] == stats1["lowerings"]
+    assert stats2["hits"] > stats1["hits"]
+    x = np.random.rand(2, 10).astype("float32")
+    np.testing.assert_allclose(p1.forward(data=x)[0],
+                               p2.forward(data=x)[0], rtol=1e-6)
+
+
+def test_forward_async_matches_forward(toy_model):
+    net, params = toy_model
+    pred = mx.Predictor(net.tojson(), params, {"data": (2, 10)})
+    x = np.random.rand(2, 10).astype("float32")
+    ref = pred.forward(data=x)
+    raw = pred.forward_async(data=x)
+    assert len(raw) == len(ref)
+    np.testing.assert_allclose(np.asarray(raw[0]), ref[0], rtol=1e-6)
+
+
+def test_forward_async_results_survive_next_dispatch(toy_model):
+    """Async results are owned by the caller: dispatching batch N+1
+    must not clobber batch N's arrays (the in-place output slots of
+    plain forward() would)."""
+    net, params = toy_model
+    pred = mx.Predictor(net.tojson(), params, {"data": (1, 10)})
+    xa = np.full((1, 10), 0.25, "float32")
+    xb = np.full((1, 10), 0.75, "float32")
+    ref_a = pred.forward(data=xa)[0].copy()
+    raw_a = pred.forward_async(data=xa)
+    _raw_b = pred.forward_async(data=xb)
+    np.testing.assert_allclose(np.asarray(raw_a[0]), ref_a, rtol=1e-6)
+
+
+def test_load_ndarray_file_round_trip(tmp_path):
+    """Satellite: bytes, str path, and os.PathLike all round-trip."""
+    from mxnet_tpu.predictor import load_ndarray_file
+    arrays = {"arg:w": mx.nd.array(np.arange(6.0).reshape(2, 3)),
+              "aux:m": mx.nd.ones((4,))}
+    path = tmp_path / "weights.params"
+    mx.nd.save(str(path), arrays)
+    for src in (str(path), path, open(str(path), "rb").read()):
+        loaded = load_ndarray_file(src)
+        assert sorted(loaded) == ["arg:w", "aux:m"]
+        np.testing.assert_array_equal(loaded["arg:w"].asnumpy(),
+                                      arrays["arg:w"].asnumpy())
+        np.testing.assert_array_equal(loaded["aux:m"].asnumpy(),
+                                      arrays["aux:m"].asnumpy())
+
+
+def test_predictor_accepts_pathlike_checkpoint(tmp_path, toy_model):
+    """Satellite: Predictor takes os.PathLike for both files."""
+    import pathlib
+    net, params = toy_model
+    arg_params = {k[4:]: v for k, v in params.items()
+                  if k.startswith("arg:")}
+    aux_params = {k[4:]: v for k, v in params.items()
+                  if k.startswith("aux:")}
+    prefix = str(tmp_path / "toy")
+    mx.model.save_checkpoint(prefix, 1, net, arg_params, aux_params)
+    sym_path = pathlib.Path(prefix + "-symbol.json")
+    params_path = pathlib.Path(prefix + "-0001.params")
+    pred = mx.Predictor(sym_path, params_path, {"data": (1, 10)})
+    out = pred.forward(data=np.zeros((1, 10), "float32"))
+    assert out[0].shape == (1, 3)

@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""mxserve — HTTP/JSON front end for the mxnet_tpu batching server.
+
+Serves ``save_checkpoint`` prefixes (or raw symbol JSON + params files)
+through :class:`mxnet_tpu.serving.ModelServer`: buckets are planned (or
+taken from ``--buckets``), every (model, bucket) pair is pre-compiled at
+startup, and concurrent requests are continuously batched under the
+``MXTPU_SERVE_*`` SLO knobs (docs/serving.md).
+
+    # one model from a checkpoint prefix (epoch 3)
+    python tools/mxserve.py --checkpoint model/mnist@3 --name mnist \\
+        --shapes "data=(784,)" --histogram "1:100,8:20" --port 8911
+
+    # raw symbol + params, explicit buckets
+    python tools/mxserve.py --symbol net-symbol.json --params net.params \\
+        --name net --shapes "data=(3,224,224)" --buckets 1,8,32
+
+Endpoints:
+    POST /v1/predict   {"model": "mnist", "inputs": {"data": [[...]]}}
+                       -> {"model", "n", "outputs": [[...]]}
+                       (single-input models may pass "inputs": [[...]])
+    GET  /v1/stats     ModelServer.stats() JSON
+    GET  /healthz      200 "ok"
+
+Backpressure surfaces as real HTTP 429 (queue full, with a
+``retry_after_ms`` hint mirrored in the Retry-After header) or 503
+(draining); both bodies are the structured ServerBusy dict.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def parse_shapes(spec):
+    """``"data=(784,),mask=(16,)"`` -> {name: per-sample shape tuple}."""
+    out = {}
+    depth, start = 0, 0
+    parts = []
+    for i, ch in enumerate(spec):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(spec[start:i])
+            start = i + 1
+    parts.append(spec[start:])
+    for part in parts:
+        part = part.strip()
+        if not part:
+            continue
+        name, _, dims = part.partition("=")
+        dims = dims.strip().strip("()")
+        shape = tuple(int(d) for d in dims.split(",") if d.strip())
+        out[name.strip()] = shape
+    return out
+
+
+def build_server(args):
+    import numpy as np  # noqa: F401  (models need it transitively)
+    from mxnet_tpu.serving import ModelServer, checkpoint_files
+
+    srv = ModelServer(max_delay_ms=args.max_delay_ms,
+                      max_queue=args.max_queue)
+    shapes = parse_shapes(args.shapes)
+    if not shapes:
+        raise SystemExit("mxserve: --shapes is required (per-sample, "
+                         "no batch axis)")
+    if args.checkpoint:
+        prefix, _, epoch = args.checkpoint.partition("@")
+        symbol, params = checkpoint_files(prefix, int(epoch or 0))
+    elif args.symbol and args.params:
+        symbol, params = args.symbol, args.params
+    else:
+        raise SystemExit("mxserve: pass --checkpoint prefix@epoch or "
+                         "--symbol + --params")
+    plan = srv.add_model(
+        args.name, symbol, params, shapes,
+        histogram=args.histogram, buckets=args.buckets,
+        priority=args.priority,
+        max_buckets=args.max_buckets)
+    sys.stderr.write("mxserve: model %r buckets %s (planned waste %.3f, "
+                     "pow2 %.3f)\n" % (args.name, list(plan.buckets),
+                                       plan.waste, plan.pow2_waste))
+    return srv
+
+
+def make_handler(srv):
+    from http.server import BaseHTTPRequestHandler
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.serving import ServerBusy
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code, doc, headers=()):
+            body = json.dumps(doc, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *fmt_args):  # quiet by default
+            if os.environ.get("MXTPU_SERVE_VERBOSE"):
+                sys.stderr.write("mxserve: " + fmt % fmt_args + "\n")
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"status": "ok"})
+            elif self.path == "/v1/stats":
+                self._reply(200, srv.stats())
+            else:
+                self._reply(404, {"error": "not_found", "path": self.path})
+
+        def do_POST(self):
+            if self.path != "/v1/predict":
+                self._reply(404, {"error": "not_found", "path": self.path})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                model = doc.get("model") or srv.models()[0]
+                inputs = doc["inputs"]
+                if isinstance(inputs, dict):
+                    import numpy as np
+                    inputs = {k: np.asarray(v, dtype="float32")
+                              for k, v in inputs.items()}
+                else:
+                    import numpy as np
+                    inputs = np.asarray(inputs, dtype="float32")
+                outs = srv.predict(model, inputs,
+                                   timeout=float(doc.get("timeout") or 30))
+            except ServerBusy as busy:
+                hdrs = []
+                if busy.retry_after_ms:
+                    hdrs.append(("Retry-After",
+                                 "%.3f" % (busy.retry_after_ms / 1e3)))
+                self._reply(busy.code, busy.to_dict(), hdrs)
+                return
+            except (KeyError, ValueError, TypeError, MXNetError) as exc:
+                # unknown model / shape mismatch / malformed body: the
+                # client's fault, not the server's
+                self._reply(400, {"error": "bad_request",
+                                  "reason": str(exc)})
+                return
+            except Exception as exc:
+                self._reply(500, {"error": "internal",
+                                  "reason": str(exc)})
+                return
+            self._reply(200, {"model": model, "n": int(outs[0].shape[0]),
+                              "outputs": [o.tolist() for o in outs]})
+
+    return Handler
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxserve", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--checkpoint",
+                    help="save_checkpoint prefix@epoch (e.g. m/mnist@3)")
+    ap.add_argument("--symbol", help="symbol JSON path")
+    ap.add_argument("--params", help="params file path")
+    ap.add_argument("--name", default="model", help="served model name")
+    ap.add_argument("--shapes", required=True,
+                    help='per-sample input shapes, "data=(784,)"')
+    ap.add_argument("--histogram",
+                    help='offered-load histogram "1:100,8:20" '
+                         "(plans buckets)")
+    ap.add_argument("--buckets", help='explicit buckets "1,8,32"')
+    ap.add_argument("--max-buckets", type=int, default=None)
+    ap.add_argument("--max-delay-ms", type=float, default=None,
+                    help="admission timer (MXTPU_SERVE_MAX_DELAY_MS)")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="queue bound (MXTPU_SERVE_MAX_QUEUE)")
+    ap.add_argument("--priority", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8911)
+    args = ap.parse_args(argv)
+
+    srv = build_server(args)
+
+    from http.server import ThreadingHTTPServer
+    httpd = ThreadingHTTPServer((args.host, args.port), make_handler(srv))
+
+    def shutdown(_sig, _frm):
+        # graceful drain: stop admission, flush accepted requests
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+
+    sys.stderr.write("mxserve: listening on http://%s:%d\n"
+                     % (args.host, args.port))
+    try:
+        httpd.serve_forever()
+    finally:
+        srv.close()
+        httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

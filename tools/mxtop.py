@@ -96,6 +96,39 @@ def render(report, stream=sys.stdout):
                 or rec.get("path") or ""))
 
 
+def render_serve(report, stream=sys.stdout):
+    """The serving view (--serve): per-model QPS, latency percentiles,
+    occupancy, padding waste, queue depth from the ``serve`` events."""
+    w = stream.write
+    sv = report.get("serve") or {}
+    models = sv.get("models") or {}
+    if not models:
+        w("no serve events.\n")
+        return
+    total = sv.get("total") or {}
+    tlat = total.get("latency_ms") or {}
+    w("mxserve — %d model(s)   qps %s   p95 %s ms   requests %s\n" % (
+        len(models), _fmt(total.get("qps"), width=8).strip(),
+        _fmt(tlat.get("p95"), width=8).strip(),
+        total.get("requests", 0)))
+    w("%-12s %8s %8s %10s %10s %10s %10s %8s  %s\n" % (
+        "model", "reqs", "qps", "p50 ms", "p95 ms", "p99 ms",
+        "occupancy", "waste", "queue max / buckets"))
+    for name, m in sorted(models.items()):
+        lat = m.get("latency_ms") or {}
+        w("%-12s %8s %8s %10s %10s %10s %10s %8s  %s / %s\n" % (
+            name, m.get("requests", 0),
+            _fmt(m.get("qps"), width=8).strip(),
+            _fmt(lat.get("p50"), width=10).strip(),
+            _fmt(lat.get("p95"), width=10).strip(),
+            _fmt(lat.get("p99"), width=10).strip(),
+            _fmt(m.get("occupancy"), width=10).strip(),
+            _fmt(m.get("padding_waste"), width=8).strip(),
+            m.get("queue_depth_max", 0),
+            " ".join("%s×%s" % (b, c)
+                     for b, c in (m.get("buckets") or {}).items())))
+
+
 def render_fault_timelines(records, before, after, stream=sys.stdout):
     w = stream.write
     hits = [i for i, r in enumerate(records)
@@ -133,6 +166,9 @@ def main(argv=None):
     ap.add_argument("--follow", action="store_true",
                     help="re-render every --interval seconds")
     ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--serve", action="store_true",
+                    help="serving view: per-model QPS, p95, occupancy, "
+                         "queue depth from serve events")
     ap.add_argument("--fault", action="store_true",
                     help="print the event timeline around each fault")
     ap.add_argument("--window", type=int, default=5,
@@ -147,8 +183,11 @@ def main(argv=None):
         records = aggregate.read_events(args.directory)
         report = aggregate.build_report(records)
         if args.json:
-            json.dump(report, sys.stdout, indent=2, default=str)
+            doc = report.get("serve", {}) if args.serve else report
+            json.dump(doc, sys.stdout, indent=2, default=str)
             sys.stdout.write("\n")
+        elif args.serve:
+            render_serve(report)
         elif args.fault:
             render_fault_timelines(records, args.window, args.window)
         else:

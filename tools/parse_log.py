@@ -110,6 +110,29 @@ def parse_telemetry(path):
             overlap_cols["%s-ms-p50" % name.replace("_", "-")] = p50
     except Exception:
         pass
+    # run-global serving columns (docs/serving.md) from "serve" records:
+    # QPS, request p50/p95 latency, occupancy, padding waste
+    try:
+        from mxnet_tpu.serving.telemetry import serve_report
+        sv = serve_report(records)
+        total = sv.get("total") or {}
+        if total.get("requests"):
+            if total.get("qps") is not None:
+                overlap_cols["serve-qps"] = total["qps"]
+            lat = total.get("latency_ms") or {}
+            if lat.get("p50") is not None:
+                overlap_cols["serve-ms-p50"] = lat["p50"]
+            if lat.get("p95") is not None:
+                overlap_cols["serve-ms-p95"] = lat["p95"]
+            if total.get("occupancy") is not None:
+                overlap_cols["serve-occupancy"] = total["occupancy"]
+            if total.get("padding_waste") is not None:
+                overlap_cols["serve-padding-waste"] = total["padding_waste"]
+    except Exception:
+        pass
+    if not acc and any(c.startswith("serve-") for c in overlap_cols):
+        # serving-only event stream (serve_bench/mxserve): one summary row
+        acc[0] = {"steps": 0, "dur_ms": [], "sps": []}
     rows = {}
     for ep, row in acc.items():
         out = {"steps": row["steps"]}

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""serve_bench — closed/open-loop load generator for the batching server.
+
+Drives an in-process :class:`mxnet_tpu.serving.ModelServer` over a toy
+MLP (or a ``--checkpoint prefix@epoch``) with a weighted request-size
+distribution, and prints exactly ONE BENCH-style JSON line:
+
+    {"metric": "serve_throughput_rps", "value": ..., "unit": "req/s",
+     "latency_ms": {"p50","p95","p99","mean"}, "occupancy": ...,
+     "padding_waste": ..., "lowerings_after_warmup": 0, "buckets": [...],
+     "rejected": 0, "mode": "closed", "requests": 200, ...}
+
+Modes:
+    closed  (default) ``--concurrency`` workers, each submits its next
+            request the moment the previous one completes — measures
+            sustainable throughput.
+    open    requests arrive on a fixed ``--rate`` schedule regardless of
+            completions — measures latency under offered load (and how
+            the 429 backpressure behaves past saturation).
+
+``lowerings_after_warmup`` comes from the executor program-registry
+counters: the AOT contract is that it stays 0 no matter how many
+requests run (the CI smoke asserts exactly that).  With telemetry on
+(``MXTPU_TELEMETRY_DIR``), per-batch ``serve`` events flow to the event
+log for ``mxtop --serve`` / ``parse_log.py``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def build_model(args):
+    """(symbol_json, params dict, per-sample input shapes, input name)."""
+    import mxnet_tpu as mx
+    if args.checkpoint:
+        from mxnet_tpu.serving import checkpoint_files
+        prefix, _, epoch = args.checkpoint.partition("@")
+        sym_path, params_path = checkpoint_files(prefix, int(epoch or 0))
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from mxserve import parse_shapes
+        shapes = parse_shapes(args.shapes)
+        return sym_path, params_path, shapes
+    # toy MLP: feature dim sized so the matmuls are real but CPU-fast
+    net = mx.models.get_mlp(num_classes=10, hidden=(64, 32))
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, args.features))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    arg_params, aux_params = mod.get_params()
+    params = {"arg:" + k: v for k, v in arg_params.items()}
+    params.update({"aux:" + k: v for k, v in aux_params.items()})
+    return net.tojson(), params, {"data": (args.features,)}
+
+
+def sample_sizes(dist, count, seed):
+    """Deterministic weighted request-size sequence from "1:100,8:20"."""
+    from mxnet_tpu.serving import parse_histogram
+    hist = parse_histogram(dist)
+    sizes, weights = zip(*sorted(hist.items()))
+    rng = random.Random(seed)
+    return [rng.choices(sizes, weights=weights)[0] for _ in range(count)]
+
+
+def run_closed(srv, model, inputs_for, sizes, concurrency):
+    """Closed loop: each worker's next request waits on its previous."""
+    lock = threading.Lock()
+    cursor = [0]
+    errors = []
+
+    def worker():
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= len(sizes):
+                    return
+                cursor[0] += 1
+            try:
+                srv.predict(model, inputs_for(sizes[i]), timeout=60.0)
+            except Exception as exc:
+                errors.append(exc)
+                return
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, 0, errors
+
+
+def run_open(srv, model, inputs_for, sizes, rate):
+    """Open loop: fixed-rate arrivals; 429 rejections are counted, not
+    retried (the generator models clients that back off)."""
+    from mxnet_tpu.serving import ServerBusy
+    futures, rejected, errors = [], 0, []
+    period = 1.0 / rate if rate > 0 else 0.0
+    t0 = time.perf_counter()
+    for i, n in enumerate(sizes):
+        target = t0 + i * period
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            futures.append(srv.submit(model, inputs_for(n)))
+        except ServerBusy:
+            rejected += 1
+    for fut in futures:
+        try:
+            fut.result(timeout=60.0)
+        except Exception as exc:
+            errors.append(exc)
+    return time.perf_counter() - t0, rejected, errors
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="serve_bench", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop worker count")
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="open-loop arrival rate (req/s)")
+    ap.add_argument("--sizes", default="1:60,2:25,4:10,8:5",
+                    help='request-size distribution "n:weight,..."')
+    ap.add_argument("--buckets", default=None,
+                    help='explicit buckets "1,8" (default: planner '
+                         "output over --sizes)")
+    ap.add_argument("--max-delay-ms", type=float, default=None)
+    ap.add_argument("--max-queue", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--features", type=int, default=128,
+                    help="toy-MLP feature dim")
+    ap.add_argument("--checkpoint", help="serve prefix@epoch instead of "
+                                         "the toy MLP")
+    ap.add_argument("--shapes", default="data=(128,)",
+                    help="per-sample shapes (with --checkpoint)")
+    ap.add_argument("--json", action="store_true",
+                    help="(default behavior; kept for symmetry)")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+    from mxnet_tpu.serving import ModelServer
+
+    symbol, params, shapes = build_model(args)
+    input_name = next(iter(shapes))
+    srv = ModelServer(max_delay_ms=args.max_delay_ms,
+                      max_queue=args.max_queue)
+    plan = srv.add_model("bench", symbol, params, shapes,
+                         histogram=args.sizes, buckets=args.buckets)
+
+    rng = np.random.RandomState(args.seed)
+    # pre-generate request payloads outside the timed window
+    sizes = sample_sizes(args.sizes, args.requests, args.seed)
+    pool = {n: rng.rand(n, *shapes[input_name]).astype("float32")
+            for n in set(sizes)}
+
+    def inputs_for(n):
+        return pool[n]
+
+    # warmup traffic (not timed): one request per bucket through the
+    # full pipeline, then snapshot the registry counters
+    for b in plan.buckets:
+        srv.predict("bench", pool.get(b, rng.rand(
+            b, *shapes[input_name]).astype("float32")))
+    from mxnet_tpu.executor import program_registry_stats
+    lowerings_at_warmup = program_registry_stats()["lowerings"]
+
+    if args.mode == "closed":
+        wall_s, rejected, errors = run_closed(
+            srv, "bench", inputs_for, sizes, args.concurrency)
+    else:
+        wall_s, rejected, errors = run_open(
+            srv, "bench", inputs_for, sizes, args.rate)
+
+    stats = srv.stats()
+    lowerings_after = program_registry_stats()["lowerings"] \
+        - lowerings_at_warmup
+    srv.close()
+    try:
+        from mxnet_tpu.observability import events as _events
+        _events.flush()
+    except Exception:
+        pass
+
+    completed = args.requests - rejected - len(errors)
+    out = {
+        "metric": "serve_throughput_rps",
+        "value": round(completed / wall_s, 2) if wall_s > 0 else 0.0,
+        "unit": "req/s",
+        "mode": args.mode,
+        "requests": args.requests,
+        "completed": completed,
+        "rejected": rejected,
+        "errors": len(errors),
+        "wall_s": round(wall_s, 3),
+        "latency_ms": stats.get("latency_ms"),
+        "occupancy": stats.get("occupancy"),
+        "padding_waste": stats.get("padding_waste"),
+        "planned_waste": round(plan.waste, 4),
+        "pow2_waste": round(plan.pow2_waste, 4),
+        "buckets": list(plan.buckets),
+        "batches": stats.get("batches"),
+        "lowerings_after_warmup": lowerings_after,
+    }
+    if errors:
+        out["first_error"] = repr(errors[0])
+    print(json.dumps(out, default=str))
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
