@@ -25,6 +25,7 @@ assign), not per-pair engine ops.
 from __future__ import annotations
 
 import pickle
+import time
 
 import jax
 import jax.numpy as jnp
@@ -238,8 +239,18 @@ class KVStore(object):
         if not (self.type.startswith("dist") and jax.process_count() > 1):
             return merged
         from .observability import spans as _spans, events as _events
+        from .observability import trace as _trace, flight as _flight
         nbytes = getattr(merged, "nbytes", None)
         timeout = _collective_timeout_s()
+        # rank-uniform sequence number: @collective_seam guarantees every
+        # rank launches its collectives in the same order, so (op, seq)
+        # names ONE pod-wide collective — the handle the flight-recorder
+        # ledger and mxtrace's cross-rank flow stitching key on
+        seq = _trace.next_seq("allreduce")
+        _flight.collective_begin(
+            "allreduce", seq, participants=list(range(self.num_workers)),
+            bytes=nbytes, rank=self.rank)
+        t0 = time.perf_counter()
         with _spans.span("allreduce"):
             if timeout:
                 # a peer that died mid-push leaves everyone else wedged
@@ -251,8 +262,13 @@ class KVStore(object):
                     phase="kvstore_push", rank=self.rank)
             else:
                 out = self._allreduce_dist(merged)
-        _events.emit("collective", op="allreduce", bytes=nbytes,
-                     num_workers=self.num_workers)
+        # only a COMPLETED collective leaves the pending ledger: on the
+        # exception path the entry survives into the flight dump, naming
+        # the hung (op, seq) for the postmortem
+        _flight.collective_end("allreduce", seq)
+        _events.emit("collective", op="allreduce", seq=seq, bytes=nbytes,
+                     dur_ms=round((time.perf_counter() - t0) * 1e3, 3),
+                     num_workers=self.num_workers, **_trace.ids())
         return out
 
     @collective_seam
@@ -457,6 +473,12 @@ class KVStore(object):
                 global_barrier("kv_barrier", timeout_s=timeout)
 
             from .observability import spans as _spans
+            from .observability import trace as _trace, flight as _flight
+            seq = _trace.next_seq("barrier")
+            _flight.collective_begin(
+                "barrier", seq,
+                participants=list(range(self.num_workers)),
+                rank=self.rank)
             with _spans.span("kv_barrier"):
                 if timeout:
                     from .resilience import run_with_timeout
@@ -465,6 +487,7 @@ class KVStore(object):
                                      rank=self.rank)
                 else:
                     _sync()
+            _flight.collective_end("barrier", seq)
 
     def _barrier(self):
         self.barrier()
@@ -806,4 +829,14 @@ def create(name="local"):
     if base.startswith("dist"):
         _maybe_init_distributed()
         _start_heartbeat()
-    return KVStore(base)
+    store = KVStore(base)
+    if base.startswith("dist"):
+        # teach the flight recorder who is alive: a hung-collective dump
+        # can then say which participant never showed up, not just that
+        # seq K is stuck (the heartbeat scan is non-blocking)
+        try:
+            from .observability import flight as _flight
+            _flight.set_liveness_probe(lambda: store.dead_nodes())
+        except Exception:
+            pass
+    return store

@@ -15,25 +15,29 @@ telemetry is off, so the default path pays one cached boolean check.
 """
 from __future__ import annotations
 
-from . import events, spans, counters, aggregate
+from . import (events, spans, counters, aggregate, phases, trace,
+               flight, slo)
 from .events import (enabled, emit, flush, refresh, run_id, last_fault,
                      EventLog)
+from .phases import PHASES, TRAIN_PHASES, SERVE_PHASES
 from .spans import span, timed_iter, SPAN_NAMES, overlap_report
 from .counters import (StepStats, percentile, global_stats,
                        emit_trainer_counters, emit_sentinel_counters)
 from .aggregate import (publish_summary, collect_summaries,
                         heartbeat_ages, pod_view, read_events,
-                        build_report)
+                        build_report, EventTailer)
 
 __all__ = [
-    "events", "spans", "counters", "aggregate",
+    "events", "spans", "counters", "aggregate", "phases", "trace",
+    "flight", "slo",
     "enabled", "emit", "flush", "refresh", "run_id", "last_fault",
     "EventLog",
+    "PHASES", "TRAIN_PHASES", "SERVE_PHASES",
     "span", "timed_iter", "SPAN_NAMES", "overlap_report",
     "StepStats", "percentile", "global_stats",
     "emit_trainer_counters", "emit_sentinel_counters",
     "publish_summary", "collect_summaries", "heartbeat_ages",
-    "pod_view", "read_events", "build_report",
+    "pod_view", "read_events", "build_report", "EventTailer",
     "record_step",
 ]
 
@@ -46,7 +50,12 @@ def record_step(step, dur_s, batch_size=None, epoch=None, **fields):
     on: emits the ``step`` record, folds the timing into the process
     :class:`StepStats`, and every ``_PUBLISH_EVERY`` steps pushes the
     compact summary to the coordination KV for the live pod view.
-    No-op when telemetry is off; never raises."""
+    No-op when telemetry is off (the step still lands in the crash
+    flight recorder's bounded ring); never raises."""
+    try:
+        flight.note("step", step, {"dur_ms": round(float(dur_s) * 1e3, 3)})
+    except Exception:
+        pass
     log = events.get()
     if log is None:
         return

@@ -214,6 +214,68 @@ def read_events(directory):
     return records
 
 
+class EventTailer(object):
+    """Rotation-safe incremental reader over one telemetry dir.
+
+    Each :meth:`poll` returns only the records appended since the last
+    call, across every ``events-rank*.jsonl`` (and rotated ``.1``)
+    file.  Offsets are tracked **per inode**, not per path: when the
+    writer hits ``MXTPU_TELEMETRY_MAX_MB`` and renames the live file to
+    ``.1``, the next poll drains the renamed file from its prior offset
+    and starts the fresh live file at zero — a follower never tails a
+    dead inode and never re-reads what it already returned.  A partial
+    trailing line (a record mid-write) is carried per inode until a
+    later poll completes it, so rotation/kill can tear at most the
+    final unflushed record, never a returned one.
+    """
+
+    def __init__(self, directory):
+        self.directory = str(directory)
+        self._state = {}        # inode -> (byte offset, carry bytes)
+
+    def poll(self):
+        """New records (wall-clock ordered) since the previous poll."""
+        paths = sorted(_glob.glob(os.path.join(
+            self.directory, "events-rank*.jsonl.1")))
+        paths += sorted(_glob.glob(os.path.join(
+            self.directory, "events-rank*.jsonl")))
+        records = []
+        seen = set()
+        for path in paths:
+            try:
+                with open(path, "rb") as fin:
+                    ino = os.fstat(fin.fileno()).st_ino
+                    seen.add(ino)
+                    offset, carry = self._state.get(ino, (0, b""))
+                    fin.seek(offset)
+                    chunk = fin.read()
+                    offset = fin.tell()
+            except OSError:
+                continue
+            if not chunk:
+                self._state[ino] = (offset, carry)
+                continue
+            lines = (carry + chunk).split(b"\n")
+            carry = lines.pop()          # b"" when chunk ended on \n
+            self._state[ino] = (offset, carry)
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line.decode("utf-8", "replace"))
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+        for ino in list(self._state):    # bound: forget deleted files
+            if ino not in seen:
+                del self._state[ino]
+        records.sort(key=lambda r: (r.get("wall_ms") or 0,
+                                    r.get("rank") or 0))
+        return records
+
+
 def build_report(records, now=None):
     """The pod report from merged event records (what ``mxtop`` shows).
 

@@ -16,8 +16,8 @@ import os
 
 from . import events
 
-__all__ = ["percentile", "StepStats", "global_stats", "reset",
-           "peak_tflops", "mfu", "collective_bytes",
+__all__ = ["percentile", "rel_spread", "StepStats", "global_stats",
+           "reset", "peak_tflops", "mfu", "collective_bytes",
            "emit_trainer_counters", "emit_sentinel_counters",
            "emit_static_roofline"]
 
@@ -33,6 +33,22 @@ def percentile(values, pct):
     idx = max(0, min(len(vals) - 1,
                      int(round(pct / 100.0 * (len(vals) - 1)))))
     return vals[idx]
+
+
+def rel_spread(values):
+    """Robust relative spread of a metric series: median absolute
+    deviation over |median| (0.0 for <2 samples or a zero median).
+    The noise estimate the SLO sentry (:mod:`.slo`) widens its
+    regression thresholds by — MAD, not stddev, because a bench
+    trajectory routinely contains one wild outlier round."""
+    vals = [float(v) for v in values if v is not None]
+    if len(vals) < 2:
+        return 0.0
+    med = percentile(vals, 50)
+    if not med:
+        return 0.0
+    mad = percentile([abs(v - med) for v in vals], 50)
+    return abs(mad / med)
 
 
 class StepStats(object):

@@ -266,7 +266,11 @@ def refresh():
 
 def emit(kind, step=None, **fields):
     """Record one event iff telemetry is enabled (the library seam —
-    cheap no-op otherwise)."""
+    cheap no-op otherwise).  Every call ALSO lands in the crash flight
+    recorder's bounded ring (:mod:`.flight`) first — one deque append
+    — so a postmortem dump has the recent event tail even when
+    telemetry never wrote a file."""
+    _flight.note(kind, step, fields)
     log = get()
     if log is not None:
         log.emit(kind, step=step, **fields)
@@ -284,3 +288,8 @@ def last_fault():
     ranks include it in their published pod summaries."""
     log = _STATE["log"]
     return log.last_fault if log is not None else None
+
+
+from . import flight as _flight  # noqa: E402  (bottom: flight's lazy
+#                                 events imports resolve against the
+#                                 fully-defined module above)

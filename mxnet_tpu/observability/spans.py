@@ -15,13 +15,17 @@ from __future__ import annotations
 import time
 
 from . import events
+from . import trace as _trace
+from .phases import TRAIN_PHASES
 
 __all__ = ["span", "SPAN_NAMES", "timed_iter", "overlap_report"]
 
 #: canonical phase names (free-form names are allowed; these are the
-#: ones the built-in wiring emits and mxtop groups by)
-SPAN_NAMES = ("data_wait", "h2d", "step", "allreduce", "kv_barrier",
-              "ckpt_save", "eval")
+#: ones the built-in wiring emits and mxtop groups by).  Compat alias
+#: for the shared registry — the ONE definition lives in
+#: :mod:`.phases` so spans / profiler.annotate / parse_log columns
+#: can't drift.
+SPAN_NAMES = TRAIN_PHASES
 
 
 class _NullSpan(object):
@@ -38,7 +42,7 @@ _NULL = _NullSpan()
 
 
 class _Span(object):
-    __slots__ = ("name", "step", "fields", "_t0", "_ann")
+    __slots__ = ("name", "step", "fields", "_t0", "_ann", "_ids")
 
     def __init__(self, name, step, fields):
         self.name = name
@@ -46,6 +50,7 @@ class _Span(object):
         self.fields = fields
         self._t0 = None
         self._ann = None
+        self._ids = None
 
     def __enter__(self):
         try:
@@ -54,18 +59,24 @@ class _Span(object):
             self._ann.__enter__()
         except Exception:               # no jax / exotic backend: host
             self._ann = None            # timing still works
+        # MXTPU_TRACE=1: push a trace frame so this span carries
+        # trace/span/parent ids and emits inside it bind to it
+        self._ids = _trace.begin_span(self.name) or None
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         dur_ms = (time.perf_counter() - self._t0) * 1e3
+        if self._ids is not None:
+            _trace.end_span()
         if self._ann is not None:
             try:
                 self._ann.__exit__(*exc)
             except Exception:
                 pass
         events.emit("span", step=self.step, name=self.name,
-                    dur_ms=round(dur_ms, 3), **self.fields)
+                    dur_ms=round(dur_ms, 3), **(self._ids or {}),
+                    **self.fields)
         return False
 
 
@@ -91,15 +102,20 @@ def timed_iter(iterable, name="data_wait", step_from=None):
         return
     it = iter(iterable)
     while True:
+        ids = _trace.begin_span(name)
         t0 = time.perf_counter()
         try:
             item = next(it)
         except StopIteration:
+            if ids:
+                _trace.end_span()
             return
         dur_ms = (time.perf_counter() - t0) * 1e3
+        if ids:
+            _trace.end_span()
         events.emit("span", name=name,
                     step=step_from() if step_from is not None else None,
-                    dur_ms=round(dur_ms, 3))
+                    dur_ms=round(dur_ms, 3), **ids)
         yield item
 
 

@@ -493,6 +493,19 @@ def interleave_grad_buckets(named_grads, order=None, bucket_nbytes=None):
     buckets = partition_buckets(sized, bucket_nbytes)
     if len(buckets) < 2:
         return named_grads
+    # trace-time (host) record of the bucket schedule: bucket index IS
+    # the collective launch order XLA derives, so mxtrace can label the
+    # in-step allreduces without runtime hooks inside the compiled step
+    try:
+        from ..observability import events as _events
+        sizes = {k: n for k, n in sized}
+        _events.emit(
+            "counter", name="grad_buckets", n_buckets=len(buckets),
+            bucket_nbytes=[sum(sizes.get(k, 0) for k in b)
+                           for b in buckets],
+            bucket_keys=[len(b) for b in buckets])
+    except Exception:
+        pass
     out = dict(named_grads)
     prev = None
     for keys in buckets:

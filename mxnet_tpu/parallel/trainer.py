@@ -612,6 +612,13 @@ class ShardedTrainer(object):
             timeout = _resilience.step_timeout_s()
 
         from .. import observability as _obs
+        # the fused step is a pod-wide rendezvous (the in-step psum means
+        # every rank must enter for any to leave), so ledger it like a
+        # collective: a step that never completes stays pending and the
+        # flight dump names which update number the pod is wedged in
+        _obs.flight.collective_begin(
+            "train_step", self.num_update,
+            participants=list(range(jax.process_count())))
         if _obs.events.get() is not None:
             # host dispatch wall only: XLA execution is async, so this
             # understates device time unless the caller syncs (the
@@ -620,20 +627,24 @@ class ShardedTrainer(object):
             t0 = _time.perf_counter()
             try:
                 if timeout:
-                    return _resilience.run_with_timeout(
+                    out = _resilience.run_with_timeout(
                         dispatch, timeout, phase="train_step",
                         step=self.num_update)
-                return dispatch()
+                else:
+                    out = dispatch()
             finally:
                 _obs.record_step(self.num_update,
                                  _time.perf_counter() - t0,
                                  batch_size=self._batch_samples(batch),
                                  timing="dispatch")
-        if timeout:
-            return _resilience.run_with_timeout(
+        elif timeout:
+            out = _resilience.run_with_timeout(
                 dispatch, timeout, phase="train_step",
                 step=self.num_update)
-        return dispatch()
+        else:
+            out = dispatch()
+        _obs.flight.collective_end("train_step", self.num_update)
+        return out
 
     @staticmethod
     def _batch_samples(batch):

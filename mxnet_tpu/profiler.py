@@ -18,9 +18,10 @@ from __future__ import annotations
 import time
 
 from .base import MXNetError
+from .observability.phases import PHASES
 
 __all__ = ["profiler_set_config", "profiler_set_state", "StepTimer",
-           "annotate"]
+           "annotate", "PHASES"]
 
 _config = {"filename": "mxtpu_profile", "mode": "symbolic"}
 _state = "stop"
@@ -50,7 +51,12 @@ def profiler_set_state(state="stop"):
 
 
 class annotate:
-    """Context manager naming a region in the trace (TraceAnnotation)."""
+    """Context manager naming a region in the trace (TraceAnnotation).
+
+    The built-in wiring passes names from the shared phase registry
+    (:data:`PHASES`, re-exported from ``observability.phases``), so an
+    xprof capture and the telemetry event log label the same work with
+    the same strings; free-form names are fine for user regions."""
 
     def __init__(self, name):
         self.name = name

@@ -94,6 +94,17 @@ def exit_for_restart(err):
         _obs.flush()
     except Exception:
         pass
+    # last words: persist the flight-recorder ring + pending-collective
+    # ledger before the hard exit, so the postmortem has the event tail
+    # even when telemetry never wrote a file
+    try:
+        from ..observability import flight as _flight
+        _flight.dump(reason="exit_restart",
+                     extra={"phase": getattr(err, "phase", None),
+                            "step": getattr(err, "step", None),
+                            "error": str(err)})
+    except Exception:
+        pass
     _os._exit(getattr(err, "exit_code", EXIT_RESTART))
 
 

@@ -126,6 +126,14 @@ class Sentinel(object):
             from . import ResilienceError
             self._emit_fault(step, verdict, signal,
                              fault="sentinel_escalate")
+            try:
+                from ..observability import flight as _flight
+                _flight.dump(reason="sentinel_escalate",
+                             extra={"step": step, "verdict": verdict,
+                                    "consecutive":
+                                        self.consecutive_skips})
+            except Exception:
+                pass
             raise ResilienceError(
                 "sentinel: %d consecutive skipped steps — numerics are "
                 "not recovering" % self.consecutive_skips,

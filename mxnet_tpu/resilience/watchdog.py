@@ -46,6 +46,16 @@ def _emit_fault(fault, phase, step, timeout_s):
                  timeout_s=timeout_s)
     except Exception:
         pass
+    # a watchdog firing usually means a wedged collective: dump the
+    # always-on flight recorder NOW, while the pending ledger still
+    # names the (op, seq) that never completed (works with telemetry
+    # off — that is the point of the ring)
+    try:
+        from ..observability import flight as _flight
+        _flight.dump(reason=fault, extra={"phase": phase, "step": step,
+                                          "timeout_s": timeout_s})
+    except Exception:
+        pass
 
 
 def run_with_timeout(fn, timeout_s, phase, step=None, rank=None,

@@ -41,6 +41,7 @@ import time
 from collections import deque
 
 from ..base import MXNetError
+from ..observability import trace as _trace
 from ..parallel.overlap import AsyncLauncher
 from . import telemetry as _tel
 from .buckets import bucket_for
@@ -127,10 +128,13 @@ class Future(object):
 
 class Request(object):
     """One admitted inference request: ``n`` samples of payload for one
-    model, plus the timing trail telemetry reads."""
+    model, plus the timing trail telemetry reads.  Under
+    ``MXTPU_TRACE=1`` each request gets a trace id at admission; the
+    batch's ``serve`` record carries all member ids, so a slow request
+    is traceable through queue → pack → device → unpack."""
 
     __slots__ = ("model", "payload", "n", "t_arrival", "future",
-                 "t_dispatch", "t_done")
+                 "t_dispatch", "t_done", "trace_id")
 
     def __init__(self, model, payload, n):
         self.model = model
@@ -140,6 +144,7 @@ class Request(object):
         self.future = Future()
         self.t_dispatch = None
         self.t_done = None
+        self.trace_id = _trace.new_id() if _trace.enabled() else None
 
 
 class _Batch(object):
@@ -356,7 +361,9 @@ class ContinuousBatcher(object):
             pack_ms=batch.pack_ms,
             device_ms=phases.get("device_ms"),
             unpack_ms=phases.get("unpack_ms"),
-            lat_ms=lat_ms)
+            lat_ms=lat_ms,
+            trace_ids=[r.trace_id for r in batch.requests
+                       if r.trace_id] or None)
 
     def _fail_batch(self, requests, exc):
         with self._lock:

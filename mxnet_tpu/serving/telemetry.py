@@ -18,15 +18,28 @@ from __future__ import annotations
 
 from ..observability import events
 from ..observability.counters import percentile
+from ..observability.phases import SERVE_PHASES
 
-__all__ = ["emit_batch", "serve_report"]
+__all__ = ["emit_batch", "serve_report", "SERVE_PHASES"]
+
+#: (accumulator key, record field) per canonical serving phase —
+#: derived from the shared registry (:mod:`..observability.phases`) so
+#: the serve record schema, this report, and parse_log's columns can't
+#: drift apart
+_PHASE_FIELDS = tuple(("_" + p, p + "_ms") for p in SERVE_PHASES)
 
 
 def emit_batch(model, bucket, n_requests, n_samples, occupancy,
                padding_waste, queue_depth, queue_wait_ms, pack_ms,
-               device_ms, unpack_ms, lat_ms):
+               device_ms, unpack_ms, lat_ms, trace_ids=None):
     """Emit one ``serve`` record for a completed batch (no-op when
-    telemetry is off, like every emit in the tree)."""
+    telemetry is off, like every emit in the tree).  ``trace_ids``:
+    the per-request trace ids of the batch's members when request
+    tracing (``MXTPU_TRACE=1``) is on — how mxtrace links a request's
+    lifecycle back to the batch that served it."""
+    extra = {}
+    if trace_ids:
+        extra["trace_ids"] = list(trace_ids)
     events.emit(
         "serve", model=model, bucket=int(bucket),
         n_requests=int(n_requests), n_samples=int(n_samples),
@@ -35,7 +48,7 @@ def emit_batch(model, bucket, n_requests, n_samples, occupancy,
         queue_depth=int(queue_depth),
         queue_wait_ms=_r(queue_wait_ms), pack_ms=_r(pack_ms),
         device_ms=_r(device_ms), unpack_ms=_r(unpack_ms),
-        lat_ms=[_r(v) for v in lat_ms])
+        lat_ms=[_r(v) for v in lat_ms], **extra)
 
 
 def _r(v, nd=3):
@@ -64,18 +77,17 @@ def serve_report(records):
         if rec.get("kind") != "serve":
             continue
         model = rec.get("model") or "?"
-        m = per.setdefault(model, {
-            "requests": 0, "samples": 0, "batches": 0, "_lat": [],
-            "_occ": [], "_waste": [], "_qw": [], "_pack": [], "_dev": [],
-            "_unpack": [], "queue_depth_max": 0, "buckets": {}})
+        m = per.setdefault(model, dict(
+            {"requests": 0, "samples": 0, "batches": 0, "_lat": [],
+             "_occ": [], "_waste": [], "queue_depth_max": 0,
+             "buckets": {}},
+            **{key: [] for key, _field in _PHASE_FIELDS}))
         m["requests"] += int(rec.get("n_requests") or 0)
         m["samples"] += int(rec.get("n_samples") or 0)
         m["batches"] += 1
         m["_lat"].extend(float(v) for v in (rec.get("lat_ms") or ()))
         for key, field in (("_occ", "occupancy"),
-                           ("_waste", "padding_waste"),
-                           ("_qw", "queue_wait_ms"), ("_pack", "pack_ms"),
-                           ("_dev", "device_ms"), ("_unpack", "unpack_ms")):
+                           ("_waste", "padding_waste")) + _PHASE_FIELDS:
             if rec.get(field) is not None:
                 m[key].append(float(rec[field]))
         m["queue_depth_max"] = max(m["queue_depth_max"],
@@ -103,9 +115,7 @@ def serve_report(records):
                "buckets": dict(sorted(m["buckets"].items(),
                                       key=lambda kv: int(kv[0])))}
         for key, field in (("_occ", "occupancy"),
-                           ("_waste", "padding_waste"),
-                           ("_qw", "queue_wait_ms"), ("_pack", "pack_ms"),
-                           ("_dev", "device_ms"), ("_unpack", "unpack_ms")):
+                           ("_waste", "padding_waste")) + _PHASE_FIELDS:
             out[field] = _mean(m.pop(key))
         if lat:
             out["latency_ms"] = {"p50": _r(percentile(lat, 50)),

@@ -84,6 +84,13 @@ case "${TASK:-python}" in
     # its self-lint so the divergence pass always prices it
     JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
       mxnet_tpu/serving --fail-on=error --format=github
+    # the tracing tier touches every collective seam (rank-uniform seq
+    # counters, the flight ledger, the SLO sentry's emit path) — its
+    # three modules must stay divergence-clean under MXL-D
+    JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+      mxnet_tpu/observability/trace.py \
+      mxnet_tpu/observability/flight.py \
+      mxnet_tpu/observability/slo.py --fail-on=error --format=github
     # the pre-fix PR-3 regression fixtures are expected-FAIL inputs:
     # MXL-D must keep flagging each with its documented rule id
     fx=tests/fixtures/divergence
@@ -155,7 +162,58 @@ assert len(rep["per_rank"]) == 2, rep
 assert rep["pod"]["step_ms_p50"] is not None, rep
 print("mxtop --json smoke OK")
 '
+    # trace-merge smoke: the same run must render through mxtrace as a
+    # valid Chrome-trace document with one process track per rank and
+    # cross-rank flow events stitching the collectives
+    python tools/mxtrace.py "$TELDIR" -o "$TELDIR/trace.json"
+    python -c '
+import json, sys
+doc = json.load(open(sys.argv[1]))
+evs = doc["traceEvents"]
+assert isinstance(evs, list) and evs, "empty trace"
+assert doc["displayTimeUnit"] == "ms", doc.keys()
+pids = {e["pid"] for e in evs if e["ph"] == "M"}
+assert pids == {0, 1}, pids
+flows = [e for e in evs if e["ph"] in ("s", "f")]
+assert flows, "no cross-rank flow events"
+print("mxtrace smoke OK: %d events, %d flow arrows"
+      % (len(evs), len(flows)))
+' "$TELDIR/trace.json"
     rm -rf "$TELDIR"
+    # hung-collective flight-dump drill: kill one of two workers
+    # mid-allreduce; the survivor must dump a postmortem naming the
+    # hung seq and the absent rank (asserted inside the drill), and
+    # mxtrace must fold the dump's pending marker into the trace.
+    # MXTPU_STEP_TIMEOUT_S stays unset: the drill arms its own watchdog.
+    TELDIR="$(mktemp -d)"
+    MXTPU_TELEMETRY=1 MXTPU_TELEMETRY_DIR="$TELDIR" MXTPU_RUN_ID=ci-flight \
+      python tools/launch.py -n 2 --launcher local --port 9898 \
+      python tests/nightly/dist_flight.py
+    python tools/mxtrace.py "$TELDIR" -o "$TELDIR/trace.json"
+    python -c '
+import json, sys
+doc = json.load(open(sys.argv[1]))
+pend = [e for e in doc["traceEvents"]
+        if e["ph"] == "i" and e["name"].startswith("PENDING")]
+assert pend, "flight dump pending marker missing from trace"
+print("flight drill trace OK: %s" % pend[0]["name"])
+' "$TELDIR/trace.json"
+    rm -rf "$TELDIR"
+    # perf-regression gate: benchdiff must pass an unchanged run and
+    # flag a synthetic +20% step-time regression against a pinned
+    # baseline (a single file: zero noise, the 10% floor applies)
+    python tools/benchdiff.py --baseline BENCH_r05.json \
+      --against BENCH_r05.json
+    if python tools/benchdiff.py --baseline BENCH_r05.json \
+        --metrics "$(python -c '
+import json
+doc = json.load(open("BENCH_r05.json"))
+print(json.dumps({"step_time_ms": doc["parsed"]["step_time_ms"] * 1.2}))
+')"; then
+      echo "benchdiff FAILED to flag a +20% step-time regression"
+      exit 1
+    fi
+    echo "benchdiff gate OK (clean run passes, +20% regression flags)"
     ;;
   perf)
     # overlap machinery (docs/perf.md "Overlap"): prefetcher/bucketing/

@@ -1,0 +1,138 @@
+"""2-worker hung-collective drill: kill one rank mid-allreduce, prove
+the survivor's flight dump names the hung (op, seq) and the absent rank.
+
+Acceptance (ISSUE 10): with the flight recorder always on, a pod that
+wedges because a peer died mid-collective must leave a postmortem that
+answers "which collective, which rank" — even though the collective
+itself can never complete.  The drill stages exactly that:
+
+1. Both ranks complete ``ROUNDS`` synchronous ``kv.push`` allreduces
+   (sequence numbers ``0..ROUNDS-1`` retire from the pending ledger;
+   the ``collective`` events carry ``seq`` so ``mxtrace`` can stitch
+   cross-rank flow arrows from this run's JSONLs).
+2. Rank 1 signals "dying" through the coordination KV, flushes its
+   telemetry, and exits without participating further.
+3. Rank 0 pushes again — allreduce ``seq=ROUNDS`` can never complete.
+   The push runs under ``run_with_timeout`` (armed LONGER than the
+   heartbeat staleness window, so the liveness probe has named rank 1
+   dead by the time the watchdog fires); the timeout's ``_emit_fault``
+   seam dumps the flight recorder.
+4. Rank 0 verifies its own dump: ``reason=watchdog_timeout``, a
+   pending ``allreduce`` entry with ``seq=ROUNDS``, ``absent_ranks``
+   containing rank 1, and a ring tail of recent events.
+
+Exit codes: 0 OK, 4 = a flight-recorder expectation failed.
+
+Run (tests/test_observability.py wraps this):
+    python tools/launch.py -n 2 --launcher local \
+        python tests/nightly/dist_flight.py
+"""
+import glob
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import observability as obs
+
+ROUNDS = 3
+#: watchdog for the doomed push: must exceed the heartbeat staleness
+#: window (5 * kvstore._HB_INTERVAL = 10s) so dead_nodes() can already
+#: name the dead peer when the dump is written
+HANG_TIMEOUT_S = 13.0
+
+
+def fail(rank, msg):
+    print("rank %d FAILED: %s" % (rank, msg), flush=True)
+    os._exit(4)
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    if nw != 2:
+        fail(rank, "drill needs exactly 2 workers, got %d" % nw)
+
+    val = mx.nd.ones((64,)) * (rank + 1)
+    kv.init("w", val)
+    out = mx.nd.zeros((64,))
+    for _ in range(ROUNDS):
+        kv.push("w", mx.nd.ones((64,)) * (rank + 1))
+        kv.pull("w", out=out)
+    if not np.all(np.isfinite(out.asnumpy())):
+        fail(rank, "warmup pushes produced non-finite values")
+    pend = obs.flight.pending_collectives()
+    if pend:
+        fail(rank, "completed collectives still pending: %r" % (pend,))
+
+    from mxnet_tpu.kvstore import _dist_client
+    client = _dist_client()
+    if client is None:
+        fail(rank, "no coordination-service client in drill env")
+
+    if rank == 1:
+        # die "mid-collective": rank 0 is about to launch seq=ROUNDS,
+        # this rank never will.  Flush telemetry first so mxtrace gets
+        # both ranks' completed-collective records, then vanish.
+        obs.flush()
+        client.key_value_set("drill_flight/dying", "1")
+        print("rank 1 exiting without seq=%d" % ROUNDS, flush=True)
+        sys.stdout.flush()
+        os._exit(0)
+
+    # ---- rank 0: the survivor ----------------------------------------
+    client.blocking_key_value_get("drill_flight/dying", 60_000)
+    from mxnet_tpu.resilience import run_with_timeout, ResilienceError
+    t0 = time.time()
+    try:
+        # MXTPU_STEP_TIMEOUT_S is unset, so the kvstore's own inner
+        # timeouts stay long (600s) and THIS watchdog is the one that
+        # fires — its _emit_fault seam writes the flight dump
+        run_with_timeout(
+            lambda: kv.push("w", mx.nd.ones((64,))), HANG_TIMEOUT_S,
+            phase="drill_hung_push", step=ROUNDS)
+        fail(rank, "push completed against a dead peer")
+    except ResilienceError:
+        pass
+    waited = time.time() - t0
+    if waited < 10.0:
+        fail(rank, "watchdog fired after %.1fs — before the heartbeat "
+                   "staleness window; absent_ranks would be a guess"
+             % waited)
+
+    dumps = sorted(glob.glob(os.path.join(
+        os.environ["MXTPU_TELEMETRY_DIR"], "flight-rank00000-*.json")))
+    if not dumps:
+        fail(rank, "watchdog fired but no flight dump was written")
+    with open(dumps[-1]) as fin:
+        doc = json.load(fin)
+    if doc.get("reason") != "watchdog_timeout":
+        fail(rank, "dump reason %r, want watchdog_timeout"
+             % (doc.get("reason"),))
+    pend = {(e.get("op"), e.get("seq"))
+            for e in doc.get("pending_collectives") or ()}
+    if ("allreduce", ROUNDS) not in pend:
+        fail(rank, "pending ledger %r does not name allreduce seq=%d"
+             % (pend, ROUNDS))
+    if 1 not in (doc.get("absent_ranks") or ()):
+        fail(rank, "absent_ranks %r does not name dead rank 1"
+             % (doc.get("absent_ranks"),))
+    if not doc.get("events"):
+        fail(rank, "dump carries no ring events")
+    seqs = doc.get("collective_seq") or {}
+    if seqs.get("allreduce") != ROUNDS + 1:
+        fail(rank, "collective_seq %r, want allreduce=%d"
+             % (seqs, ROUNDS + 1))
+    obs.flush()
+    print("survivor dump names allreduce seq=%d, absent rank 1 (%s)"
+          % (ROUNDS, os.path.basename(dumps[-1])), flush=True)
+    print("rank %d FLIGHT DRILL OK" % rank, flush=True)
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

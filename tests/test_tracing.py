@@ -1,0 +1,875 @@
+"""Tracing tier tests (ISSUE 10).
+
+Covers trace/span id propagation (MXTPU_TRACE), the rank-uniform
+collective sequence counter, the always-on crash flight recorder (ring
+bound, pending-collective ledger, crash-seam dumps), the SLO
+perf-regression sentry + benchdiff gate, the mxtrace Chrome-trace
+merger, the rotation-safe EventTailer behind ``mxtop --follow``, the
+shared phase registry, the telemetry-env recheck/rotation-boundary
+integrity satellites, and the 2-process hung-collective drill
+(tests/nightly/dist_flight.py).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (forces conftest device setup)
+from mxnet_tpu import observability as obs
+from mxnet_tpu.observability import (aggregate, counters, events, flight,
+                                     phases, slo, spans, trace)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    """Telemetry/trace off, fresh singletons, bounded flight ring."""
+    for var in ("MXTPU_TELEMETRY", "MXTPU_TELEMETRY_DIR", "MXTPU_RUN_ID",
+                "MXTPU_TRACE", "MXTPU_FLIGHT_DEPTH",
+                "MXTPU_SLO_BASELINE"):
+        monkeypatch.delenv(var, raising=False)
+    events.refresh()
+    trace.refresh()
+    flight.reset()
+    counters.reset()
+    yield
+    events.refresh()
+    trace.refresh()
+    flight.reset()
+    counters.reset()
+
+
+def _enable(monkeypatch, tmp_path, run_id="tracerun", trace_on=True):
+    d = str(tmp_path / "tel")
+    monkeypatch.setenv("MXTPU_TELEMETRY", "1")
+    monkeypatch.setenv("MXTPU_TELEMETRY_DIR", d)
+    monkeypatch.setenv("MXTPU_RUN_ID", run_id)
+    if trace_on:
+        monkeypatch.setenv("MXTPU_TRACE", "1")
+    events.refresh()
+    trace.refresh()
+    return d
+
+
+# ----------------------------------------------------------------------
+# trace.py
+# ----------------------------------------------------------------------
+def test_trace_off_by_default():
+    assert not trace.enabled()
+    assert trace.begin_span("step") == {}
+    trace.end_span()                      # imbalance never raises
+    assert trace.ids() == {}
+
+
+def test_trace_nesting_and_ids(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE", "1")
+    trace.refresh()
+    outer = trace.begin_span("step")
+    inner = trace.begin_span("allreduce")
+    assert outer["trace_id"] == inner["trace_id"]
+    assert inner["parent_span"] == outer["span_id"]
+    assert "parent_span" not in outer
+    # an emit inside the inner span binds to it
+    bound = trace.ids()
+    assert bound["span_id"] == inner["span_id"]
+    trace.end_span()
+    assert trace.ids()["span_id"] == outer["span_id"]
+    trace.end_span()
+    # stack empty: ids() still names the thread's trace
+    assert trace.ids() == {"trace_id": outer["trace_id"]}
+
+
+def test_trace_ids_are_per_thread(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE", "1")
+    trace.refresh()
+    main_id = trace.current_trace()
+    seen = {}
+
+    def worker():
+        seen["trace"] = trace.current_trace()
+        seen["span"] = trace.begin_span("data_wait")
+        trace.end_span()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen["trace"] != main_id
+    assert seen["span"]["trace_id"] == seen["trace"]
+
+
+def test_set_trace_adoption(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE", "1")
+    trace.refresh()
+    mine = trace.current_trace()
+    prev = trace.set_trace("feedbeef00000001")
+    assert trace.current_trace() == "feedbeef00000001"
+    trace.clear_trace(prev)
+    assert trace.current_trace() == mine
+
+
+def test_trace_env_probe_is_rate_limited(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE", "1")
+    assert trace.refresh()
+    monkeypatch.delenv("MXTPU_TRACE")
+    # within the recheck window the cached verdict holds ...
+    assert trace.enabled()
+    # ... and refresh() re-probes immediately
+    assert not trace.refresh()
+
+
+def test_next_seq_per_op_and_snapshot():
+    base_ar = trace.next_seq("allreduce")
+    assert trace.next_seq("allreduce") == base_ar + 1
+    base_b = trace.next_seq("barrier")
+    assert trace.next_seq("barrier") == base_b + 1
+    # independent counters; snapshot reports counts issued
+    snap = trace.seq_snapshot()
+    assert snap["allreduce"] == base_ar + 2
+    assert snap["barrier"] == base_b + 2
+
+
+def test_span_records_carry_trace_ids(monkeypatch, tmp_path):
+    d = _enable(monkeypatch, tmp_path)
+    with spans.span("step", step=7):
+        with spans.span("allreduce", step=7):
+            pass
+    events.flush()
+    recs = aggregate.read_events(d)
+    by_name = {r["name"]: r for r in recs if r["kind"] == "span"}
+    assert by_name["allreduce"]["trace_id"] == \
+        by_name["step"]["trace_id"]
+    assert by_name["allreduce"]["parent_span"] == \
+        by_name["step"]["span_id"]
+
+
+def test_timed_iter_carries_trace_ids(monkeypatch, tmp_path):
+    d = _enable(monkeypatch, tmp_path)
+    list(spans.timed_iter([1, 2], name="data_wait"))
+    events.flush()
+    recs = [r for r in aggregate.read_events(d) if r["kind"] == "span"]
+    assert len(recs) == 2
+    assert all(r.get("trace_id") and r.get("span_id") for r in recs)
+
+
+def test_span_records_clean_without_trace(monkeypatch, tmp_path):
+    d = _enable(monkeypatch, tmp_path, trace_on=False)
+    with spans.span("step", step=1):
+        pass
+    events.flush()
+    rec = [r for r in aggregate.read_events(d)
+           if r["kind"] == "span"][0]
+    assert "trace_id" not in rec and "span_id" not in rec
+
+
+# ----------------------------------------------------------------------
+# flight.py
+# ----------------------------------------------------------------------
+def test_flight_ring_is_bounded(monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DEPTH", "8")
+    rec = flight.reset()
+    for i in range(50):
+        flight.note("step", i, {"dur_ms": 1.0})
+    snap = rec.snapshot()
+    assert len(snap["events"]) == 8
+    assert [e["step"] for e in snap["events"]] == list(range(42, 50))
+
+
+def test_flight_depth_zero_disables(monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DEPTH", "0")
+    assert flight.reset() is None
+    flight.note("step", 1, {})            # silent no-op
+    assert flight.pending_collectives() == []
+    assert flight.dump("unit") is None
+
+
+def test_flight_captures_with_telemetry_off(tmp_path):
+    """The whole point: events land in the ring with MXTPU_TELEMETRY
+    unset, and a dump still renders them."""
+    assert events.get() is None
+    events.emit("fault", step=3, fault="watchdog_stall", phase="x")
+    path = flight.dump("unit_test", directory=str(tmp_path))
+    assert path and os.path.exists(path)
+    doc = json.load(open(path))
+    tail = [e for e in doc["events"] if e["kind"] == "fault"]
+    assert tail and tail[-1]["fault"] == "watchdog_stall"
+    assert doc["reason"] == "unit_test"
+
+
+def test_flight_pending_ledger(tmp_path):
+    flight.reset()
+    flight.collective_begin("allreduce", 4, participants=[0, 1],
+                            bytes=1024)
+    flight.collective_begin("barrier", 9, participants=[0, 1])
+    flight.collective_end("barrier", 9)
+    pend = flight.pending_collectives()
+    assert [(e["op"], e["seq"]) for e in pend] == [("allreduce", 4)]
+    doc = json.load(open(flight.dump("unit", directory=str(tmp_path))))
+    entry = doc["pending_collectives"][0]
+    assert entry["participants"] == [0, 1]
+    assert entry["bytes"] == 1024
+    assert entry["age_ms"] >= 0
+    assert "allreduce" in doc["collective_seq"] or True  # snapshot dict
+    # retiring clears it from later dumps
+    flight.collective_end("allreduce", 4)
+    assert flight.pending_collectives() == []
+
+
+def test_flight_dump_includes_liveness(tmp_path):
+    flight.reset()
+    flight.set_liveness_probe(lambda: [1, 3])
+    doc = json.load(open(flight.dump("unit", directory=str(tmp_path))))
+    assert doc["absent_ranks"] == [1, 3]
+
+
+def test_flight_dump_never_raises(tmp_path):
+    flight.reset()
+    flight.set_liveness_probe(lambda: 1 / 0)
+    doc = json.load(open(flight.dump("unit", directory=str(tmp_path))))
+    assert doc["absent_ranks"] is None    # probe failure ≠ dump failure
+    # unwritable directory: returns None instead of raising
+    assert flight.dump("unit", directory="/dev/null/nope") is None
+
+
+def test_watchdog_timeout_dumps_flight(monkeypatch, tmp_path):
+    d = str(tmp_path / "tel")
+    monkeypatch.setenv("MXTPU_TELEMETRY_DIR", d)   # dump dir only:
+    monkeypatch.setenv("MXTPU_TELEMETRY", "0")     # telemetry itself OFF
+    events.refresh()
+    flight.reset()
+    flight.collective_begin("allreduce", 2, participants=[0, 1])
+    from mxnet_tpu.resilience import ResilienceError, run_with_timeout
+    with pytest.raises(ResilienceError):
+        run_with_timeout(lambda: time.sleep(5.0), 0.2,
+                         phase="drill_stall", step=42)
+    dumps = [f for f in os.listdir(d) if f.startswith("flight-rank")]
+    assert len(dumps) == 1
+    doc = json.load(open(os.path.join(d, dumps[0])))
+    assert doc["reason"] == "watchdog_timeout"
+    assert doc["phase"] == "drill_stall" and doc["step"] == 42
+    assert [(e["op"], e["seq"]) for e in doc["pending_collectives"]] \
+        == [("allreduce", 2)]
+
+
+def test_sentinel_escalation_dumps_flight(monkeypatch, tmp_path):
+    d = str(tmp_path / "tel")
+    monkeypatch.setenv("MXTPU_TELEMETRY_DIR", d)
+    monkeypatch.setenv("MXTPU_TELEMETRY", "0")
+    events.refresh()
+    flight.reset()
+    from mxnet_tpu.resilience import ResilienceError
+    from mxnet_tpu.resilience.sentinel import Sentinel
+    sent = Sentinel(max_consecutive_skips=2)
+    with pytest.raises(ResilienceError):
+        for step in range(5):
+            sent.check(step=step, loss=float("nan"))
+    dumps = [f for f in os.listdir(d) if f.startswith("flight-rank")]
+    assert len(dumps) == 1
+    doc = json.load(open(os.path.join(d, dumps[0])))
+    assert doc["reason"] == "sentinel_escalate"
+    # the ring tail shows the skip events that led to the escalation
+    skips = [e for e in doc["events"]
+             if e.get("fault") == "sentinel_skip"]
+    assert len(skips) >= 1
+
+
+def test_exit_for_restart_dumps_flight(tmp_path):
+    """os._exit path: run in a subprocess, assert the dump exists."""
+    d = str(tmp_path / "tel")
+    code = (
+        "import mxnet_tpu.observability as obs\n"
+        "obs.flight.collective_begin('allreduce', 7, participants=[0])\n"
+        "from mxnet_tpu.resilience import ResilienceError, "
+        "exit_for_restart\n"
+        "exit_for_restart(ResilienceError('drill', phase='p', step=1))\n")
+    env = dict(os.environ, MXTPU_TELEMETRY_DIR=d, MXTPU_TELEMETRY="0",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=_ROOT,
+                          env=env, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 3, proc.stderr
+    assert "FLIGHT RECORDER: dumped" in proc.stderr
+    dumps = [f for f in os.listdir(d) if f.startswith("flight-rank")]
+    doc = json.load(open(os.path.join(d, dumps[0])))
+    assert doc["reason"] == "exit_restart"
+    assert [(e["op"], e["seq"]) for e in doc["pending_collectives"]] \
+        == [("allreduce", 7)]
+
+
+def test_sigterm_dumps_flight(tmp_path):
+    d = str(tmp_path / "tel")
+    code = (
+        "import os, signal, sys, time\n"
+        "import mxnet_tpu.observability as obs\n"
+        "obs.flight.get()\n"                 # install the handler
+        "obs.emit('step', step=5, dur_ms=1.0)\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(60)\n")
+    env = dict(os.environ, MXTPU_TELEMETRY_DIR=d, MXTPU_TELEMETRY="0",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-u", "-c", code],
+                            cwd=_ROOT, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        proc.kill()
+    dumps = [f for f in os.listdir(d) if f.startswith("flight-rank")]
+    assert dumps, "no flight dump after SIGTERM"
+    doc = json.load(open(os.path.join(d, dumps[0])))
+    assert doc["reason"] == "sigterm"
+    assert any(e["kind"] == "step" for e in doc["events"])
+
+
+# ----------------------------------------------------------------------
+# satellite (c): env recheck + rotation-boundary integrity
+# ----------------------------------------------------------------------
+def test_events_refresh_bypasses_rate_limit(monkeypatch, tmp_path):
+    d = _enable(monkeypatch, tmp_path, trace_on=False)
+    log = events.get()
+    assert log is not None
+    monkeypatch.setenv("MXTPU_TELEMETRY", "0")
+    # inside the recheck window get() serves the cached singleton
+    assert events.get() is log
+    # refresh() re-derives immediately
+    assert events.refresh() is None
+    # and re-enabling rebuilds a NEW log against the same dir
+    monkeypatch.setenv("MXTPU_TELEMETRY", "1")
+    log2 = events.refresh()
+    assert log2 is not None and log2 is not log
+    assert log2.directory == d
+
+
+def test_rotation_never_tears_a_record(tmp_path):
+    """Every line on both sides of a rotation parses as complete JSON —
+    a record is written entirely before or entirely after the cut."""
+    log = events.EventLog(str(tmp_path), rank=0, run_id="rot",
+                          max_bytes=4096, flush_interval_s=3600.0)
+    payload = "x" * 100
+    n = 200
+    for i in range(n):
+        log.emit("step", step=i, dur_ms=1.0, pad=payload)
+        if i % 7 == 0:
+            log.flush()                   # rotations happen mid-stream
+    log.close()
+    kept = []
+    for suffix in (".1", ""):
+        path = log.path + suffix
+        if not os.path.exists(path):
+            continue
+        with open(path) as fin:
+            for line in fin:
+                rec = json.loads(line)    # torn line would raise here
+                kept.append(rec["step"])
+    assert kept == sorted(kept)
+    # bounded: at most one predecessor kept, so the tail survives
+    assert kept[-1] == n - 1
+    assert os.path.exists(log.path + ".1")
+
+
+def test_event_tailer_incremental(tmp_path):
+    d = str(tmp_path)
+    path = os.path.join(d, "events-rank00000.jsonl")
+    tailer = aggregate.EventTailer(d)
+    assert tailer.poll() == []
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "step", "step": 1,
+                            "wall_ms": 10}) + "\n")
+    assert [r["step"] for r in tailer.poll()] == [1]
+    assert tailer.poll() == []            # nothing new
+    with open(path, "a") as f:
+        f.write(json.dumps({"kind": "step", "step": 2,
+                            "wall_ms": 20}) + "\n")
+    assert [r["step"] for r in tailer.poll()] == [2]
+
+
+def test_event_tailer_carries_partial_lines(tmp_path):
+    d = str(tmp_path)
+    path = os.path.join(d, "events-rank00000.jsonl")
+    line = json.dumps({"kind": "step", "step": 1, "wall_ms": 10}) + "\n"
+    with open(path, "w") as f:
+        f.write(line[:10])                # a record mid-write
+    assert aggregate.EventTailer(d).poll() == []
+    tailer = aggregate.EventTailer(d)
+    tailer.poll()
+    with open(path, "a") as f:
+        f.write(line[10:])                # writer finishes the record
+    assert [r["step"] for r in tailer.poll()] == [1]
+
+
+def test_event_tailer_survives_rotation(tmp_path):
+    """Satellite (a): the --follow reader keeps reading after the
+    writer rotates — drains the renamed inode from its old offset and
+    starts the fresh live file at zero, no loss, no duplicates."""
+    d = str(tmp_path)
+    path = os.path.join(d, "events-rank00000.jsonl")
+
+    def rec(i):
+        return json.dumps({"kind": "step", "step": i,
+                           "wall_ms": i * 10}) + "\n"
+
+    with open(path, "w") as f:
+        f.write(rec(1) + rec(2))
+    tailer = aggregate.EventTailer(d)
+    assert [r["step"] for r in tailer.poll()] == [1, 2]
+    with open(path, "a") as f:
+        f.write(rec(3))                   # written before the rotation,
+    os.rename(path, path + ".1")          # not yet polled
+    with open(path, "w") as f:
+        f.write(rec(4))                   # the fresh live file
+    got = [r["step"] for r in tailer.poll()]
+    assert sorted(got) == [3, 4]
+    assert tailer.poll() == []
+
+
+def test_mxtop_follow_survives_rotation(tmp_path):
+    """Satellite (a) at the tool level: a following mxtop keeps
+    reporting records appended AFTER the live file was rotated."""
+    d = str(tmp_path / "tel")
+    os.makedirs(d)
+    path = os.path.join(d, "events-rank00000.jsonl")
+
+    def rec(i):
+        return json.dumps({"run_id": "rot", "rank": 0, "kind": "step",
+                           "step": i, "wall_ms": 1000 + i,
+                           "dur_ms": 2.0}) + "\n"
+
+    with open(path, "w") as f:
+        f.write(rec(1) + rec(2))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_ROOT, "tools", "mxtop.py"), d,
+         "--follow", "--json", "--interval", "0.3"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        time.sleep(1.2)                   # first polls see steps 1-2
+        os.rename(path, path + ".1")      # writer rotates ...
+        with open(path, "w") as f:        # ... and keeps appending
+            f.write(rec(3))
+        time.sleep(1.2)
+        proc.send_signal(signal.SIGINT)
+        out, _err = proc.communicate(timeout=60)
+    finally:
+        proc.kill()
+    # the LAST report must include the post-rotation step: 3 steps total
+    decoder = json.JSONDecoder()
+    docs, idx = [], 0
+    while idx < len(out):
+        try:
+            doc, end = decoder.raw_decode(out, idx)
+        except ValueError:
+            break
+        docs.append(doc)
+        idx = end + 1
+    assert docs, out[:500]
+    assert docs[-1]["per_rank"]["0"]["steps"] == 3, docs[-1]
+
+
+# ----------------------------------------------------------------------
+# shared phase registry (satellite b)
+# ----------------------------------------------------------------------
+def test_phase_registry_is_shared():
+    assert phases.PHASES == phases.TRAIN_PHASES + phases.SERVE_PHASES
+    assert spans.SPAN_NAMES is phases.TRAIN_PHASES
+    from mxnet_tpu import profiler
+    assert profiler.PHASES is phases.PHASES
+    from mxnet_tpu.serving import telemetry as stel
+    assert stel.SERVE_PHASES is phases.SERVE_PHASES
+    # the serve record schema derives from the registry
+    assert [f for _k, f in stel._PHASE_FIELDS] == \
+        [p + "_ms" for p in phases.SERVE_PHASES]
+    assert phases.is_canonical("allreduce")
+    assert not phases.is_canonical("made_up_phase")
+
+
+def test_parse_log_serve_phase_columns(tmp_path):
+    d = str(tmp_path / "tel")
+    os.makedirs(d)
+    rec = {"run_id": "r", "rank": 0, "kind": "serve", "model": "m",
+           "bucket": 8, "n_requests": 2, "n_samples": 4,
+           "occupancy": 0.5, "padding_waste": 0.5, "queue_depth": 1,
+           "queue_wait_ms": 2.0, "pack_ms": 1.0, "device_ms": 5.0,
+           "unpack_ms": 0.5, "lat_ms": [8.0, 9.0], "wall_ms": 1000}
+    rec2 = dict(rec, wall_ms=2000)
+    step = {"run_id": "r", "rank": 0, "kind": "step", "step": 1,
+            "dur_ms": 5.0, "wall_ms": 500}
+    with open(os.path.join(d, "events-rank00000.jsonl"), "w") as f:
+        f.write("\n".join(json.dumps(r)
+                          for r in (step, rec, rec2)) + "\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "parse_log.py"),
+         d], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    for phase in phases.SERVE_PHASES:
+        assert "serve-%s-ms" % phase.replace("_", "-") in proc.stdout
+
+
+# ----------------------------------------------------------------------
+# kvstore/serving integration (single process)
+# ----------------------------------------------------------------------
+def test_collective_seq_and_ledger_roundtrip(monkeypatch, tmp_path):
+    """Single-process _allreduce is the identity (no dist), so drive
+    the seam pieces directly the way kvstore does."""
+    flight.reset()
+    seq = trace.next_seq("allreduce")
+    flight.collective_begin("allreduce", seq, participants=[0], bytes=64)
+    assert [(e["op"], e["seq"])
+            for e in flight.pending_collectives()] == [("allreduce", seq)]
+    flight.collective_end("allreduce", seq)
+    assert flight.pending_collectives() == []
+
+
+def test_serving_requests_get_trace_ids(monkeypatch, tmp_path):
+    d = _enable(monkeypatch, tmp_path)
+    from mxnet_tpu.serving import telemetry as stel
+    stel.emit_batch(model="m", bucket=8, n_requests=2, n_samples=4,
+                    occupancy=0.5, padding_waste=0.5, queue_depth=0,
+                    queue_wait_ms=1.0, pack_ms=1.0, device_ms=1.0,
+                    unpack_ms=1.0, lat_ms=[4.0, 5.0],
+                    trace_ids=["aaaa", "bbbb"])
+    events.flush()
+    rec = [r for r in aggregate.read_events(d)
+           if r["kind"] == "serve"][0]
+    assert rec["trace_ids"] == ["aaaa", "bbbb"]
+    # and the Request object mints an id iff tracing is on
+    from mxnet_tpu.serving.batcher import Request
+    assert Request("m", None, 1).trace_id
+    monkeypatch.delenv("MXTPU_TRACE")
+    trace.refresh()
+    assert Request("m", None, 1).trace_id is None
+
+
+# ----------------------------------------------------------------------
+# slo.py + benchdiff
+# ----------------------------------------------------------------------
+def test_rel_spread():
+    assert counters.rel_spread([]) == 0.0
+    assert counters.rel_spread([5.0]) == 0.0
+    assert counters.rel_spread([10.0, 10.0, 10.0]) == 0.0
+    spread = counters.rel_spread([100.0, 110.0, 90.0, 105.0])
+    assert 0.0 < spread < 0.2
+
+
+def test_load_bench_schema(tmp_path):
+    # the committed BENCH schema
+    p = tmp_path / "BENCH_a.json"
+    p.write_text(json.dumps({
+        "n": 1, "cmd": "bench", "rc": 0,
+        "parsed": {"metric": "train_epoch", "value": 2.0,
+                   "unit": "images/sec", "step_time_ms": 100.0}}))
+    m = slo.load_bench(str(p))
+    assert m == {"step_time_ms": 100.0, "images_per_sec": 2.0}
+    # a failed round is skipped, not fatal
+    q = tmp_path / "BENCH_b.json"
+    q.write_text(json.dumps({"n": 2, "cmd": "bench", "rc": 1,
+                             "parsed": None}))
+    assert slo.load_bench(str(q)) is None
+    # a bare metric dict (benchdiff --metrics snapshots)
+    r = tmp_path / "cur.json"
+    r.write_text(json.dumps({"step_time_ms": 120.0, "unknown": 5}))
+    assert slo.load_bench(str(r)) == {"step_time_ms": 120.0}
+    assert slo.load_bench(str(tmp_path / "missing.json")) is None
+
+
+def test_load_trajectory_globs_in_name_order(tmp_path):
+    for name, val in (("BENCH_r01.json", 100.0),
+                      ("BENCH_r02.json", 90.0)):
+        (tmp_path / name).write_text(json.dumps(
+            {"rc": 0, "parsed": {"step_time_ms": val}}))
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps({"rc": 1, "parsed": None}))   # failed round skipped
+    traj = slo.load_trajectory(str(tmp_path / "BENCH_*.json"))
+    assert [os.path.basename(p) for p, _m in traj] == \
+        ["BENCH_r01.json", "BENCH_r02.json"]
+    assert [m["step_time_ms"] for _p, m in traj] == [100.0, 90.0]
+
+
+def test_compare_directions_and_floor():
+    base = {"step_time_ms": 100.0, "images_per_sec": 50.0}
+    # +20% step time (worse-up) and -20% throughput (worse-down) flag
+    regs, checked = slo.compare({"step_time_ms": 120.0,
+                                 "images_per_sec": 40.0}, base)
+    assert {f["metric"] for f in regs} == {"step_time_ms",
+                                           "images_per_sec"}
+    assert all(f["threshold_pct"] == 10.0 for f in checked)
+    # equal-size IMPROVEMENTS never flag
+    regs, _ = slo.compare({"step_time_ms": 80.0,
+                           "images_per_sec": 60.0}, base)
+    assert regs == []
+    # inside the 10% floor: quiet
+    regs, _ = slo.compare({"step_time_ms": 105.0}, base)
+    assert regs == []
+
+
+def test_compare_noise_widens_threshold():
+    base = {"step_time_ms": 100.0}
+    cur = {"step_time_ms": 125.0}
+    regs, _ = slo.compare(cur, base)                 # floor: flags
+    assert regs
+    regs, checked = slo.compare(cur, base,
+                                noise={"step_time_ms": 0.15})
+    assert regs == []                                # 3*0.15=45% > 25%
+    assert checked[0]["threshold_pct"] == 45.0
+
+
+def test_telemetry_metrics_mapping():
+    report = {"pod": {"step_ms_p50": 10.0, "step_ms_p95": 12.0,
+                      "samples_per_sec": 640.0, "overlap_ratio": 1.3,
+                      "mfu": 0.41},
+              "serve": {"total": {"padding_waste": 0.2, "qps": 55.0,
+                                  "latency_ms": {"p95": 30.0}}}}
+    m = slo.telemetry_metrics(report)
+    assert m == {"step_ms_p50": 10.0, "step_ms_p95": 12.0,
+                 "samples_per_sec": 640.0, "overlap_ratio": 1.3,
+                 "mfu": 0.41, "serve_padding_waste": 0.2,
+                 "serve_qps": 55.0, "serve_ms_p95": 30.0}
+
+
+def test_emit_regressions_lands_fault_events(monkeypatch, tmp_path):
+    d = _enable(monkeypatch, tmp_path, trace_on=False)
+    regs, _ = slo.compare({"step_time_ms": 200.0},
+                          {"step_time_ms": 100.0})
+    slo.emit_regressions(regs, step=9, baseline_name="BENCH_x.json")
+    recs = [r for r in aggregate.read_events(d)
+            if r.get("fault") == "perf_regression"]
+    assert len(recs) == 1
+    assert recs[0]["metric"] == "step_time_ms"
+    assert recs[0]["baseline_name"] == "BENCH_x.json"
+    assert recs[0]["delta_pct"] == 100.0
+
+
+def _benchdiff(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "benchdiff.py")]
+        + list(args), cwd=_ROOT, capture_output=True, text=True,
+        timeout=180)
+
+
+def test_benchdiff_gate(tmp_path):
+    """CI-gate contract: unchanged run exits 0, a +20% step-time
+    regression against a pinned baseline exits 1, usage errors exit 2."""
+    baseline = {"rc": 0, "parsed": {"step_time_ms": 100.0,
+                                    "transformer_tokens_per_sec": 5e4}}
+    bpath = str(tmp_path / "BENCH_base.json")
+    with open(bpath, "w") as f:
+        json.dump(baseline, f)
+    proc = _benchdiff("--baseline", bpath, "--against", bpath)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _benchdiff("--baseline", bpath, "--metrics",
+                      json.dumps({"step_time_ms": 120.0}))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "REGRESSION" in proc.stdout
+    proc = _benchdiff("--baseline", bpath, "--metrics",
+                      json.dumps({"step_time_ms": 120.0}), "--json")
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["regressions"][0]["metric"] == "step_time_ms"
+    # improvements pass
+    proc = _benchdiff("--baseline", bpath, "--metrics",
+                      json.dumps({"step_time_ms": 50.0,
+                                  "transformer_tokens_per_sec": 9e4}))
+    assert proc.returncode == 0
+    # usage errors: no source, missing baseline
+    assert _benchdiff("--baseline", bpath).returncode == 2
+    assert _benchdiff("--baseline",
+                      str(tmp_path / "nope.json"),
+                      "--metrics", "{}").returncode == 2
+
+
+def test_benchdiff_against_committed_trajectory():
+    """The repo's own BENCH_*.json trajectory loads and self-compares
+    clean (this is the CI smoke invocation)."""
+    proc = _benchdiff("--against", "BENCH_r05.json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ----------------------------------------------------------------------
+# mxtrace
+# ----------------------------------------------------------------------
+def _write_rank(d, rank, recs):
+    with open(os.path.join(d, "events-rank%05d.jsonl" % rank), "w") as f:
+        for r in recs:
+            r = dict(r, run_id="mx", rank=rank)
+            f.write(json.dumps(r) + "\n")
+
+
+def test_mxtrace_merges_ranks_and_stitches_flows(tmp_path):
+    d = str(tmp_path / "tel")
+    os.makedirs(d)
+    base = 1_700_000_000_000
+    for rank in (0, 1):
+        _write_rank(d, rank, [
+            {"kind": "step", "step": 1, "wall_ms": base + 100,
+             "dur_ms": 50},
+            {"kind": "span", "name": "allreduce", "step": 1,
+             "wall_ms": base + 95, "dur_ms": 10, "trace_id": "t",
+             "span_id": "s%d" % rank},
+            {"kind": "collective", "op": "allreduce", "seq": 0,
+             "wall_ms": base + 95, "dur_ms": 9, "num_workers": 2},
+            {"kind": "fault", "fault": "watchdog_timeout",
+             "wall_ms": base + 300},
+        ])
+    out = str(tmp_path / "trace.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "mxtrace.py"),
+         d, "-o", out], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    doc = json.load(open(out))
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and doc["displayTimeUnit"] == "ms"
+    # per-rank process tracks
+    names = {(e["pid"], e["args"]["name"]) for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {(0, "rank 0"), (1, "rank 1")}
+    # slices exist on both ranks and carry trace ids
+    slices = [e for e in evs if e["ph"] == "X"]
+    assert {e["pid"] for e in slices} == {0, 1}
+    ar = [e for e in slices if e["name"] == "allreduce"]
+    assert {e["args"]["span_id"] for e in ar} == {"s0", "s1"}
+    # ≥1 cross-rank flow pair stitching (op, seq) across ranks
+    starts = [e for e in evs if e["ph"] == "s"]
+    finishes = [e for e in evs if e["ph"] == "f"]
+    assert starts and finishes
+    assert starts[0]["id"] == finishes[0]["id"]
+    assert starts[0]["pid"] != finishes[0]["pid"]
+    assert starts[0]["name"] == "allreduce seq=0"
+    # faults render as instants
+    assert any(e["ph"] == "i" and "watchdog_timeout" in e["name"]
+               for e in evs)
+
+
+def test_mxtrace_ingests_flight_dumps(tmp_path):
+    d = str(tmp_path / "tel")
+    os.makedirs(d)
+    _write_rank(d, 0, [{"kind": "step", "step": 1,
+                        "wall_ms": 1000, "dur_ms": 5}])
+    with open(os.path.join(d, "flight-rank00000-0.json"), "w") as f:
+        json.dump({"reason": "watchdog_timeout", "rank": 0,
+                   "wall_ms": 2000, "absent_ranks": [1],
+                   "pending_collectives": [
+                       {"op": "allreduce", "seq": 3,
+                        "launch_wall_ms": 1500,
+                        "participants": [0, 1]}],
+                   "events": []}, f)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "mxtrace.py"), d],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    pend = [e for e in doc["traceEvents"]
+            if e["ph"] == "i" and e["name"].startswith("PENDING")]
+    assert pend and pend[0]["name"] == "PENDING allreduce seq=3"
+    assert pend[0]["args"]["absent_ranks"] == [1]
+
+
+def test_mxtrace_empty_dir_exits_1(tmp_path):
+    d = str(tmp_path / "tel")
+    os.makedirs(d)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "mxtrace.py"), d],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+
+
+# ----------------------------------------------------------------------
+# acceptance: overhead bound with tracing + flight recorder ON
+# ----------------------------------------------------------------------
+def test_overhead_under_2_percent_with_tracing(monkeypatch, tmp_path):
+    """The ISSUE 4 <2% bound must hold with MXTPU_TRACE=1 and the
+    flight recorder active: per-call cost of a traced span + record_step
+    (now also ring-noting) vs the median of a small real step.  Same
+    median-of-medians methodology as the ISSUE 4 test."""
+    a = np.random.RandomState(0).rand(512, 512)
+
+    def work():
+        return (a @ a).sum()
+
+    _enable(monkeypatch, tmp_path)        # telemetry + MXTPU_TRACE=1
+    flight.reset()
+    obs.record_step(0, 0.001)
+    for _ in range(10):
+        work()
+    steps = []
+    for _ in range(50):
+        t0 = time.perf_counter()
+        work()
+        steps.append(time.perf_counter() - t0)
+    steps.sort()
+    step_s = steps[len(steps) // 2]
+
+    costs = []
+    for i in range(2000):
+        t0 = time.perf_counter()
+        with spans.span("step", step=i):
+            pass
+        obs.record_step(i, 0.001, batch_size=8)
+        costs.append(time.perf_counter() - t0)
+    events.flush()
+    costs.sort()
+    cost_s = costs[len(costs) // 2]
+
+    ratio = (step_s + cost_s) / step_s
+    assert ratio < 1.02, \
+        "tracing overhead %.1f%% (hook %.1fus on a %.2fms step)" \
+        % ((ratio - 1) * 100, cost_s * 1e6, step_s * 1e3)
+
+
+# ----------------------------------------------------------------------
+# acceptance: the 2-process hung-collective drill
+# ----------------------------------------------------------------------
+def test_dist_flight_drill(tmp_path):
+    """Kill one worker mid-allreduce: the survivor's flight dump names
+    the hung collective's seq and the absent rank, and mxtrace merges
+    the run's JSONLs into a valid Chrome trace with per-rank tracks and
+    cross-rank flow events."""
+    tel_dir = str(tmp_path / "tel")
+    cmd = [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+           "-n", "2", "--launcher", "local", "--workdir", _ROOT,
+           "--port", "9904",
+           sys.executable, os.path.join("tests", "nightly",
+                                        "dist_flight.py")]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env.update({"MXTPU_TELEMETRY": "1", "MXTPU_TELEMETRY_DIR": tel_dir,
+                "MXTPU_RUN_ID": "flightdrill"})
+    proc = subprocess.run(cmd, cwd=_ROOT, env=env, timeout=420,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True)
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    assert "FLIGHT DRILL OK" in proc.stdout, proc.stdout[-2000:]
+
+    # the survivor's dump: hung seq + absent rank (drill asserts too;
+    # re-assert here so the test stands alone)
+    dumps = [f for f in os.listdir(tel_dir)
+             if f.startswith("flight-rank00000")]
+    assert dumps, os.listdir(tel_dir)
+    doc = json.load(open(os.path.join(tel_dir, sorted(dumps)[-1])))
+    assert doc["reason"] == "watchdog_timeout"
+    assert ("allreduce", 3) in {(e["op"], e["seq"])
+                                for e in doc["pending_collectives"]}
+    assert 1 in doc["absent_ranks"]
+
+    # mxtrace merges the drill's JSONLs: valid Chrome trace, per-rank
+    # tracks, ≥1 cross-rank flow event, and the pending marker
+    out = str(tmp_path / "trace.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "mxtrace.py"),
+         tel_dir, "-o", out], capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    trace_doc = json.load(open(out))
+    evs = trace_doc["traceEvents"]
+    assert {e["pid"] for e in evs if e["ph"] == "M"} == {0, 1}
+    starts = [e for e in evs if e["ph"] == "s"]
+    finishes = [e for e in evs if e["ph"] == "f"]
+    assert starts and finishes
+    assert {e["pid"] for e in starts + finishes} == {0, 1}
+    assert any(e["ph"] == "i" and "PENDING allreduce seq=3" in e["name"]
+               for e in evs)

@@ -179,8 +179,22 @@ def main(argv=None):
         sys.stderr.write("mxtop: no such directory: %s\n" % args.directory)
         return 2
 
+    # --follow tails incrementally through aggregate.EventTailer, which
+    # tracks per-inode offsets: when the writer rotates the live file to
+    # ``.1`` at MXTPU_TELEMETRY_MAX_MB, the next poll drains the renamed
+    # inode and picks up the fresh file from zero — no dead-inode tail,
+    # no re-reading the whole directory every interval
+    tailer = aggregate.EventTailer(args.directory)
+    records = []
     while True:
-        records = aggregate.read_events(args.directory)
+        if args.follow:
+            new = tailer.poll()
+            if new:
+                records.extend(new)
+                records.sort(key=lambda r: (r.get("wall_ms") or 0,
+                                            r.get("rank") or 0))
+        else:
+            records = aggregate.read_events(args.directory)
         report = aggregate.build_report(records)
         if args.json:
             doc = report.get("serve", {}) if args.serve else report

@@ -111,11 +111,15 @@ def parse_telemetry(path):
     except Exception:
         pass
     # run-global serving columns (docs/serving.md) from "serve" records:
-    # QPS, request p50/p95 latency, occupancy, padding waste
+    # QPS, request p50/p95 latency, occupancy, padding waste, and the
+    # per-phase means — phase names come from the shared registry
+    # (observability.phases.SERVE_PHASES), never hand-listed here
     try:
+        from mxnet_tpu.observability.phases import SERVE_PHASES
         from mxnet_tpu.serving.telemetry import serve_report
         sv = serve_report(records)
         total = sv.get("total") or {}
+        models = sv.get("models") or {}
         if total.get("requests"):
             if total.get("qps") is not None:
                 overlap_cols["serve-qps"] = total["qps"]
@@ -128,6 +132,12 @@ def parse_telemetry(path):
                 overlap_cols["serve-occupancy"] = total["occupancy"]
             if total.get("padding_waste") is not None:
                 overlap_cols["serve-padding-waste"] = total["padding_waste"]
+            for phase in SERVE_PHASES:
+                vals = [m[phase + "_ms"] for m in models.values()
+                        if m.get(phase + "_ms") is not None]
+                if vals:
+                    overlap_cols["serve-%s-ms" % phase.replace("_", "-")] \
+                        = sum(vals) / len(vals)
     except Exception:
         pass
     if not acc and any(c.startswith("serve-") for c in overlap_cols):
