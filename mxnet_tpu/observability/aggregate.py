@@ -294,6 +294,8 @@ def build_report(records, now=None):
     incidents = []
     kv_hb_ages = None
     last_elastic = None
+    last_resume = None
+    adopt_wall = {}             # generation -> newest propose/adopt wall
     for rec in records:
         kind = rec.get("kind")
         rank = rec.get("rank")
@@ -327,6 +329,14 @@ def build_report(records, now=None):
             if rec.get("generation") is not None:
                 state["generation"] = rec.get("generation")
             last_elastic = rec
+            event = rec.get("event")
+            gen = rec.get("generation")
+            if event in ("propose", "adopt") and gen is not None \
+                    and rec.get("wall_ms") is not None:
+                adopt_wall[gen] = max(adopt_wall.get(gen, 0),
+                                      rec["wall_ms"])
+            elif event == "resume":
+                last_resume = rec
         elif kind == "counter" and rec.get("name") == "heartbeat_ages":
             kv_hb_ages = rec.get("ages")
         elif kind == "counter" and rec.get("name") == "trainer_cost":
@@ -365,8 +375,26 @@ def build_report(records, now=None):
         pod["last_elastic"] = {
             k: last_elastic.get(k)
             for k in ("event", "generation", "world_size", "reason",
-                      "from_world", "rank", "step")
+                      "from_world", "rank", "step", "path",
+                      "fallback_reason", "duration_ms")
             if last_elastic.get(k) is not None}
+    if last_resume is not None:
+        # the recovery-cost rollup (PR 11): which rung of the resume
+        # ladder the last transition took (warm = host memory, cold =
+        # checkpoint), the restore cost the resume event measured
+        # itself, and the end-to-end transition wall — verdict
+        # adopt/propose (old incarnation) to resume (new one), pairable
+        # because both carry the agreed generation
+        tr = {k: last_resume.get(k)
+              for k in ("path", "generation", "step", "world_size",
+                        "fallback_reason", "duration_ms")
+              if last_resume.get(k) is not None}
+        gen = last_resume.get("generation")
+        if gen in adopt_wall and last_resume.get("wall_ms") is not None \
+                and last_resume["wall_ms"] >= adopt_wall[gen]:
+            tr["transition_ms"] = round(
+                last_resume["wall_ms"] - adopt_wall[gen], 3)
+        pod["last_transition"] = tr
     if phase_totals:
         pod["slowest_phase"] = max(phase_totals, key=phase_totals.get)
         pod["phase_totals_ms"] = {k: round(v, 3)

@@ -24,11 +24,13 @@ from __future__ import annotations
 
 __all__ = ["TRAIN_PHASES", "SERVE_PHASES", "PHASES", "is_canonical",
            "DATA_WAIT", "H2D", "STEP", "ALLREDUCE", "KV_BARRIER",
-           "CKPT_SAVE", "EVAL", "QUEUE_WAIT", "PACK", "DEVICE", "UNPACK"]
+           "CKPT_SAVE", "EVAL", "HOTSTATE_SNAPSHOT", "WARM_RESUME",
+           "QUEUE_WAIT", "PACK", "DEVICE", "UNPACK"]
 
-#: phases the training wiring emits (fit loops, ShardedTrainer, kvstore)
+#: phases the training wiring emits (fit loops, ShardedTrainer, kvstore,
+#: and the warm-elasticity transition: host offload + warm assembly)
 TRAIN_PHASES = ("data_wait", "h2d", "step", "allreduce", "kv_barrier",
-                "ckpt_save", "eval")
+                "ckpt_save", "eval", "hotstate_snapshot", "warm_resume")
 
 #: request-visible serving phases, in pipeline order (docs/serving.md)
 SERVE_PHASES = ("queue_wait", "pack", "device", "unpack")
@@ -36,8 +38,8 @@ SERVE_PHASES = ("queue_wait", "pack", "device", "unpack")
 #: every built-in phase name, training first then serving
 PHASES = TRAIN_PHASES + SERVE_PHASES
 
-(DATA_WAIT, H2D, STEP, ALLREDUCE, KV_BARRIER, CKPT_SAVE, EVAL) = \
-    TRAIN_PHASES
+(DATA_WAIT, H2D, STEP, ALLREDUCE, KV_BARRIER, CKPT_SAVE, EVAL,
+ HOTSTATE_SNAPSHOT, WARM_RESUME) = TRAIN_PHASES
 (QUEUE_WAIT, PACK, DEVICE, UNPACK) = SERVE_PHASES
 
 _CANON = frozenset(PHASES)

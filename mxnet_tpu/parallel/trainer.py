@@ -497,31 +497,83 @@ class ShardedTrainer(object):
         return (restored["params"], restored["opt_state"],
                 restored["aux"], step)
 
+    def hotstate_snapshot(self, params, opt_state, aux):
+        """Host-offload this rank's shards of the training state into
+        the warm-handoff area (``resilience.hotstate.snapshot``): the
+        device→host half of warm elasticity.  Call at every stable
+        point (right after a versioned checkpoint commits is the
+        natural cadence) and again before ``exit_for_remesh``."""
+        from ..resilience import hotstate as _hotstate
+        return _hotstate.snapshot(
+            {"params": params, "opt_state": opt_state, "aux": aux},
+            step=self.num_update)
+
     def elastic_resume(self, directory, data_shapes, label_shapes=None,
-                       dtype=_np.float32):
+                       dtype=_np.float32, source="auto", kv=None):
         """:meth:`auto_resume` for a re-meshed incarnation — the
         resharded-resume seam of elastic training.
 
-        Identical restore mechanics (``abstract_state`` supplies
-        ShapeDtypeStruct+sharding targets for THIS trainer's mesh, and
-        orbax reshards the saved leaves into the new layout on
-        restore — a checkpoint written under the old world size comes
-        back placed for the new one), plus the ``elastic`` telemetry
-        record every transition must leave: an ``event="resume"``
-        stamped with the incarnation's generation and world size, so
-        ``mxtop`` and the ``--fault`` timelines show where the
-        topology changed and what step training picked back up from.
+        ``source`` picks the rung of the recovery ladder:
+
+        - ``"warm"``: resume from the host-memory handoff area
+          (``resilience.hotstate``) — the KV-agreed shard directory
+          names which surviving payload serves each old rank, the
+          assembled host tree is re-placed with THIS trainer's mesh
+          shardings (``put_replicated_host``), and no checkpoint is
+          read.  Any missing/corrupt shard degrades to the checkpoint
+          rung — structured, never a crash.
+        - ``"cold"``: the PR-3 versioned checkpoint under
+          ``directory`` (``abstract_state`` supplies
+          ShapeDtypeStruct+sharding targets and orbax reshards the
+          saved leaves onto the new mesh).
+        - ``"auto"`` (default): warm when ``MXTPU_WARM_REMESH`` is on,
+          cold otherwise.
+
+        Either way the transition leaves its ``elastic`` telemetry
+        record: an ``event="resume"`` stamped with generation, world
+        size, the ``path`` actually taken (``warm``/``cold``), the
+        restore ``duration_ms``, and — when the warm rung gave way —
+        the ``fallback_reason``, so ``mxtop`` and the ``--fault``
+        timelines show the topology change AND what the recovery cost.
         """
-        got = self.auto_resume(directory, data_shapes, label_shapes,
-                               dtype)
+        import time as _t
         from ..resilience import elastic as _elastic
+        from ..resilience import hotstate as _hotstate
+        from .sharding import put_replicated_host
+        t0 = _t.monotonic()
+        got, path, fallback, meta = None, "cold", None, None
+        try_warm = source == "warm" or (
+            source == "auto" and _hotstate.warm_enabled())
+        if try_warm:
+            abstract = self.abstract_state(data_shapes, label_shapes,
+                                           dtype)
+            target = {"params": abstract[0], "opt_state": abstract[1],
+                      "aux": abstract[2]}
+            try:
+                host_tree, step, meta = _hotstate.warm_resume(
+                    target, kv=kv)
+                placed = jax.tree_util.tree_map(
+                    lambda a, t: put_replicated_host(a, t.sharding),
+                    host_tree, target)
+                self.num_update = step
+                got = (placed["params"], placed["opt_state"],
+                       placed["aux"], step)
+                path = "warm"
+            except _hotstate.HotStateUnavailable as exc:
+                fallback = exc.reason
+        if got is None:
+            got = self.auto_resume(directory, data_shapes, label_shapes,
+                                   dtype)
         try:
             world = jax.process_count()
         except Exception:
             world = 1
         _elastic.emit_transition(
             "resume", step=None if got is None else got[3],
-            world_size=world, fresh=got is None,
+            world_size=world, fresh=got is None, path=path,
+            fallback_reason=fallback,
+            n_payloads=None if meta is None else meta.get("n_payloads"),
+            duration_ms=round((_t.monotonic() - t0) * 1000.0, 3),
             mesh={a: int(s) for a, s in self.mesh.shape.items()})
         return got
 

@@ -24,6 +24,11 @@ silent hang inside a collective.  This package supplies the pieces:
   (generation-stamped verdicts over the coordination KV, ledger-backed
   generation fencing) that lets ``tools/launch.py --elastic`` shrink a
   pod onto its survivors and grow it back when capacity returns.
+- **recovery cost** → :mod:`.hotstate`: warm elasticity — redundant
+  host-memory hot state (ring-buddy replicas, CRC-verified, KV-agreed
+  shard directory) so a re-mesh resumes from peer RAM with zero
+  checkpoint reads, degrading to the versioned checkpoint on any
+  missing/corrupt shard.
 - **testability** → :mod:`.faultinject`: a deterministic fault
   injector (env ``MXTPU_FAULT_SPEC``) that plants NaN grads,
   checkpoint-write crashes, slow/hung steps, and dead-node reports at
@@ -167,9 +172,11 @@ from .retry import RetryPolicy, retry_call  # noqa: E402
 from .sentinel import Sentinel  # noqa: E402
 from .ckptmgr import CheckpointManager, latest_classic_epoch  # noqa: E402
 from . import elastic  # noqa: E402
+from . import hotstate  # noqa: E402
+from .hotstate import HotStateUnavailable  # noqa: E402
 
 __all__ = [
-    "elastic",
+    "elastic", "hotstate", "HotStateUnavailable",
     "EXIT_RESTART", "ResilienceError", "exit_for_restart",
     "install_excepthook",
     "step_timeout_s", "retry_max", "ckpt_keep", "sentinel_enabled",

@@ -233,12 +233,45 @@ class CheckpointManager(object):
         return tree, saved_step
 
     def auto_resume(self, abstract_tree):
-        """``(tree, step)`` from the latest committed checkpoint, or
-        None when the run is fresh — the one-liner a preemptible
-        training script puts before its loop."""
-        if self.latest_step() is None:
+        """``(tree, step)`` from the newest *readable* committed
+        checkpoint, or None when the run is fresh — the one-liner a
+        preemptible training script puts before its loop.
+
+        A committed checkpoint can still be damaged after the fact
+        (storage loss, an operator's stray truncation, bit rot); the
+        commit protocol only guarantees no checkpoint is *born*
+        half-written.  So restore failures walk back through the kept
+        versions, newest first, emitting a ``restore_corrupt_skip``
+        ckpt event per bad one; only when every kept version is bad
+        does this raise :class:`ResilienceError`
+        (kind=``restore_corrupt``).  A ``restore_mismatch`` propagates
+        immediately instead: a target-shape disagreement is a mis-wired
+        resume, and every older version would "mismatch" the same way —
+        walking back would bury the real diagnosis under a misleading
+        corruption report.
+        """
+        steps = self.all_steps()
+        if not steps:
             return None
-        return self.restore(abstract_tree)
+        from . import ResilienceError
+        failures = []
+        for step in reversed(steps):
+            try:
+                return self.restore(abstract_tree, step=step)
+            except ResilienceError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - any read tear
+                failures.append((step, exc))
+                self.logger.warning(
+                    "checkpoint step %d unreadable (%s); trying the "
+                    "previous kept version", step, exc)
+                _emit_ckpt("restore_corrupt_skip", step,
+                           self.step_path(step))
+        raise ResilienceError(
+            "all %d kept checkpoints under %s are unreadable (%s)"
+            % (len(failures), self.directory,
+               "; ".join("step %d: %r" % (s, e) for s, e in failures)),
+            phase="ckpt_restore", step=steps[-1], kind="restore_corrupt")
 
     # ------------------------------------------------------------------
     # hygiene

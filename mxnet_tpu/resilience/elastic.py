@@ -364,9 +364,28 @@ def _gc_poll_key(client, round_id):
         pass
 
 
-def exit_for_remesh(verdict):
+def exit_for_remesh(verdict, hot_state=None, step=None):
     """Flush telemetry and exit with the restart signal, carrying the
-    adopted verdict's context — the last line a survivor prints."""
+    adopted verdict's context — the last line a survivor prints.
+
+    ``hot_state`` (optional): a host/placed pytree to offload into the
+    warm-handoff area first (``hotstate.snapshot``), so the next
+    incarnation can resume from host memory instead of the checkpoint.
+    Only meaningful at a *stable* point — the clean post-epoch adopt
+    path, where every rank holds the same agreed state; the fault path
+    passes nothing and relies on the last stable-point snapshot.  A
+    snapshot failure (including an injected ``snapshot_crash``) must
+    never block the restart: it is logged and the next incarnation
+    takes the checkpoint rung of the fallback ladder.
+    """
+    if hot_state is not None:
+        from . import hotstate as _hotstate
+        try:
+            if _hotstate.warm_enabled():
+                _hotstate.snapshot(hot_state, step=step)
+        except Exception as exc:  # noqa: BLE001 - degrade, never wedge
+            emit_transition("snapshot_failed", step=step,
+                            error=str(exc))
     exit_for_restart(ResilienceError(
         "re-mesh agreed: generation %s world %s (%s)"
         % (verdict.get("generation"), verdict.get("world_size"),

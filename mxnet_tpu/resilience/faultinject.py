@@ -14,6 +14,17 @@ on demand at the seams the runtime already passes through:
   ``ckpt_crash``: raise :class:`InjectedFault`, the preemption analog)
 - ``dead_node`` — kvstore liveness scan (kind ``dead_node``: report
   ``n`` peers dead without any real process dying)
+- ``host_snapshot`` — hot-state host offload, before any payload is
+  written (kind ``snapshot_crash``: raise :class:`InjectedFault`, the
+  preemption-mid-offload analog; the warm path must degrade to the
+  checkpoint, never wedge the re-mesh)
+- ``handoff_read`` — hot-state warm resume, per payload read (kind
+  ``corrupt``: flip the payload bytes after load so the CRC check
+  rejects it — the drillable half of "corrupt shard -> CRC reject ->
+  checkpoint fallback")
+- ``buddy_loss`` — hot-state snapshot, before the ring-buddy replica
+  writes (kind ``buddy_loss``: skip them, simulating a lost replica
+  push; a later host loss then has no redundant copy to serve)
 
 Faults are described by ``MXTPU_FAULT_SPEC``, a ``;``-separated list
 of ``:``-separated ``key=value`` clauses (docs/resilience.md):
@@ -43,6 +54,9 @@ KIND_SEAMS = {
     "ckpt_crash": "ckpt_commit",
     "crash": "ckpt_commit",
     "dead_node": "dead_node",
+    "snapshot_crash": "host_snapshot",
+    "corrupt": "handoff_read",
+    "buddy_loss": "buddy_loss",
 }
 
 _KNOWN_KINDS = frozenset(KIND_SEAMS)
@@ -179,11 +193,12 @@ def _current_rank():
 def maybe_fault(seam, step=None, rank=None):
     """Fire a matching fault at this seam, if any.
 
-    Side effects by kind: ``ckpt_crash``/``crash`` raise
-    :class:`InjectedFault`; ``hang``/``slow`` sleep (``seconds``,
+    Side effects by kind: ``ckpt_crash``/``crash``/``snapshot_crash``
+    raise :class:`InjectedFault`; ``hang``/``slow`` sleep (``seconds``,
     defaulting to 3600 for hang / 1 for slow).  Kinds the caller must
-    act on itself (``nan``, ``dead_node``) are returned.  Returns the
-    spec that fired, or None.  Near-zero cost when no spec is set.
+    act on itself (``nan``, ``dead_node``, ``corrupt``, ``buddy_loss``)
+    are returned.  Returns the spec that fired, or None.  Near-zero
+    cost when no spec is set.
     """
     inj = injector()
     if inj is None:
@@ -193,7 +208,7 @@ def maybe_fault(seam, step=None, rank=None):
     spec = inj.match(seam, step=step, rank=rank)
     if spec is None:
         return None
-    if spec.kind in ("ckpt_crash", "crash"):
+    if spec.kind in ("ckpt_crash", "crash", "snapshot_crash"):
         raise InjectedFault(
             "injected %s at seam=%s step=%s" % (spec.kind, seam, step))
     if spec.kind in ("hang", "slow"):

@@ -232,6 +232,81 @@ class ModelServer(object):
         return self.add_model(name, sym_path, params_path, input_shapes,
                               **kwargs)
 
+    # -- warm elasticity (docs/resilience.md "Warm elasticity") ------------
+
+    def snapshot_hotstate(self, step=0):
+        """Host-offload every served model — bound params AND the bind
+        config (symbol JSON, input shapes, buckets, priority, dtypes) —
+        into the warm-handoff area under the ``serve`` namespace
+        (``resilience.hotstate.snapshot``), so an elastic serving
+        re-mesh can rebuild this server without the original
+        checkpoint files.  Call before ``elastic.exit_for_remesh``
+        (or at any stable point)."""
+        from ..resilience import hotstate as _hotstate
+        tree, configs = {}, {}
+        for name, entry in self._entries.items():
+            first = entry.predictors[min(entry.buckets)]
+            params = {}
+            for k, v in first._arg_params.items():
+                params["arg:" + k] = v.asnumpy()
+            for k, v in first._aux_params.items():
+                params["aux:" + k] = v.asnumpy()
+            # bound inputs live in arg_dict, not _arg_params, so the
+            # payload holds exactly the learned state
+            tree[name] = params
+            configs[name] = {
+                "symbol_json": first.symbol.tojson(),
+                "input_shapes": {nm: list(shape) for nm, shape
+                                 in entry.input_shapes.items()},
+                "buckets": [int(b) for b in entry.buckets],
+                "priority": entry.priority,
+                "compute_dtype": entry.plan.compute_dtype,
+                "dtypes": {nm: _np.dtype(dt).str for nm, dt
+                           in entry.dtypes.items()},
+            }
+        return _hotstate.snapshot(tree, step=step, namespace="serve",
+                                  extra={"models": configs})
+
+    def warm_resume_models(self, kv=None, ctx=None):
+        """Rebuild every model from the ``serve`` handoff area — the
+        serving half of warm elasticity.  The KV-agreed shard directory
+        (when ``kv`` spans multiple replicas) names which surviving
+        payload serves the state; params come back from host memory and
+        each bucket re-binds through the PR-8 program registry, so a
+        warm swap in a surviving process performs **zero new
+        lowerings** (``stats()['models'][m]['lowerings_since_warmup']``
+        stays 0).  Raises
+        :class:`~mxnet_tpu.resilience.HotStateUnavailable` when no
+        complete payload survives — the caller's cue to re-add models
+        from checkpoint files instead.  Returns the restored names."""
+        import time as _t
+        from .. import ndarray as _nd
+        from ..resilience import elastic as _elastic
+        from ..resilience import hotstate as _hotstate
+        t0 = _t.monotonic()
+        tree, step, meta = _hotstate.warm_resume(None, kv=kv,
+                                                 namespace="serve")
+        configs = (meta.get("extra") or {}).get("models") or {}
+        restored = []
+        for name in sorted(configs):
+            cfg = configs[name]
+            self.add_model(
+                name, cfg["symbol_json"],
+                {k: _nd.array(v) for k, v in
+                 (tree.get(name) or {}).items()},
+                {nm: tuple(shape) for nm, shape
+                 in cfg["input_shapes"].items()},
+                buckets=cfg["buckets"], ctx=ctx,
+                priority=cfg.get("priority", 0),
+                compute_dtype=cfg.get("compute_dtype", "float32"),
+                dtypes=cfg.get("dtypes"))
+            restored.append(name)
+        _elastic.emit_transition(
+            "resume", step=step, tier="serve", path="warm",
+            models=restored, n_payloads=meta.get("n_payloads"),
+            duration_ms=round((_t.monotonic() - t0) * 1000.0, 3))
+        return restored
+
     # -- request path ------------------------------------------------------
 
     def submit(self, model, inputs, n=None):

@@ -91,6 +91,11 @@ case "${TASK:-python}" in
       mxnet_tpu/observability/trace.py \
       mxnet_tpu/observability/flight.py \
       mxnet_tpu/observability/slo.py --fail-on=error --format=github
+    # warm elasticity's shard-directory agreement is another pod-wide
+    # decision protocol (rank 0 publishes, everyone adopts) — pin its
+    # MXL-D self-lint like elastic.py's
+    JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+      mxnet_tpu/resilience/hotstate.py --fail-on=error --format=github
     # the pre-fix PR-3 regression fixtures are expected-FAIL inputs:
     # MXL-D must keep flagging each with its documented rule id
     fx=tests/fixtures/divergence
@@ -129,7 +134,10 @@ case "${TASK:-python}" in
     # ckpt-crash/dead-node faults must each hit their recovery path,
     # plus the kill-one-worker resume smoke
     JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
-      --deselect tests/test_resilience.py::test_elastic_shrink_grow_drill
+      --deselect tests/test_resilience.py::test_elastic_shrink_grow_drill \
+      --deselect tests/test_resilience.py::test_warm_shrink_grow_drill \
+      --deselect tests/test_resilience.py::test_warm_corrupt_shard_falls_back_to_checkpoint \
+      --deselect tests/test_resilience.py::test_multihost_warm_shrink_grow_drill
     # elasticity acceptance (docs/resilience.md "Elasticity"): its own
     # leg so a skip/deselect upstream can never silently drop it —
     # kill one of three workers, agree a generation-stamped shrink
@@ -137,6 +145,20 @@ case "${TASK:-python}" in
     # reference losses bit-for-bit
     JAX_PLATFORMS=cpu python -m pytest -q \
       tests/test_resilience.py::test_elastic_shrink_grow_drill
+    # warm-elasticity acceptance (docs/resilience.md "Warm elasticity"):
+    # the same kill/shrink/grow drill with MXTPU_WARM_REMESH=1 — losses
+    # must stay bit-identical to the cold references while the telemetry
+    # log shows zero checkpoint reads on the warm path
+    JAX_PLATFORMS=cpu python -m pytest -q \
+      tests/test_resilience.py::test_warm_shrink_grow_drill
+    # structured degradation: a CRC-corrupt hot shard on rank 0 must fall
+    # back to the PR-3 checkpoint with a named fallback_reason, never crash
+    JAX_PLATFORMS=cpu python -m pytest -q \
+      tests/test_resilience.py::test_warm_corrupt_shard_falls_back_to_checkpoint
+    # multi-host-sim shrink/grow: 4 workers over 2 simulated hosts, lose a
+    # whole host, rebuild from ring-buddy copies on the survivor
+    JAX_PLATFORMS=cpu python -m pytest -q \
+      tests/test_resilience.py::test_multihost_warm_shrink_grow_drill
     # lint must stay clean under the resilience wiring (github-annotated
     # output so findings land on the PR diff)
     JAX_PLATFORMS=cpu python tools/mxlint.py --all-models \

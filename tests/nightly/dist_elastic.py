@@ -37,6 +37,16 @@ post-transition losses are identical (the agreement protocol must not
 perturb the math; training is deterministic end-to-end: seeded init,
 seeded per-epoch partition, rank-ordered KV allreduce).
 
+Warm mode (MXTPU_WARM_REMESH=1): every stable point also host-snapshots
+the param tree into the handoff area (own copy + off-host buddy), the
+victim burns its whole simulated host (hotstate.simulate_host_loss) on
+the way down, and each resume tries hotstate.warm_resume first — the
+checkpoint manager is only the fallback rung.  The resume transition
+event carries path="warm"/"cold" (+ fallback_reason), so the wrapper
+can assert the warm run never read a checkpoint and still produced
+bit-identical losses.  MXTPU_DRILL_EPOCHS overrides the epoch count
+(the corrupt-shard drill runs a shortened 3-epoch timeline).
+
 Artifacts under MXTPU_ELASTIC_DIR: ``losses-elastic.jsonl`` (rank 0,
 one line per finished epoch, appended across incarnations),
 ``losses-ref-w<W>-s<N>.jsonl`` (reference runs), and
@@ -54,9 +64,9 @@ import sys
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu.resilience import elastic
+from mxnet_tpu.resilience import elastic, hotstate
 
-TOTAL_EPOCHS = 5
+TOTAL_EPOCHS = int(os.environ.get("MXTPU_DRILL_EPOCHS", "5"))
 BATCH = 20
 DATA_SEED = 11          # seeded shuffle: batch order = f(seed, epoch)
 INIT_SEED = 5           # rank-uniform init (np global RNG feeds Uniform)
@@ -163,18 +173,32 @@ def main():
         loss_path = os.path.join(edir,
                                  "losses-ref-w%d-s%d.jsonl" % (nw, step))
     else:
-        got = mgr.auto_resume(abstract)
+        # warm rung first (host-memory handoff, no checkpoint reads),
+        # checkpoint rung on any HotStateUnavailable — the ladder the
+        # docs promise.  Both rungs land on the same committed step.
+        got, resume_path, fallback_reason = None, "cold", None
+        if hotstate.warm_enabled():
+            try:
+                tree, step, _meta = hotstate.warm_resume(abstract, kv=kv)
+                got, resume_path = (tree, step), "warm"
+            except hotstate.HotStateUnavailable as cold:
+                fallback_reason = cold.reason
+        if got is None:
+            got = mgr.auto_resume(abstract)
         if got is not None:
             load_tree(mod, got[0])
         start_epoch = 0 if got is None else got[1]
         stop_epoch = TOTAL_EPOCHS
         loss_path = os.path.join(edir, "losses-elastic.jsonl")
         elastic.emit_transition("resume", step=start_epoch, world_size=nw,
-                                fresh=got is None)
-        print("rank %d gen %d world %d: %s at epoch %d" % (
+                                fresh=got is None, path=resume_path,
+                                fallback_reason=fallback_reason)
+        print("rank %d gen %d world %d: %s at epoch %d (path=%s%s)" % (
             rank, gen, nw,
             "fresh start" if got is None else "resumed step %d" % got[1],
-            start_epoch), flush=True)
+            start_epoch, resume_path,
+            " fallback=%s" % fallback_reason if fallback_reason else ""),
+            flush=True)
 
     mod.init_optimizer(kvstore=kv, optimizer="sgd",
                        optimizer_params={"learning_rate": 0.3})
@@ -218,9 +242,18 @@ def main():
         if reference:
             continue
         kv.barrier()
-        mgr.save(tree_of(mod), epoch + 1)
+        stable = tree_of(mod)
+        mgr.save(stable, epoch + 1)
+        if hotstate.warm_enabled():
+            # every stable point refreshes the handoff area, so a
+            # later torn-epoch death still warm-resumes from here
+            hotstate.snapshot(stable, step=epoch + 1)
         if kill is not None and (gen, epoch, rank) == kill:
             _write_capacity(nw - 1)      # capacity drops WITH the node
+            if hotstate.warm_enabled():
+                # host RAM dies with the host: survivors must serve
+                # this rank's state from the off-host buddy replica
+                hotstate.simulate_host_loss(hotstate.host_index(rank, nw))
             print("rank %d: simulated preemption (capacity -> %d)"
                   % (rank, nw - 1), flush=True)
             sys.stdout.flush()
@@ -236,7 +269,11 @@ def main():
             # launcher bump the generation itself
             mx.resilience.exit_for_restart(orphan)
         if verdict is not None:
-            elastic.exit_for_remesh(verdict)
+            # clean adopt: state is stable here, so hand it to the
+            # handoff area once more on the way out (fault-path exits
+            # above ride the last post-save snapshot instead)
+            elastic.exit_for_remesh(verdict, hot_state=stable,
+                                    step=epoch + 1)
 
     print("rank %d done at gen %d (world %d)" % (rank, gen, nw),
           flush=True)

@@ -28,6 +28,7 @@ def _run(args, timeout):
                           capture_output=True, text=True, timeout=timeout)
 
 
+@pytest.mark.slow
 def test_topology_mesh_compile_only_devices():
     if os.environ.get("MXTPU_AOT_TOPOLOGY", "1") in ("0", "off", "no"):
         pytest.skip("topology probe disabled (MXTPU_AOT_TOPOLOGY=0)")

@@ -6,10 +6,12 @@ import json
 import os
 import subprocess
 import sys
+import pytest
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_bench_smoke_contract():
     env = dict(os.environ)
     env.update({
@@ -35,6 +37,7 @@ def test_bench_smoke_contract():
     assert d["compile_s"] > 0 and d["total_s"] > 0
 
 
+@pytest.mark.slow
 def test_bench_smoke_disabled_by_zero():
     """BENCH_SMOKE=0 must run the FULL bench, not the smoke tier (the
     file's boolean-knob convention: "0" disables)."""
@@ -60,6 +63,7 @@ def test_bench_smoke_disabled_by_zero():
     assert d["metric"] == "resnet18_train_images_per_sec", d
     assert "smoke" not in d
 
+@pytest.mark.slow
 def test_bench_replay_of_session_harvest(tmp_path):
     """When every probe fails, the operator opted in with
     BENCH_ALLOW_REPLAY=1, and a real-TPU measurement was banked earlier
@@ -118,6 +122,7 @@ def test_bench_replay_of_session_harvest(tmp_path):
     assert d.get("platform") == "cpu"   # fresh cpu-fallback measurement
 
 
+@pytest.mark.slow
 def test_bench_replay_rejects_smoke_and_stale(tmp_path):
     """A banked smoke line, an over-age measurement, or a payload with
     no embedded emit-time stamp must never be replayed as the headline

@@ -1,4 +1,5 @@
 """Smoke tests over the example/ tree (parity: tests/python/train)."""
+import pytest
 import os
 import subprocess
 import sys
@@ -370,6 +371,7 @@ def test_lstm_inference_model_matches_unrolled():
         assert np.allclose(got, want[t], atol=1e-5), t
 
 
+@pytest.mark.slow
 def test_memcost_mirroring_example():
     """Activation recompute demo (reference example/memcost): asserts the
     mirrored step recomputes in backward, shrinks the fwd->bwd residual

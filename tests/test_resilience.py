@@ -992,6 +992,7 @@ def _launch_raw(cmd_args, extra_env=None, expect_rc=0, timeout=420):
     return proc.stdout
 
 
+@pytest.mark.slow
 def test_elastic_shrink_grow_drill(tmp_path):
     """The ISSUE 7 acceptance drill: 3 workers, one dies mid-training ->
     survivors agree one generation-stamped shrink verdict, re-mesh to
@@ -1061,6 +1062,409 @@ def test_elastic_shrink_grow_drill(tmp_path):
     # resumed from the same checkpoint (the agreement protocol must not
     # perturb the math)
     for world, step, stop, port in ((2, 2, 3, "9912"), (3, 3, 5, "9913")):
+        _launch_raw(["-n", str(world), "--launcher", "local",
+                     "--workdir", _ROOT, "--port", port,
+                     sys.executable, drill],
+                    extra_env={"MXTPU_ELASTIC_DIR": edir,
+                               "MXTPU_ELASTIC_REFERENCE": "1",
+                               "MXTPU_RESUME_STEP": str(step),
+                               "MXTPU_STOP_EPOCH": str(stop)})
+        ref = os.path.join(edir, "losses-ref-w%d-s%d.jsonl" % (world,
+                                                               step))
+        with open(ref) as f:
+            ref_rows = [json.loads(line) for line in f]
+        assert ref_rows, "reference run recorded no losses"
+        by_epoch = {r["epoch"]: r for r in rows}
+        for r in ref_rows:
+            assert r["loss"] == by_epoch[r["epoch"]]["loss"], \
+                (world, r["epoch"])
+
+
+# ----------------------------------------------------------------------
+# warm elasticity: redundant host-memory hot state
+# (docs/resilience.md "Warm elasticity")
+# ----------------------------------------------------------------------
+from mxnet_tpu.resilience import hotstate  # noqa: E402
+from mxnet_tpu.resilience.hotstate import HotStateUnavailable  # noqa: E402
+
+
+def _warm_env(tmp_path, monkeypatch, **env):
+    monkeypatch.setenv("MXTPU_WARM_REMESH", "1")
+    monkeypatch.setenv("MXTPU_HANDOFF_DIR", str(tmp_path / "handoff"))
+    for var in ("MXTPU_NUM_HOSTS", "MXTPU_HOST_INDEX",
+                "MXTPU_HOTSTATE_BUDDIES", "MXTPU_ELASTIC_GENERATION"):
+        monkeypatch.delenv(var, raising=False)
+    for key, val in env.items():
+        monkeypatch.setenv(key, val)
+
+
+def _warm_tree(scale=1.0):
+    return {"params": {"w": np.arange(12, dtype=np.float32)
+                       .reshape(3, 4) * scale,
+                       "b": np.ones(4, np.float32) * scale},
+            "opt_state": {"m": np.zeros((3, 4), np.float32)}}
+
+
+def _warm_abstract():
+    return {"params": {"w": np.zeros((3, 4), np.float32),
+                       "b": np.zeros(4, np.float32)},
+            "opt_state": {"m": np.zeros((3, 4), np.float32)}}
+
+
+def test_hotstate_snapshot_warm_resume_roundtrip(tmp_path, monkeypatch):
+    _warm_env(tmp_path, monkeypatch)
+    tree = _warm_tree()
+    hotstate.snapshot(tree, step=5)
+    out, step, meta = hotstate.warm_resume(_warm_abstract())
+    assert step == 5 and meta["n_payloads"] == 1
+    for group in ("params", "opt_state"):
+        for leaf, want in tree[group].items():
+            assert np.array_equal(out[group][leaf], want), (group, leaf)
+    # without an abstract target the manifests' own nesting comes back
+    out2, step2, _ = hotstate.warm_resume(None)
+    assert step2 == 5
+    assert np.array_equal(out2["params"]["b"], tree["params"]["b"])
+
+
+def test_hotstate_newest_complete_step_wins(tmp_path, monkeypatch):
+    _warm_env(tmp_path, monkeypatch)
+    hotstate.snapshot(_warm_tree(scale=1.0), step=3)
+    hotstate.snapshot(_warm_tree(scale=7.0), step=9)
+    out, step, _ = hotstate.warm_resume(_warm_abstract())
+    assert step == 9
+    assert np.array_equal(out["params"]["w"],
+                          _warm_tree(scale=7.0)["params"]["w"])
+
+
+def test_hotstate_disabled_and_cold_verdicts(tmp_path, monkeypatch):
+    _warm_env(tmp_path, monkeypatch)
+    # empty handoff area -> cold verdict, reason no_payloads
+    verdict = hotstate.decide_sources()
+    assert verdict == {"mode": "cold", "reason": "no_payloads"}
+    with pytest.raises(HotStateUnavailable) as ei:
+        hotstate.warm_resume(_warm_abstract())
+    assert ei.value.reason == "cold_verdict"
+    # a group missing one rank's payload never satisfies the directory
+    hotstate._write_payload(
+        {"params/w": [([[0, 3], [0, 4]],
+                       np.zeros((3, 4), np.float32))]},
+        step=4, rank=0, world=2, host=0, namespace="train")
+    assert hotstate.decide_sources()["reason"] == "incomplete"
+    # the knob itself off -> structured "disabled", nothing read
+    monkeypatch.setenv("MXTPU_WARM_REMESH", "0")
+    assert not hotstate.warm_enabled()
+    with pytest.raises(HotStateUnavailable) as ei:
+        hotstate.warm_resume(_warm_abstract())
+    assert ei.value.reason == "disabled"
+
+
+def test_hotstate_buddy_lands_off_host_and_survives_host_loss(
+        tmp_path, monkeypatch):
+    """4 ranks on 2 simulated hosts; burning host 1 leaves every rank's
+    sharded state reconstructible from host 0 (owns + buddy replicas)."""
+    _warm_env(tmp_path, monkeypatch, MXTPU_NUM_HOSTS="2")
+    # contiguous-block host map, and buddies never on their own host
+    assert [hotstate.host_index(r, 4) for r in range(4)] == [0, 0, 1, 1]
+    assert hotstate.buddy_hosts(0, 4) == [1]
+    assert hotstate.buddy_hosts(3, 4) == [0]
+    w = np.arange(16, dtype=np.float32).reshape(4, 4)
+    for rank in range(4):
+        hotstate._write_payload(
+            {"params/w": [([[rank, rank + 1], [0, 4]],
+                           w[rank:rank + 1])]},
+            step=7, rank=rank, world=4,
+            host=hotstate.host_index(rank, 4), namespace="train")
+    hotstate.simulate_host_loss(1)
+    verdict = hotstate.decide_sources()
+    assert verdict["mode"] == "warm" and verdict["step"] == 7
+    assert verdict["n_buddy"] == 2          # ranks 2,3 serve via buddies
+    out, step, meta = hotstate.load_sources(
+        verdict, {"params": {"w": np.zeros((4, 4), np.float32)}})
+    assert step == 7 and meta["n_payloads"] == 4
+    assert np.array_equal(out["params"]["w"], w)
+
+
+def test_hotstate_buddy_loss_seam_drops_redundancy(tmp_path, monkeypatch):
+    _warm_env(tmp_path, monkeypatch, MXTPU_NUM_HOSTS="2")
+    _arm(monkeypatch, "kind=buddy_loss:rank=0")
+    hotstate.snapshot(_warm_tree(), step=2, rank=0, world=2)
+    recs = hotstate.scan()
+    assert {r["source"] for r in recs} == {"own"}   # replica push lost
+    # own host burns -> nothing left to serve rank 0 -> cold
+    hotstate.simulate_host_loss(0)
+    assert hotstate.decide_sources()["mode"] == "cold"
+
+
+def test_hotstate_corrupt_payload_is_rejected_by_crc(tmp_path,
+                                                     monkeypatch):
+    _warm_env(tmp_path, monkeypatch)
+    hotstate.snapshot(_warm_tree(), step=5)
+    _arm(monkeypatch, "kind=corrupt:rank=0")
+    with pytest.raises(HotStateUnavailable) as ei:
+        hotstate.warm_resume(_warm_abstract())
+    assert ei.value.reason == "crc_mismatch"
+    # the fault fired once: the next attempt reads clean bytes
+    out, step, _ = hotstate.warm_resume(_warm_abstract())
+    assert step == 5
+    assert np.array_equal(out["params"]["b"], np.ones(4, np.float32))
+
+
+def test_hotstate_snapshot_crash_seam_raises_injected(tmp_path,
+                                                      monkeypatch):
+    _warm_env(tmp_path, monkeypatch)
+    _arm(monkeypatch, "kind=snapshot_crash:step=3")
+    with pytest.raises(InjectedFault):
+        hotstate.snapshot(_warm_tree(), step=3)
+    assert hotstate.scan() == []            # nothing half-written
+
+
+def test_hotstate_target_mismatch_names_leaf(tmp_path, monkeypatch):
+    _warm_env(tmp_path, monkeypatch)
+    hotstate.snapshot(_warm_tree(), step=1)
+    bad = _warm_abstract()
+    bad["params"]["w"] = np.zeros((5, 4), np.float32)
+    with pytest.raises(HotStateUnavailable) as ei:
+        hotstate.warm_resume(bad)
+    assert ei.value.reason == "target_mismatch"
+    assert "params/w" in str(ei.value)
+
+
+def test_trainer_warm_elastic_resume_and_checkpoint_fallback(
+        tmp_path, monkeypatch):
+    """ShardedTrainer.elastic_resume: the warm rung re-places the
+    handoff tree with the trainer's shardings and never opens a
+    checkpoint; a corrupt payload degrades to the checkpoint rung with
+    the fallback reason in the resume telemetry."""
+    _warm_env(tmp_path, monkeypatch)
+    ckdir = str(tmp_path / "ckpts")
+    shapes = {"data": (16, 8)}
+    lbl = {"softmax_label": (16,)}
+    tr, params, opt_state, aux, batch = _trainer()
+    for _ in range(2):
+        params, opt_state, aux, _ = tr.step(params, opt_state, aux, batch)
+    tr.save_checkpoint_versioned(ckdir, params, opt_state, aux)
+    tr.hotstate_snapshot(params, opt_state, aux)
+    want = _host(params)
+
+    events = []
+    monkeypatch.setattr(elastic, "emit_transition",
+                        lambda event, **f: events.append((event, f)))
+    tr2, _, _, _, _ = _trainer()
+    got = tr2.elastic_resume(ckdir, shapes, label_shapes=lbl,
+                             source="warm")
+    assert got is not None
+    p2, _, _, step = got
+    assert step == 2 and tr2.num_update == 2
+    for name, arr in _host(p2).items():
+        assert np.array_equal(want[name], arr), name
+    (event, fields), = [e for e in events if e[0] == "resume"]
+    assert fields["path"] == "warm" and fields["fallback_reason"] is None
+    assert fields["n_payloads"] == 1
+
+    # corrupt the payload -> CRC rejects -> checkpoint rung, reason kept
+    events.clear()
+    _arm(monkeypatch, "kind=corrupt")
+    tr3, _, _, _, _ = _trainer()
+    got = tr3.elastic_resume(ckdir, shapes, label_shapes=lbl,
+                             source="auto")
+    assert got is not None and got[3] == 2
+    for name, arr in _host(got[0]).items():
+        assert np.array_equal(want[name], arr), name
+    (event, fields), = [e for e in events if e[0] == "resume"]
+    assert fields["path"] == "cold"
+    assert fields["fallback_reason"] == "crc_mismatch"
+
+
+# ----------------------------------------------------------------------
+# auto_resume corruption fallback (satellite: a committed checkpoint
+# damaged after the fact must not end the run while an older one works)
+# ----------------------------------------------------------------------
+def test_auto_resume_walks_back_past_corrupt_latest(tmp_path):
+    from mxnet_tpu.parallel.ckpt import abstract_like
+    mgr = CheckpointManager(str(tmp_path / "run"), keep=0,
+                            payload_format="host")
+    for step in (1, 2):
+        mgr.save({"w": jnp.arange(8, dtype=jnp.float32) * step}, step)
+    # truncate the newest manifest: simulated post-commit damage
+    manifest = os.path.join(mgr.step_path(2), "host_ckpt.json")
+    with open(manifest, "w") as f:
+        f.write('{"step": 2, "keys"')
+    restored, step = mgr.auto_resume(
+        abstract_like({"w": jnp.zeros(8, jnp.float32)}))
+    assert step == 1
+    assert np.allclose(np.asarray(restored["w"]), np.arange(8))
+
+    # every kept version bad -> structured restore_corrupt, not a crash
+    manifest1 = os.path.join(mgr.step_path(1), "host_ckpt.json")
+    with open(manifest1, "w") as f:
+        f.write("not json")
+    with pytest.raises(ResilienceError) as ei:
+        mgr.auto_resume(abstract_like({"w": jnp.zeros(8, jnp.float32)}))
+    assert ei.value.kind == "restore_corrupt"
+    assert ei.value.phase == "ckpt_restore"
+
+
+def _read_elastic_events(tdir):
+    recs = []
+    for path in glob.glob(os.path.join(tdir, "events-rank*.jsonl*")):
+        with open(path) as f:
+            recs += [json.loads(line) for line in f if line.strip()]
+    return recs
+
+
+@pytest.mark.slow
+def test_warm_shrink_grow_drill(tmp_path):
+    """The warm-elasticity acceptance drill: the SAME shrink/grow
+    timeline as test_elastic_shrink_grow_drill but with
+    MXTPU_WARM_REMESH=1 — every transition resumes from the host-memory
+    handoff area (the victim's host RAM burns with it; its state is
+    served by the off-host ring buddy), ZERO checkpoint reads happen on
+    any resume, and the loss trajectory is still bit-identical to
+    fixed-world reference runs from the same steps."""
+    edir = str(tmp_path / "elastic")
+    tdir = os.path.join(edir, "telemetry")
+    drill = os.path.join("tests", "nightly", "dist_elastic.py")
+    _launch_raw(["-n", "3", "--launcher", "local", "--workdir", _ROOT,
+                 "--port", "9916", "--elastic", "--min-world", "2",
+                 "--elastic-dir", edir, "--max-restarts", "4", "--warm",
+                 sys.executable, drill],
+                extra_env={"MXTPU_STEP_TIMEOUT_S": "12",
+                           "MXTPU_TELEMETRY_DIR": tdir})
+
+    with open(os.path.join(edir, "LEDGER.json")) as f:
+        led = json.load(f)
+    assert led["generation"] == 2 and led["world_size"] == 3
+
+    with open(os.path.join(edir, "losses-elastic.jsonl")) as f:
+        rows = [json.loads(line) for line in f]
+    assert [r["epoch"] for r in rows] == [0, 1, 2, 3, 4]
+    assert [r["world"] for r in rows] == [3, 3, 2, 3, 3]
+
+    recs = _read_elastic_events(tdir)
+    el = [r for r in recs if r.get("kind") == "elastic"]
+    # acceptance: the warm path never opened a checkpoint — zero ckpt
+    # resume (or corrupt-skip) events across the whole timeline
+    ckpt_reads = [r for r in recs if r.get("kind") == "ckpt"
+                  and r.get("phase") in ("resume", "restore_corrupt_skip")]
+    assert ckpt_reads == [], ckpt_reads
+    # both post-transition incarnations resumed warm, every rank
+    resumes = [r for r in el if r["event"] == "resume"]
+    warm = [(r["generation"], r["world_size"]) for r in resumes
+            if r.get("path") == "warm"]
+    assert warm.count((1, 2)) == 2
+    assert warm.count((2, 3)) == 3
+    for r in resumes:
+        if r["generation"] >= 1:
+            assert r.get("path") == "warm", r
+            assert not r.get("fallback_reason"), r
+    # each stable point host-offloaded (snapshot events with bytes and
+    # off-host buddy placement), and the handoff area is where the env
+    # says it is
+    snaps = [r for r in el if r["event"] == "snapshot"]
+    assert snaps and all(s["bytes"] > 0 for s in snaps)
+    assert any(s["buddies"] and s["host"] not in s["buddies"]
+               for s in snaps)
+    assert os.path.isdir(os.path.join(edir, "handoff", "train"))
+
+    # warm resumes are bit-identical to fixed-world reference runs
+    # restored from the same steps (checkpoints exist for references
+    # even though the elastic run never read them)
+    for world, step, stop, port in ((2, 2, 3, "9917"), (3, 3, 5, "9918")):
+        _launch_raw(["-n", str(world), "--launcher", "local",
+                     "--workdir", _ROOT, "--port", port,
+                     sys.executable, drill],
+                    extra_env={"MXTPU_ELASTIC_DIR": edir,
+                               "MXTPU_ELASTIC_REFERENCE": "1",
+                               "MXTPU_RESUME_STEP": str(step),
+                               "MXTPU_STOP_EPOCH": str(stop)})
+        ref = os.path.join(edir, "losses-ref-w%d-s%d.jsonl" % (world,
+                                                               step))
+        with open(ref) as f:
+            ref_rows = [json.loads(line) for line in f]
+        assert ref_rows, "reference run recorded no losses"
+        by_epoch = {r["epoch"]: r for r in rows}
+        for r in ref_rows:
+            assert r["loss"] == by_epoch[r["epoch"]]["loss"], \
+                (world, r["epoch"])
+
+
+@pytest.mark.slow
+def test_warm_corrupt_shard_falls_back_to_checkpoint(tmp_path):
+    """Structured degradation: a corrupt handoff payload on rank 0
+    fails the CRC at warm-resume time, and that rank alone falls back
+    to the versioned checkpoint — resume completes at the same step,
+    with the fallback reason named in its elastic telemetry."""
+    edir = str(tmp_path / "elastic")
+    tdir = os.path.join(edir, "telemetry")
+    drill = os.path.join("tests", "nightly", "dist_elastic.py")
+    _launch_raw(["-n", "3", "--launcher", "local", "--workdir", _ROOT,
+                 "--port", "9919", "--elastic", "--min-world", "2",
+                 "--elastic-dir", edir, "--max-restarts", "4", "--warm",
+                 sys.executable, drill],
+                extra_env={"MXTPU_STEP_TIMEOUT_S": "12",
+                           "MXTPU_TELEMETRY_DIR": tdir,
+                           "MXTPU_DRILL_EPOCHS": "3",
+                           "MXTPU_DRILL_GROW": "",
+                           "MXTPU_FAULT_SPEC": "kind=corrupt:rank=0"})
+
+    with open(os.path.join(edir, "losses-elastic.jsonl")) as f:
+        rows = [json.loads(line) for line in f]
+    assert [r["epoch"] for r in rows] == [0, 1, 2]
+    assert [r["world"] for r in rows] == [3, 3, 2]
+
+    el = [r for r in _read_elastic_events(tdir)
+          if r.get("kind") == "elastic"]
+    gen1 = [r for r in el if r["event"] == "resume"
+            and r["generation"] == 1]
+    assert len(gen1) == 2, gen1
+    paths = sorted((r.get("path"), r.get("fallback_reason"))
+                   for r in gen1)
+    # rank 0's payload read is corrupted -> checkpoint rung, named
+    # reason; the untouched rank stays warm.  Both land on step 2.
+    assert paths == [("cold", "crc_mismatch"), ("warm", None)], paths
+    assert all(r["step"] == 2 for r in gen1)
+
+
+@pytest.mark.slow
+def test_multihost_warm_shrink_grow_drill(tmp_path):
+    """Multi-host simulation: 4 workers over 2 simulated hosts
+    (contiguous block mapping).  Killing rank 3 burns host 1's whole
+    handoff RAM — ranks 2 and 3's own payloads vanish together — and
+    the survivors still warm-resume the full tree from host 0's owns +
+    ring-buddy replicas, bit-identical to a cold reference."""
+    edir = str(tmp_path / "elastic")
+    tdir = os.path.join(edir, "telemetry")
+    drill = os.path.join("tests", "nightly", "dist_elastic.py")
+    _launch_raw(["-n", "4", "--launcher", "local", "--workdir", _ROOT,
+                 "--port", "9921", "--elastic", "--min-world", "3",
+                 "--elastic-dir", edir, "--max-restarts", "4", "--warm",
+                 sys.executable, drill],
+                extra_env={"MXTPU_STEP_TIMEOUT_S": "12",
+                           "MXTPU_TELEMETRY_DIR": tdir,
+                           "MXTPU_NUM_HOSTS": "2",
+                           "MXTPU_DRILL_EPOCHS": "4",
+                           "MXTPU_DRILL_KILL": "0:1:3",
+                           "MXTPU_DRILL_GROW": "1:2:4"})
+
+    with open(os.path.join(edir, "losses-elastic.jsonl")) as f:
+        rows = [json.loads(line) for line in f]
+    assert [r["epoch"] for r in rows] == [0, 1, 2, 3]
+    assert [r["world"] for r in rows] == [4, 4, 3, 4]
+
+    recs = _read_elastic_events(tdir)
+    ckpt_reads = [r for r in recs if r.get("kind") == "ckpt"
+                  and r.get("phase") in ("resume", "restore_corrupt_skip")]
+    assert ckpt_reads == [], ckpt_reads
+    el = [r for r in recs if r.get("kind") == "elastic"]
+    warm = [(r["generation"], r["world_size"])
+            for r in el if r["event"] == "resume"
+            and r.get("path") == "warm"]
+    assert warm.count((1, 3)) == 3
+    assert warm.count((2, 4)) == 4
+
+    # warm losses bit-identical to cold (fixed-world, checkpoint-
+    # restored) references through both transitions
+    for world, step, stop, port in ((3, 2, 3, "9922"), (4, 3, 4, "9923")):
         _launch_raw(["-n", str(world), "--launcher", "local",
                      "--workdir", _ROOT, "--port", port,
                      sys.executable, drill],

@@ -458,3 +458,46 @@ def test_predictor_accepts_pathlike_checkpoint(tmp_path, toy_model):
     pred = mx.Predictor(sym_path, params_path, {"data": (1, 10)})
     out = pred.forward(data=np.zeros((1, 10), "float32"))
     assert out[0].shape == (1, 3)
+
+
+def test_server_warm_remesh_rebind_zero_lowerings(toy_model, tmp_path,
+                                                  monkeypatch):
+    """Serving warm elasticity (docs/resilience.md "Warm elasticity"):
+    snapshot_hotstate captures every model's params AND bind config into
+    the ``serve`` handoff namespace; a fresh ModelServer rebuilds from
+    host memory alone (warm_resume_models) — no checkpoint/param files —
+    answers bit-identically, and the per-bucket rebinds ride the PR-8
+    program registry, so the swap performs zero new lowerings."""
+    net, params = toy_model
+    monkeypatch.setenv("MXTPU_WARM_REMESH", "1")
+    monkeypatch.setenv("MXTPU_HANDOFF_DIR", str(tmp_path / "handoff"))
+    srv = ModelServer(max_delay_ms=2)
+    srv.add_model("toy", net.tojson(), params, {"data": (10,)},
+                  buckets=(1, 4), priority=2)
+    x = np.random.RandomState(9).rand(3, 10).astype("float32")
+    want = srv.predict("toy", x)
+    srv.snapshot_hotstate(step=11)
+    srv.close()
+
+    srv2 = ModelServer(max_delay_ms=2)
+    before = program_registry_stats()["lowerings"]
+    restored = srv2.warm_resume_models()
+    assert restored == ["toy"]
+    assert program_registry_stats()["lowerings"] == before
+    got = srv2.predict("toy", x)
+    stats = srv2.stats()
+    srv2.close()
+    np.testing.assert_array_equal(got[0], want[0])
+    assert stats["models"]["toy"]["lowerings_since_warmup"] == 0
+    # the bind config came back from the payload, not from defaults
+    assert list(srv2.plan("toy").buckets) == [1, 4]
+    assert srv2._entries["toy"].priority == 2
+
+    # no surviving payload -> structured HotStateUnavailable, the cue
+    # to fall back to checkpoint files
+    from mxnet_tpu.resilience import HotStateUnavailable, hotstate
+    hotstate.clear("serve")
+    srv3 = ModelServer(max_delay_ms=2)
+    with pytest.raises(HotStateUnavailable):
+        srv3.warm_resume_models()
+    srv3.close()

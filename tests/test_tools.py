@@ -1,5 +1,6 @@
 """CLI tools coverage (parity: the reference's tools/ family is exercised
 by its nightly scripts; here each tool gets a direct test)."""
+import pytest
 import os
 import sys
 
@@ -282,6 +283,7 @@ def test_native_im2rec_color_keep(tmp_path):
     assert Image.open(_io.BytesIO(buf)).mode == "L"
 
 
+@pytest.mark.slow
 def test_pjrt_predict_runner(tmp_path):
     """Python-free deployment spike (reference amalgamation/
     mxnet_predict0.cc): the amalgamation bundle carries raw StableHLO

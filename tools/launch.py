@@ -229,6 +229,14 @@ def supervise_elastic(args, command):
         os.environ["MXTPU_ELASTIC_MIN_WORLD"] = str(min_world)
         os.environ["MXTPU_ELASTIC_GENERATION"] = str(gen)
         os.environ["MXTPU_ELASTIC_TARGET_WORLD"] = str(target)
+        # warm elasticity: the handoff area must outlive each
+        # incarnation, so it defaults under the (stable) elastic dir;
+        # an explicit MXTPU_HANDOFF_DIR (e.g. a /dev/shm tmpfs for true
+        # disklessness) wins
+        os.environ.setdefault("MXTPU_HANDOFF_DIR",
+                              os.path.join(elastic_dir, "handoff"))
+        if getattr(args, "warm", False):
+            os.environ["MXTPU_WARM_REMESH"] = "1"
         args.num_workers = world
         # fresh port per incarnation: the previous coordinator's socket
         # may linger in TIME_WAIT past the respawn
@@ -314,6 +322,11 @@ def main():
                              "(default ./mxtpu_elastic)")
     parser.add_argument("--max-restarts", type=int, default=None,
                         help="--elastic: give up after this many respawns")
+    parser.add_argument("--warm", action="store_true",
+                        help="--elastic: warm re-mesh — set "
+                             "MXTPU_WARM_REMESH=1 so transitions resume "
+                             "from host-memory hot state instead of the "
+                             "checkpoint (docs/resilience.md)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.command:

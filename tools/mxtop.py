@@ -57,6 +57,17 @@ def render(report, stream=sys.stdout):
             pod["generation"],
             pod.get("world_size", "?"),
             last.get("event") or "-"))
+        tr = pod.get("last_transition")
+        if tr:
+            parts = ["      last transition resumed %s"
+                     % (tr.get("path") or "?")]
+            if tr.get("fallback_reason"):
+                parts.append("(fell back: %s)" % tr["fallback_reason"])
+            if tr.get("duration_ms") is not None:
+                parts.append("restore %.0f ms" % tr["duration_ms"])
+            if tr.get("transition_ms") is not None:
+                parts.append("end-to-end %.0f ms" % tr["transition_ms"])
+            w("   ".join(parts) + "\n")
     if pod.get("phase_totals_ms"):
         w("      phase totals: %s\n" % "  ".join(
             "%s=%.1fms" % (k, v)
