@@ -51,6 +51,9 @@ DIRECTIONS = {
     "transformer_step_ms": "up",
     "serve_padding_waste": "up",
     "serve_ms_p95": "up",
+    "serve_ttft_ms_p95": "up",
+    "serve_itl_ms_p95": "up",
+    "serve_tokens_per_sec": "down",
     "images_per_sec": "down",
     "module_path_images_per_sec": "down",
     "transformer_tokens_per_sec": "down",
@@ -83,6 +86,16 @@ def _bench_metrics(parsed):
     if parsed.get("value") is not None \
             and parsed.get("unit") == "images/sec":
         out["images_per_sec"] = float(parsed["value"])
+    if parsed.get("value") is not None \
+            and parsed.get("metric") == "serve_tokens_per_sec":
+        # serve_bench --generate BENCH line: throughput + the tail
+        # latency pair the generation SLO story cares about
+        out["serve_tokens_per_sec"] = float(parsed["value"])
+        for src, dst in (("ttft_ms", "serve_ttft_ms_p95"),
+                         ("itl_ms", "serve_itl_ms_p95")):
+            p95 = (parsed.get(src) or {}).get("p95")
+            if p95 is not None:
+                out[dst] = float(p95)
     return out
 
 
@@ -141,6 +154,13 @@ def telemetry_metrics(report):
     lat = total.get("latency_ms") or {}
     if lat.get("p95") is not None:
         out["serve_ms_p95"] = float(lat["p95"])
+    if total.get("tokens_per_sec") is not None:
+        out["serve_tokens_per_sec"] = float(total["tokens_per_sec"])
+    for src, dst in (("ttft_ms", "serve_ttft_ms_p95"),
+                     ("itl_ms", "serve_itl_ms_p95")):
+        p95 = (total.get(src) or {}).get("p95")
+        if p95 is not None:
+            out[dst] = float(p95)
     return out
 
 

@@ -183,3 +183,190 @@ class MultiHeadAttention(OperatorProperty):
         if notes:
             out["notes"] = notes
         return out
+
+
+class _CachedMHAParam(ParamStruct):
+    num_heads = Field(int, required=True, lower=1)
+    mode = Field(str, default="decode", doc="prefill | decode")
+
+
+@register_op("CachedMultiHeadAttention")
+class CachedMultiHeadAttention(OperatorProperty):
+    """Decode-mode MultiHeadAttention over a block-paged KV cache.
+
+    The generative counterpart of :class:`MultiHeadAttention`: same
+    projection weights (so one checkpoint serves training, full
+    forward, prefill, and decode graphs), but keys/values stream
+    through the paged pools of :mod:`mxnet_tpu.serving.kvcache` and the
+    cache append is a **functional update** — the op returns the new
+    pools as extra outputs, so the whole step stays jit-pure and the
+    compiled program is shape-stable across sequences.
+
+    Inputs beyond the MHA five: ``k_cache``/``v_cache`` pools
+    ``(num_blocks, block_size, H, D)``, ``block_table`` ``(B,
+    blocks_per_seq)`` naming each row's pool blocks, and ``seq_pos``
+    ``(B,)`` — the prompt length in prefill mode (positions ``0..L-1``
+    are written; padded positions scatter to the trash block), the new
+    token's position in decode mode (position-offset masking limits
+    attention to slots ``<= seq_pos``).
+
+    - ``mode="prefill"``: data ``(B, S, E)``; causal self-attention over
+      the prompt (identical math to the full-forward reference path)
+      plus a scatter of all S keys/values into the pools.
+    - ``mode="decode"``: data ``(B, 1, E)``; scatter the single new
+      k/v at ``(table[b, pos//bs], pos % bs)``, then single-query
+      attention over every cached slot the table names, masked to
+      positions ``<= seq_pos`` — padded rows route to the trash block
+      and produce ignored outputs, never clobbered cache state.
+    """
+    param_cls = _CachedMHAParam
+    mxu = True
+
+    def list_arguments(self):
+        return ["data", "qkv_weight", "qkv_bias", "out_weight", "out_bias",
+                "k_cache", "v_cache", "block_table", "seq_pos"]
+
+    def list_outputs(self):
+        return ["output", "k_cache_out", "v_cache_out"]
+
+    def infer_shape(self, in_shapes):
+        data, cache = in_shapes[0], in_shapes[5]
+        if data is None or cache is None:
+            require_known("CachedMultiHeadAttention",
+                          [in_shapes[0], in_shapes[5]],
+                          ["data", "k_cache"])
+        if len(data) != 3:
+            raise MXNetError(
+                "CachedMultiHeadAttention: data must be (B, S, E)")
+        if len(cache) != 4:
+            raise MXNetError(
+                "CachedMultiHeadAttention: k_cache must be "
+                "(num_blocks, block_size, num_heads, head_dim)")
+        B, S, E = data
+        H = self.param.num_heads
+        if E % H:
+            raise MXNetError("embed dim %d not divisible by num_heads %d"
+                             % (E, H))
+        if cache[2] != H or cache[3] != E // H:
+            raise MXNetError(
+                "cache heads/head_dim %s do not match (H=%d, D=%d)"
+                % (cache[2:], H, E // H))
+        if self.param.mode == "decode" and S != 1:
+            raise MXNetError("decode mode takes one token per row, "
+                             "got S=%d" % S)
+        if self.param.mode not in ("prefill", "decode"):
+            raise MXNetError("mode must be prefill|decode, got %r"
+                             % self.param.mode)
+        table = in_shapes[7]
+        mb = table[1] if table is not None and len(table) == 2 else None
+        if mb is None:
+            raise MXNetError("block_table must be (B, blocks_per_seq)")
+        return ([data, (3 * E, E), (3 * E,), (E, E), (E,),
+                 tuple(cache), tuple(cache), (B, mb), (B,)],
+                [data, tuple(cache), tuple(cache)], [])
+
+    def _ctx_len(self, in_shapes):
+        """Cached context slots the table can name (attention width)."""
+        cache, table = in_shapes[5], in_shapes[7]
+        return int(table[1]) * int(cache[1])
+
+    def cost_mxu_dims(self, in_shapes, out_shapes):
+        B, S, E = in_shapes[0]
+        H = self.param.num_heads
+        D = E // H
+        T = self._ctx_len(in_shapes) if self.param.mode == "decode" else S
+        # qkv proj, out proj, then per-(batch, head): q@k.T and p@v over
+        # the cached context length
+        return [(B * S, E, 3 * E), (B * S, E, E),
+                (S, D, T), (S, T, D)]
+
+    def cost_flops(self, in_shapes, out_shapes):
+        B, S, E = in_shapes[0]
+        H = self.param.num_heads
+        D = E // H
+        T = self._ctx_len(in_shapes) if self.param.mode == "decode" else S
+        proj = 2 * B * S * E * (3 * E + E)
+        attn = 2 * B * H * (S * D * T + S * T * D)
+        return float(proj + attn)
+
+    def cost_reduce_len(self, in_shapes, out_shapes):
+        return int(self._ctx_len(in_shapes)
+                   if self.param.mode == "decode" else in_shapes[0][1])
+
+    def forward(self, inputs, aux, is_train, rng):
+        import jax
+        x, wqkv, bqkv, wo, bo, kc, vc, table, seq_pos = inputs
+        B, S, E = x.shape
+        H = self.param.num_heads
+        D = E // H
+        BS = kc.shape[1]
+        table = table.astype(jnp.int32)
+        pos = seq_pos.astype(jnp.int32)
+        qkv = x @ wqkv.T + bqkv                       # (B, S, 3E)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        kh = k.reshape(B, S, H, D)
+        vh = v.reshape(B, S, H, D)
+
+        if self.param.mode == "prefill":
+            from ..parallel.ring_attention import attention_reference
+
+            def heads(t):
+                return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+            o = attention_reference(heads(q), heads(k), heads(v),
+                                    causal=True)
+            o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
+            # scatter every prompt position; padded ones (>= seq_pos)
+            # route to the trash block so the write stays static-shape
+            j = jnp.arange(S, dtype=jnp.int32)
+            blocks = jnp.take_along_axis(
+                table, jnp.broadcast_to((j // BS)[None, :], (B, S)), axis=1)
+            blocks = jnp.where(j[None, :] < pos[:, None], blocks, 0)
+            idx_b = blocks.reshape(-1)
+            idx_s = jnp.tile(j % BS, B)
+            kc = kc.at[idx_b, idx_s].set(
+                kh.reshape(B * S, H, D).astype(kc.dtype))
+            vc = vc.at[idx_b, idx_s].set(
+                vh.reshape(B * S, H, D).astype(vc.dtype))
+        else:
+            # decode: append the one new k/v, then single-query
+            # attention over the cached context (scatter-then-attend:
+            # the new token reads its own k/v back from the pool)
+            blk = jnp.take_along_axis(table, (pos // BS)[:, None],
+                                      axis=1)[:, 0]
+            slot = pos % BS
+            kc = kc.at[blk, slot].set(kh[:, 0].astype(kc.dtype))
+            vc = vc.at[blk, slot].set(vh[:, 0].astype(vc.dtype))
+            MB = table.shape[1]
+            kk = kc[table].reshape(B, MB * BS, H, D).astype(q.dtype)
+            vv = vc[table].reshape(B, MB * BS, H, D).astype(q.dtype)
+            scale = 1.0 / float(_np.sqrt(D))
+            qh = q.reshape(B, H, D)
+            s = jnp.einsum("bhd,bthd->bht", qh, kk) * scale
+            # position-offset mask: only slots holding tokens <= pos
+            t_idx = jnp.arange(MB * BS, dtype=jnp.int32)
+            s = jnp.where(t_idx[None, None, :] <= pos[:, None, None],
+                          s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bht,bthd->bhd", p, vv.astype(p.dtype))
+            o = o.astype(q.dtype).reshape(B, 1, E)
+        return [o @ wo.T + bo, kc, vc], None
+
+    def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
+        # head-parallel like MHA: cache pools shard dim 2 (heads) on the
+        # same axis as qkv_weight dim 0; tables/positions replicated
+        data, qkv_w = in_specs[0], in_specs[1]
+        head = tuple(qkv_w[0] if qkv_w else ())
+        cache = (tuple(), tuple(), head, tuple())
+        batch = tuple(data[0] if data else ())
+        seq = tuple(data[1] if len(data) > 1 else ())
+        out_w = in_specs[3]
+        out_c = tuple(out_w[1] if len(out_w) > 1 else ())
+        feat = () if (head and head == out_c) \
+            else dedup_axes(out_w[0] if out_w else (), batch + seq)
+        out = {"out": [(batch, seq, feat), cache, cache],
+               "in": [None, None, (head,), None, (feat,),
+                      cache, cache, None, None]}
+        if head and head == out_c:
+            out["reduce"] = {head: "head-parallel cached attention closed "
+                                   "by row-parallel out projection"}
+        return out

@@ -9,6 +9,12 @@ admissible bucket under SLO knobs (admission timer, bounded queue,
 priorities, structured backpressure) with host pack/unpack overlapping
 device execution.
 
+Generative serving (docs/serving.md "Generation") adds the second
+workload class: a block-paged KV cache (:mod:`.kvcache`), AOT
+prefill/decode programs and iteration-level decode batching
+(:mod:`.generate`), opened through
+:meth:`ModelServer.add_generative_model` / :meth:`ModelServer.generate`.
+
 Entry points: :class:`ModelServer` (in-process), ``tools/mxserve.py``
 (HTTP), ``tools/serve_bench.py`` (load generator),
 ``mxtop --serve`` (telemetry view).
@@ -19,6 +25,9 @@ from .buckets import (BucketPlan, bucket_for, model_matmul_dims,
                       parse_buckets, parse_histogram, plan_buckets,
                       plan_cost, pow2_buckets, request_waste)
 from .batcher import ContinuousBatcher, Future, Request, ServerBusy
+from .kvcache import CacheExhausted, KVCacheConfig, PagedKVCache
+from .generate import (GenerationEngine, GenerativeEntry, TokenStream,
+                       generation_mats)
 from .server import ModelServer, checkpoint_files
 from .telemetry import emit_batch, serve_report
 
@@ -27,6 +36,9 @@ __all__ = [
     "parse_histogram", "plan_buckets", "plan_cost", "pow2_buckets",
     "request_waste",
     "ContinuousBatcher", "Future", "Request", "ServerBusy",
+    "CacheExhausted", "KVCacheConfig", "PagedKVCache",
+    "GenerationEngine", "GenerativeEntry", "TokenStream",
+    "generation_mats",
     "ModelServer", "checkpoint_files",
     "emit_batch", "serve_report",
 ]

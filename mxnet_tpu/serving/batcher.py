@@ -32,6 +32,18 @@ ModelServer` for the real one): ``name``, ``priority``, ``buckets``
 (sorted admissible batch sizes), ``pack(requests, bucket)`` →
 payload, ``launch(payload, bucket)`` → handle, ``unpack(handle,
 requests, bucket)`` → ``(per-request results, phase dict)``.
+
+**Generative entries** (``generative = True``, see :class:`mxnet_tpu.
+serving.generate.GenerativeEntry`) extend the protocol for
+iteration-level decode batching: ``buckets`` are prompt-length buckets
+and each queued request is ONE prompt (popped alone into a bucketed
+prefill, so new prompts join without evicting running decodes), while
+``has_decode_work()``/``pack_decode()`` surface decode iterations the
+scheduler dispatches even with an empty queue — one step over every
+active sequence, results settled through ``complete(handle, batch)``.
+The scheduler runs at most one in-flight job per generative entry
+(step N+1 consumes step N's tokens and cache pools) and alternates
+prefill/decode when both pend, so neither phase starves the other.
 """
 from __future__ import annotations
 
@@ -78,22 +90,26 @@ class ServerBusy(MXNetError):
     a message string."""
 
     def __init__(self, model, queue_depth, limit, retry_after_ms=None,
-                 code=429, reason="queue full"):
+                 code=429, reason="queue full", extra=None):
         self.model = model
         self.queue_depth = int(queue_depth)
         self.limit = int(limit)
         self.retry_after_ms = retry_after_ms
         self.code = int(code)
         self.reason = reason
+        self.extra = dict(extra) if extra else None
         super(ServerBusy, self).__init__(
             "server busy (%d): %s — model %r queue depth %d >= limit %d"
             % (self.code, reason, model, self.queue_depth, self.limit))
 
     def to_dict(self):
-        return {"error": "server_busy", "code": self.code,
-                "reason": self.reason, "model": self.model,
-                "queue_depth": self.queue_depth, "limit": self.limit,
-                "retry_after_ms": self.retry_after_ms}
+        d = {"error": "server_busy", "code": self.code,
+             "reason": self.reason, "model": self.model,
+             "queue_depth": self.queue_depth, "limit": self.limit,
+             "retry_after_ms": self.retry_after_ms}
+        if self.extra:
+            d.update(self.extra)         # e.g. blocks_free on KV 429s
+        return d
 
 
 class Future(object):
@@ -148,13 +164,15 @@ class Request(object):
 
 
 class _Batch(object):
-    """In-flight batch bookkeeping between the three pipeline stages."""
+    """In-flight batch bookkeeping between the three pipeline stages.
+    ``phase`` is None for plain predict batches, "prefill"/"decode"
+    for generative jobs (which settle via ``entry.complete``)."""
 
     __slots__ = ("entry", "requests", "bucket", "n_samples", "pack_ms",
-                 "queue_depth", "t_packed")
+                 "queue_depth", "t_packed", "phase", "payload")
 
     def __init__(self, entry, requests, bucket, n_samples, pack_ms,
-                 queue_depth):
+                 queue_depth, phase=None, payload=None):
         self.entry = entry
         self.requests = requests
         self.bucket = bucket
@@ -162,6 +180,8 @@ class _Batch(object):
         self.pack_ms = pack_ms
         self.queue_depth = queue_depth
         self.t_packed = time.perf_counter()
+        self.phase = phase
+        self.payload = payload
 
 
 class ContinuousBatcher(object):
@@ -181,6 +201,7 @@ class ContinuousBatcher(object):
         self._cv = threading.Condition(self._lock)
         self._dispatch = AsyncLauncher(name="%s-dispatch" % name)
         self._unpack = AsyncLauncher(name="%s-unpack" % name)
+        self._gen_busy = set()          # generative entries in flight
         self._thread = None
         self._stop = False
         self._accepting = True
@@ -244,17 +265,39 @@ class ContinuousBatcher(object):
     # -- scheduler ---------------------------------------------------------
 
     def _pick(self):
-        """The ripest (entry, its pending deque): highest priority
-        first, then oldest head request.  None when nothing pends."""
+        """The ripest (entry, deque, kind): highest priority first,
+        then oldest head request.  ``kind`` is "predict" for plain
+        entries, "prefill"/"decode" for generative ones.  A generative
+        entry with a job in flight is skipped (iteration serialization);
+        when it has both a queued prompt and active decodes, the phases
+        alternate via ``prefer_prefill`` so neither starves.  None when
+        nothing is runnable."""
         best = None
+        now = time.perf_counter()
         for name, q in self._pending.items():
-            if not q:
-                continue
             entry = self._entries[name]
-            key = (-getattr(entry, "priority", 0), q[0].t_arrival)
+            gen = getattr(entry, "generative", False)
+            if gen and name in self._gen_busy:
+                continue
+            has_req = bool(q)
+            has_dec = gen and entry.has_decode_work()
+            if not has_req and not has_dec:
+                continue
+            if not gen:
+                kind = "predict"
+            elif has_req and has_dec:
+                kind = "prefill" if entry.prefer_prefill else "decode"
+            elif has_req:
+                kind = "prefill"
+            else:
+                kind = "decode"
+            # decode-only work carries no queue timestamp: rank it at
+            # `now` so an older queued request (any model) goes first
+            age = q[0].t_arrival if has_req else now
+            key = (-getattr(entry, "priority", 0), age)
             if best is None or key < best[0]:
-                best = (key, entry, q)
-        return (best[1], best[2]) if best else None
+                best = (key, entry, q, kind)
+        return best[1:] if best else None
 
     def _loop(self):
         while True:
@@ -265,54 +308,110 @@ class ContinuousBatcher(object):
                         return
                     self._cv.wait(0.05)
                     continue
-                entry, q = picked
-                now = time.perf_counter()
-                samples = sum(r.n for r in q)
-                head_age_ms = (now - q[0].t_arrival) * 1e3
-                # iteration-level (ORCA-style) ripeness: a batch goes
-                # the moment the largest bucket fills, the head request
-                # exhausts its admission window, OR the pipeline has
-                # idle capacity (< 2 batches in flight keeps the
-                # device double-buffered) — waiting for companions
-                # only ever happens while the device is already busy,
-                # so batching never costs latency it isn't hiding
-                idle = (self._dispatch.pending() == 0
-                        and self._unpack.pending() < 2)
-                ripe = (samples >= entry.buckets[-1]
-                        or head_age_ms >= self.max_delay_ms
-                        or idle
-                        or not self._accepting or self._stop)
-                if not ripe:
-                    # sleep until the head's admission deadline (a new
-                    # arrival or a completed batch notifies sooner)
-                    self._cv.wait(
-                        max((self.max_delay_ms - head_age_ms) / 1e3, 1e-4))
-                    continue
-                # pop FIFO while the batch still fits the largest bucket
-                reqs, total = [], 0
-                while q and total + q[0].n <= entry.buckets[-1]:
+                entry, q, kind = picked
+                if kind == "decode":
+                    self._gen_busy.add(entry.name)
+                    entry.prefer_prefill = True
+                    depth_after = sum(len(qq)
+                                      for qq in self._pending.values())
+                elif kind == "prefill":
+                    self._gen_busy.add(entry.name)
+                    entry.prefer_prefill = False
                     req = q.popleft()
-                    reqs.append(req)
-                    total += req.n
-                depth_after = sum(len(qq) for qq in self._pending.values())
+                    depth_after = sum(len(qq)
+                                      for qq in self._pending.values())
+                if kind == "predict":
+                    now = time.perf_counter()
+                    samples = sum(r.n for r in q)
+                    head_age_ms = (now - q[0].t_arrival) * 1e3
+                    # iteration-level (ORCA-style) ripeness: a batch
+                    # goes the moment the largest bucket fills, the
+                    # head request exhausts its admission window, OR
+                    # the pipeline has idle capacity (< 2 batches in
+                    # flight keeps the device double-buffered) —
+                    # waiting for companions only ever happens while
+                    # the device is already busy, so batching never
+                    # costs latency it isn't hiding
+                    idle = (self._dispatch.pending() == 0
+                            and self._unpack.pending() < 2)
+                    ripe = (samples >= entry.buckets[-1]
+                            or head_age_ms >= self.max_delay_ms
+                            or idle
+                            or not self._accepting or self._stop)
+                    if not ripe:
+                        # sleep until the head's admission deadline (a
+                        # new arrival or a completed batch notifies
+                        # sooner)
+                        self._cv.wait(
+                            max((self.max_delay_ms - head_age_ms) / 1e3,
+                                1e-4))
+                        continue
+                    # pop FIFO while the batch still fits the bucket
+                    reqs, total = [], 0
+                    while q and total + q[0].n <= entry.buckets[-1]:
+                        req = q.popleft()
+                        reqs.append(req)
+                        total += req.n
+                    depth_after = sum(len(qq)
+                                      for qq in self._pending.values())
             # pack OUTSIDE the lock: host work for batch N+1 overlaps
             # device execution of batch N (the whole point)
-            bucket = bucket_for(total, entry.buckets)
             t0 = time.perf_counter()
-            try:
-                payload = entry.pack(reqs, bucket)
-            except BaseException as exc:
-                self._fail_batch(reqs, exc)
-                continue
-            pack_ms = (time.perf_counter() - t0) * 1e3
-            for req in reqs:
+            if kind == "decode":
+                # one decode iteration over every active sequence —
+                # no queue involvement, ready the moment the previous
+                # step lands (generative jobs are always ripe)
+                try:
+                    payload, bucket, n_active = entry.pack_decode()
+                except BaseException:
+                    with self._lock:
+                        self._stats["failed"] += 1
+                    self._gen_done(entry)
+                    time.sleep(0.005)   # don't spin on a broken packer
+                    continue
+                pack_ms = (time.perf_counter() - t0) * 1e3
+                batch = _Batch(entry, [], bucket, n_active, pack_ms,
+                               depth_after, phase="decode",
+                               payload=payload)
+            elif kind == "prefill":
+                # exactly one prompt per prefill dispatch: joining
+                # sequences never evict or delay running decodes
+                # beyond this single bucketed forward
+                bucket = bucket_for(req.n, entry.buckets)
+                try:
+                    payload = entry.pack([req], bucket)
+                except BaseException as exc:
+                    self._fail_batch([req], exc)
+                    self._gen_done(entry)
+                    continue
+                pack_ms = (time.perf_counter() - t0) * 1e3
                 req.t_dispatch = time.perf_counter()
-            batch = _Batch(entry, reqs, bucket, total, pack_ms,
-                           depth_after)
+                batch = _Batch(entry, [req], bucket, req.n, pack_ms,
+                               depth_after, phase="prefill",
+                               payload=payload)
+            else:
+                bucket = bucket_for(total, entry.buckets)
+                try:
+                    payload = entry.pack(reqs, bucket)
+                except BaseException as exc:
+                    self._fail_batch(reqs, exc)
+                    continue
+                pack_ms = (time.perf_counter() - t0) * 1e3
+                for req in reqs:
+                    req.t_dispatch = time.perf_counter()
+                batch = _Batch(entry, reqs, bucket, total, pack_ms,
+                               depth_after)
             self._dispatch.submit(
                 lambda b=batch, p=payload: self._launch(b, p))
 
     # -- pipeline stages ---------------------------------------------------
+
+    def _gen_done(self, entry):
+        """Clear a generative entry's in-flight gate (its next
+        iteration becomes schedulable) and wake the scheduler."""
+        with self._cv:
+            self._gen_busy.discard(entry.name)
+            self._cv.notify_all()
 
     def _launch(self, batch, payload):
         """Dispatch worker: async XLA launch, then hand the handle to
@@ -321,6 +420,9 @@ class ContinuousBatcher(object):
         try:
             handle = batch.entry.launch(payload, batch.bucket)
         except BaseException as exc:
+            if batch.phase is not None:
+                batch.entry.fail_inflight(exc, payload)
+                self._gen_done(batch.entry)
             self._fail_batch(batch.requests, exc)
             return
         self._unpack.submit(lambda: self._finish(batch, handle))
@@ -328,6 +430,9 @@ class ContinuousBatcher(object):
     def _finish(self, batch, handle):
         """Unpack worker: block on the device arrays, slice results,
         complete futures, emit the per-batch ``serve`` record."""
+        if batch.phase is not None:
+            self._finish_generative(batch, handle)
+            return
         try:
             results, phases = batch.entry.unpack(handle, batch.requests,
                                                  batch.bucket)
@@ -362,6 +467,52 @@ class ContinuousBatcher(object):
             device_ms=phases.get("device_ms"),
             unpack_ms=phases.get("unpack_ms"),
             lat_ms=lat_ms,
+            trace_ids=[r.trace_id for r in batch.requests
+                       if r.trace_id] or None)
+
+    def _finish_generative(self, batch, handle):
+        """Unpack worker, generative path: the entry settles its own
+        sequences (streams, futures, block frees) and hands back the
+        telemetry fields; the batcher keeps the ledger and re-opens
+        the entry's iteration gate."""
+        try:
+            tel = batch.entry.complete(handle, batch)
+        except BaseException as exc:
+            batch.entry.fail_inflight(exc, batch.payload)
+            self._fail_batch(batch.requests, exc)
+            self._gen_done(batch.entry)
+            return
+        t_done = time.perf_counter()
+        for req in batch.requests:
+            req.t_done = t_done
+        occupancy = batch.n_samples / float(batch.bucket)
+        lat_ms = tel.get("lat_ms") or []
+        with self._cv:
+            self._stats["requests"] += len(lat_ms)   # finished seqs
+            self._stats["samples"] += tel.get("tokens", 0)
+            self._stats["batches"] += 1
+            self._stats["occupancy_sum"] += occupancy
+            self._lat_ms.extend(lat_ms)
+            self._gen_busy.discard(batch.entry.name)
+            self._cv.notify_all()
+        queue_wait = [(r.t_dispatch - r.t_arrival) * 1e3
+                      for r in batch.requests if r.t_dispatch]
+        _tel.emit_batch(
+            model=batch.entry.name, bucket=batch.bucket,
+            n_requests=len(lat_ms),     # sequences FINISHED this step,
+            n_samples=batch.n_samples,  # so qps = completions/sec
+            occupancy=occupancy, padding_waste=1.0 - occupancy,
+            queue_depth=batch.queue_depth,
+            queue_wait_ms=(sum(queue_wait) / len(queue_wait)
+                           if queue_wait else 0.0),
+            pack_ms=batch.pack_ms,
+            device_ms=tel.get("device_ms"),
+            unpack_ms=tel.get("unpack_ms"),
+            lat_ms=lat_ms or None,
+            phase=batch.phase, tokens=tel.get("tokens"),
+            kv_occupancy=tel.get("kv_occupancy"),
+            ttft_ms=tel.get("ttft_ms") or None,
+            itl_ms=tel.get("itl_ms") or None,
             trace_ids=[r.trace_id for r in batch.requests
                        if r.trace_id] or None)
 
@@ -404,10 +555,20 @@ class ContinuousBatcher(object):
             except ValueError:
                 timeout = 30.0
         deadline = time.monotonic() + timeout
+
+        def busy():
+            if any(q for q in self._pending.values()) or self._gen_busy:
+                return True
+            # active generations keep decoding while draining: flush
+            # until every admitted sequence reaches EOS/length cap
+            return any(getattr(e, "generative", False)
+                       and e.has_decode_work()
+                       for e in self._entries.values())
+
         with self._cv:
             self._accepting = False
             self._cv.notify_all()
-            while any(q for q in self._pending.values()):
+            while busy():
                 if not self._cv.wait(timeout=0.02):
                     pass
                 if time.monotonic() > deadline:

@@ -28,6 +28,15 @@ matmul triples at batch 1 (``m`` absorbs any sequence dim, so the same
 planner plans sequence-length buckets: pass the token-count histogram
 and per-token mats).  :func:`model_matmul_dims` derives them from a
 Symbol via the MXL-R cost rows.
+
+Two size axes, one cost hook: every cost function also takes
+``quad_mats`` — triples whose work scales with the size on *both* the
+m and n dims (``(size·m, k, size·n)``).  A decode plan (batch axis)
+leaves it empty: doubling the batch doubles every matmul.  A prefill
+plan (sequence-length axis) passes the attention score/value matmuls
+there, because doubling the prompt quadruples the S² attention work —
+pricing that S² term is what makes prompt-length buckets and
+batch-size buckets coexist per model without a second planner.
 """
 from __future__ import annotations
 
@@ -113,39 +122,46 @@ def bucket_for(n, buckets):
     return None
 
 
-def useful_flops(n, mats=DEFAULT_MATS):
+def useful_flops(n, mats=DEFAULT_MATS, quad_mats=()):
     """MAC-units of requested work for ``n`` samples (2-FLOPs-per-MAC
-    scaling cancels out of every ratio here, so it is omitted)."""
-    return n * sum(m * k * nn for m, k, nn in mats)
+    scaling cancels out of every ratio here, so it is omitted).
+    ``quad_mats`` rows pay ``n²`` — the sequence-axis attention term."""
+    lin = n * sum(m * k * nn for m, k, nn in mats)
+    return lin + n * n * sum(m * k * nn for m, k, nn in quad_mats)
 
 
-def padded_flops(batch, mats=DEFAULT_MATS, compute_dtype="float32"):
+def padded_flops(batch, mats=DEFAULT_MATS, compute_dtype="float32",
+                 quad_mats=()):
     """Systolic-array work one batch of ``batch`` samples actually pays
     after MXU tile rounding — the analyzer's ``mxu_padding_waste``
-    inverted: padded = useful / (1 - waste)."""
+    inverted: padded = useful / (1 - waste).  Linear rows grow the m
+    dim with the size; ``quad_mats`` rows grow m AND n."""
     dims = [(batch * m, k, n) for m, k, n in mats]
-    done = useful_flops(batch, mats)
+    dims += [(batch * m, k, batch * n) for m, k, n in quad_mats]
+    done = useful_flops(batch, mats, quad_mats)
     waste = mxu_padding_waste(dims, compute_dtype)
     if waste >= 1.0:
         raise MXNetError("degenerate matmul dims %r" % (mats,))
     return done / (1.0 - waste)
 
 
-def request_waste(n, bucket, mats=DEFAULT_MATS, compute_dtype="float32"):
+def request_waste(n, bucket, mats=DEFAULT_MATS, compute_dtype="float32",
+                  quad_mats=()):
     """Fraction of the bucket's padded MXU work that is NOT the ``n``
     requested samples (batch-fill padding + tile padding combined)."""
-    padded = padded_flops(bucket, mats, compute_dtype)
-    return 1.0 - useful_flops(n, mats) / padded
+    padded = padded_flops(bucket, mats, compute_dtype, quad_mats)
+    return 1.0 - useful_flops(n, mats, quad_mats) / padded
 
 
 def plan_cost(buckets, histogram, mats=DEFAULT_MATS,
-              compute_dtype="float32"):
+              compute_dtype="float32", quad_mats=()):
     """Total padded MXU work of serving ``histogram`` (each request of
     size ``s``, weighted, dispatched alone in its smallest admissible
     bucket).  Raises when any size is inadmissible."""
     hist = parse_histogram(histogram)
     buckets = parse_buckets(buckets)
-    per_bucket = {b: padded_flops(b, mats, compute_dtype) for b in buckets}
+    per_bucket = {b: padded_flops(b, mats, compute_dtype, quad_mats)
+                  for b in buckets}
     total = 0.0
     for size, weight in sorted(hist.items()):
         b = bucket_for(size, buckets)
@@ -176,23 +192,26 @@ class BucketPlan(object):
     work over the histogram), ``useful`` (requested work), ``waste``
     (1 − useful/cost, the expected padding-waste fraction),
     ``pow2_cost``/``pow2_waste`` (the naive baseline on the same
-    histogram), ``mats``, ``compute_dtype``.
+    histogram), ``mats``, ``quad_mats``, ``compute_dtype``.
     """
 
-    def __init__(self, buckets, histogram, mats, compute_dtype):
+    def __init__(self, buckets, histogram, mats, compute_dtype,
+                 quad_mats=()):
         self.buckets = parse_buckets(buckets)
         self.histogram = parse_histogram(histogram)
         self.mats = tuple(tuple(int(d) for d in row) for row in mats)
+        self.quad_mats = tuple(tuple(int(d) for d in row)
+                               for row in quad_mats)
         self.compute_dtype = compute_dtype
         self.cost = plan_cost(self.buckets, self.histogram, self.mats,
-                              compute_dtype)
-        self.useful = sum(w * useful_flops(s, self.mats)
+                              compute_dtype, self.quad_mats)
+        self.useful = sum(w * useful_flops(s, self.mats, self.quad_mats)
                           for s, w in self.histogram.items())
         self.waste = 1.0 - self.useful / self.cost if self.cost else 0.0
         p2 = pow2_buckets(self.histogram)
         self.pow2_buckets = p2
         self.pow2_cost = plan_cost(p2, self.histogram, self.mats,
-                                   compute_dtype)
+                                   compute_dtype, self.quad_mats)
         self.pow2_waste = 1.0 - self.useful / self.pow2_cost \
             if self.pow2_cost else 0.0
 
@@ -211,7 +230,8 @@ class BucketPlan(object):
                 "waste": round(self.waste, 6),
                 "pow2_buckets": list(self.pow2_buckets),
                 "pow2_waste": round(self.pow2_waste, 6),
-                "compute_dtype": self.compute_dtype}
+                "compute_dtype": self.compute_dtype,
+                "quadratic": bool(self.quad_mats)}
 
     def __repr__(self):
         return "BucketPlan(buckets=%s, waste=%.3f, pow2_waste=%.3f)" % (
@@ -219,23 +239,32 @@ class BucketPlan(object):
 
 
 def plan_buckets(histogram, mats=None, max_buckets=None,
-                 compute_dtype="float32", include=()):
+                 compute_dtype="float32", include=(), quad_mats=()):
     """Choose ≤ ``max_buckets`` batch buckets minimizing total padded
     MXU work over ``histogram`` — exact DP over the observed sizes.
 
     ``include``: sizes forced into the bucket set (e.g. a bucket for
-    the configured max batch even if unobserved).  Deterministic for a
-    fixed histogram regardless of input ordering.
+    the configured max batch even if unobserved).  ``quad_mats``: rows
+    whose work scales quadratically with the size — pass the attention
+    score/value matmuls when planning on the sequence-length axis.
+    Deterministic for a fixed histogram regardless of input ordering.
+    The DP's optimality argument survives the quadratic rows unchanged:
+    a bucket's cost still only depends on the largest size it serves
+    (cost_of is still monotone in the size), so restricting candidates
+    to observed sizes remains WLOG.
     """
     hist = parse_histogram(histogram)
     mats = tuple(mats) if mats else DEFAULT_MATS
+    quad_mats = tuple(quad_mats)
     k_max = max_buckets or default_max_buckets()
     sizes = sorted(set(hist) | {int(s) for s in include})
     weights = [hist.get(s, 0.0) for s in sizes]
     n = len(sizes)
     if n <= k_max:
-        return BucketPlan(sizes, hist, mats, compute_dtype)
-    cost_of = [padded_flops(s, mats, compute_dtype) for s in sizes]
+        return BucketPlan(sizes, hist, mats, compute_dtype,
+                          quad_mats=quad_mats)
+    cost_of = [padded_flops(s, mats, compute_dtype, quad_mats)
+               for s in sizes]
     # prefix weights: W[i] = sum(weights[:i])
     prefix = [0.0]
     for w in weights:
@@ -266,7 +295,8 @@ def plan_buckets(histogram, mats=None, max_buckets=None,
         chosen.append(sizes[i - 1])
         i = back[i][k]
         k -= 1
-    return BucketPlan(sorted(chosen), hist, mats, compute_dtype)
+    return BucketPlan(sorted(chosen), hist, mats, compute_dtype,
+                      quad_mats=quad_mats)
 
 
 def model_matmul_dims(symbol, input_shapes, batch=1, target="tpu"):

@@ -232,6 +232,65 @@ class ModelServer(object):
         return self.add_model(name, sym_path, params_path, input_shapes,
                               **kwargs)
 
+    def add_generative_model(self, name, params, vocab_size, num_layers,
+                             num_heads, dim, priority=0, **engine_kwargs):
+        """Open a decoder-only LM for token generation: builds the
+        :class:`~mxnet_tpu.serving.generate.GenerationEngine` (paged
+        KV cache + AOT prefill/decode programs — prompt-length buckets
+        and decode batch buckets both planned through the exact-DP
+        planner, every bucket warmed here) and registers its
+        :class:`~mxnet_tpu.serving.generate.GenerativeEntry` with the
+        batcher.  After this call the generation steady state performs
+        zero lowerings.  Returns the engine (its ``prompt_plan``/
+        ``decode_plan`` carry the planner ledgers)."""
+        from .generate import GenerationEngine, GenerativeEntry
+        if name in self._entries:
+            raise MXNetError("model %r already added" % name)
+        engine = GenerationEngine(
+            params=params, vocab_size=vocab_size, num_layers=num_layers,
+            num_heads=num_heads, dim=dim, **engine_kwargs)
+        entry = GenerativeEntry(name, engine, priority=priority)
+        from ..executor import program_registry_stats
+        self._entries[name] = entry
+        self._warmup[name] = program_registry_stats()["lowerings"]
+        self._batcher.register(entry)
+        return engine
+
+    def generate(self, model, prompt_tokens, max_new_tokens=None,
+                 eos_id=None):
+        """Admit one generation request.  Returns ``(future, stream)``:
+        the :class:`~mxnet_tpu.serving.generate.TokenStream` yields
+        tokens as decode iterations land; the Future resolves at
+        finish with ``{"tokens", "n_prompt", "finish_reason"}``.
+        Raises :class:`~mxnet_tpu.serving.batcher.ServerBusy` — 429
+        with ``blocks_free`` in ``to_dict()`` — when the KV cache
+        cannot hold the sequence's whole block budget (admission-time
+        reservation: running decodes never hit allocation failures)."""
+        entry = self._entries.get(model)
+        if entry is None or not getattr(entry, "generative", False):
+            raise MXNetError("unknown generative model %r (have: %s)"
+                             % (model, [m for m, e in self._entries.items()
+                                        if getattr(e, "generative", False)]))
+        prompt = [int(t) for t in prompt_tokens]
+        seq_id, stream = entry.new_request(prompt, max_new=max_new_tokens,
+                                           eos_id=eos_id)
+        try:
+            future = self._batcher.submit(model, {"seq_id": seq_id},
+                                          n=len(prompt))
+        except BaseException:
+            entry.abort(seq_id)
+            raise
+        return future, stream
+
+    def generate_sync(self, model, prompt_tokens, max_new_tokens=None,
+                      eos_id=None, timeout=60.0):
+        """Blocking convenience: generate + wait; returns the result
+        dict (``tokens`` is the generated ids, prompt excluded)."""
+        future, _stream = self.generate(model, prompt_tokens,
+                                        max_new_tokens=max_new_tokens,
+                                        eos_id=eos_id)
+        return future.result(timeout=timeout)
+
     # -- warm elasticity (docs/resilience.md "Warm elasticity") ------------
 
     def snapshot_hotstate(self, step=0):
@@ -245,6 +304,10 @@ class ModelServer(object):
         from ..resilience import hotstate as _hotstate
         tree, configs = {}, {}
         for name, entry in self._entries.items():
+            if getattr(entry, "generative", False):
+                # generation state (KV pools, live sequences) is not
+                # warm-handoff material — clients re-issue prompts
+                continue
             first = entry.predictors[min(entry.buckets)]
             params = {}
             for k, v in first._arg_params.items():
@@ -317,6 +380,9 @@ class ModelServer(object):
         if entry is None:
             raise MXNetError("unknown model %r (have: %s)"
                              % (model, sorted(self._entries)))
+        if getattr(entry, "generative", False):
+            raise MXNetError("model %r is generative; use generate()"
+                             % model)
         payload, n = entry.validate(inputs, n)
         return self._batcher.submit(model, payload, n=n)
 
@@ -342,12 +408,16 @@ class ModelServer(object):
         out["registry"] = reg
         out["models"] = {}
         for name, entry in self._entries.items():
-            out["models"][name] = {
-                "buckets": list(entry.buckets),
-                "priority": entry.priority,
-                "planned_waste": round(entry.plan.waste, 4),
-                "lowerings_since_warmup":
-                    reg["lowerings"] - self._warmup[name]}
+            m = {"buckets": list(entry.buckets),
+                 "priority": entry.priority,
+                 "lowerings_since_warmup":
+                     reg["lowerings"] - self._warmup[name]}
+            if getattr(entry, "generative", False):
+                m["generative"] = True
+                m.update(entry.stats())     # kv occupancy, token counts
+            else:
+                m["planned_waste"] = round(entry.plan.waste, 4)
+            out["models"][name] = m
         return out
 
     def queue_depth(self):

@@ -31,15 +31,32 @@ _PHASE_FIELDS = tuple(("_" + p, p + "_ms") for p in SERVE_PHASES)
 
 def emit_batch(model, bucket, n_requests, n_samples, occupancy,
                padding_waste, queue_depth, queue_wait_ms, pack_ms,
-               device_ms, unpack_ms, lat_ms, trace_ids=None):
+               device_ms, unpack_ms, lat_ms, trace_ids=None,
+               phase=None, tokens=None, kv_occupancy=None,
+               ttft_ms=None, itl_ms=None):
     """Emit one ``serve`` record for a completed batch (no-op when
     telemetry is off, like every emit in the tree).  ``trace_ids``:
     the per-request trace ids of the batch's members when request
     tracing (``MXTPU_TRACE=1``) is on — how mxtrace links a request's
-    lifecycle back to the batch that served it."""
+    lifecycle back to the batch that served it.
+
+    Generative batches additionally carry ``phase`` ∈ {prefill,
+    decode}, ``tokens`` (generated this step), ``kv_occupancy``
+    (fraction of KV-cache blocks in use after the step), and the
+    per-sequence ``ttft_ms``/``itl_ms`` samples that landed in it —
+    the raw material for the tokens/sec, TTFT, and inter-token-latency
+    columns downstream."""
     extra = {}
     if trace_ids:
         extra["trace_ids"] = list(trace_ids)
+    if phase is not None:
+        extra["phase"] = str(phase)
+        extra["tokens"] = int(tokens or 0)
+        extra["kv_occupancy"] = _r(kv_occupancy, 4)
+        if ttft_ms:
+            extra["ttft_ms"] = [_r(v) for v in ttft_ms]
+        if itl_ms:
+            extra["itl_ms"] = [_r(v) for v in itl_ms]
     events.emit(
         "serve", model=model, bucket=int(bucket),
         n_requests=int(n_requests), n_samples=int(n_samples),
@@ -48,7 +65,7 @@ def emit_batch(model, bucket, n_requests, n_samples, occupancy,
         queue_depth=int(queue_depth),
         queue_wait_ms=_r(queue_wait_ms), pack_ms=_r(pack_ms),
         device_ms=_r(device_ms), unpack_ms=_r(unpack_ms),
-        lat_ms=[_r(v) for v in lat_ms], **extra)
+        lat_ms=[_r(v) for v in lat_ms or ()], **extra)
 
 
 def _r(v, nd=3):
@@ -80,12 +97,22 @@ def serve_report(records):
         m = per.setdefault(model, dict(
             {"requests": 0, "samples": 0, "batches": 0, "_lat": [],
              "_occ": [], "_waste": [], "queue_depth_max": 0,
-             "buckets": {}},
+             "buckets": {}, "tokens": 0, "_kv": [], "_ttft": [],
+             "_itl": [], "phases": {}},
             **{key: [] for key, _field in _PHASE_FIELDS}))
         m["requests"] += int(rec.get("n_requests") or 0)
         m["samples"] += int(rec.get("n_samples") or 0)
         m["batches"] += 1
         m["_lat"].extend(float(v) for v in (rec.get("lat_ms") or ()))
+        if rec.get("phase"):
+            m["phases"][rec["phase"]] = \
+                m["phases"].get(rec["phase"], 0) + 1
+            m["tokens"] += int(rec.get("tokens") or 0)
+            if rec.get("kv_occupancy") is not None:
+                m["_kv"].append(float(rec["kv_occupancy"]))
+            m["_ttft"].extend(float(v)
+                              for v in (rec.get("ttft_ms") or ()))
+            m["_itl"].extend(float(v) for v in (rec.get("itl_ms") or ()))
         for key, field in (("_occ", "occupancy"),
                            ("_waste", "padding_waste")) + _PHASE_FIELDS:
             if rec.get(field) is not None:
@@ -106,7 +133,9 @@ def serve_report(records):
         spans[model] = (min(lo, wall), max(hi, wall))
 
     models = {}
-    all_lat, total = [], {"requests": 0, "samples": 0, "batches": 0}
+    all_lat = []
+    all_ttft, all_itl, total_tokens = [], [], 0
+    total = {"requests": 0, "samples": 0, "batches": 0}
     for model, m in sorted(per.items()):
         lat = m.pop("_lat")
         out = {"requests": m["requests"], "samples": m["samples"],
@@ -114,6 +143,20 @@ def serve_report(records):
                "queue_depth_max": m["queue_depth_max"],
                "buckets": dict(sorted(m["buckets"].items(),
                                       key=lambda kv: int(kv[0])))}
+        if m["phases"]:                 # generative model: token view
+            out["phases"] = dict(sorted(m["phases"].items()))
+            out["tokens"] = m["tokens"]
+            out["kv_occupancy"] = _mean(m["_kv"])
+            for key, name in (("_ttft", "ttft_ms"), ("_itl", "itl_ms")):
+                vals = m[key]
+                if vals:
+                    out[name] = {"p50": _r(percentile(vals, 50)),
+                                 "p95": _r(percentile(vals, 95)),
+                                 "mean": _mean(vals)}
+            total_tokens += m["tokens"]
+            all_ttft.extend(m["_ttft"])
+            all_itl.extend(m["_itl"])
+        m.pop("_kv"), m.pop("_ttft"), m.pop("_itl")
         for key, field in (("_occ", "occupancy"),
                            ("_waste", "padding_waste")) + _PHASE_FIELDS:
             out[field] = _mean(m.pop(key))
@@ -126,8 +169,13 @@ def serve_report(records):
         if span and span[1] > span[0]:
             out["qps"] = round(m["requests"] / ((span[1] - span[0]) / 1e3),
                                2)
+            if m["phases"]:
+                out["tokens_per_sec"] = round(
+                    m["tokens"] / ((span[1] - span[0]) / 1e3), 2)
         else:
             out["qps"] = None
+            if m["phases"]:
+                out["tokens_per_sec"] = None
         models[model] = out
         all_lat.extend(lat)
         for k in ("requests", "samples", "batches"):
@@ -142,6 +190,16 @@ def serve_report(records):
     hi = max(s[1] for s in spans.values()) if spans else None
     if lo is not None and hi > lo:
         total["qps"] = round(total["requests"] / ((hi - lo) / 1e3), 2)
+        if total_tokens:
+            total["tokens_per_sec"] = round(
+                total_tokens / ((hi - lo) / 1e3), 2)
+    if total_tokens:
+        total["tokens"] = total_tokens
+    for vals, name in ((all_ttft, "ttft_ms"), (all_itl, "itl_ms")):
+        if vals:
+            total[name] = {"p50": _r(percentile(vals, 50)),
+                           "p95": _r(percentile(vals, 95)),
+                           "mean": _mean(vals)}
     occs = [m["occupancy"] for m in models.values()
             if m["occupancy"] is not None]
     wastes = [m["padding_waste"] for m in models.values()

@@ -17,9 +17,12 @@
 #                    an mxtop --json smoke over the drill's event dir
 #   TASK=perf        overlap unit suite + the 2-process overlap drill
 #                    (asserts overlap_ratio > 1.05, bit-identical math)
-#   TASK=serving     serving unit suite + the serve_load acceptance
-#                    drill (>= 3x serial batch-1, bounded p95, zero
-#                    lowerings after warmup) + serve_bench/mxtop smoke
+#   TASK=serving     serving unit suite (planner/batcher/server + KV
+#                    cache + generation) + the serve_load and
+#                    serve_generate acceptance drills (>= 3x serial
+#                    batch-1; decode == full forward; structured KV
+#                    429s; zero lowerings after warmup) +
+#                    serve_bench/mxtop smoke in both modes
 set -e
 cd "$(dirname "$0")/../.."
 
@@ -84,6 +87,26 @@ case "${TASK:-python}" in
     # its self-lint so the divergence pass always prices it
     JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
       mxnet_tpu/serving --fail-on=error --format=github
+    # generative serving's cache allocator + engine make per-process
+    # admission and scheduling decisions (block budgets, prefill/decode
+    # alternation) — pinned explicitly on top of the directory sweep so
+    # a future sweep-config change can never silently drop them
+    JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+      mxnet_tpu/serving/kvcache.py mxnet_tpu/serving/generate.py \
+      --fail-on=error --format=github
+    # the paged KV cache's (block_size, head_dim) decode layout must
+    # stay MXL-K tile-legal at every serving dtype — including the
+    # int8 the quantized tier will bind — straight from the registered
+    # kernel spec
+    JAX_PLATFORMS=cpu python -c '
+from mxnet_tpu.serving.kvcache import cache_kernel_spec
+from mxnet_tpu.analysis.tiling import spec_findings
+for dt in ("float32", "bfloat16", "int8"):
+    bad = [f for f in spec_findings(cache_kernel_spec(dtype=dt))
+           if f[1] == "error"]
+    assert not bad, (dt, bad)
+print("paged_kv_cache MXL-K sweep OK (f32/bf16/int8)")
+'
     # the tracing tier touches every collective seam (rank-uniform seq
     # counters, the flight ledger, the SLO sentry's emit path) — its
     # three modules must stay divergence-clean under MXL-D
@@ -263,8 +286,13 @@ print("mxtop overlap_ratio %.3f OK" % ratio)
     # suite, then the acceptance drill — continuous batching must beat
     # the serial batch-1 Predictor >= 3x at bounded p95 with zero
     # lowerings after warmup (all asserted inside the drill)
-    JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+    JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
+      tests/test_kvcache.py tests/test_generate.py -q
     JAX_PLATFORMS=cpu python tests/nightly/serve_load.py
+    # generative acceptance drill (docs/serving.md "Generation"):
+    # decode == full forward, zero lowerings, structured 429 under KV
+    # pressure while running decodes finish, bounded p95 TTFT
+    JAX_PLATFORMS=cpu python tests/nightly/serve_generate.py
     # bench smoke with telemetry on: the BENCH JSON line must show an
     # intact AOT contract and carry the latency/occupancy/waste fields
     # the SLO dashboards read
@@ -291,6 +319,21 @@ assert sv["total"]["requests"] >= 200, sv["total"]
 print("mxtop --serve smoke OK: %d requests" % sv["total"]["requests"])
 '
     rm -rf "$TELDIR"
+    # generative bench smoke: the tokens/sec BENCH line must show the
+    # AOT contract intact (zero lowerings across prefill AND decode)
+    # and carry the TTFT/ITL percentiles the SLO sentry prices
+    JAX_PLATFORMS=cpu python tools/serve_bench.py --generate \
+      --requests 40 --max-new 8 | python -c '
+import json, sys
+rep = json.loads(sys.stdin.readlines()[-1])
+assert rep["metric"] == "serve_tokens_per_sec", rep
+assert rep["lowerings_after_warmup"] == 0, rep
+assert rep["errors"] == 0 and rep["requests"] == 40, rep
+assert rep["ttft_ms"]["p95"] is not None, rep
+assert rep["itl_ms"]["p95"] is not None, rep
+print("serve_bench --generate smoke OK: %.0f tok/s, ttft p95 %.2f ms"
+      % (rep["value"], rep["ttft_ms"]["p95"]))
+'
     ;;
   *)
     echo "unknown TASK=${TASK}" >&2

@@ -1,0 +1,227 @@
+"""Generative serving drill: mixed prompt-length load through the
+batching server's prefill/decode scheduler.
+
+The acceptance run for docs/serving.md "Generation" (wired as the CI
+smoke in tests/ci/run_test.sh TASK=serving), all on the virtual CPU
+mesh:
+
+1. **Correctness under concurrency** — every request's streamed tokens
+   must equal its future's ``tokens``, and a singleton re-run of each
+   distinct prompt through the inline engine loop must reproduce the
+   batched result (iteration-level batching never changes tokens).
+2. **AOT proof** — zero lowerings after ``add_generative_model``
+   returns, across the entire mixed prefill/decode run, from the
+   program-registry counters.
+3. **Backpressure** — with the pool nearly full, further admissions
+   raise structured 429s carrying ``blocks_free`` while every running
+   decode completes; afterwards the pool drains back to zero blocks
+   used.
+4. **Tail latency** — p95 TTFT stays under a generous bound derived
+   from the measured single-prefill device time (the scheduler must
+   not starve prefills behind decode batches).
+
+Prints one JSON line with every figure.  Exit codes: 0 OK, 4 = an
+expectation failed.
+
+Run:  JAX_PLATFORMS=cpu python tests/nightly/serve_generate.py
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx                                  # noqa: E402,F401
+from mxnet_tpu import ndarray as nd                     # noqa: E402
+from mxnet_tpu.executor import program_registry_stats  # noqa: E402
+from mxnet_tpu.models import transformer as tf          # noqa: E402
+from mxnet_tpu.serving import (ModelServer, ServerBusy)  # noqa: E402
+
+N_REQUESTS = int(os.environ.get("SERVE_GEN_REQUESTS", "48"))
+CONCURRENCY = int(os.environ.get("SERVE_GEN_CONCURRENCY", "8"))
+MAX_NEW = int(os.environ.get("SERVE_GEN_MAX_NEW", "8"))
+V, L, H, E, S = 64, 2, 4, 32, 48
+
+
+def fail(msg, report):
+    report["failed"] = msg
+    print(json.dumps(report), flush=True)
+    print("serve_generate FAILED: %s" % msg, file=sys.stderr, flush=True)
+    os._exit(4)
+
+
+def toy_params():
+    full = tf.get_symbol(vocab_size=V, num_layers=L, num_heads=H,
+                         dim=E, seq_len=S)
+    rng = np.random.RandomState(0)
+    shapes = full.infer_shape(data=(1, S), softmax_label=(1, S))[0]
+    params = {}
+    for name, shp in zip(full.list_arguments(), shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        params[name] = nd.array(rng.randn(*shp).astype(np.float32) * 0.05)
+    return params
+
+
+def main():
+    params = toy_params()
+    srv = ModelServer(max_delay_ms=2.0)
+    engine = srv.add_generative_model(
+        "lm", params, vocab_size=V, num_layers=L, num_heads=H, dim=E,
+        max_seq_len=S, max_new_tokens=MAX_NEW,
+        prompt_buckets=(8, 16, 32), decode_buckets=(1, 2, 4, 8),
+        kv_blocks=64, kv_block_size=8)
+    lowerings_at_warmup = program_registry_stats()["lowerings"]
+
+    # measured single-prefill device time on the largest bucket — the
+    # TTFT bound's unit of work
+    rng = np.random.RandomState(7)
+    t_pre = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        engine.generate([[1] * 30], max_new_tokens=1)
+        t_pre.append(time.perf_counter() - t0)
+    prefill_ms = sorted(t_pre)[len(t_pre) // 2] * 1e3
+
+    # -- 1+2: mixed concurrent load, streams vs futures ----------------
+    prompts = [list(map(int, rng.randint(1, V, size=n)))
+               for n in rng.choice([3, 7, 12, 20, 30], size=N_REQUESTS)]
+    results = [None] * N_REQUESTS
+    ttfts = []
+    errors = []
+    lock = threading.Lock()
+    cursor = [0]
+
+    def worker():
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= N_REQUESTS:
+                    return
+                cursor[0] += 1
+            try:
+                t0 = time.perf_counter()
+                while True:
+                    try:
+                        future, stream = srv.generate(
+                            "lm", prompts[i], max_new_tokens=MAX_NEW)
+                        break
+                    except ServerBusy as busy:
+                        time.sleep((busy.retry_after_ms or 10) / 1e3)
+                streamed, first = [], None
+                for tok in stream:
+                    if first is None:
+                        first = time.perf_counter() - t0
+                    streamed.append(tok)
+                res = future.result(timeout=120)
+                if res["tokens"] != streamed:
+                    raise AssertionError(
+                        "stream %r != future %r" % (streamed,
+                                                    res["tokens"]))
+                results[i] = res["tokens"]
+                with lock:
+                    ttfts.append(first * 1e3)
+            except Exception as exc:
+                with lock:
+                    errors.append(exc)
+                return
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(CONCURRENCY)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    total_tokens = sum(len(r) for r in results if r)
+    lowerings_after = program_registry_stats()["lowerings"] \
+        - lowerings_at_warmup
+
+    # batched tokens must equal the inline singleton run per prompt
+    singleton_ok = True
+    for i in (0, N_REQUESTS // 2, N_REQUESTS - 1):
+        alone = engine.generate([prompts[i]], max_new_tokens=MAX_NEW)[0]
+        if results[i] != alone:
+            singleton_ok = False
+            break
+
+    # -- 3: backpressure while decodes progress ------------------------
+    blocks_total = engine.cache.blocks_total()
+    hogs = []
+    rejected = None
+    for _ in range(500):        # admission outruns completion quickly
+        try:
+            hogs.append(srv.generate("lm", [1] * 30,
+                                     max_new_tokens=MAX_NEW))
+        except ServerBusy as busy:
+            rejected = busy
+            break
+    hog_tokens = [fut.result(timeout=120)["tokens"] for fut, _s in hogs]
+    deadline = time.time() + 30
+    while engine.cache.blocks_used() and time.time() < deadline:
+        time.sleep(0.01)
+    blocks_left = engine.cache.blocks_used()
+
+    stats = srv.stats()["models"]["lm"]
+    srv.close()
+
+    ttfts.sort()
+    ttft_p95 = ttfts[int(0.95 * (len(ttfts) - 1))] if ttfts else None
+    # generous: an admission window + 8 largest-bucket prefills ahead
+    # of ours plus scheduling slack — catches starvation, not jitter
+    bound_ms = 2.0 + 8.0 * prefill_ms + 250.0
+    report = {
+        "metric": "serve_generate_drill",
+        "requests": N_REQUESTS,
+        "concurrency": CONCURRENCY,
+        "tokens": total_tokens,
+        "tokens_per_sec": round(total_tokens / wall_s, 1),
+        "wall_s": round(wall_s, 2),
+        "ttft_ms_p95": round(ttft_p95, 3) if ttft_p95 else None,
+        "ttft_bound_ms": round(bound_ms, 1),
+        "prefill_ms": round(prefill_ms, 3),
+        "prompt_buckets": list(engine.prompt_buckets),
+        "decode_buckets": list(engine.decode_buckets),
+        "kv_blocks_high_water": stats.get("blocks_high_water"),
+        "blocks_total": blocks_total,
+        "rejected_needs": rejected.extra.get("blocks_needed")
+        if rejected else None,
+        "rejected_free": rejected.extra.get("blocks_free")
+        if rejected else None,
+        "lowerings_after_warmup": lowerings_after,
+        "errors": len(errors),
+    }
+    if errors:
+        fail("request errors: %r" % errors[0], report)
+    if any(r is None for r in results):
+        fail("missing results", report)
+    if not singleton_ok:
+        fail("batched tokens differ from singleton inline run", report)
+    if lowerings_after != 0:
+        fail("%d lowerings after warmup (AOT contract broken)"
+             % lowerings_after, report)
+    if rejected is None:
+        fail("full pool did not raise ServerBusy", report)
+    if rejected.code != 429 or "blocks_free" not in rejected.extra:
+        fail("rejection not a structured 429: %r"
+             % rejected.to_dict(), report)
+    if any(len(toks) != MAX_NEW for toks in hog_tokens):
+        fail("running decodes did not complete under cache pressure",
+             report)
+    if blocks_left:
+        fail("%d blocks leaked after drain" % blocks_left, report)
+    if ttft_p95 is None or ttft_p95 > bound_ms:
+        fail("ttft p95 %.1f ms exceeds bound %.1f ms"
+             % (ttft_p95 or -1, bound_ms), report)
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,307 @@
+"""Generative serving (docs/serving.md "Generation"): the sequence-axis
+planner hook, cached-decode correctness vs the full forward, the
+zero-lowerings contract, TokenStream semantics, and the ModelServer
+generation path with KV backpressure.
+
+The correctness anchor is :func:`test_decode_matches_full_forward`:
+greedy decode through the paged cache must be token-identical, at every
+step, to the argmax of a plain full-sequence forward of the same
+checkpoint — the strongest equivalence the subsystem can claim.
+
+All on the virtual CPU mesh with a toy LM (vocab 64, 2 layers) so the
+AOT compiles stay in seconds.
+"""
+import itertools
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.executor import program_registry_stats
+from mxnet_tpu.models import transformer as tf
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.serving import (CacheExhausted, GenerationEngine,
+                               ModelServer, ServerBusy, TokenStream,
+                               generation_mats)
+from mxnet_tpu.serving.buckets import (BucketPlan, padded_flops,
+                                       plan_buckets, plan_cost,
+                                       useful_flops)
+
+V, L, H, E, S = 64, 2, 4, 32, 48        # toy LM dims shared by the module
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    """Random checkpoint of the full :func:`tf.get_symbol` model — the
+    same weights must bind the training graph, the full forward, and
+    both generation graphs (the weight-name compatibility contract)."""
+    full = tf.get_symbol(vocab_size=V, num_layers=L, num_heads=H, dim=E,
+                         seq_len=S)
+    rng = np.random.RandomState(0)
+    shapes = full.infer_shape(data=(1, S), softmax_label=(1, S))[0]
+    params = {}
+    for name, shp in zip(full.list_arguments(), shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        params[name] = nd.array(rng.randn(*shp).astype(np.float32) * 0.05)
+    return full, params
+
+
+@pytest.fixture(scope="module")
+def ref_next(lm_params):
+    """Greedy next-token oracle from the uncached full forward."""
+    full, params = lm_params
+    pred = Predictor(full.tojson(), params,
+                     {"data": (1, S), "softmax_label": (1, S)})
+
+    def _next(tokens):
+        data = np.zeros((1, S), np.float32)
+        data[0, :len(tokens)] = tokens
+        out = pred.forward(data=data,
+                           softmax_label=np.zeros((1, S), np.float32))
+        probs = np.asarray(out[0])               # (S, V) softmax rows
+        return int(np.argmax(probs[len(tokens) - 1]))
+    return _next
+
+
+# ---------------------------------------------------------------------------
+# planner: the quadratic (sequence) axis
+# ---------------------------------------------------------------------------
+
+def test_quad_mats_cost_model():
+    """quad rows pay n² useful work and (n·m, k, n·n) padded dims —
+    the S² attention term on the prompt-length axis."""
+    assert useful_flops(4, mats=(), quad_mats=((1, 1, 1),)) == 16
+    assert useful_flops(4, mats=((1, 1, 1),)) == 4
+    # padded work with a quad row grows superlinearly in the bucket
+    # (tile-saturated dims so MXU rounding doesn't mask the n growth)
+    small = padded_flops(128, mats=(), quad_mats=((1, 128, 1),))
+    big = padded_flops(256, mats=(), quad_mats=((1, 128, 1),))
+    assert big > 2 * small
+
+
+def test_generation_mats_shapes():
+    linear, quad = generation_mats(V, L, H, E, ffn_mult=4)
+    assert (1, E, V) in linear                   # lm_head
+    assert len(quad) == 2 * L * H                # score + value per head
+    assert all(m == 1 for m, _k, _n in quad)
+
+
+def test_planner_optimal_vs_brute_force_on_seq_axis():
+    """With the S² hook active the DP must still match brute force over
+    all bucket subsets — the optimality argument survives quad_mats."""
+    linear, quad = generation_mats(V, L, H, E)
+    hist = {3: 9, 7: 5, 12: 4, 20: 2, 33: 1}
+    sizes = sorted(hist)
+    best = min(
+        plan_cost(combo, hist, mats=linear, quad_mats=quad)
+        for k in (1, 2)
+        for combo in itertools.combinations(sizes, k)
+        if combo[-1] == sizes[-1])
+    plan = plan_buckets(hist, mats=linear, max_buckets=2, quad_mats=quad)
+    assert plan.cost == pytest.approx(best)
+    assert plan.to_dict()["quadratic"]
+
+
+def test_quad_term_steers_bucket_choice():
+    """The quadratic axis must actually price differently: the same
+    histogram planned with and without quad_mats yields different
+    costs, and the quad cost dominates at long sequences."""
+    linear, quad = generation_mats(V, L, H, E)
+    hist = {4: 10, 40: 1}
+    with_q = BucketPlan((4, 40), hist, linear, "float32", quad_mats=quad)
+    without = BucketPlan((4, 40), hist, linear, "float32")
+    assert with_q.cost > without.cost
+
+
+# ---------------------------------------------------------------------------
+# TokenStream
+# ---------------------------------------------------------------------------
+
+def test_token_stream_iterates_then_closes():
+    stream = TokenStream()
+    for t in (5, 6, 7):
+        stream._put(t)
+    stream._close()
+    assert stream.next_token(timeout=1.0) == 5
+    assert list(stream) == [6, 7]                   # iteration drains to END
+    with pytest.raises(TimeoutError):               # END was consumed
+        stream.next_token(timeout=0.05)
+
+
+def test_token_stream_propagates_failure():
+    stream = TokenStream()
+    stream._put(1)
+    stream._fail(MXNetError("boom"))
+    assert stream.next_token(timeout=1.0) == 1
+    with pytest.raises(MXNetError):
+        stream.next_token(timeout=1.0)
+
+
+# ---------------------------------------------------------------------------
+# cached decode == full forward (the acceptance property)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine(lm_params):
+    _full, params = lm_params
+    return GenerationEngine(
+        params=params, vocab_size=V, num_layers=L, num_heads=H, dim=E,
+        max_seq_len=S, max_new_tokens=6, prompt_buckets=(8, 16),
+        decode_buckets=(1, 2, 4), kv_blocks=32, kv_block_size=8)
+
+
+def test_decode_matches_full_forward(engine, ref_next):
+    """Greedy generation through prefill + paged decode must equal the
+    full-forward argmax reference at EVERY step, across mixed prompt
+    lengths (different prefill buckets, padded decode rows)."""
+    prompts = [[3, 5, 7], [2, 4, 6, 8, 10, 1], [9] * 11]
+    max_new = 6
+    ref = []
+    for p in prompts:
+        toks = list(p)
+        for _ in range(max_new):
+            toks.append(ref_next(toks))
+        ref.append(toks[len(p):])
+    got = engine.generate(prompts, max_new_tokens=max_new)
+    assert got == ref
+
+
+def test_generate_steady_state_zero_lowerings(engine):
+    """After construction (which warms every bucket) generation must
+    never lower again — the AOT contract."""
+    engine.generate([[1, 2, 3]], max_new_tokens=3)   # shake out any lazies
+    before = program_registry_stats()["lowerings"]
+    engine.generate([[4, 5], [6, 7, 8, 9, 10, 11, 12]], max_new_tokens=6)
+    assert program_registry_stats()["lowerings"] == before
+
+
+def test_eos_stops_early(engine, ref_next):
+    """Declaring the reference's first generated token as EOS must stop
+    the sequence at one token with finish_reason 'stop'."""
+    prompt = [3, 5, 7]
+    eos = ref_next(prompt)
+    sid = ("t", "eos")
+    engine.admit(sid, prompt, max_new=6, eos_id=eos)
+    try:
+        pred, inputs, _b = engine.start_prefill(sid)
+        engine.finish_prefill(sid, engine.run_async(pred, inputs))
+        state = engine.state(sid)
+        assert state.done and state.finish_reason == "eos"
+        assert state.generated() == [eos]
+    finally:
+        engine.release(sid)
+
+
+def test_engine_admission_backpressure(engine):
+    """Whole-budget reservation: a flood of admits must hit
+    CacheExhausted (with blocks_free) while already-admitted sequences
+    keep their blocks; release recovers everything."""
+    admitted = []
+    with pytest.raises(CacheExhausted) as err:
+        for i in range(100):
+            sid = ("t", "flood", i)
+            engine.admit(sid, [1, 2, 3, 4], max_new=6)
+            admitted.append(sid)
+    assert err.value.blocks_free < err.value.blocks_needed
+    assert admitted                                  # some got in first
+    for sid in admitted:
+        engine.release(sid)
+    assert engine.cache.blocks_used() == 0
+
+
+def test_engine_stats(engine):
+    s = engine.stats()
+    assert s["prompt_buckets"] == [8, 16]
+    assert s["decode_buckets"] == [1, 2, 4]
+    assert s["blocks_total"] == 31
+    assert s["tokens_generated"] > 0
+
+
+# ---------------------------------------------------------------------------
+# ModelServer generation path
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server(lm_params):
+    _full, params = lm_params
+    srv = ModelServer(max_delay_ms=2.0)
+    srv.add_generative_model(
+        "lm", params, vocab_size=V, num_layers=L, num_heads=H, dim=E,
+        max_seq_len=S, max_new_tokens=6, prompt_buckets=(8, 16),
+        decode_buckets=(1, 2, 4), kv_blocks=32, kv_block_size=8)
+    yield srv
+    srv.close()
+
+
+def test_server_generate_matches_engine(server, engine):
+    """The batcher-driven path (prefill/decode scheduling, streams)
+    must produce the same tokens as the inline engine loop."""
+    prompts = [[3, 5, 7], [2, 4, 6, 8, 10, 1]]
+    expect = engine.generate(prompts, max_new_tokens=6)
+    handles = [server.generate("lm", p, max_new_tokens=6)
+               for p in prompts]
+    for (future, stream), want, prompt in zip(handles, expect, prompts):
+        streamed = list(stream)                      # token-by-token
+        res = future.result(timeout=60)
+        assert res["tokens"] == want
+        assert streamed == want
+        assert res["finish_reason"] == "length"
+        assert res["n_prompt"] == len(prompt)
+
+
+def test_server_generate_zero_steady_state_lowerings(server):
+    server.generate_sync("lm", [1, 2, 3, 4, 5], timeout=60)
+    for _ in range(3):
+        server.generate_sync("lm", [7, 8], timeout=60)
+    stats = server.stats()
+    m = stats["models"]["lm"]
+    assert m["generative"] is True
+    assert m["lowerings_since_warmup"] == 0
+    assert m["tokens_generated"] > 0
+    assert m["seqs_active"] == 0                     # all released
+
+
+def test_server_generate_429_with_blocks_free(lm_params):
+    """KV exhaustion at admission surfaces as structured 429 carrying
+    blocks_free, while the running decode completes untouched."""
+    _full, params = lm_params
+    srv = ModelServer(max_delay_ms=2.0)
+    srv.add_generative_model(
+        "lm", params, vocab_size=V, num_layers=L, num_heads=H, dim=E,
+        max_seq_len=S, max_new_tokens=6, prompt_buckets=(16,),
+        decode_buckets=(1, 2), kv_blocks=4, kv_block_size=8)
+    try:
+        future, _stream = srv.generate("lm", [1, 2, 3], max_new_tokens=6)
+        rejected = None
+        for _ in range(50):                          # 3 blocks: pool is full
+            try:
+                srv.generate("lm", [4, 5, 6], max_new_tokens=6)
+            except ServerBusy as busy:
+                rejected = busy
+                break
+        assert rejected is not None
+        doc = rejected.to_dict()
+        assert rejected.code == 429
+        assert doc["error"] == "kv_cache_exhausted"
+        assert doc["blocks_total"] == 3
+        assert doc["blocks_free"] >= 0
+        assert rejected.retry_after_ms > 0
+        res = future.result(timeout=60)              # in-flight unharmed
+        assert len(res["tokens"]) == 6
+        deadline = time.time() + 30
+        while srv.stats()["models"]["lm"]["blocks_used"] and \
+                time.time() < deadline:
+            time.sleep(0.01)
+        assert srv.stats()["models"]["lm"]["blocks_used"] == 0
+    finally:
+        srv.close()
+
+
+def test_server_submit_rejects_generative(server):
+    with pytest.raises(MXNetError):
+        server.submit("lm", np.zeros((1, 4), np.float32))
+    with pytest.raises(MXNetError):
+        server.generate("nope", [1, 2])

@@ -1,0 +1,253 @@
+"""Paged KV cache (docs/serving.md "Generation"): block allocator,
+table correctness, tile legality, and sharding rules.
+
+All host-side except the functional-update identity test — block
+bookkeeping is pure Python, tile checks are the MXL-K static rules, so
+these run in milliseconds on CPU.
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving.kvcache import (TRASH_BLOCK, CacheExhausted,
+                                       KVCacheConfig, PagedKVCache,
+                                       cache_kernel_spec,
+                                       cache_sharding_rules, kv_block_size,
+                                       kv_blocks, max_new_tokens)
+
+
+def small_cache(num_blocks=8, block_size=8, init_pools=False):
+    cfg = KVCacheConfig(num_layers=2, num_heads=2, head_dim=8,
+                        max_seq_len=4 * block_size, num_blocks=num_blocks,
+                        block_size=block_size)
+    return PagedKVCache(cfg, init_pools=init_pools)
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+def test_allocate_reserves_whole_budget():
+    cache = small_cache()
+    row = cache.allocate("a", 20)                   # ceil(20/8) = 3 blocks
+    assert row.dtype == np.int32
+    assert row.shape == (cache.config.blocks_per_seq,)
+    used = [b for b in row if b != TRASH_BLOCK]
+    assert len(used) == 3 and len(set(used)) == 3
+    assert all(b == TRASH_BLOCK for b in row[3:])   # tail pads to trash
+    assert cache.blocks_used() == 3
+    assert cache.blocks_free() == cache.blocks_total() - 3
+
+
+def test_trash_block_never_allocated():
+    cache = small_cache(num_blocks=16)
+    handed_out = []
+    for i in range(15):                             # drain the whole pool
+        row = cache.allocate(i, 8)
+        handed_out.extend(b for b in row if b != TRASH_BLOCK)
+    assert TRASH_BLOCK not in handed_out
+    assert sorted(handed_out) == list(range(1, 16))
+    assert cache.blocks_free() == 0
+
+
+def test_free_and_reuse_out_of_order():
+    """Finishing sequences in any order keeps tables disjoint and
+    returns every block — the PagedAttention invariant."""
+    cache = small_cache(num_blocks=10)
+    rows = {s: cache.allocate(s, 24) for s in ("a", "b", "c")}  # 3 each
+    assert cache.free("b") == 3
+    row_d = cache.allocate("d", 24)                 # reuses b's blocks
+    live = {s: {b for b in r if b != TRASH_BLOCK}
+            for s, r in dict(rows, d=row_d).items() if s != "b"}
+    all_blocks = [b for blocks in live.values() for b in blocks]
+    assert len(all_blocks) == len(set(all_blocks))  # no aliasing
+    assert set(row_d) - {TRASH_BLOCK} == set(rows["b"]) - {TRASH_BLOCK}
+    for s in ("a", "c", "d"):
+        cache.free(s)
+    assert cache.blocks_used() == 0
+    assert sorted(cache.active()) == []
+
+
+def test_free_unknown_sequence_raises():
+    cache = small_cache()
+    with pytest.raises(MXNetError):
+        cache.free("nope")
+    cache.allocate("a", 8)
+    cache.free("a")
+    with pytest.raises(MXNetError):                 # double free is a bug
+        cache.free("a")
+
+
+def test_double_allocate_raises():
+    cache = small_cache()
+    cache.allocate("a", 8)
+    with pytest.raises(MXNetError):
+        cache.allocate("a", 8)
+
+
+def test_exhaustion_is_structured_and_side_effect_free():
+    """CacheExhausted carries the 429 payload and leaves the allocator
+    untouched — backpressure, not corruption."""
+    cache = small_cache(num_blocks=4)               # 3 usable blocks
+    cache.allocate("a", 16)                         # takes 2
+    free_before = cache.blocks_free()
+    active_before = cache.active()
+    with pytest.raises(CacheExhausted) as err:
+        cache.allocate("b", 16)                     # needs 2, 1 free
+    exc = err.value
+    assert exc.to_dict() == {"error": "kv_cache_exhausted",
+                             "blocks_needed": 2, "blocks_free": 1,
+                             "blocks_total": 3}
+    assert cache.blocks_free() == free_before
+    assert cache.active() == active_before
+    cache.free("a")                                 # recovers fully
+    assert cache.blocks_free() == 3
+    cache.allocate("b", 16)
+
+
+def test_over_max_seq_len_raises():
+    cache = small_cache()
+    with pytest.raises(MXNetError):
+        cache.allocate("a", cache.config.max_seq_len + 1)
+
+
+def test_stats_and_high_water():
+    cache = small_cache(num_blocks=8)
+    cache.allocate("a", 16)
+    cache.allocate("b", 8)
+    s = cache.stats()
+    assert s["blocks_total"] == 7
+    assert s["blocks_used"] == 3
+    assert s["seqs_active"] == 2
+    assert s["occupancy"] == pytest.approx(3 / 7.0, abs=1e-3)
+    cache.free("a")
+    s2 = cache.stats()
+    assert s2["blocks_used"] == 1
+    assert s2["blocks_high_water"] == 3             # watermark sticks
+    assert cache.occupancy() == pytest.approx(1 / 7.0)
+
+
+# ---------------------------------------------------------------------------
+# config / env knobs
+# ---------------------------------------------------------------------------
+
+def test_config_validation():
+    with pytest.raises(MXNetError):                 # trash block needs >= 2
+        KVCacheConfig(1, 2, 8, 32, num_blocks=1, block_size=8)
+    with pytest.raises(MXNetError):                 # MXL-K001 sublane granule
+        KVCacheConfig(1, 2, 8, 32, num_blocks=8, block_size=3)
+    cfg = KVCacheConfig(2, 4, 16, 100, num_blocks=8, block_size=8)
+    assert cfg.pool_shape == (8, 8, 4, 16)
+    assert cfg.blocks_per_seq == 13                 # ceil(100/8)
+    assert cfg.blocks_for(1) == 1
+    assert cfg.blocks_for(8) == 1
+    assert cfg.blocks_for(9) == 2
+    assert cfg.to_dict()["block_size"] == 8
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv("MXTPU_SERVE_KV_BLOCKS", "99")
+    monkeypatch.setenv("MXTPU_SERVE_KV_BLOCK_SIZE", "16")
+    monkeypatch.setenv("MXTPU_SERVE_MAX_NEW_TOKENS", "7")
+    assert kv_blocks() == 99
+    assert kv_block_size() == 16
+    assert max_new_tokens() == 7
+    assert kv_blocks(12) == 12                      # explicit beats env
+    monkeypatch.setenv("MXTPU_SERVE_KV_BLOCKS", "junk")
+    assert kv_blocks() == 256                       # default on garbage
+
+
+# ---------------------------------------------------------------------------
+# tile legality (MXL-K) across the dtype sweep
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+def test_cache_layout_is_tile_legal(dtype):
+    """The default (block_size, head_dim) block must pass the MXL-K
+    static rules at every serving dtype — the quantized tier reuses
+    this exact layout."""
+    from mxnet_tpu.analysis.tiling import spec_findings
+    spec = cache_kernel_spec(dtype=dtype)
+    errors = [f for f in spec_findings(spec) if f[1] == "error"]
+    assert errors == [], errors
+
+
+def test_cache_spec_registered_and_clean():
+    """The registry sweep (what mxlint/CI runs) must report zero errors
+    for the paged_kv_cache spec at all three dtypes."""
+    from mxnet_tpu.analysis.tiling import kernel_spec_issues
+    bad = [i for i in kernel_spec_issues()
+           if i[0] == "paged_kv_cache" and i[2] == "error"]
+    assert bad == [], bad
+    names = {i[0] for i in kernel_spec_issues()}
+    assert "paged_kv_cache" in names or not any(
+        i[0] == "paged_kv_cache" for i in kernel_spec_issues())
+
+
+def test_illegal_block_size_flagged_by_spec():
+    """Sanity that the lint actually bites: a bf16 cache with a
+    float32-granule block size must raise at config time."""
+    with pytest.raises(MXNetError):
+        KVCacheConfig(1, 2, 8, 64, num_blocks=8, block_size=8,
+                      dtype="int8")                 # int8 granule is 32
+
+
+# ---------------------------------------------------------------------------
+# sharding rules
+# ---------------------------------------------------------------------------
+
+def test_sharding_rules_split_heads_over_tp():
+    from jax.sharding import PartitionSpec as P
+    rules = cache_sharding_rules(tp_axis="tp")
+    pool = (8, 8, 4, 16)
+    assert rules.match("layer0_k_cache", pool) == P(None, None, "tp", None)
+    assert rules.match("layer3_v_cache", pool) == P(None, None, "tp", None)
+    assert rules.match("block_table", (4, 13)) == P(None, None)
+
+
+def test_shard_pools_on_mesh():
+    """On the 8-device virtual mesh the pools land head-split; with
+    heads == tp size each shard holds one head."""
+    import jax
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:2])
+    mesh = Mesh(devs, ("tp",))
+    cfg = KVCacheConfig(num_layers=1, num_heads=2, head_dim=8,
+                        max_seq_len=32, num_blocks=4, block_size=8)
+    cache = PagedKVCache(cfg, init_pools=True)
+    spec = cache.shard_pools(mesh, tp_axis="tp")
+    assert tuple(spec) == (None, None, "tp", None)
+    shard_shapes = {s.data.shape for s in cache.k_pools[0].addressable_shards}
+    assert shard_shapes == {(4, 8, 1, 8)}           # one head per tp rank
+
+
+# ---------------------------------------------------------------------------
+# functional update identity
+# ---------------------------------------------------------------------------
+
+def test_functional_pool_update_roundtrip():
+    """A jit-pure ``.at[].set`` append installed via set_pools must be
+    readable back bit-identically — the cache round-trip the decode
+    loop performs every step."""
+    import jax
+    import jax.numpy as jnp
+    cache = small_cache(num_blocks=4, block_size=8, init_pools=True)
+    row = cache.allocate("s", 8)
+    block = int(row[0])
+    payload = np.arange(8 * 2 * 8, dtype=np.float32).reshape(8, 2, 8)
+
+    @jax.jit
+    def append(pool, val):
+        return pool.at[block].set(val)
+
+    new_k = [append(p, jnp.asarray(payload)) for p in cache.k_pools]
+    new_v = [append(p, jnp.asarray(-payload)) for p in cache.v_pools]
+    cache.set_pools(new_k, new_v)
+    np.testing.assert_array_equal(np.asarray(cache.k_pools[1][block]),
+                                  payload)
+    np.testing.assert_array_equal(np.asarray(cache.v_pools[0][block]),
+                                  -payload)
+    # trash block untouched
+    assert float(jnp.abs(cache.k_pools[0][TRASH_BLOCK]).sum()) == 0.0
+    with pytest.raises(MXNetError):                 # layer-count guard
+        cache.set_pools(new_k[:1], new_v)

@@ -179,11 +179,11 @@ def test_batcher_priority_selection():
     b._pending["lo"].append(Request("lo", 1, 1))
     time.sleep(0.002)
     b._pending["hi"].append(Request("hi", 2, 1))
-    entry, _q = b._pick()
-    assert entry.name == "hi"
+    entry, _q, kind = b._pick()
+    assert entry.name == "hi" and kind == "predict"
     b._pending["hi"].clear()
-    entry, _q = b._pick()
-    assert entry.name == "lo"
+    entry, _q, kind = b._pick()
+    assert entry.name == "lo" and kind == "predict"
     b.close(drain=False)
 
 

@@ -19,12 +19,25 @@ Endpoints:
     POST /v1/predict   {"model": "mnist", "inputs": {"data": [[...]]}}
                        -> {"model", "n", "outputs": [[...]]}
                        (single-input models may pass "inputs": [[...]])
+    POST /v1/generate  {"model": "lm", "prompt": [1, 2, 3],
+                        "max_new_tokens": 16, "eos_id": null}
+                       -> {"model", "tokens", "n_prompt",
+                           "finish_reason"}
+                       (requires a --generative model; KV-cache
+                       exhaustion returns 429 with blocks_free)
     GET  /v1/stats     ModelServer.stats() JSON
     GET  /healthz      200 "ok"
 
-Backpressure surfaces as real HTTP 429 (queue full, with a
-``retry_after_ms`` hint mirrored in the Retry-After header) or 503
-(draining); both bodies are the structured ServerBusy dict.
+Backpressure surfaces as real HTTP 429 (queue full — or, for
+``/v1/generate``, KV-cache block exhaustion with ``blocks_free`` in
+the body — with a ``retry_after_ms`` hint mirrored in the Retry-After
+header) or 503 (draining); both bodies are the structured ServerBusy
+dict.
+
+``--generative`` serves the checkpoint as a decoder-only LM through
+``add_generative_model`` (paged KV cache + AOT prefill/decode): pass
+the model dims (``--vocab --layers --heads --dim --max-seq-len``) and
+optionally the bucket/cache knobs.
 """
 from __future__ import annotations
 
@@ -70,16 +83,37 @@ def build_server(args):
 
     srv = ModelServer(max_delay_ms=args.max_delay_ms,
                       max_queue=args.max_queue)
+    if args.checkpoint:
+        prefix, _, epoch = args.checkpoint.partition("@")
+        symbol, params = checkpoint_files(prefix, int(epoch or 0))
+    elif args.params:
+        symbol, params = args.symbol, args.params
+    else:
+        raise SystemExit("mxserve: pass --checkpoint prefix@epoch or "
+                         "--symbol + --params")
+    if args.generative:
+        engine = srv.add_generative_model(
+            args.name, params, vocab_size=args.vocab,
+            num_layers=args.layers, num_heads=args.heads, dim=args.dim,
+            max_seq_len=args.max_seq_len, max_new_tokens=args.max_new,
+            prompt_buckets=args.prompt_buckets,
+            prompt_histogram=args.histogram,
+            decode_buckets=args.decode_buckets,
+            kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size,
+            priority=args.priority)
+        sys.stderr.write(
+            "mxserve: generative model %r prompt buckets %s decode "
+            "buckets %s, %d KV blocks x %d\n"
+            % (args.name, list(engine.prompt_buckets),
+               list(engine.decode_buckets),
+               engine.cache.stats()["blocks_total"],
+               engine.cache.config.block_size))
+        return srv
     shapes = parse_shapes(args.shapes)
     if not shapes:
         raise SystemExit("mxserve: --shapes is required (per-sample, "
                          "no batch axis)")
-    if args.checkpoint:
-        prefix, _, epoch = args.checkpoint.partition("@")
-        symbol, params = checkpoint_files(prefix, int(epoch or 0))
-    elif args.symbol and args.params:
-        symbol, params = args.symbol, args.params
-    else:
+    if not args.checkpoint and not args.symbol:
         raise SystemExit("mxserve: pass --checkpoint prefix@epoch or "
                          "--symbol + --params")
     plan = srv.add_model(
@@ -124,6 +158,9 @@ def make_handler(srv):
                 self._reply(404, {"error": "not_found", "path": self.path})
 
         def do_POST(self):
+            if self.path == "/v1/generate":
+                self._generate()
+                return
             if self.path != "/v1/predict":
                 self._reply(404, {"error": "not_found", "path": self.path})
                 return
@@ -161,6 +198,34 @@ def make_handler(srv):
             self._reply(200, {"model": model, "n": int(outs[0].shape[0]),
                               "outputs": [o.tolist() for o in outs]})
 
+        def _generate(self):
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                model = doc.get("model") or srv.models()[0]
+                prompt = [int(t) for t in doc["prompt"]]
+                res = srv.generate_sync(
+                    model, prompt,
+                    max_new_tokens=doc.get("max_new_tokens"),
+                    eos_id=doc.get("eos_id"),
+                    timeout=float(doc.get("timeout") or 60))
+            except ServerBusy as busy:
+                hdrs = []
+                if busy.retry_after_ms:
+                    hdrs.append(("Retry-After",
+                                 "%.3f" % (busy.retry_after_ms / 1e3)))
+                self._reply(busy.code, busy.to_dict(), hdrs)
+                return
+            except (KeyError, ValueError, TypeError, MXNetError) as exc:
+                self._reply(400, {"error": "bad_request",
+                                  "reason": str(exc)})
+                return
+            except Exception as exc:
+                self._reply(500, {"error": "internal",
+                                  "reason": str(exc)})
+                return
+            self._reply(200, dict(res, model=model))
+
     return Handler
 
 
@@ -173,8 +238,9 @@ def main(argv=None):
     ap.add_argument("--symbol", help="symbol JSON path")
     ap.add_argument("--params", help="params file path")
     ap.add_argument("--name", default="model", help="served model name")
-    ap.add_argument("--shapes", required=True,
-                    help='per-sample input shapes, "data=(784,)"')
+    ap.add_argument("--shapes", default="",
+                    help='per-sample input shapes, "data=(784,)" '
+                         "(required unless --generative)")
     ap.add_argument("--histogram",
                     help='offered-load histogram "1:100,8:20" '
                          "(plans buckets)")
@@ -187,6 +253,27 @@ def main(argv=None):
     ap.add_argument("--priority", type=int, default=0)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8911)
+    gen = ap.add_argument_group("generative serving")
+    gen.add_argument("--generative", action="store_true",
+                     help="serve the checkpoint as a decoder-only LM "
+                          "(/v1/generate)")
+    gen.add_argument("--vocab", type=int, default=32000)
+    gen.add_argument("--layers", type=int, default=4)
+    gen.add_argument("--heads", type=int, default=8)
+    gen.add_argument("--dim", type=int, default=256)
+    gen.add_argument("--max-seq-len", type=int, default=512)
+    gen.add_argument("--max-new", type=int, default=None,
+                     help="per-request token cap "
+                          "(MXTPU_SERVE_MAX_NEW_TOKENS)")
+    gen.add_argument("--prompt-buckets",
+                     help='explicit prompt-length buckets "8,16,32"')
+    gen.add_argument("--decode-buckets",
+                     help='explicit decode batch buckets "1,2,4,8"')
+    gen.add_argument("--kv-blocks", type=int, default=None,
+                     help="KV cache blocks (MXTPU_SERVE_KV_BLOCKS)")
+    gen.add_argument("--kv-block-size", type=int, default=None,
+                     help="tokens per block "
+                          "(MXTPU_SERVE_KV_BLOCK_SIZE)")
     args = ap.parse_args(argv)
 
     srv = build_server(args)

@@ -138,6 +138,18 @@ def parse_telemetry(path):
                 if vals:
                     overlap_cols["serve-%s-ms" % phase.replace("_", "-")] \
                         = sum(vals) / len(vals)
+            # generative columns (docs/serving.md "Generation"):
+            # tokens/sec, TTFT tail, and KV-block occupancy
+            if total.get("tokens_per_sec") is not None:
+                overlap_cols["serve-tokens-per-sec"] = \
+                    total["tokens_per_sec"]
+            ttft = total.get("ttft_ms") or {}
+            if ttft.get("p95") is not None:
+                overlap_cols["serve-ttft-ms-p95"] = ttft["p95"]
+            kv = [m["kv_occupancy"] for m in models.values()
+                  if m.get("kv_occupancy") is not None]
+            if kv:
+                overlap_cols["serve-kv-occupancy"] = sum(kv) / len(kv)
     except Exception:
         pass
     if not acc and any(c.startswith("serve-") for c in overlap_cols):

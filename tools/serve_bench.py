@@ -18,6 +18,21 @@ Modes:
             completions — measures latency under offered load (and how
             the 429 backpressure behaves past saturation).
 
+``--generate`` switches the bench to the generative workload: a small
+decoder-only LM served through ``add_generative_model`` under a mixed
+prompt-length distribution (``--prompt-sizes``), closed-loop workers
+streaming tokens.  The BENCH line becomes::
+
+    {"metric": "serve_tokens_per_sec", "value": ..., "unit": "tok/s",
+     "ttft_ms": {"p50","p95"}, "itl_ms": {"p50","p95"},
+     "lowerings_after_warmup": 0, "rejected_429": ..., ...}
+
+tokens/sec counts generated tokens over the timed window; TTFT is
+submit → first streamed token, ITL the gap between consecutive streamed
+tokens of one sequence.  KV-cache 429s are retried after the server's
+``retry_after_ms`` hint and counted in ``rejected_429`` — past
+saturation the bench demonstrates (rather than dies on) backpressure.
+
 ``lowerings_after_warmup`` comes from the executor program-registry
 counters: the AOT contract is that it stays 0 no matter how many
 requests run (the CI smoke asserts exactly that).  With telemetry on
@@ -123,6 +138,142 @@ def run_open(srv, model, inputs_for, sizes, rate):
     return time.perf_counter() - t0, rejected, errors
 
 
+def build_lm(args):
+    """Small decoder-only LM + deterministic random params for the
+    generative bench (token-level correctness is covered by tests;
+    the bench only needs real matmul shapes)."""
+    import numpy as np
+    from mxnet_tpu import ndarray as nd
+    from mxnet_tpu.models import transformer as tf
+    full = tf.get_symbol(vocab_size=args.vocab, num_layers=args.layers,
+                         num_heads=args.heads, dim=args.dim,
+                         seq_len=args.max_seq_len)
+    shapes = full.infer_shape(data=(1, args.max_seq_len),
+                              softmax_label=(1, args.max_seq_len))[0]
+    rng = np.random.RandomState(args.seed)
+    params = {}
+    for name, shp in zip(full.list_arguments(), shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        params[name] = nd.array(rng.randn(*shp).astype(np.float32) * 0.05)
+    return params
+
+
+def run_generate(args):
+    """Closed-loop generative drill; prints the tokens/sec BENCH line."""
+    import numpy as np
+    from mxnet_tpu.observability.counters import percentile
+    from mxnet_tpu.serving import ModelServer, ServerBusy
+
+    params = build_lm(args)
+    srv = ModelServer(max_delay_ms=args.max_delay_ms,
+                      max_queue=args.max_queue)
+    engine = srv.add_generative_model(
+        "lm", params, vocab_size=args.vocab, num_layers=args.layers,
+        num_heads=args.heads, dim=args.dim, max_seq_len=args.max_seq_len,
+        max_new_tokens=args.max_new,
+        prompt_buckets=args.prompt_buckets,
+        prompt_histogram=None if args.prompt_buckets else args.prompt_sizes,
+        decode_buckets=args.decode_buckets,
+        kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size)
+    from mxnet_tpu.executor import program_registry_stats
+    lowerings_at_warmup = program_registry_stats()["lowerings"]
+
+    lengths = sample_sizes(args.prompt_sizes, args.requests, args.seed)
+    rng = np.random.RandomState(args.seed)
+    prompts = [rng.randint(1, args.vocab, size=n).tolist()
+               for n in lengths]
+
+    lock = threading.Lock()
+    cursor = [0]
+    ttft, itl, errors = [], [], []
+    rejected = [0]
+    tokens = [0]
+
+    def worker():
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= len(prompts):
+                    return
+                cursor[0] += 1
+            t_submit = time.perf_counter()
+            while True:
+                try:
+                    _fut, stream = srv.generate(
+                        "lm", prompts[i], max_new_tokens=args.max_new)
+                    break
+                except ServerBusy as exc:
+                    with lock:
+                        rejected[0] += 1
+                    time.sleep((exc.retry_after_ms or 50.0) / 1e3)
+            t_prev = None
+            try:
+                for _tok in stream:
+                    t_now = time.perf_counter()
+                    with lock:
+                        tokens[0] += 1
+                        if t_prev is None:
+                            ttft.append((t_now - t_submit) * 1e3)
+                        else:
+                            itl.append((t_now - t_prev) * 1e3)
+                    t_prev = t_now
+            except Exception as exc:
+                errors.append(exc)
+                return
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(args.concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+
+    stats = srv.stats()
+    lowerings_after = program_registry_stats()["lowerings"] \
+        - lowerings_at_warmup
+    kv = engine.cache.stats()
+    srv.close()
+    try:
+        from mxnet_tpu.observability import events as _events
+        _events.flush()
+    except Exception:
+        pass
+
+    def pct(vals):
+        if not vals:
+            return None
+        return {"p50": round(percentile(vals, 50), 3),
+                "p95": round(percentile(vals, 95), 3),
+                "mean": round(sum(vals) / len(vals), 3)}
+
+    out = {
+        "metric": "serve_tokens_per_sec",
+        "value": round(tokens[0] / wall_s, 2) if wall_s > 0 else 0.0,
+        "unit": "tok/s",
+        "mode": "generate",
+        "requests": args.requests,
+        "tokens": tokens[0],
+        "rejected_429": rejected[0],
+        "errors": len(errors),
+        "wall_s": round(wall_s, 3),
+        "ttft_ms": pct(ttft),
+        "itl_ms": pct(itl),
+        "prompt_buckets": list(engine.prompt_buckets),
+        "decode_buckets": list(engine.decode_buckets),
+        "kv_blocks_high_water": kv["blocks_high_water"],
+        "kv_block_size": kv["block_size"],
+        "batches": stats.get("batches"),
+        "lowerings_after_warmup": lowerings_after,
+    }
+    if errors:
+        out["first_error"] = repr(errors[0])
+    print(json.dumps(out, default=str))
+    return 1 if errors else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="serve_bench", description=__doc__,
@@ -149,7 +300,28 @@ def main(argv=None):
                     help="per-sample shapes (with --checkpoint)")
     ap.add_argument("--json", action="store_true",
                     help="(default behavior; kept for symmetry)")
+    gen = ap.add_argument_group("generative mode")
+    gen.add_argument("--generate", action="store_true",
+                     help="bench token generation instead of predict")
+    gen.add_argument("--prompt-sizes", default="4:50,12:30,24:20",
+                     help='prompt-length distribution "len:weight,..."')
+    gen.add_argument("--prompt-buckets", default=None,
+                     help='explicit prompt-length buckets "8,16,32"')
+    gen.add_argument("--decode-buckets", default=None,
+                     help='explicit decode batch buckets "1,2,4,8"')
+    gen.add_argument("--max-new", type=int, default=16,
+                     help="tokens generated per request")
+    gen.add_argument("--kv-blocks", type=int, default=None)
+    gen.add_argument("--kv-block-size", type=int, default=None)
+    gen.add_argument("--vocab", type=int, default=128)
+    gen.add_argument("--layers", type=int, default=2)
+    gen.add_argument("--heads", type=int, default=4)
+    gen.add_argument("--dim", type=int, default=64)
+    gen.add_argument("--max-seq-len", type=int, default=64)
     args = ap.parse_args(argv)
+
+    if args.generate:
+        return run_generate(args)
 
     import numpy as np
     from mxnet_tpu.serving import ModelServer
