@@ -91,12 +91,14 @@ def resolve_device_kind(ctx):
     return dk or _os.environ.get("MXTPU_LINT_DEVICE_KIND", "v5e")
 
 
-def device_peaks(device_kind):
+def device_peaks(device_kind, dtype=None):
     """(peak_flops_per_s, peak_hbm_bytes_per_s) from bench.py's spec
-    table (env overrides apply), or (None, None) when unknown."""
+    table (env overrides apply), or (None, None) when unknown.
+    ``dtype`` ("int8"/"fp8") reads the quantized peak tables — how a
+    graph with QuantizedDense nodes prices those rows."""
     try:
         import bench
-        tf, _note = bench._lookup_peak_tflops(device_kind)
+        tf, _note = bench._lookup_peak_tflops(device_kind, dtype=dtype)
         gb, _note2 = bench._lookup_peak_hbm(device_kind)
     except Exception:
         return None, None
@@ -168,6 +170,7 @@ def _op_costs(ctx):
             "mxu": cost["mxu"],
             "mxu_dims": cost["mxu_dims"],
             "reduce_len": int(reduce_len),
+            "compute_dtype": cost.get("compute_dtype"),
         })
     param_bytes = 0
     if training:
@@ -202,6 +205,23 @@ def roofline_report(ctx):
     byts = sum(r["bytes"] for r in facts["rows"]) + facts["param_bytes"]
     device_kind = resolve_device_kind(ctx)
     peak_f, peak_b = device_peaks(device_kind)
+    # mixed-precision pricing: rows that declare their own compute
+    # dtype (QuantizedDense -> int8/fp8) run at that dtype's peak, so
+    # the graph's effective peak is flops-over-time across the mix
+    # (time = Σ flops_d / peak_d) — a fully-int8 graph gets the full
+    # int8 rate, a mixed graph something in between
+    quant_flops = sum(r["flops"] for r in facts["rows"]
+                      if r.get("compute_dtype"))
+    if peak_f and quant_flops:
+        t = 0.0
+        for r in facts["rows"]:
+            pf = peak_f
+            if r.get("compute_dtype"):
+                pd, _ = device_peaks(device_kind, dtype=r["compute_dtype"])
+                pf = pd or peak_f
+            t += r["flops"] / pf
+        if t > 0:
+            peak_f = flops / t
     report = {
         "flops_per_step": flops,
         "hbm_bytes_per_step": byts,
@@ -211,6 +231,7 @@ def roofline_report(ctx):
         "peak_hbm_gbps": (peak_b / 1e9) if peak_b else None,
         "ridge": None, "mfu_ceiling": None, "bound": None,
         "compute_dtype": facts["compute_dtype"],
+        "quantized_flops": quant_flops or 0,
         "mode": "training" if facts["training"] else "inference",
         "complete": facts["complete"],
         "per_op": sorted(facts["rows"], key=lambda r: -r["flops"])[:8],

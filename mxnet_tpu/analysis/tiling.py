@@ -96,6 +96,10 @@ def _ensure_builtin_specs():
         from ..parallel import ring_attention  # noqa: F401
     except Exception:
         pass
+    try:
+        from .. import kernels  # noqa: F401  (quantize/flash_decode/fused_opt)
+    except Exception:
+        pass
 
 
 # ----------------------------------------------------------------------

@@ -396,6 +396,14 @@ class Executor:
         self._arg_names = symbol.list_arguments()
         self._out_names = symbol.list_outputs()
         self._aux_names = symbol.list_auxiliary_states()
+        # graphs embedding host-callback ops (CustomOp/NativeOp, the
+        # torch/plugin bridges) need a sync point after backward: the
+        # callback replay runs on jax's async callback thread while the
+        # caller may mutate host state (a torch optimizer stepping the
+        # module's params in-place) as soon as backward() returns
+        self._has_host_ops = any(
+            getattr(node.op, "host_callback", False)
+            for node in symbol._topo() if node.op is not None)
 
         arg_list = _as_list(args, self._arg_names, "args")
         if any(a is None for a in arg_list):
@@ -609,6 +617,11 @@ class Executor:
                 tgt._set_data(tgt.data + g)
             else:
                 tgt._set_data(g)
+        if self._has_host_ops:
+            # order the host-side backward effects (torch .grad fills,
+            # custom-op buffer writes) before the caller's next move
+            for n in wrt_names:
+                grads[n].block_until_ready()
 
     def forward_backward(self, out_grads=None, **kwargs):
         """Fused train step building block: one XLA computation for fwd+bwd."""
@@ -639,6 +652,9 @@ class Executor:
                 tgt._set_data(tgt.data + grads[n])
             else:
                 tgt._set_data(grads[n])
+        if self._has_host_ops:
+            for n in wrt_names:
+                grads[n].block_until_ready()
         return self.outputs
 
     # -- fused train step (fwd + bwd + optimizer update, ONE dispatch) --

@@ -1,0 +1,71 @@
+"""Shared plumbing for the quantized + fused kernel tier.
+
+Every kernel in this package follows the ``parallel/ring_attention``
+contract: a jnp reference implementation (exact, runs anywhere), a
+Pallas kernel (TPU), and a resolution rule deciding which one a call
+uses.  The rule is centralized here so the three kernels cannot drift:
+
+- an EXPLICIT ``interpret`` argument wins: ``True`` exercises the
+  kernel off-TPU (tests), ``False`` forces the Mosaic path;
+- a force env var (``MXTPU_FLASH_DECODE`` etc.) set to ``1``/``kernel``
+  selects the Mosaic path, but only on a TPU backend or inside
+  ``aot_lowering_scope()`` (compile-only lowering against a TPU
+  topology) — a leaked force flag must not abort a cpu/gpu run;
+- otherwise: kernel on TPU, ``None`` (= caller's reference fallback)
+  elsewhere.
+"""
+from __future__ import annotations
+
+import os as _os
+
+__all__ = ["resolve_interpret", "pick_block", "env_flag"]
+
+
+def env_flag(name, default=""):
+    """Env knob value, lower-cased; '' when unset."""
+    return _os.environ.get(name, default).strip().lower()
+
+
+def _on_tpu():
+    import jax
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def _aot_depth():
+    from ..parallel import ring_attention
+    return getattr(ring_attention, "_AOT_LOWERING_DEPTH", 0)
+
+
+def resolve_interpret(interpret, force_env=None):
+    """Resolve a kernel call's execution mode.
+
+    Returns ``True``/``False`` (run the pallas_call with that
+    ``interpret``) or ``None`` (take the jnp reference fallback).
+    """
+    if interpret is not None:
+        return bool(interpret)
+    on_tpu = _on_tpu()
+    if force_env and env_flag(force_env) in ("1", "kernel", "force") \
+            and (on_tpu or _aot_depth() > 0):
+        return False
+    if not on_tpu:
+        return None
+    return False
+
+
+def pick_block(dim, granule, target):
+    """Largest granule-aligned divisor of ``dim`` that is <= ``target``,
+    else the whole dim (a block covering its whole array dim is legal at
+    any size — Mosaic pads it).  Keeps every grid step exact: the index
+    maps in this package assume no trailing partial block."""
+    dim = int(dim)
+    if dim <= target:
+        return dim
+    best = None
+    c = (target // granule) * granule
+    while c >= granule:
+        if dim % c == 0:
+            best = c
+            break
+        c -= granule
+    return best if best is not None else dim

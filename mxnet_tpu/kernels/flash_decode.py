@@ -1,0 +1,209 @@
+"""Flash-decode: fused single-query attention over the paged KV cache.
+
+The decode sibling of the ring_attention flash kernel: one new query
+per sequence attends over that sequence's cache blocks, named by its
+block-table row (serving/kvcache.py).  The reference path in
+``CachedMultiHeadAttention.decode`` gathers the whole table
+(``kc[table]``), materializes the (B, T, H, D) context and the (B, H,
+T) score matrix in HBM, and softmaxes it; this kernel walks the table
+block-by-block with the online-softmax recurrence instead —
+
+    m' = max(m, rowmax(s));  c = exp(m - m')
+    l  = l*c + rowsum(exp(s - m'));  o = o*c + exp(s - m') @ v
+
+— a block-parallel partial softmax whose per-block stats combine by
+logsumexp, so nothing bigger than one (block_size, H, D) cache block is
+ever live.  Grid is one program per batch row; the per-head score and
+context matmuls batch over H on the MXU.  Stats ride lane-broadcast as
+(H, 128) tiles (the historical flash-lse rule: a 1-D stats row is not
+a legal Mosaic block).
+
+Masking matches the reference bit-for-bit in structure: positions
+``> pos`` get -1e30 before the max, which also neutralizes fully-padded
+trailing blocks (their contribution underflows to zero once a real
+block has set the running max; block 0 always holds position 0).
+
+Selection: ``MXTPU_FLASH_DECODE=1`` flips the decode path in
+``ops/attention.py`` onto this kernel (TPU or ``aot_lowering_scope``;
+elsewhere the env flag falls back to the reference so CPU tests and
+serving smoke runs stay exact).  ``interpret=True`` exercises the
+kernel anywhere — the equivalence gate in tests/test_kernels.py runs it
+against :func:`decode_attention_reference` on mixed positions.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as _np
+
+from ..analysis.tiling import register_kernel_spec
+from .common import resolve_interpret
+
+__all__ = ["decode_attention_reference", "flash_decode_attention",
+           "flash_decode_kernel_spec", "flash_decode_enabled"]
+
+_NEG_INF = -1e30
+#: stats (m, l) are broadcast across one 128-lane row per head so their
+#: in-kernel layout is a legal (sublane, lane) tile
+_STAT_LANES = 128
+
+
+def flash_decode_enabled():
+    """True when MXTPU_FLASH_DECODE selects the kernel decode path."""
+    from .common import env_flag
+    return env_flag("MXTPU_FLASH_DECODE") in ("1", "kernel", "force")
+
+
+def decode_attention_reference(q, k_pool, v_pool, table, pos, scale=None):
+    """Gather + einsum decode attention (the pre-kernel path, kept as
+    the exact fallback).  ``q (B, H, D)``, pools ``(NB, BS, H, D)``,
+    ``table (B, MB) int32``, ``pos (B,) int32`` (current position, the
+    newest token's index).  Returns ``(B, H, D)`` in q's dtype."""
+    import jax
+    import jax.numpy as jnp
+    B, H, D = q.shape
+    BS = k_pool.shape[1]
+    MB = table.shape[1]
+    if scale is None:
+        scale = 1.0 / float(_np.sqrt(D))
+    kk = k_pool[table].reshape(B, MB * BS, H, D).astype(q.dtype)
+    vv = v_pool[table].reshape(B, MB * BS, H, D).astype(q.dtype)
+    s = jnp.einsum("bhd,bthd->bht", q, kk) * scale
+    t_idx = jnp.arange(MB * BS, dtype=jnp.int32)
+    s = jnp.where(t_idx[None, None, :] <= pos[:, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bht,bthd->bhd", p, vv.astype(p.dtype))
+    return o.astype(q.dtype)
+
+
+def _decode_block_layout(b, h, nb, bs, mb, d, dtype):
+    """(block, array, dtype) triples of the pallas_call, inputs
+    (q, k_pool, v_pool, table, pos) then output — shared by the call
+    and the registered MXL-K spec.  The pools, table, and pos ride as
+    whole-array blocks (every dim covers its array dim: legal at any
+    size); q and the output window one batch row, keeping (H, D) — both
+    full array dims — as the tileable pair."""
+    in_blocks = [
+        ((1, h, d), (b, h, d), str(dtype)),            # q
+        ((nb, bs, h, d), (nb, bs, h, d), str(dtype)),  # k pool
+        ((nb, bs, h, d), (nb, bs, h, d), str(dtype)),  # v pool
+        ((b, mb), (b, mb), "int32"),                   # block table
+        ((b, 1), (b, 1), "int32"),                     # seq positions
+    ]
+    out_blocks = [((1, h, d), (b, h, d), str(dtype))]
+    return in_blocks, out_blocks
+
+
+def _flash_decode_kernel(q_ref, k_ref, v_ref, tbl_ref, pos_ref, o_ref, *,
+                         block_size, blocks_per_seq, scale):
+    """Grid (B,): one program per sequence; fori_loop over its table."""
+    import jax.numpy as jnp
+    from jax import lax
+    import jax.experimental.pallas as pl
+
+    b = pl.program_id(0)
+    q = q_ref[0].astype(jnp.float32)               # (H, D)
+    pos = pos_ref[b, 0]
+    H, D = q.shape
+
+    m0 = jnp.full((H, _STAT_LANES), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H, _STAT_LANES), jnp.float32)
+    o0 = jnp.zeros((H, D), jnp.float32)
+
+    def body(j, carry):
+        m, l, o = carry
+        blk = tbl_ref[b, j]
+        k = k_ref[pl.dslice(blk, 1)][0].astype(jnp.float32)  # (BS, H, D)
+        v = v_ref[pl.dslice(blk, 1)][0].astype(jnp.float32)
+        # per-head scores (H, BS): contract D, batch H
+        s = lax.dot_general(q, k, (((1,), (2,)), ((0,), (1,))),
+                            preferred_element_type=jnp.float32) * scale
+        idx = j * block_size + lax.broadcasted_iota(
+            jnp.int32, (H, block_size), 1)
+        s = jnp.where(idx <= pos, s, _NEG_INF)
+        s_max = jnp.max(s, axis=-1)[:, None]               # (H, 1)
+        m_new = jnp.maximum(m, jnp.broadcast_to(s_max, m.shape))
+        p = jnp.exp(s - m_new[:, :1])                      # (H, BS)
+        c = jnp.exp(m - m_new)                             # (H, LANES)
+        l_new = l * c + jnp.broadcast_to(
+            jnp.sum(p, axis=-1)[:, None], l.shape)
+        # per-head context (H, D): contract BS, batch H
+        o_new = o * c[:, :1] + lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, o_new
+
+    m, l, o = lax.fori_loop(0, blocks_per_seq, body, (m0, l0, o0))
+    l_safe = jnp.maximum(l[:, :1], 1e-30)
+    o_ref[0] = (o / l_safe).astype(o_ref.dtype)
+
+
+def flash_decode_attention(q, k_pool, v_pool, table, pos, scale=None,
+                           interpret=None):
+    """Fused decode attention; same signature/semantics as
+    :func:`decode_attention_reference`.  Pallas on TPU (or explicit
+    ``interpret``), reference fallback elsewhere."""
+    mode = resolve_interpret(interpret, "MXTPU_FLASH_DECODE")
+    if mode is None:
+        return decode_attention_reference(q, k_pool, v_pool, table, pos,
+                                          scale=scale)
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+
+    B, H, D = q.shape
+    NB, BS = k_pool.shape[0], k_pool.shape[1]
+    MB = table.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    in_blocks, out_blocks = _decode_block_layout(B, H, NB, BS, MB, D,
+                                                 q.dtype)
+    kernel = functools.partial(_flash_decode_kernel, block_size=BS,
+                               blocks_per_seq=MB, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec(in_blocks[0][0], lambda b: (b, 0, 0)),
+            pl.BlockSpec(in_blocks[1][0], lambda b: (0, 0, 0, 0)),
+            pl.BlockSpec(in_blocks[2][0], lambda b: (0, 0, 0, 0)),
+            pl.BlockSpec(in_blocks[3][0], lambda b: (0, 0)),
+            pl.BlockSpec(in_blocks[4][0], lambda b: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec(out_blocks[0][0], lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(out_blocks[0][1], q.dtype),
+        interpret=mode,
+    )(q, k_pool, v_pool, table.astype(jnp.int32),
+      pos.reshape(B, 1).astype(jnp.int32))
+    return out
+
+
+def flash_decode_kernel_spec(batch=8, heads=8, head_dim=64, num_blocks=64,
+                             block_size=32, blocks_per_seq=16,
+                             dtype="float32"):
+    """MXL-K spec at one cache dtype — same layout helper as the call
+    (the CI sweep asserts f32/bf16/int8 legality of the geometry, the
+    int8 row covering the quantized-cache variant the paged_kv_cache
+    spec already anticipates)."""
+    in_blocks, out_blocks = _decode_block_layout(
+        batch, heads, num_blocks, block_size, blocks_per_seq, head_dim,
+        dtype)
+    roles = [("in", "q"), ("in", "k_pool"), ("in", "v_pool"),
+             ("in", "block_table"), ("in", "seq_pos")]
+    blocks = [{"role": r, "name": nm, "block": blk, "array": arr,
+               "dtype": dt}
+              for (r, nm), (blk, arr, dt) in zip(roles, in_blocks)]
+    blocks.append({"role": "out", "name": "out",
+                   "block": out_blocks[0][0], "array": out_blocks[0][1],
+                   "dtype": out_blocks[0][2]})
+    return {"name": "flash_decode[%s]" % dtype,
+            "origin": "mxnet_tpu/kernels/flash_decode.py",
+            "grid": (batch,),
+            "blocks": blocks}
+
+
+register_kernel_spec(
+    "kernels.flash_decode",
+    lambda: [flash_decode_kernel_spec(dtype=dt)
+             for dt in ("float32", "bfloat16", "int8")])

@@ -336,18 +336,18 @@ class CachedMultiHeadAttention(OperatorProperty):
             slot = pos % BS
             kc = kc.at[blk, slot].set(kh[:, 0].astype(kc.dtype))
             vc = vc.at[blk, slot].set(vh[:, 0].astype(vc.dtype))
-            MB = table.shape[1]
-            kk = kc[table].reshape(B, MB * BS, H, D).astype(q.dtype)
-            vv = vc[table].reshape(B, MB * BS, H, D).astype(q.dtype)
             scale = 1.0 / float(_np.sqrt(D))
             qh = q.reshape(B, H, D)
-            s = jnp.einsum("bhd,bthd->bht", qh, kk) * scale
-            # position-offset mask: only slots holding tokens <= pos
-            t_idx = jnp.arange(MB * BS, dtype=jnp.int32)
-            s = jnp.where(t_idx[None, None, :] <= pos[:, None, None],
-                          s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bht,bthd->bhd", p, vv.astype(p.dtype))
+            from ..kernels import flash_decode as _fd
+            if _fd.flash_decode_enabled():
+                # MXTPU_FLASH_DECODE: block-parallel partial-softmax
+                # kernel over the block table (Pallas on TPU; the env
+                # resolver falls back to the exact reference elsewhere)
+                o = _fd.flash_decode_attention(qh, kc, vc, table, pos,
+                                               scale=scale)
+            else:
+                o = _fd.decode_attention_reference(qh, kc, vc, table, pos,
+                                                   scale=scale)
             o = o.astype(q.dtype).reshape(B, 1, E)
         return [o @ wo.T + bo, kc, vc], None
 

@@ -180,6 +180,106 @@ class FullyConnected(OperatorProperty):
         return out
 
 
+class _QuantizedDenseParam(ParamStruct):
+    num_hidden = Field(int, required=True, lower=1)
+    no_bias = Field(bool, default=False)
+    qdtype = Field(str, default="int8", enum=("int8", "fp8_e4m3"))
+
+
+@register_op("QuantizedDense")
+class QuantizedDense(OperatorProperty):
+    """Weight-only quantized FullyConnected: y = x_2d · dequant(Wq)ᵀ + b.
+
+    Produced by ``kernels.quantize.quantize_symbol`` rewriting matched
+    FullyConnected nodes; weight rides in the quantized storage dtype
+    with a per-output-channel float32 ``scale`` argument spliced in at
+    index 2.  Forward lowers to ``kernels.quantize.quantized_matmul``
+    (Pallas dequant-in-registers on TPU, exact jnp reference elsewhere);
+    cost rules price the MXU dims at the quantized dtype so rooflines
+    use the int8/fp8 peak tables.
+    """
+    param_cls = _QuantizedDenseParam
+    mxu = True
+
+    def list_arguments(self):
+        args = ["data", "weight", "scale"]
+        if not self.param.no_bias:
+            args.append("bias")
+        return args
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            require_known("QuantizedDense", in_shapes[:1], ["data"])
+        num_in = int(_np.prod(data[1:], dtype=_np.int64))
+        nh = self.param.num_hidden
+        shapes = [data, (nh, num_in), (nh,)]
+        if not self.param.no_bias:
+            shapes.append((nh,))
+        return shapes, [(data[0], nh)], []
+
+    def infer_type(self, in_types):
+        from ..kernels.quantize import storage_dtype
+        st = _np.dtype(storage_dtype(self.param.qdtype))
+        f32 = _np.dtype(_np.float32)
+        wide = next((t for i, t in enumerate(in_types)
+                     if t is not None and i not in (1, 2)), None)
+        types = [wide, st, f32]
+        if not self.param.no_bias:
+            types.append(wide)
+        return types, [wide], []
+
+    def forward(self, inputs, aux, is_train, rng):
+        from ..kernels.quantize import quantized_matmul
+        x = inputs[0].reshape((inputs[0].shape[0], -1))
+        y = quantized_matmul(x, inputs[1], inputs[2])
+        if not self.param.no_bias:
+            y = y + inputs[3]
+        return [y], None
+
+    # compute dtype of the MXU contraction (roofline prices peaks at it)
+    def cost_compute_dtype(self, in_shapes, out_shapes):
+        return "fp8" if self.param.qdtype == "fp8_e4m3" else "int8"
+
+    def cost_mxu_dims(self, in_shapes, out_shapes):
+        data = in_shapes[0]
+        num_in = int(_np.prod(data[1:], dtype=_np.int64))
+        return [(int(data[0]), num_in, int(self.param.num_hidden))]
+
+    def cost_flops(self, in_shapes, out_shapes):
+        (m, k, n), = self.cost_mxu_dims(in_shapes, out_shapes)
+        extra = m * n                       # scale epilogue
+        if not self.param.no_bias:
+            extra += m * n
+        return float(2 * m * k * n + extra)
+
+    def infer_sharding(self, in_specs, in_shapes, out_shapes, mesh_shape):
+        data, weight = in_specs[0], in_specs[1]
+        c_idx = next((i for i in range(1, len(data)) if data[i]), None)
+        d_c = data[c_idx] if c_idx is not None else ()
+        w_c = weight[1] if len(weight) > 1 else ()
+        reduce, notes, conflict = contract_sharding(
+            d_c, w_c, 0, 1, "QuantizedDense")
+        required = [None] * len(in_specs)
+        if conflict:
+            req = list(data)
+            req[c_idx] = w_c
+            required[0] = tuple(req)
+        batch = data[0] if data else ()
+        cols = dedup_axes(weight[0] if weight else (), batch)
+        # scale (and bias) are per-output-channel rows: follow cols
+        if len(required) > 2:
+            required[2] = (cols,)
+        if not self.param.no_bias and len(required) > 3:
+            required[3] = (cols,)
+        out = {"out": [(tuple(batch), cols)], "in": required}
+        if reduce:
+            out["reduce"] = reduce
+        if notes:
+            out["notes"] = notes
+        return out
+
+
 # ----------------------------------------------------------------------
 # Convolution / Deconvolution
 # ----------------------------------------------------------------------

@@ -272,12 +272,17 @@ def op_cost(op, in_shapes, out_shapes):
     Returns ``{"flops", "bytes_elements", "mxu", "mxu_dims",
     "reduce_len"}`` — forward-pass figures; the roofline pass applies
     the training multipliers."""
+    hook = getattr(op, "cost_compute_dtype", None)
     out = {
         "flops": op.cost_flops(in_shapes, out_shapes),
         "bytes_elements": op.cost_bytes_elements(in_shapes, out_shapes),
         "mxu": bool(type(op).mxu),
         "mxu_dims": op.cost_mxu_dims(in_shapes, out_shapes),
         "reduce_len": op.cost_reduce_len(in_shapes, out_shapes),
+        # an op whose MXU contraction runs at its own dtype (int8/fp8
+        # QuantizedDense) declares it here; None = the graph-wide
+        # compute dtype
+        "compute_dtype": hook(in_shapes, out_shapes) if hook else None,
     }
     fn = COST_FLOPS.get(type(op).op_name)
     if fn is not None:

@@ -46,9 +46,17 @@ class Optimizer(object):
     jax arrays`` and ``update_fn`` (a pure function; jitted lazily on first
     use).  ``update(index, weight, grad, state)`` keeps the reference's
     imperative signature for kvstore updaters and Module.update.
+
+    ``elementwise`` marks optimizers whose ``update_fn`` is purely
+    elementwise (no per-tensor norms, no per-leaf randomness): the
+    fused optimizer sweep (``kernels/fused_opt.py``) may flatten and
+    concatenate such leaves into buckets with bit-identical results.
+    LAMB (trust ratios from per-tensor norms) and SGLD (a fresh noise
+    draw per leaf) must keep the default False.
     """
 
     opt_registry = {}
+    elementwise = False
 
     @staticmethod
     def register(klass):
@@ -211,6 +219,8 @@ class SGD(Optimizer):
     update: m = mu*m - lr*(grad + wd*w);  w += m
     """
 
+    elementwise = True
+
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -282,6 +292,8 @@ class ccSGD(SGD):
 class Adam(Optimizer):
     """Adam (parity: optimizer.py:504). state = (mean, var); bias-corrected."""
 
+    elementwise = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -308,6 +320,8 @@ class Adam(Optimizer):
 class AdamW(Optimizer):
     """Adam with decoupled weight decay (modern LLM default; beyond-reference)."""
 
+    elementwise = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -333,6 +347,8 @@ class AdamW(Optimizer):
 class AdaGrad(Optimizer):
     """AdaGrad (parity: optimizer.py:605). state = sum of squared grads."""
 
+    elementwise = True
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
@@ -354,6 +370,8 @@ class RMSProp(Optimizer):
     state = (n, g, delta): n = ema(grad^2), g = ema(grad),
     delta = gamma2*delta - lr*grad/sqrt(n - g^2 + eps); w += delta.
     """
+
+    elementwise = True
 
     def __init__(self, learning_rate=0.002, gamma1=0.95, gamma2=0.9,
                  **kwargs):
@@ -377,6 +395,8 @@ class RMSProp(Optimizer):
 @register
 class AdaDelta(Optimizer):
     """AdaDelta (parity: optimizer.py:728). state = (acc_g, acc_delta)."""
+
+    elementwise = True
 
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
@@ -429,6 +449,8 @@ class LAMB(Optimizer):
 class Test(Optimizer):
     """Test optimizer: w -= grad (parity: optimizer.py:782; used by
     dist_sync_kvstore.py to verify server-side updates)."""
+
+    elementwise = True
 
     def create_state_arrays(self, shape, dtype):
         return jnp.zeros(shape, dtype=dtype)

@@ -127,6 +127,18 @@ class ShardedTrainer(object):
         # Identity math; pointless on a single device.
         self._bucket_grads = _overlap.bucket_bytes() > 0 \
             and self.mesh.size > 1
+        # fused optimizer sweep (MXTPU_FUSED_OPT): replace the per-leaf
+        # update tree-map with one bucketed flatten/update/unflatten —
+        # bit-identical, elementwise optimizers only.  The Pallas sweep
+        # ('kernel') is a single-device program; on a multi-device mesh
+        # it degrades to the fused XLA sweep ('1'), which GSPMD
+        # partitions like any other elementwise computation.
+        from ..kernels import fused_opt as _fused
+        self._fused_mod = _fused
+        self._fused_opt = _fused.fused_opt_mode() \
+            if _fused.supports_fused(optimizer) else ""
+        if self._fused_opt == "kernel" and self.mesh.size > 1:
+            self._fused_opt = "1"
 
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
@@ -197,10 +209,19 @@ class ShardedTrainer(object):
 
             new_params = {}
             new_opt_state = {}
-            for name in params:
-                g = preprocess(grads[name])
-                w, s = opt_update(params[name], g, opt_state.get(name),
-                                  lr, wd, t)
+            if self._fused_opt:
+                fused_w, fused_s = self._fused_mod.fused_apply(
+                    optimizer, params, grads, opt_state, lr, wd, t,
+                    mode=self._fused_opt, preprocess=preprocess)
+                leaf_iter = ((n, fused_w[n], fused_s[n]) for n in params)
+            else:
+                def _leafwise():
+                    for name in params:
+                        g = preprocess(grads[name])
+                        yield (name,) + opt_update(
+                            params[name], g, opt_state.get(name), lr, wd, t)
+                leaf_iter = _leafwise()
+            for name, w, s in leaf_iter:
                 if self.zero1:
                     # pin layouts: state stays dp-sharded, weights come
                     # back replicated (XLA inserts the all-gather) — the
@@ -261,9 +282,18 @@ class ShardedTrainer(object):
 
             new_params = {}
             new_opt_state = {}
-            for name in params:
-                w, s = opt_update(params[name], gs[name],
-                                  opt_state.get(name), lr, wd, t)
+            if self._fused_opt:
+                # gs is already preprocessed (the gate checks the true
+                # grads), so no preprocess hook here
+                fused_w, fused_s = self._fused_mod.fused_apply(
+                    optimizer, params, gs, opt_state, lr, wd, t,
+                    mode=self._fused_opt)
+                leaf_iter = ((n, fused_w[n], fused_s[n]) for n in params)
+            else:
+                leaf_iter = ((name,) + opt_update(
+                    params[name], gs[name], opt_state.get(name), lr, wd, t)
+                    for name in params)
+            for name, w, s in leaf_iter:
                 w = jnp.where(finite, w, params[name])
                 if s is not None:
                     s = jax.tree_util.tree_map(
@@ -745,7 +775,8 @@ class ShardedTrainer(object):
             _overlap.rules_fingerprint(self.rules),
             str(self.compute_dtype), self.seq_axis, self.remat,
             self.zero1, self.fsdp, self.sentinel, self._donate,
-            self._bucket_grads, sorted(self._cast_exempt),
+            self._bucket_grads, self._fused_opt,
+            sorted(self._cast_exempt),
             _overlap.optimizer_fingerprint(self.optimizer),
             jax.__version__)
 

@@ -39,6 +39,7 @@ def torch_module(module, data, **kwargs):
 class _TorchModule(OperatorProperty):
     param_cls = None
     hint = "torch"
+    host_callback = True    # pure_callback body: analysis/lowering.py lint
     accepts_any_attrs = True
 
     def __init__(self, **attrs):
@@ -130,6 +131,7 @@ def torch_criterion(criterion, data, label, grad_scale=1.0, **kwargs):
 class _TorchCriterion(OperatorProperty):
     param_cls = None
     hint = "torchcrit"
+    host_callback = True    # pure_callback body: analysis/lowering.py lint
     accepts_any_attrs = True
 
     def __init__(self, **attrs):

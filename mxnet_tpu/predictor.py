@@ -58,9 +58,16 @@ class Predictor(object):
         prefixed names, the save_checkpoint format) or a plain dict
     input_shapes : dict name -> shape
     ctx : Context (default cpu; pass mx.tpu() for the chip)
+    quantize : None | "int8" | "fp8_e4m3" — weight-only quantization:
+        rewrite matched FullyConnected nodes to QuantizedDense
+        (kernels/quantize.py) and quantize the corresponding params.
+        Defaults to the MXTPU_QUANTIZE env var; idempotent when handed
+        an already-quantized symbol/params pair (the GenerationEngine
+        quantizes params once and every bucket Predictor reuses them).
     """
 
-    def __init__(self, symbol_json, param_file, input_shapes, ctx=None):
+    def __init__(self, symbol_json, param_file, input_shapes, ctx=None,
+                 quantize=None):
         import os
         # compilation rides the PR-8 caches: the cross-symbol program
         # registry (executor._PROGRAM_REGISTRY, graph-hash keyed) makes
@@ -93,6 +100,18 @@ class Predictor(object):
             else:
                 arg_params[k] = v
 
+        if quantize is None:
+            quantize = os.environ.get("MXTPU_QUANTIZE", "") or None
+        self._quantize = quantize
+        if quantize:
+            from .kernels import quantize as _q
+            qjs, qnames = _q.quantize_symbol(self.symbol.tojson(),
+                                             qdtype=quantize)
+            if qnames:
+                self.symbol = sym.load_json(qjs)
+                arg_params = _q.quantize_params(arg_params, qnames,
+                                                qdtype=quantize)
+
         self._input_names = list(input_shapes)
         arg_names = self.symbol.list_arguments()
         # args in neither inputs nor params (a loss head's label slot)
@@ -111,7 +130,11 @@ class Predictor(object):
             if name in input_shapes:
                 args[name] = nd.zeros(input_shapes[name])
             elif name in arg_params:
-                args[name] = arg_params[name]
+                v = arg_params[name]
+                # plain-numpy dicts are allowed: wrap so the executor's
+                # .data access yields a jax array (np.ndarray.data is a
+                # memoryview), preserving dtype (int8/fp8 for quantized)
+                args[name] = v if isinstance(v, nd.NDArray) else nd.array(v)
             elif inferred.get(name) is not None:
                 args[name] = nd.zeros(inferred[name])
             else:

@@ -513,6 +513,7 @@ class ContinuousBatcher(object):
             kv_occupancy=tel.get("kv_occupancy"),
             ttft_ms=tel.get("ttft_ms") or None,
             itl_ms=tel.get("itl_ms") or None,
+            dtype=tel.get("dtype"), kernel=tel.get("kernel"),
             trace_ids=[r.trace_id for r in batch.requests
                        if r.trace_id] or None)
 

@@ -116,7 +116,7 @@ class TokenStream(object):
 class _SeqState(object):
     __slots__ = ("seq_id", "tokens", "n_prompt", "max_new", "eos_id",
                  "table_row", "n_generated", "started", "done",
-                 "finish_reason")
+                 "finish_reason", "logits")
 
     def __init__(self, seq_id, prompt, max_new, eos_id, table_row):
         self.seq_id = seq_id
@@ -129,6 +129,7 @@ class _SeqState(object):
         self.started = False        # prefill landed
         self.done = False
         self.finish_reason = None
+        self.logits = []            # per-step rows when collect_logits
 
     def record(self, token):
         """Append one generated token; returns True when the sequence
@@ -162,9 +163,18 @@ class GenerationEngine(object):
                  decode_histogram=None, max_new_tokens=None,
                  kv_blocks=None, kv_block_size=None,
                  cache_dtype="float32", compute_dtype="float32",
-                 max_buckets=None, ctx=None, mesh=None, tp_axis="tp"):
+                 max_buckets=None, ctx=None, mesh=None, tp_axis="tp",
+                 quantize=None):
+        import os
         from ..predictor import Predictor
         from ..models import transformer as _tf
+        if quantize is None:
+            quantize = os.environ.get("MXTPU_QUANTIZE", "") or None
+        self.quantize = quantize
+        #: what mxtop/parse_log surface: the dtype tokens are computed at
+        self.serving_dtype = quantize or compute_dtype
+        self.collect_logits = False   # per-step logits on _SeqState
+        self.last_logits = []         # filled by generate() when set
         self.vocab_size = int(vocab_size)
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
@@ -233,6 +243,16 @@ class GenerationEngine(object):
         kw = dict(vocab_size=vocab_size, num_layers=num_layers,
                   num_heads=num_heads, dim=dim, max_seq_len=max_seq_len,
                   ffn_mult=ffn_mult)
+        dec_json = _tf.get_decode_symbol(**kw).tojson()
+        if quantize:
+            # quantize params ONCE up front (the quantizable weight set
+            # is architecture-wide, identical across prefill buckets and
+            # decode); every bucket Predictor then re-runs the symbol
+            # rewrite but finds the params already in storage dtype —
+            # quantize_params is idempotent, so no per-bucket requant
+            from ..kernels import quantize as _q
+            qnames = _q.quantizable_weights(dec_json)
+            params = _q.quantize_params(params, qnames, qdtype=quantize)
         self._prefill = {}
         for S in self.prompt_buckets:
             shapes = dict({"data": (1, S), "pos_ids": (1, S),
@@ -240,14 +260,14 @@ class GenerationEngine(object):
                           **cache_shapes)
             self._prefill[S] = Predictor(
                 _tf.get_prefill_symbol(S, **kw).tojson(), params, shapes,
-                ctx=ctx)
+                ctx=ctx, quantize=quantize)
         self._decode = {}
-        dec_json = _tf.get_decode_symbol(**kw).tojson()
         for B in self.decode_buckets:
             shapes = dict({"data": (B, 1), "pos_ids": (B, 1),
                            "seq_pos": (B,), "block_table": (B, mb)},
                           **cache_shapes)
-            self._decode[B] = Predictor(dec_json, params, shapes, ctx=ctx)
+            self._decode[B] = Predictor(dec_json, params, shapes, ctx=ctx,
+                                        quantize=quantize)
 
         self._lock = threading.Lock()
         self._seqs = {}
@@ -360,6 +380,8 @@ class GenerationEngine(object):
         state = self.state(seq_id)
         logits = _np.asarray(outs[0])           # (S, vocab)
         tok = int(_np.argmax(logits[state.n_prompt - 1]))
+        if self.collect_logits:
+            state.logits.append(logits[state.n_prompt - 1].copy())
         self._install(outs)
         state.started = True
         done = state.record(tok)
@@ -399,6 +421,8 @@ class GenerationEngine(object):
         for b, sid in enumerate(seq_ids):
             state = self.state(sid)
             tok = int(_np.argmax(logits[b]))
+            if self.collect_logits:
+                state.logits.append(logits[b].copy())
             done = state.record(tok)
             results.append((sid, tok, done))
         with self._lock:
@@ -469,18 +493,33 @@ class GenerationEngine(object):
                 pred, inputs, bucket = self.start_decode(chunk)
                 self.finish_decode(chunk, self.run_async(pred, inputs))
         finally:
+            logits_out = {}
             for sid in ids:
                 state = self.release(sid)
                 if state is not None:
                     results[sid] = state.generated()
+                    logits_out[sid] = state.logits
+            if self.collect_logits:
+                #: one (n_generated, vocab) row list per prompt, aligned
+                #: with the returned token lists — the equivalence gate's
+                #: raw material (tests + serve_bench --check-logits)
+                self.last_logits = [logits_out.get(sid, []) for sid in ids]
         return [results.get(sid, []) for sid in ids]
 
     # -- introspection -----------------------------------------------------
+
+    def kernel_path(self):
+        """Which decode-attention path steps take right now (env-driven,
+        so evaluated per call): ``flash_decode`` or ``gather``."""
+        from ..kernels.flash_decode import flash_decode_enabled
+        return "flash_decode" if flash_decode_enabled() else "gather"
 
     def stats(self):
         s = self.cache.stats()
         s["prompt_buckets"] = list(self.prompt_buckets)
         s["decode_buckets"] = list(self.decode_buckets)
+        s["serving_dtype"] = self.serving_dtype
+        s["kernel_path"] = self.kernel_path()
         with self._lock:
             s["seqs_known"] = len(self._seqs)
             s["tokens_generated"] = self._tokens_out
@@ -625,6 +664,8 @@ class GenerativeEntry(object):
         kv = self.engine.cache.stats()
         tel["kv_occupancy"] = kv["occupancy"]
         tel["kv_blocks_used"] = kv["blocks_used"]
+        tel["dtype"] = self.engine.serving_dtype
+        tel["kernel"] = self.engine.kernel_path()
         tel["unpack_ms"] = (time.perf_counter() - t1) * 1e3
         return tel
 

@@ -33,7 +33,7 @@ def emit_batch(model, bucket, n_requests, n_samples, occupancy,
                padding_waste, queue_depth, queue_wait_ms, pack_ms,
                device_ms, unpack_ms, lat_ms, trace_ids=None,
                phase=None, tokens=None, kv_occupancy=None,
-               ttft_ms=None, itl_ms=None):
+               ttft_ms=None, itl_ms=None, dtype=None, kernel=None):
     """Emit one ``serve`` record for a completed batch (no-op when
     telemetry is off, like every emit in the tree).  ``trace_ids``:
     the per-request trace ids of the batch's members when request
@@ -57,6 +57,10 @@ def emit_batch(model, bucket, n_requests, n_samples, occupancy,
             extra["ttft_ms"] = [_r(v) for v in ttft_ms]
         if itl_ms:
             extra["itl_ms"] = [_r(v) for v in itl_ms]
+        if dtype is not None:
+            extra["dtype"] = str(dtype)      # serving compute dtype
+        if kernel is not None:
+            extra["kernel"] = str(kernel)    # decode-attention path
     events.emit(
         "serve", model=model, bucket=int(bucket),
         n_requests=int(n_requests), n_samples=int(n_samples),
@@ -108,6 +112,10 @@ def serve_report(records):
             m["phases"][rec["phase"]] = \
                 m["phases"].get(rec["phase"], 0) + 1
             m["tokens"] += int(rec.get("tokens") or 0)
+            if rec.get("dtype"):
+                m["dtype"] = rec["dtype"]          # last-seen wins
+            if rec.get("kernel"):
+                m["kernel_path"] = rec["kernel"]
             if rec.get("kv_occupancy") is not None:
                 m["_kv"].append(float(rec["kv_occupancy"]))
             m["_ttft"].extend(float(v)
@@ -147,6 +155,10 @@ def serve_report(records):
             out["phases"] = dict(sorted(m["phases"].items()))
             out["tokens"] = m["tokens"]
             out["kv_occupancy"] = _mean(m["_kv"])
+            if m.get("dtype"):
+                out["dtype"] = m["dtype"]
+            if m.get("kernel_path"):
+                out["kernel_path"] = m["kernel_path"]
             for key, name in (("_ttft", "ttft_ms"), ("_itl", "itl_ms")):
                 vals = m[key]
                 if vals:

@@ -107,6 +107,28 @@ for dt in ("float32", "bfloat16", "int8"):
     assert not bad, (dt, bad)
 print("paged_kv_cache MXL-K sweep OK (f32/bf16/int8)")
 '
+    # the quantized + fused kernel tier (docs/perf.md "Quantization &
+    # fused kernels"): all three Pallas specs — dequant matmul, flash
+    # decode, fused optimizer sweep — must stay Mosaic tile-legal at
+    # every compute dtype they serve
+    JAX_PLATFORMS=cpu python -c '
+from mxnet_tpu.analysis.tiling import spec_findings
+from mxnet_tpu.kernels.flash_decode import flash_decode_kernel_spec
+from mxnet_tpu.kernels.fused_opt import fused_opt_kernel_spec
+from mxnet_tpu.kernels.quantize import qmm_kernel_spec
+for mk in (qmm_kernel_spec, flash_decode_kernel_spec,
+           fused_opt_kernel_spec):
+    for dt in ("float32", "bfloat16", "int8"):
+        spec = mk(dtype=dt)
+        bad = [f for f in spec_findings(spec) if f[1] == "error"]
+        assert not bad, (spec["name"], bad)
+print("kernel-tier MXL-K sweep OK "
+      "(qmm/flash_decode/fused_opt x f32/bf16/int8)")
+'
+    # ...and the kernel tier itself (env-gated dispatch, bucket plans)
+    # must stay divergence-clean under the MXL-D self-lint
+    JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+      mxnet_tpu/kernels --fail-on=error --format=github
     # the tracing tier touches every collective seam (rank-uniform seq
     # counters, the flight ledger, the SLO sentry's emit path) — its
     # three modules must stay divergence-clean under MXL-D
@@ -287,7 +309,7 @@ print("mxtop overlap_ratio %.3f OK" % ratio)
     # the serial batch-1 Predictor >= 3x at bounded p95 with zero
     # lowerings after warmup (all asserted inside the drill)
     JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
-      tests/test_kvcache.py tests/test_generate.py -q
+      tests/test_kvcache.py tests/test_generate.py tests/test_kernels.py -q
     JAX_PLATFORMS=cpu python tests/nightly/serve_load.py
     # generative acceptance drill (docs/serving.md "Generation"):
     # decode == full forward, zero lowerings, structured 429 under KV
@@ -333,6 +355,23 @@ assert rep["ttft_ms"]["p95"] is not None, rep
 assert rep["itl_ms"]["p95"] is not None, rep
 print("serve_bench --generate smoke OK: %.0f tok/s, ttft p95 %.2f ms"
       % (rep["value"], rep["ttft_ms"]["p95"]))
+'
+    # quantized serving smoke (docs/perf.md "Quantization & fused
+    # kernels"): int8 weight-only generation must keep the AOT contract
+    # (zero steady-state lowerings) AND pass the logits-equivalence
+    # gate — per-step cosine >= 0.999 vs the f32 reference, enforced
+    # both by serve_bench itself (exit 1) and re-asserted here
+    JAX_PLATFORMS=cpu python tools/serve_bench.py --generate \
+      --quantize int8 --check-logits --requests 24 --max-new 6 \
+      | python -c '
+import json, sys
+rep = json.loads(sys.stdin.readlines()[-1])
+assert rep["lowerings_after_warmup"] == 0, rep
+assert rep["errors"] == 0, rep
+assert rep["quantize"] == "int8" and rep["serving_dtype"] == "int8", rep
+assert rep["logits_cosine_min"] >= 0.999, rep
+print("quantized serve_bench smoke OK: %.0f tok/s at int8, "
+      "logits cosine %.5f" % (rep["value"], rep["logits_cosine_min"]))
 '
     ;;
   *)

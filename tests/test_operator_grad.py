@@ -385,6 +385,12 @@ EXEMPT = {
         "graphs are inference-only); forward equivalence against the "
         "trainable attention path is pinned by tests/test_generate.py::"
         "test_decode_matches_full_forward",
+    "QuantizedDense":
+        "inference-only weight-quantized FullyConnected (quantize_symbol "
+        "rewrites predict/serve graphs, never training graphs — training "
+        "keeps f32 FullyConnected); forward equivalence vs the f32 path "
+        "is pinned by tests/test_kernels.py::"
+        "test_predictor_quantized_cosine",
 }
 
 

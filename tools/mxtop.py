@@ -142,20 +142,23 @@ def render_serve(report, stream=sys.stdout):
     if gen:
         # generative models: the token view under the request view
         w("generation:\n")
-        w("%-12s %8s %10s %10s %10s %10s %10s  %s\n" % (
+        w("%-12s %8s %10s %10s %10s %10s %10s %7s %12s  %s\n" % (
             "model", "tokens", "tok/s", "ttft p50", "ttft p95",
-            "itl p95", "kv occ", "prefill/decode batches"))
+            "itl p95", "kv occ", "dtype", "kernel",
+            "prefill/decode batches"))
         for name, m in sorted(gen.items()):
             ttft = m.get("ttft_ms") or {}
             itl = m.get("itl_ms") or {}
             phases = m.get("phases") or {}
-            w("%-12s %8s %10s %10s %10s %10s %10s  %s/%s\n" % (
+            w("%-12s %8s %10s %10s %10s %10s %10s %7s %12s  %s/%s\n" % (
                 name, m.get("tokens", 0),
                 _fmt(m.get("tokens_per_sec"), width=10).strip(),
                 _fmt(ttft.get("p50"), width=10).strip(),
                 _fmt(ttft.get("p95"), width=10).strip(),
                 _fmt(itl.get("p95"), width=10).strip(),
                 _fmt(m.get("kv_occupancy"), width=10).strip(),
+                m.get("dtype") or "-",
+                m.get("kernel_path") or "-",
                 phases.get("prefill", 0), phases.get("decode", 0)))
 
 

@@ -150,6 +150,17 @@ def parse_telemetry(path):
                   if m.get("kv_occupancy") is not None]
             if kv:
                 overlap_cols["serve-kv-occupancy"] = sum(kv) / len(kv)
+            # serving compute dtype + decode-attention kernel path
+            # (docs/perf.md "Quantization & fused kernels"): string
+            # columns, comma-joined when models disagree
+            dts = sorted({m["dtype"] for m in models.values()
+                          if m.get("dtype")})
+            if dts:
+                overlap_cols["serve-dtype"] = ",".join(dts)
+            kps = sorted({m["kernel_path"] for m in models.values()
+                          if m.get("kernel_path")})
+            if kps:
+                overlap_cols["serve-kernel"] = ",".join(kps)
     except Exception:
         pass
     if not acc and any(c.startswith("serve-") for c in overlap_cols):
@@ -193,8 +204,12 @@ def main():
         print("|" + "|".join("---" for _ in header) + "|")
     else:
         print(sep.join(header))
+    def _fmt(v):
+        # serve-dtype / serve-kernel are strings; everything else numeric
+        return v if isinstance(v, str) else "%g" % v
+
     for ep in sorted(rows):
-        vals = [str(ep)] + ["%g" % rows[ep].get(c, float("nan"))
+        vals = [str(ep)] + [_fmt(rows[ep].get(c, float("nan")))
                             for c in cols]
         line = sep.join(vals)
         print("| " + line + " |" if args.format == "markdown" else line)

@@ -159,6 +159,45 @@ def build_lm(args):
     return params
 
 
+def check_logits(args, params):
+    """Equivalence gate (--check-logits): greedy-decode a small mixed
+    prompt set twice — f32 reference vs the --quantize dtype — through
+    standalone engines with per-step logits collection on, and return
+    the minimum per-step cosine similarity.  docs/perf.md sets the bar
+    at >= 0.999; the caller fails the bench below it."""
+    import numpy as np
+    from mxnet_tpu.serving.generate import GenerationEngine
+
+    kw = dict(vocab_size=args.vocab, num_layers=args.layers,
+              num_heads=args.heads, dim=args.dim,
+              max_seq_len=args.max_seq_len, max_new_tokens=args.max_new,
+              prompt_buckets=args.prompt_buckets,
+              prompt_histogram=(None if args.prompt_buckets
+                                else args.prompt_sizes),
+              decode_buckets=args.decode_buckets,
+              kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size)
+    lengths = sorted(set(sample_sizes(args.prompt_sizes, 8, args.seed)))
+    rng = np.random.RandomState(args.seed)
+    prompts = [rng.randint(1, args.vocab, size=n).tolist()
+               for n in lengths]
+    per_engine = []
+    for quantize in ("", args.quantize):   # "" forces f32 even with env
+        eng = GenerationEngine(params=dict(params), quantize=quantize,
+                               **kw)
+        eng.collect_logits = True
+        eng.generate(prompts)
+        per_engine.append(eng.last_logits)
+    worst = 1.0
+    for ref_rows, q_rows in zip(*per_engine):
+        for a, b in zip(ref_rows, q_rows):
+            a = np.asarray(a, dtype=np.float64).ravel()
+            b = np.asarray(b, dtype=np.float64).ravel()
+            denom = float(np.linalg.norm(a) * np.linalg.norm(b))
+            cos = float(np.dot(a, b)) / denom if denom else 1.0
+            worst = min(worst, cos)
+    return worst
+
+
 def run_generate(args):
     """Closed-loop generative drill; prints the tokens/sec BENCH line."""
     import numpy as np
@@ -166,12 +205,18 @@ def run_generate(args):
     from mxnet_tpu.serving import ModelServer, ServerBusy
 
     params = build_lm(args)
+    logits_cos = None
+    if args.check_logits:
+        if not args.quantize:
+            print("--check-logits requires --quantize", file=sys.stderr)
+            return 2
+        logits_cos = check_logits(args, params)
     srv = ModelServer(max_delay_ms=args.max_delay_ms,
                       max_queue=args.max_queue)
     engine = srv.add_generative_model(
         "lm", params, vocab_size=args.vocab, num_layers=args.layers,
         num_heads=args.heads, dim=args.dim, max_seq_len=args.max_seq_len,
-        max_new_tokens=args.max_new,
+        max_new_tokens=args.max_new, quantize=args.quantize,
         prompt_buckets=args.prompt_buckets,
         prompt_histogram=None if args.prompt_buckets else args.prompt_sizes,
         decode_buckets=args.decode_buckets,
@@ -267,11 +312,22 @@ def run_generate(args):
         "kv_block_size": kv["block_size"],
         "batches": stats.get("batches"),
         "lowerings_after_warmup": lowerings_after,
+        "quantize": args.quantize or None,
+        "serving_dtype": engine.serving_dtype,
+        "kernel_path": engine.kernel_path(),
     }
+    if logits_cos is not None:
+        out["logits_cosine_min"] = round(logits_cos, 7)
     if errors:
         out["first_error"] = repr(errors[0])
     print(json.dumps(out, default=str))
-    return 1 if errors else 0
+    if errors:
+        return 1
+    if logits_cos is not None and logits_cos < 0.999:
+        print("logits equivalence gate FAILED: min cosine %.7f < 0.999"
+              % logits_cos, file=sys.stderr)
+        return 1
+    return 0
 
 
 def main(argv=None):
@@ -311,6 +367,13 @@ def main(argv=None):
                      help='explicit decode batch buckets "1,2,4,8"')
     gen.add_argument("--max-new", type=int, default=16,
                      help="tokens generated per request")
+    gen.add_argument("--quantize", default=None,
+                     help='weight-only quantization dtype ("int8" or '
+                          '"fp8_e4m3"; default: MXTPU_QUANTIZE env)')
+    gen.add_argument("--check-logits", action="store_true",
+                     help="before the timed run, greedy-decode a probe "
+                          "prompt set at f32 and at --quantize and fail "
+                          "unless per-step logits cosine >= 0.999")
     gen.add_argument("--kv-blocks", type=int, default=None)
     gen.add_argument("--kv-block-size", type=int, default=None)
     gen.add_argument("--vocab", type=int, default=128)
