@@ -431,23 +431,7 @@ class KVStore(object):
             return []
         ranks = [node_id] if node_id is not None \
             else range(self.num_workers)
-        try:
-            entries = dict(client.key_value_dir_get(_HB_PREFIX))
-        except Exception:
-            return sorted(ranks)
-        now = _now()
-        dead = []
-        for r in ranks:
-            stamp = entries.get("%s%d" % (_HB_PREFIX, r))
-            if stamp is None:
-                # no stamp yet: dead only once the peer has had longer
-                # than `timeout` since this store came up to write one
-                # (avoids a startup race counting slow starters as dead)
-                if now - self._created > timeout:
-                    dead.append(r)
-            elif now - float(stamp) > timeout:
-                dead.append(r)
-        return sorted(dead)
+        return scan_dead_ranks(client, ranks, self._created, timeout)
 
     def num_dead_nodes(self, node_id=None, timeout=None):
         """Count of stale workers (parity:
@@ -530,6 +514,34 @@ def _states_from_host(states):
 
 _HB_PREFIX = "mxtpu_hb/"
 _HB_INTERVAL = 2.0
+
+
+def scan_dead_ranks(client, ranks, created, timeout, prefix=_HB_PREFIX):
+    """Sorted members of ``ranks`` whose ``<prefix><rank>`` heartbeat
+    stamp is stale or missing — the liveness scan shared by
+    :meth:`KVStore.dead_nodes` (jax coordination client) and the fleet
+    serving router (:class:`mxnet_tpu.serving.fleet.FileKV`).  ``client``
+    is anything with ``key_value_dir_get``; ``created`` is the scanner's
+    own start time (missing stamps only count as dead once the peer has
+    had ``timeout`` seconds since then to write one — the startup-grace
+    rule).  An unreachable KV reports every rank dead: the coordination
+    plane itself is gone and restart watchdogs must fire rather than
+    read a healthy empty list."""
+    try:
+        entries = dict(client.key_value_dir_get(prefix))
+    except Exception:
+        return sorted(ranks)
+    now = _now()
+    dead = []
+    for r in ranks:
+        stamp = entries.get("%s%d" % (prefix, r))
+        if stamp is None:
+            if now - created > timeout:
+                dead.append(r)
+        elif now - float(stamp) > timeout:
+            dead.append(r)
+    return sorted(dead)
+
 
 _CSUM_CACHE = {}
 
@@ -703,22 +715,29 @@ def _dist_client():
 _HB_STATE = {"thread": None, "stop": None}
 
 
-def _start_heartbeat():
+def _start_heartbeat(client=None, rank=None):
     """Background liveness stamping for num_dead_nodes (ps-lite heartbeat
     analog).  Idempotent per process; the thread is a daemon AND is
     stopped via atexit, so interpreter shutdown can neither hang joining
-    it nor race it against a torn-down coordination client."""
+    it nor race it against a torn-down coordination client.
+
+    ``client``/``rank`` default to the jax coordination service and
+    ``jax.process_index()``; fleet serving replicas inject their own
+    file-backed KV client and replica index so the SAME stamping/scan
+    machinery tracks replica liveness without a jax.distributed pod."""
     t = _HB_STATE["thread"]
     if t is not None and t.is_alive():
         return
-    client = _dist_client()
+    if client is None:
+        client = _dist_client()
     if client is None:
         return
     import atexit
     import threading
     import time as _time
-    rank = jax.process_index()
-    key = "%s%d" % (_HB_PREFIX, rank)
+    if rank is None:
+        rank = jax.process_index()
+    key = "%s%d" % (_HB_PREFIX, int(rank))
     stop = threading.Event()
 
     def _beat():

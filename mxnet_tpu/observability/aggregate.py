@@ -419,6 +419,16 @@ def build_report(records, now=None):
         sv = None
     if sv and sv.get("models"):
         out["serve"] = sv
+    # fleet rollup (docs/serving.md "Fleet"): per-replica qps/p95/
+    # occupancy/param-version + fleet-wide straggler gap and version
+    # skew, from the replica-stamped serve records
+    try:
+        from ..serving.telemetry import fleet_report
+        fl = fleet_report(records)
+    except Exception:
+        fl = None
+    if fl and fl.get("replicas"):
+        out["fleet"] = fl
     return out
 
 

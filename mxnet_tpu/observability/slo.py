@@ -62,6 +62,11 @@ DIRECTIONS = {
     "overlap_ratio": "down",
     "mfu": "down",
     "allreduce_gbps": "down",
+    # fleet serving (serve_bench --fleet / docs/serving.md "Fleet")
+    "fleet_rps": "down",
+    "fleet_balance_ratio": "up",
+    "fleet_swap_pause_ms_p95": "up",
+    "fleet_straggler_gap_ms": "up",
 }
 
 #: default regression floor (relative) and noise multiplier
@@ -96,6 +101,17 @@ def _bench_metrics(parsed):
             p95 = (parsed.get(src) or {}).get("p95")
             if p95 is not None:
                 out[dst] = float(p95)
+    if parsed.get("value") is not None \
+            and parsed.get("metric") == "fleet_throughput_rps":
+        # serve_bench --fleet BENCH line: fleet throughput plus the
+        # two health numbers the fleet story gates on — dispatch
+        # balance (1.0 = even) and the hot-swap rotation pause
+        out["fleet_rps"] = float(parsed["value"])
+        if parsed.get("balance_ratio") is not None:
+            out["fleet_balance_ratio"] = float(parsed["balance_ratio"])
+        if parsed.get("swap_pause_ms_p95") is not None:
+            out["fleet_swap_pause_ms_p95"] = \
+                float(parsed["swap_pause_ms_p95"])
     return out
 
 
@@ -161,6 +177,12 @@ def telemetry_metrics(report):
         p95 = (total.get(src) or {}).get("p95")
         if p95 is not None:
             out[dst] = float(p95)
+    fleet = report.get("fleet") or {}
+    if fleet.get("straggler_gap_ms") is not None:
+        out["fleet_straggler_gap_ms"] = \
+            float(fleet["straggler_gap_ms"])
+    if fleet.get("balance_ratio") is not None:
+        out["fleet_balance_ratio"] = float(fleet["balance_ratio"])
     return out
 
 

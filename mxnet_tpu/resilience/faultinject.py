@@ -25,6 +25,14 @@ on demand at the seams the runtime already passes through:
 - ``buddy_loss`` — hot-state snapshot, before the ring-buddy replica
   writes (kind ``buddy_loss``: skip them, simulating a lost replica
   push; a later host loss then has no redundant copy to serve)
+- ``replica_death`` — fleet serving replica, request path (kind
+  ``replica_death``: returned to the replica wrapper, which hard-kills
+  its own process mid-request — the router must fail over, never hang
+  the client's future)
+- ``swap_install`` — live weight hot-swap, between building the new
+  per-bucket Predictors and installing them (kind ``swap_crash``:
+  raise :class:`InjectedFault`; the old param version must keep
+  serving — a failed swap is a no-op, not an outage)
 
 Faults are described by ``MXTPU_FAULT_SPEC``, a ``;``-separated list
 of ``:``-separated ``key=value`` clauses (docs/resilience.md):
@@ -57,6 +65,8 @@ KIND_SEAMS = {
     "snapshot_crash": "host_snapshot",
     "corrupt": "handoff_read",
     "buddy_loss": "buddy_loss",
+    "replica_death": "replica_death",
+    "swap_crash": "swap_install",
 }
 
 _KNOWN_KINDS = frozenset(KIND_SEAMS)
@@ -193,12 +203,13 @@ def _current_rank():
 def maybe_fault(seam, step=None, rank=None):
     """Fire a matching fault at this seam, if any.
 
-    Side effects by kind: ``ckpt_crash``/``crash``/``snapshot_crash``
-    raise :class:`InjectedFault`; ``hang``/``slow`` sleep (``seconds``,
-    defaulting to 3600 for hang / 1 for slow).  Kinds the caller must
-    act on itself (``nan``, ``dead_node``, ``corrupt``, ``buddy_loss``)
-    are returned.  Returns the spec that fired, or None.  Near-zero
-    cost when no spec is set.
+    Side effects by kind: ``ckpt_crash``/``crash``/``snapshot_crash``/
+    ``swap_crash`` raise :class:`InjectedFault`; ``hang``/``slow``
+    sleep (``seconds``, defaulting to 3600 for hang / 1 for slow).
+    Kinds the caller must act on itself (``nan``, ``dead_node``,
+    ``corrupt``, ``buddy_loss``, ``replica_death``) are returned.
+    Returns the spec that fired, or None.  Near-zero cost when no spec
+    is set.
     """
     inj = injector()
     if inj is None:
@@ -208,7 +219,8 @@ def maybe_fault(seam, step=None, rank=None):
     spec = inj.match(seam, step=step, rank=rank)
     if spec is None:
         return None
-    if spec.kind in ("ckpt_crash", "crash", "snapshot_crash"):
+    if spec.kind in ("ckpt_crash", "crash", "snapshot_crash",
+                     "swap_crash"):
         raise InjectedFault(
             "injected %s at seam=%s step=%s" % (spec.kind, seam, step))
     if spec.kind in ("hang", "slow"):

@@ -15,9 +15,18 @@ prefill/decode programs and iteration-level decode batching
 (:mod:`.generate`), opened through
 :meth:`ModelServer.add_generative_model` / :meth:`ModelServer.generate`.
 
+Fleet serving (docs/serving.md "Fleet") scales past one process: a
+:class:`FleetRouter` spawns N replica processes (each its own
+ModelServer + AOT bucket set), routes least-loaded with aggregate
+admission control, tracks replica health through the kvstore heartbeat
+machinery, re-meshes on death via the elastic generation ledger, and
+hot-swaps weight versions replica-by-replica without drain
+(:meth:`ModelServer.swap_params` through the program registry — zero
+new lowerings).
+
 Entry points: :class:`ModelServer` (in-process), ``tools/mxserve.py``
-(HTTP), ``tools/serve_bench.py`` (load generator),
-``mxtop --serve`` (telemetry view).
+(HTTP), ``tools/mxfleet.py`` (multi-replica), ``tools/serve_bench.py``
+(load generator), ``mxtop --serve`` (telemetry view).
 """
 from __future__ import annotations
 
@@ -29,7 +38,10 @@ from .kvcache import CacheExhausted, KVCacheConfig, PagedKVCache
 from .generate import (GenerationEngine, GenerativeEntry, TokenStream,
                        generation_mats)
 from .server import ModelServer, checkpoint_files
-from .telemetry import emit_batch, serve_report
+from .telemetry import (emit_batch, serve_report, fleet_report,
+                        set_fleet_context)
+from .fleet import (FileKV, FleetRouter, HTTPReplicaClient,
+                    ReplicaDead, launch_fleet, run_replica)
 
 __all__ = [
     "BucketPlan", "bucket_for", "model_matmul_dims", "parse_buckets",
@@ -40,5 +52,7 @@ __all__ = [
     "GenerationEngine", "GenerativeEntry", "TokenStream",
     "generation_mats",
     "ModelServer", "checkpoint_files",
-    "emit_batch", "serve_report",
+    "emit_batch", "serve_report", "fleet_report", "set_fleet_context",
+    "FileKV", "FleetRouter", "HTTPReplicaClient", "ReplicaDead",
+    "launch_fleet", "run_replica",
 ]

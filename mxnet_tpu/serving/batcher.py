@@ -152,7 +152,7 @@ class Request(object):
     __slots__ = ("model", "payload", "n", "t_arrival", "future",
                  "t_dispatch", "t_done", "trace_id")
 
-    def __init__(self, model, payload, n):
+    def __init__(self, model, payload, n, trace_id=None):
         self.model = model
         self.payload = payload
         self.n = int(n)
@@ -160,7 +160,11 @@ class Request(object):
         self.future = Future()
         self.t_dispatch = None
         self.t_done = None
-        self.trace_id = _trace.new_id() if _trace.enabled() else None
+        # an explicit id wins: the fleet router mints the id at ITS
+        # admission edge and threads it through so the replica's batch
+        # record joins the router's span in one trace
+        self.trace_id = trace_id or (_trace.new_id() if _trace.enabled()
+                                     else None)
 
 
 class _Batch(object):
@@ -232,10 +236,12 @@ class ContinuousBatcher(object):
         with self._lock:
             return sum(len(q) for q in self._pending.values())
 
-    def submit(self, model, payload, n=1):
+    def submit(self, model, payload, n=1, trace_id=None):
         """Admit one request (``n`` samples) and return its Future.
         Raises :class:`ServerBusy` on backpressure, MXNetError for an
-        unknown model or an inadmissible sample count."""
+        unknown model or an inadmissible sample count.  ``trace_id``:
+        adopt a caller-minted trace id (the fleet router's) instead of
+        minting one here."""
         with self._cv:
             entry = self._entries.get(model)
             if entry is None:
@@ -252,7 +258,7 @@ class ContinuousBatcher(object):
                 self._stats["rejected"] += 1
                 raise ServerBusy(model, depth, self.max_queue,
                                  retry_after_ms=self.max_delay_ms)
-            req = Request(model, payload, n)
+            req = Request(model, payload, n, trace_id=trace_id)
             self._pending[model].append(req)
             if self._thread is None:
                 self._thread = threading.Thread(

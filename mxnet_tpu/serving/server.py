@@ -147,6 +147,8 @@ class ModelServer(object):
                                           max_queue_=max_queue)
         self._entries = {}
         self._warmup = {}        # model -> registry-counter snapshot
+        self._swap_count = 0
+        self.param_version = "v0"    # bumped by swap_params
 
     # -- model lifecycle ---------------------------------------------------
 
@@ -370,12 +372,80 @@ class ModelServer(object):
             duration_ms=round((_t.monotonic() - t0) * 1000.0, 3))
         return restored
 
+    # -- live weight hot-swap (docs/serving.md "Fleet") --------------------
+
+    def swap_params(self, params, version=None, models=None):
+        """Re-bind served models onto new parameters WITHOUT drain.
+
+        The swap primitive behind ``mxfleet swap``: build a fresh
+        :class:`~mxnet_tpu.predictor.Predictor` per (model, bucket)
+        from ``params`` (a path, bytes, or ``arg:``/``aux:``-prefixed
+        dict — the ``save_checkpoint`` format), then install the new
+        predictor set in one reference swap per model.  Requests in
+        flight finish on the old programs; the next dispatched batch
+        sees the new weights.  Because the symbol and bucket shapes are
+        unchanged, every re-bind resolves through the PR-8 program
+        registry — **zero new lowerings**, asserted here from the
+        registry counters and reported back so the fleet router can
+        enforce the contract per replica.
+
+        A failure anywhere before install — including an injected
+        ``swap_crash`` at the ``swap_install`` seam — leaves the old
+        version serving untouched.  Generative entries are skipped
+        (their engine owns params jointly with live KV state; swap
+        those by replica replacement instead).
+
+        Returns ``{"version", "models", "lowerings", "swap_ms"}``.
+        """
+        from ..predictor import Predictor
+        from ..executor import program_registry_stats
+        from ..resilience.faultinject import maybe_fault
+        t0 = time.perf_counter()
+        wanted = sorted(self._entries) if models is None else list(models)
+        for name in wanted:
+            if name not in self._entries:
+                raise MXNetError("unknown model %r (have: %s)"
+                                 % (name, sorted(self._entries)))
+        names = [m for m in wanted
+                 if not getattr(self._entries[m], "generative", False)]
+        before = program_registry_stats()["lowerings"]
+        staged = {}
+        for name in names:
+            entry = self._entries[name]
+            old = entry.predictors[min(entry.buckets)]
+            symbol_json = old.symbol.tojson()
+            preds = {}
+            for b in entry.buckets:
+                shapes = {nm: (b,) + shape
+                          for nm, shape in entry.input_shapes.items()}
+                preds[b] = Predictor(symbol_json, params, shapes,
+                                     ctx=old._ctx)
+            staged[name] = preds
+        # the crash seam sits between build and install: an injected
+        # swap_crash (or any real failure above) discards the staged
+        # predictors and the old version keeps serving
+        maybe_fault("swap_install")
+        self._swap_count += 1
+        new_version = version if version is not None \
+            else "v%d" % self._swap_count
+        for name, preds in staged.items():
+            # single reference assignment: the batcher's launch stage
+            # reads entry.predictors[bucket] once per batch, so it sees
+            # either the old set or the new set, never a mix
+            self._entries[name].predictors = preds
+        self.param_version = str(new_version)
+        lowerings = program_registry_stats()["lowerings"] - before
+        return {"version": self.param_version, "models": names,
+                "lowerings": lowerings,
+                "swap_ms": round((time.perf_counter() - t0) * 1e3, 3)}
+
     # -- request path ------------------------------------------------------
 
-    def submit(self, model, inputs, n=None):
+    def submit(self, model, inputs, n=None, trace_id=None):
         """Admit one request; returns a Future whose ``result()`` is the
         list of per-output arrays (``n`` rows each).  Raises
-        :class:`~mxnet_tpu.serving.batcher.ServerBusy` on backpressure."""
+        :class:`~mxnet_tpu.serving.batcher.ServerBusy` on backpressure.
+        ``trace_id`` adopts a caller-minted id (the fleet router's)."""
         entry = self._entries.get(model)
         if entry is None:
             raise MXNetError("unknown model %r (have: %s)"
@@ -384,7 +454,8 @@ class ModelServer(object):
             raise MXNetError("model %r is generative; use generate()"
                              % model)
         payload, n = entry.validate(inputs, n)
-        return self._batcher.submit(model, payload, n=n)
+        return self._batcher.submit(model, payload, n=n,
+                                    trace_id=trace_id)
 
     def predict(self, model, inputs, timeout=30.0):
         """Blocking convenience: submit + wait."""
@@ -406,6 +477,7 @@ class ModelServer(object):
         reg = program_registry_stats()
         out = self._batcher.stats()
         out["registry"] = reg
+        out["param_version"] = self.param_version
         out["models"] = {}
         for name, entry in self._entries.items():
             m = {"buckets": list(entry.buckets),

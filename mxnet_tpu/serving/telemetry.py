@@ -16,11 +16,47 @@ mean occupancy and padding waste, phase means, max queue depth.
 """
 from __future__ import annotations
 
+import os as _os
+
 from ..observability import events
 from ..observability.counters import percentile
 from ..observability.phases import SERVE_PHASES
 
-__all__ = ["emit_batch", "serve_report", "SERVE_PHASES"]
+__all__ = ["emit_batch", "serve_report", "fleet_report",
+           "set_fleet_context", "SERVE_PHASES"]
+
+#: fleet identity stamped onto every serve record this process emits:
+#: replica index + the param version it currently serves.  Set by the
+#: replica wrapper (serving.fleet) via :func:`set_fleet_context`; the
+#: replica index falls back to MXTPU_FLEET_REPLICA so even a bare
+#: ModelServer inside a fleet-launched process tags its records.
+_FLEET = {"replica": None, "param_version": None}
+
+
+def set_fleet_context(replica=None, param_version=None):
+    """Stamp subsequent serve records with a replica index and/or param
+    version (pass None to leave a field unchanged)."""
+    if replica is not None:
+        _FLEET["replica"] = int(replica)
+    if param_version is not None:
+        _FLEET["param_version"] = str(param_version)
+
+
+def _fleet_fields():
+    rep = _FLEET["replica"]
+    if rep is None:
+        raw = _os.environ.get("MXTPU_FLEET_REPLICA")
+        if raw:
+            try:
+                rep = int(raw)
+            except ValueError:
+                rep = None
+    if rep is None:
+        return {}
+    out = {"replica": rep}
+    if _FLEET["param_version"] is not None:
+        out["param_version"] = _FLEET["param_version"]
+    return out
 
 #: (accumulator key, record field) per canonical serving phase —
 #: derived from the shared registry (:mod:`..observability.phases`) so
@@ -46,7 +82,7 @@ def emit_batch(model, bucket, n_requests, n_samples, occupancy,
     per-sequence ``ttft_ms``/``itl_ms`` samples that landed in it —
     the raw material for the tokens/sec, TTFT, and inter-token-latency
     columns downstream."""
-    extra = {}
+    extra = dict(_fleet_fields())
     if trace_ids:
         extra["trace_ids"] = list(trace_ids)
     if phase is not None:
@@ -219,3 +255,68 @@ def serve_report(records):
     total["occupancy"] = _mean(occs)
     total["padding_waste"] = _mean(wastes)
     return {"models": models, "total": total}
+
+
+def fleet_report(records):
+    """Per-replica serving rollup from merged event records — the fleet
+    view behind ``mxtop --serve`` and ``aggregate.build_report``.
+
+    Groups ``serve`` records by their ``replica`` stamp (absent on
+    single-process runs → ``{"replicas": {}}``).  Each replica entry
+    carries ``requests``, ``batches``, ``qps`` (over that replica's
+    own wall span), ``latency_ms`` {p50, p95}, ``occupancy``, and
+    ``param_version`` (last seen).  Fleet-wide: ``straggler_gap_ms``
+    (max p95 − median p95 across replicas — the serving analog of the
+    training straggler gap), ``balance_ratio`` (max requests / mean
+    requests; 1.0 = perfectly level), and ``version_skew``
+    {param_version: [replicas]} — more than one key means a swap is in
+    flight or failed partway.
+    """
+    per = {}
+    for rec in records:
+        if rec.get("kind") != "serve" or rec.get("replica") is None:
+            continue
+        r = int(rec["replica"])
+        m = per.setdefault(r, {"requests": 0, "batches": 0, "_lat": [],
+                               "_occ": [], "_walls": [],
+                               "param_version": None})
+        m["requests"] += int(rec.get("n_requests") or 0)
+        m["batches"] += 1
+        m["_lat"].extend(float(v) for v in (rec.get("lat_ms") or ()))
+        if rec.get("occupancy") is not None:
+            m["_occ"].append(float(rec["occupancy"]))
+        if rec.get("wall_ms") is not None:
+            m["_walls"].append(float(rec["wall_ms"]))
+        if rec.get("param_version") is not None:
+            m["param_version"] = str(rec["param_version"])
+    if not per:
+        return {"replicas": {}}
+    replicas, p95s, reqs = {}, [], []
+    skew = {}
+    for r, m in sorted(per.items()):
+        lat = m.pop("_lat")
+        occ = m.pop("_occ")
+        walls = m.pop("_walls")
+        out = {"requests": m["requests"], "batches": m["batches"],
+               "param_version": m["param_version"],
+               "occupancy": _mean(occ)}
+        if lat:
+            out["latency_ms"] = {"p50": _r(percentile(lat, 50)),
+                                 "p95": _r(percentile(lat, 95))}
+            p95s.append(percentile(lat, 95))
+        span = (max(walls) - min(walls)) / 1e3 if len(walls) > 1 else 0.0
+        out["qps"] = round(m["requests"] / span, 2) if span > 0 else None
+        replicas[str(r)] = out
+        reqs.append(m["requests"])
+        skew.setdefault(m["param_version"] or "?", []).append(r)
+    fleet = {"replicas": replicas,
+             "version_skew": {v: sorted(rs)
+                              for v, rs in sorted(skew.items())}}
+    if p95s:
+        fleet["straggler_gap_ms"] = _r(
+            max(p95s) - percentile(p95s, 50))
+    if reqs and sum(reqs):
+        fleet["balance_ratio"] = round(
+            max(reqs) / (sum(reqs) / float(len(reqs))), 3)
+    fleet["requests"] = sum(reqs)
+    return fleet

@@ -87,6 +87,12 @@ case "${TASK:-python}" in
     # its self-lint so the divergence pass always prices it
     JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
       mxnet_tpu/serving --fail-on=error --format=github
+    # the fleet router makes the most divergence-sensitive serving
+    # decisions of all (per-replica dispatch, generation verdicts,
+    # rotation during hot-swap) — pinned on top of the directory sweep
+    # so a sweep-config change can never silently drop it
+    JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+      mxnet_tpu/serving/fleet.py --fail-on=error --format=github
     # generative serving's cache allocator + engine make per-process
     # admission and scheduling decisions (block budgets, prefill/decode
     # alternation) — pinned explicitly on top of the directory sweep so
@@ -311,6 +317,14 @@ print("mxtop overlap_ratio %.3f OK" % ratio)
     JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
       tests/test_kvcache.py tests/test_generate.py tests/test_kernels.py -q
     JAX_PLATFORMS=cpu python tests/nightly/serve_load.py
+    # fleet unit suite + the multi-process fleet drill (docs/serving.md
+    # "Fleet"): 3 real replica processes behind the router; SIGKILL one
+    # and hot-swap weights mid-load — zero client-visible errors, p95
+    # within the degraded-window bound, zero swap lowerings, post-swap
+    # outputs bit-identical, and a generation-stamped replica_death
+    # verdict in the fleet ledger (all asserted inside the drill)
+    JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
+    JAX_PLATFORMS=cpu python tests/nightly/serve_load_fleet.py
     # generative acceptance drill (docs/serving.md "Generation"):
     # decode == full forward, zero lowerings, structured 429 under KV
     # pressure while running decodes finish, bounded p95 TTFT
@@ -355,6 +369,23 @@ assert rep["ttft_ms"]["p95"] is not None, rep
 assert rep["itl_ms"]["p95"] is not None, rep
 print("serve_bench --generate smoke OK: %.0f tok/s, ttft p95 %.2f ms"
       % (rep["value"], rep["ttft_ms"]["p95"]))
+'
+    # fleet bench smoke: the fleet_throughput_rps BENCH line must show
+    # a balanced fleet, an AOT-clean mid-run hot-swap (zero lowerings,
+    # enforced by serve_bench itself via exit 1), and carry the
+    # balance/swap-pause fields the SLO sentry prices
+    JAX_PLATFORMS=cpu python tools/serve_bench.py --fleet 2 \
+      --requests 120 | python -c '
+import json, sys
+rep = json.loads(sys.stdin.readlines()[-1])
+assert rep["metric"] == "fleet_throughput_rps", rep
+assert rep["errors"] == 0, rep
+assert rep["swap_lowerings"] == 0, rep
+assert rep["balance_ratio"] is not None, rep
+assert rep["swap_pause_ms_p95"] is not None, rep
+assert sorted(rep["version_skew"]) == ["v2"], rep
+print("serve_bench --fleet smoke OK: %.0f rps, balance %.2f"
+      % (rep["value"], rep["balance_ratio"]))
 '
     # quantized serving smoke (docs/perf.md "Quantization & fused
     # kernels"): int8 weight-only generation must keep the AOT contract

@@ -1,0 +1,267 @@
+"""Fleet serving drill: replica death + live weight hot-swap under load.
+
+The acceptance run for docs/serving.md "Fleet" (wired as the CI
+multi-process drill in tests/ci/run_test.sh TASK=serving).  Spawns
+REPLICAS real replica processes (each its own ModelServer + AOT bucket
+set, heartbeating into the fleet FileKV) behind an in-process
+FleetRouter, then — under sustained closed-loop load:
+
+1. **Kill a replica** (SIGKILL, no warning) at ~1/3 of the run.  The
+   router must absorb it: transport failures fail over to survivors,
+   the client-visible error count stays ZERO, and the fleet ledger
+   gains a generation-stamped ``replica_death`` shrink verdict whose
+   members exclude the killed index.
+2. **Hot-swap weights** (``router.swap`` to perturbed v2 params) at
+   ~2/3 of the run, WITHOUT drain.  Each surviving replica re-binds
+   through the program registry: the per-replica ``lowerings`` delta
+   must be 0, and the post-run version-skew map must show every
+   survivor on v2.
+3. **p95 SLO gate** — client-observed p95 (HTTP round trip through
+   the router) <= admission window + 2x measured batch time + the
+   closed-loop single-server queueing term: with a kill AND a swap in
+   the window the fleet briefly degrades to ONE ready replica, so the
+   tail request can find every other client queued ahead of it
+   (CONCURRENCY warm round trips, x2 for the contended CI host).
+4. **Bit-identity** — post-swap fleet outputs match a local Predictor
+   over the v2 params exactly (the swap moved WEIGHTS, not numerics).
+
+Prints one JSON line with every figure.  Exit codes: 0 OK, 4 = an
+expectation failed.
+
+Run:  JAX_PLATFORMS=cpu python tests/nightly/serve_load_fleet.py
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx                                  # noqa: E402
+from mxnet_tpu import ndarray as nd                     # noqa: E402
+from mxnet_tpu.resilience import elastic                # noqa: E402
+from mxnet_tpu.serving.fleet import (                   # noqa: E402
+    fleet_ledger_path, launch_fleet)
+
+N_REQUESTS = int(os.environ.get("FLEET_LOAD_REQUESTS", "300"))
+CONCURRENCY = int(os.environ.get("FLEET_LOAD_CONCURRENCY", "12"))
+MAX_DELAY_MS = float(os.environ.get("FLEET_LOAD_MAX_DELAY_MS", "25"))
+REPLICAS = int(os.environ.get("FLEET_LOAD_REPLICAS", "3"))
+BASE_PORT = int(os.environ.get("FLEET_LOAD_BASE_PORT", "8961"))
+KILL_INDEX = REPLICAS - 1
+FEATURES = 64
+BUCKETS = (1, 8)
+
+
+def fail(msg, report):
+    report["failed"] = msg
+    print(json.dumps(report, default=str), flush=True)
+    print("serve_load_fleet FAILED: %s" % msg, file=sys.stderr,
+          flush=True)
+    os._exit(4)
+
+
+def main():
+    net = mx.models.get_mlp(num_classes=10, hidden=(64, 32))
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, FEATURES))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    arg_params, aux_params = mod.get_params()
+    v1 = {"arg:" + k: v for k, v in arg_params.items()}
+    v1.update({"aux:" + k: v for k, v in aux_params.items()})
+    v2 = {k: nd.array(v.asnumpy() * 1.25 + 0.01) for k, v in v1.items()}
+    v2_np = {k: v.asnumpy() for k, v in v2.items()}
+
+    tmp = tempfile.mkdtemp(prefix="fleet_drill_")
+    sym_path = os.path.join(tmp, "net-symbol.json")
+    with open(sym_path, "w") as fout:
+        fout.write(net.tojson())
+    v1_path = os.path.join(tmp, "net-v1.params")
+    nd.save(v1_path, v1)
+    v2_path = os.path.join(tmp, "net-v2.params")
+    nd.save(v2_path, v2)
+    spec_path = os.path.join(tmp, "fleet.json")
+    with open(spec_path, "w") as fout:
+        json.dump({"models": [{
+            "name": "net", "symbol": sym_path, "params": v1_path,
+            "input_shapes": {"data": [FEATURES]},
+            "buckets": list(BUCKETS)}],
+            "version": "v1", "max_delay_ms": MAX_DELAY_MS}, fout)
+
+    # local batch-time reference for the latency bound (same model,
+    # largest bucket, this host)
+    rng = np.random.RandomState(11)
+    xb = rng.rand(max(BUCKETS), FEATURES).astype("float32")
+    ref_pred = mx.Predictor(net.tojson(),
+                            {k: v.asnumpy() for k, v in v1.items()},
+                            {"data": xb.shape})
+    ref_pred.forward(data=xb)
+    times = []
+    for _ in range(20):
+        t = time.perf_counter()
+        ref_pred.forward(data=xb)
+        times.append(time.perf_counter() - t)
+    batch_ms = sorted(times)[len(times) // 2] * 1e3
+
+    # respawn off: the drill asserts the SHRINK verdict is the final
+    # ledger state (a grow verdict would supersede its member list)
+    router = launch_fleet(spec_path, n_replicas=REPLICAS,
+                          directory=os.path.join(tmp, "fleet"),
+                          base_port=BASE_PORT, respawn=False,
+                          startup_timeout_s=300.0)
+    report = {"metric": "fleet_drill", "replicas": REPLICAS,
+              "requests": N_REQUESTS, "concurrency": CONCURRENCY}
+    try:
+        x1 = rng.rand(1, FEATURES).astype("float32")
+        # warm transport + every replica's pipeline (untimed), and
+        # measure the warm single-request round trip
+        rtts = []
+        for _ in range(4 * REPLICAS):
+            t = time.perf_counter()
+            router.predict("net", {"data": x1}, timeout=60.0)
+            rtts.append((time.perf_counter() - t) * 1e3)
+        rtt_ms = sorted(rtts)[len(rtts) // 2]
+
+        kill_at = N_REQUESTS // 3
+        swap_at = (2 * N_REQUESTS) // 3
+        cursor, lock = [0], threading.Lock()
+        errors, lat_ms = [], []
+        killed = threading.Event()
+        swap_result = {}
+        swap_err = []
+
+        def do_kill():
+            rep = router._replicas[KILL_INDEX]
+            rep.proc.kill()        # SIGKILL: no drain, no goodbye
+            killed.set()
+
+        def do_swap():
+            try:
+                swap_result.update(router.swap(v2_path, version="v2"))
+            except Exception as exc:       # pragma: no cover
+                swap_err.append(exc)
+
+        def worker():
+            while True:
+                with lock:
+                    i = cursor[0]
+                    if i >= N_REQUESTS:
+                        return
+                    cursor[0] += 1
+                if i == kill_at:
+                    threading.Thread(target=do_kill,
+                                     daemon=True).start()
+                if i == swap_at:
+                    threading.Thread(target=do_swap,
+                                     daemon=True).start()
+                t = time.perf_counter()
+                try:
+                    out = router.predict("net", {"data": x1},
+                                         timeout=60.0)
+                    assert out[0].shape == (1, 10), out[0].shape
+                except Exception as exc:
+                    errors.append(exc)
+                    return
+                lat_ms.append((time.perf_counter() - t) * 1e3)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(CONCURRENCY)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_s = time.perf_counter() - t0
+
+        # post-swap bit-identity through the fleet
+        fleet_out = router.predict("net", {"data": x1}, timeout=60.0)
+        st = router.stats()
+    finally:
+        router.close(drain=False)
+
+    lat_sorted = sorted(lat_ms)
+    p95 = lat_sorted[int(0.95 * (len(lat_sorted) - 1))] \
+        if lat_sorted else None
+    # degraded-window tail bound: one replica killed + one rebinding
+    # leaves a single ready server, so the worst request queues behind
+    # every other closed-loop client (see module docstring, gate 3)
+    bound_ms = MAX_DELAY_MS + 2.0 * batch_ms \
+        + 2.0 * CONCURRENCY * rtt_ms
+    swap_lowerings = {str(i): r.get("lowerings")
+                      for i, r in (swap_result.get("replicas")
+                                   or {}).items()
+                      if isinstance(r, dict) and "error" not in r}
+    led = elastic.read_ledger(
+        path=fleet_ledger_path(os.path.join(tmp, "fleet")))
+    report.update({
+        "value": round(len(lat_ms) / wall_s, 1) if wall_s else 0,
+        "unit": "req/s",
+        "wall_s": round(wall_s, 3),
+        "completed": len(lat_ms),
+        "errors": len(errors),
+        "p95_ms": round(p95, 3) if p95 is not None else None,
+        "p95_bound_ms": round(bound_ms, 3),
+        "single_batch_ms": round(batch_ms, 3),
+        "warm_rtt_ms": round(rtt_ms, 3),
+        "killed_replica": KILL_INDEX,
+        "swap_lowerings": swap_lowerings,
+        "swap_pause_ms": swap_result.get("swap_pause_ms"),
+        "version_skew": st.get("version_skew"),
+        "generation": st.get("generation"),
+        "ledger": led,
+        "router": {k: st.get(k) for k in
+                   ("requests", "retries", "failed", "rejected")},
+    })
+
+    if errors:
+        fail("client-visible errors: %r (failover must absorb the "
+             "kill)" % errors[0], report)
+    if len(lat_ms) != N_REQUESTS:
+        fail("completed %d != %d requested"
+             % (len(lat_ms), N_REQUESTS), report)
+    if not killed.is_set():
+        fail("kill never fired", report)
+    if swap_err or not swap_result:
+        fail("swap failed: %r" % (swap_err or "never ran"), report)
+    survivors = sorted(i for i in range(REPLICAS) if i != KILL_INDEX)
+    bad_swaps = {i: r for i, r in
+                 (swap_result.get("replicas") or {}).items()
+                 if not isinstance(r, dict) or "error" in r}
+    if bad_swaps:
+        fail("per-replica swap errors: %s" % bad_swaps, report)
+    if any(v != 0 for v in swap_lowerings.values()):
+        fail("swap performed new lowerings: %s (must re-bind through "
+             "the program registry)" % swap_lowerings, report)
+    if st.get("version_skew", {}).get("v2") != survivors:
+        fail("version skew %s: survivors %s must all serve v2"
+             % (st.get("version_skew"), survivors), report)
+    if p95 is None or p95 > bound_ms:
+        fail("p95 %.3f ms exceeds bound %.3f ms with kill+swap in "
+             "window" % (p95 or -1, bound_ms), report)
+    if not led or led.get("reason") != "replica_death":
+        fail("ledger %s: want a replica_death shrink verdict" % (led,),
+             report)
+    if led.get("generation", 0) < 1:
+        fail("ledger generation %s never advanced" % led.get(
+            "generation"), report)
+    if KILL_INDEX in (led.get("members") or []):
+        fail("ledger members %s still include killed replica %d"
+             % (led.get("members"), KILL_INDEX), report)
+    ref = mx.Predictor(net.tojson(), v2_np,
+                       {"data": x1.shape}).forward(data=x1)[0]
+    if not np.array_equal(np.asarray(fleet_out[0]), np.asarray(ref)):
+        fail("post-swap fleet output differs from local v2 predictor "
+             "(swap must be bit-identical)", report)
+    print(json.dumps(report, default=str), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

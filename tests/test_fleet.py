@@ -1,0 +1,548 @@
+"""Fleet serving (docs/serving.md "Fleet"): FileKV, heartbeat liveness
+scan, router admission math, failover, live weight hot-swap, and the
+fleet telemetry rollup.
+
+All CPU-only and in-process: router tests run against duck-typed fake
+replica clients (no subprocesses, no HTTP), the swap tests drive
+ModelServer.swap_params on a toy MLP over the virtual CPU mesh, and
+the liveness tests exercise the SAME scan_dead_ranks rule
+KVStore.dead_nodes uses — pointed at a FileKV instead of the jax
+coordination client.  The multi-process kill-a-replica drill lives in
+tests/nightly/serve_load_fleet.py (CI TASK=serving).
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.executor import program_registry_stats
+from mxnet_tpu.kvstore import scan_dead_ranks
+from mxnet_tpu.serving import ModelServer, ServerBusy
+from mxnet_tpu.serving.fleet import (FileKV, FleetRouter, ReplicaDead,
+                                     decode_arrays, encode_arrays,
+                                     fleet_ledger_path, fleet_max_queue)
+from mxnet_tpu.serving.telemetry import fleet_report
+
+
+# ---------------------------------------------------------------------------
+# FileKV: the file-backed coordination-client stand-in
+# ---------------------------------------------------------------------------
+
+def test_filekv_roundtrip_and_prefix_scan(tmp_path):
+    kv = FileKV(tmp_path / "kv")
+    kv.key_value_set("mxtpu_hb/0", "1.5")
+    kv.key_value_set("mxtpu_hb/1", "2.5")
+    kv.key_value_set("other/0", "9")
+    got = dict(kv.key_value_dir_get("mxtpu_hb/"))
+    assert got == {"mxtpu_hb/0": "1.5", "mxtpu_hb/1": "2.5"}
+    # last write wins (the heartbeat stamp pattern)
+    kv.key_value_set("mxtpu_hb/0", "3.5")
+    assert dict(kv.key_value_dir_get("mxtpu_hb/"))["mxtpu_hb/0"] == "3.5"
+    kv.key_value_delete("mxtpu_hb/0")
+    assert "mxtpu_hb/0" not in dict(kv.key_value_dir_get("mxtpu_hb/"))
+
+
+def test_filekv_blocking_get(tmp_path):
+    kv = FileKV(tmp_path / "kv")
+    with pytest.raises(TimeoutError):
+        kv.blocking_key_value_get("missing", 60)
+    kv.key_value_set("k", "v")
+    assert kv.blocking_key_value_get("k", 60) == "v"
+
+
+def test_filekv_keys_with_slashes_are_flat_files(tmp_path):
+    # heartbeat keys contain "/": they must quote into flat filenames,
+    # never create subdirectories the prefix scan would miss
+    kv = FileKV(tmp_path / "kv")
+    kv.key_value_set("a/b/c", "x")
+    assert dict(kv.key_value_dir_get("a/"))["a/b/c"] == "x"
+    assert not any(p.is_dir() for p in (tmp_path / "kv").iterdir())
+
+
+# ---------------------------------------------------------------------------
+# liveness: the dead_nodes scan rule over a FileKV
+# ---------------------------------------------------------------------------
+
+def test_scan_dead_ranks_fresh_vs_stale(tmp_path, monkeypatch):
+    from mxnet_tpu import kvstore as kvmod
+    kv = FileKV(tmp_path / "kv")
+    monkeypatch.setattr(kvmod, "_now", lambda: 100.0)
+    kv.key_value_set("mxtpu_hb/0", "99.0")     # fresh
+    kv.key_value_set("mxtpu_hb/1", "80.0")     # stale
+    dead = scan_dead_ranks(kv, [0, 1, 2], created=95.0, timeout=10.0)
+    # 1 is stale; 2 never stamped but the fleet is young (grace)
+    assert dead == [1]
+    dead = scan_dead_ranks(kv, [0, 1, 2], created=50.0, timeout=10.0)
+    assert dead == [1, 2]                      # grace expired for 2
+
+
+def test_router_health_loop_uses_shared_scan(tmp_path, monkeypatch):
+    """A replica whose heartbeat goes stale is marked dead by the
+    router's health loop — the same machinery dead_nodes uses."""
+    from mxnet_tpu import kvstore as kvmod
+    kv = FileKV(tmp_path / "kv")
+    now = time.time()
+    kv.key_value_set("mxtpu_hb/0", str(now + 1000))  # forever fresh
+    kv.key_value_set("mxtpu_hb/1", str(now - 1000))  # long stale
+    router = FleetRouter([_OkClient(), _OkClient()], kv=kv,
+                         max_queue=8, hb_timeout_s=5.0,
+                         directory=str(tmp_path), respawn=False)
+    try:
+        from mxnet_tpu.resilience import elastic
+        led = None
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            st = router.stats()
+            led = elastic.read_ledger(
+                path=fleet_ledger_path(str(tmp_path)))
+            # state flips before the fsync'd ledger write lands:
+            # wait for both
+            if st["replicas"]["1"]["state"] == "dead" and led:
+                break
+            time.sleep(0.1)
+        st = router.stats()
+        assert st["replicas"]["0"]["state"] == "ready"
+        assert st["replicas"]["1"]["state"] == "dead"
+        assert led["reason"] == "replica_death"
+        assert led["members"] == [0]
+        assert led["generation"] == 1
+    finally:
+        router.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# fault seams
+# ---------------------------------------------------------------------------
+
+def test_replica_death_seam_returned_not_raised(monkeypatch):
+    from mxnet_tpu.resilience import faultinject
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "kind=replica_death:rank=2")
+    faultinject.reset()
+    assert faultinject.maybe_fault("replica_death", rank=1) is None
+    spec = faultinject.maybe_fault("replica_death", rank=2)
+    assert spec is not None and spec.kind == "replica_death"
+    faultinject.reset()
+
+
+def test_swap_crash_seam_raises(monkeypatch):
+    from mxnet_tpu.resilience import faultinject
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "kind=swap_crash")
+    faultinject.reset()
+    with pytest.raises(faultinject.InjectedFault):
+        faultinject.maybe_fault("swap_install")
+    faultinject.reset()
+
+
+# ---------------------------------------------------------------------------
+# router admission + dispatch over fake clients
+# ---------------------------------------------------------------------------
+
+class _OkClient(object):
+    """Duck-typed replica client: records calls, returns instantly."""
+
+    def __init__(self):
+        self.calls = []
+        self.trace_ids = []
+
+    def predict(self, model, inputs, n=None, trace_id=None):
+        self.calls.append(model)
+        self.trace_ids.append(trace_id)
+        return [np.zeros((int(n or 1), 2), dtype="float32")]
+
+    def stats(self):
+        return {"requests": len(self.calls)}
+
+    def swap(self, params, version=None, timeout=None):
+        return {"version": version or "v1", "lowerings": 0,
+                "models": ["m"], "swap_ms": 0.1}
+
+    def drain(self):
+        return True
+
+    def healthz(self):
+        return True
+
+
+class _BlockingClient(_OkClient):
+    """Holds every predict until released — keeps work in flight so
+    admission tests can fill the aggregate window deterministically."""
+
+    def __init__(self):
+        super(_BlockingClient, self).__init__()
+        self.release = threading.Event()
+
+    def predict(self, model, inputs, n=None, trace_id=None):
+        assert self.release.wait(timeout=30)
+        return super(_BlockingClient, self).predict(
+            model, inputs, n=n, trace_id=trace_id)
+
+
+class _DeadClient(_OkClient):
+    def predict(self, model, inputs, n=None, trace_id=None):
+        raise ConnectionError("replica gone")
+
+
+def test_fleet_max_queue_defaults_to_replicas_times_serve(monkeypatch):
+    monkeypatch.delenv("MXTPU_FLEET_MAX_QUEUE", raising=False)
+    monkeypatch.setenv("MXTPU_SERVE_MAX_QUEUE", "32")
+    assert fleet_max_queue(n_replicas=3) == 96
+    monkeypatch.setenv("MXTPU_FLEET_MAX_QUEUE", "10")
+    assert fleet_max_queue(n_replicas=3) == 10
+    assert fleet_max_queue(7, n_replicas=3) == 7
+
+
+def test_router_429_honors_aggregate_not_per_replica(tmp_path):
+    """The fleet front door admits against the AGGREGATE depth (queue +
+    total in-flight), not any single replica's bound: with max_queue=6
+    over two blocked replicas, exactly 6 requests are admitted even
+    though each replica alone would have rejected far sooner."""
+    clients = [_BlockingClient(), _BlockingClient()]
+    router = FleetRouter(clients, max_queue=6, directory=str(tmp_path),
+                         respawn=False, threads=2)
+    try:
+        futs = [router.submit("m", {"x": np.zeros(1)}, n=1)
+                for _ in range(6)]
+        with pytest.raises(ServerBusy) as exc:
+            router.submit("m", {"x": np.zeros(1)}, n=1)
+        busy = exc.value
+        assert busy.code == 429
+        assert busy.queue_depth == 6          # aggregate, fleet-wide
+        assert busy.limit == 6
+        assert busy.retry_after_ms is not None
+        for c in clients:
+            c.release.set()
+        for f in futs:
+            f.result(timeout=30)
+        # drained: the next request is admitted again
+        router.submit("m", {"x": np.zeros(1)}, n=1).result(timeout=30)
+    finally:
+        router.close(drain=False)
+
+
+def test_router_drain_returns_503_fleet_wide(tmp_path):
+    clients = [_OkClient(), _OkClient()]
+    router = FleetRouter(clients, max_queue=8, directory=str(tmp_path),
+                         respawn=False, threads=2)
+    try:
+        router.predict("m", {"x": np.zeros(1)}, n=1, timeout=10)
+        router.drain(timeout=10)
+        with pytest.raises(ServerBusy) as exc:
+            router.submit("m", {"x": np.zeros(1)}, n=1)
+        assert exc.value.code == 503
+        assert exc.value.reason == "draining"
+    finally:
+        router.close(drain=False)
+
+
+def test_dead_replica_future_fails_structured_not_hangs(tmp_path):
+    """Queued futures on a fleet with no survivors fail with a
+    structured ReplicaDead carrying a to_dict payload — never hang."""
+    router = FleetRouter([_DeadClient()], max_queue=8,
+                         directory=str(tmp_path), respawn=False,
+                         threads=1, rebind_wait_s=0.2)
+    try:
+        fut = router.submit("m", {"x": np.zeros(1)}, n=1)
+        with pytest.raises(ReplicaDead) as exc:
+            fut.result(timeout=15)
+        doc = exc.value.to_dict()
+        assert doc["error"] == "replica_dead"
+        assert doc["model"] == "m"
+        st = router.stats()
+        assert st["replicas"]["0"]["state"] == "dead"
+        assert st["generation"] == 1          # shrink verdict written
+    finally:
+        router.close(drain=False)
+
+
+def test_router_fails_over_to_survivor(tmp_path):
+    """Transport death on one replica retries on a sibling: the client
+    sees a result, the dead replica leaves rotation, and the ledger
+    records the shrink."""
+    ok = _OkClient()
+    router = FleetRouter([_DeadClient(), ok], max_queue=8,
+                         directory=str(tmp_path), respawn=False,
+                         threads=1)
+    try:
+        out = router.predict("m", {"x": np.zeros(1)}, n=1, timeout=15)
+        assert out[0].shape == (1, 2)
+        assert ok.calls == ["m"]
+        st = router.stats()
+        assert st["replicas"]["0"]["state"] == "dead"
+        assert st["replicas"]["1"]["state"] == "ready"
+        from mxnet_tpu.resilience import elastic
+        led = elastic.read_ledger(path=fleet_ledger_path(str(tmp_path)))
+        assert led["reason"] == "replica_death"
+        assert led["members"] == [1]
+    finally:
+        router.close(drain=False)
+
+
+def test_router_least_loaded_spreads_work(tmp_path):
+    class _SlowClient(_OkClient):
+        def predict(self, model, inputs, n=None, trace_id=None):
+            # long enough that requests overlap and inflight counts
+            # drive the pick; instant fakes would let replica 0 (the
+            # tie-break winner) legally serve everything
+            time.sleep(0.02)
+            return super(_SlowClient, self).predict(
+                model, inputs, n=n, trace_id=trace_id)
+
+    clients = [_SlowClient(), _SlowClient(), _SlowClient()]
+    router = FleetRouter(clients, max_queue=64, directory=str(tmp_path),
+                         respawn=False, threads=3)
+    try:
+        futs = [router.submit("m", {"x": np.zeros(1)}, n=1)
+                for _ in range(30)]
+        for f in futs:
+            f.result(timeout=30)
+        counts = [len(c.calls) for c in clients]
+        assert sum(counts) == 30
+        assert all(c > 0 for c in counts)     # nobody starved
+    finally:
+        router.close(drain=False)
+
+
+def test_router_mints_and_threads_trace_ids(tmp_path):
+    ok = _OkClient()
+    router = FleetRouter([ok], max_queue=8, directory=str(tmp_path),
+                         respawn=False, threads=1)
+    try:
+        router.predict("m", {"x": np.zeros(1)}, n=1, timeout=10)
+        assert ok.trace_ids == [None]          # tracing off: no id
+        fut = router.submit("m", {"x": np.zeros(1)}, n=1,
+                            trace_id="req-42")
+        fut.result(timeout=10)
+        assert ok.trace_ids[-1] == "req-42"    # explicit id wins
+    finally:
+        router.close(drain=False)
+
+
+def test_router_swap_holds_replica_out_only_during_rebind(tmp_path):
+    clients = [_OkClient(), _OkClient()]
+    router = FleetRouter(clients, max_queue=8, directory=str(tmp_path),
+                         respawn=False, threads=2)
+    try:
+        res = router.swap("/dev/null", version="v2")
+        assert sorted(res["replicas"]) == [0, 1]
+        assert all(r["version"] == "v2"
+                   for r in res["replicas"].values())
+        assert len(res["swap_pause_ms"]) == 2
+        st = router.stats()
+        assert st["version_skew"] == {"v2": [0, 1]}
+        assert all(r["state"] == "ready"
+                   for r in st["replicas"].values())
+        assert st["swap_pause_ms_p95"] is not None
+    finally:
+        router.close(drain=False)
+
+
+def test_router_swap_failure_leaves_old_version_in_skew(tmp_path):
+    class _BadSwap(_OkClient):
+        def swap(self, params, version=None, timeout=None):
+            raise ConnectionError("swap wire broke")
+
+    router = FleetRouter([_OkClient(), _BadSwap()], max_queue=8,
+                         directory=str(tmp_path), respawn=False,
+                         threads=2)
+    try:
+        res = router.swap("/dev/null", version="v2")
+        assert "error" in res["replicas"][1]
+        st = router.stats()
+        # skew report names the divergence: replica 0 on v2, 1 stale
+        assert st["version_skew"]["v2"] == [0]
+        assert 1 in st["version_skew"]["?"]
+        assert st["replicas"]["1"]["state"] == "ready"   # still serving
+        router.predict("m", {"x": np.zeros(1)}, n=1, timeout=10)
+    finally:
+        router.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# npz transport codec
+# ---------------------------------------------------------------------------
+
+def test_npz_codec_roundtrip():
+    arrays = {"data": np.arange(6, dtype="float32").reshape(2, 3),
+              "mask": np.ones((2,), dtype="int32")}
+    got = decode_arrays(encode_arrays(arrays))
+    for k in arrays:
+        np.testing.assert_array_equal(got[k], arrays[k])
+    bare = np.arange(4, dtype="float32")
+    np.testing.assert_array_equal(decode_arrays(encode_arrays(bare)),
+                                  bare)
+
+
+# ---------------------------------------------------------------------------
+# live weight hot-swap on a real ModelServer (in-process)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def toy_model():
+    net = mx.models.get_mlp(num_classes=3, hidden=(8,))
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, 10))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    arg_params, aux_params = mod.get_params()
+    params = {"arg:" + k: v for k, v in arg_params.items()}
+    params.update({"aux:" + k: v for k, v in aux_params.items()})
+    return net, params
+
+
+def _perturbed(params, scale=1.25, shift=0.01):
+    return {k: mx.nd.array(v.asnumpy() * scale + shift)
+            for k, v in params.items()}
+
+
+def test_swap_params_zero_lowerings_and_bit_identical(toy_model):
+    """The hot-swap contract: new params re-bind through the program
+    registry (zero new lowerings — the registry counters prove it) and
+    post-swap outputs are bit-identical to a fresh Predictor over the
+    new params."""
+    net, params = toy_model
+    v2 = _perturbed(params)
+    v2_np = {k: v.asnumpy() for k, v in v2.items()}
+    srv = ModelServer(max_delay_ms=2)
+    srv.add_model("toy", net.tojson(), params, {"data": (10,)},
+                  buckets=(1, 4))
+    x = np.random.RandomState(11).rand(3, 10).astype("float32")
+    before_out = srv.predict("toy", x, timeout=30)[0]
+    before_lower = program_registry_stats()["lowerings"]
+    res = srv.swap_params(v2, version="v2")
+    assert res["version"] == "v2"
+    assert res["lowerings"] == 0
+    assert res["models"] == ["toy"]
+    assert program_registry_stats()["lowerings"] == before_lower
+    after_out = srv.predict("toy", x, timeout=30)[0]
+    stats = srv.stats()
+    srv.close()
+    ref = mx.Predictor(net.tojson(), v2_np,
+                       {"data": x.shape}).forward(data=x)[0]
+    assert np.array_equal(np.asarray(after_out), np.asarray(ref))
+    assert not np.array_equal(np.asarray(after_out),
+                              np.asarray(before_out))
+    assert stats["param_version"] == "v2"
+
+
+def test_swap_params_unknown_model_raises(toy_model):
+    net, params = toy_model
+    srv = ModelServer(max_delay_ms=2)
+    srv.add_model("toy", net.tojson(), params, {"data": (10,)},
+                  buckets=(1,))
+    with pytest.raises(MXNetError):
+        srv.swap_params(params, models=["nope"])
+    srv.close()
+
+
+def test_swap_crash_keeps_old_params_serving(toy_model, monkeypatch):
+    """An injected swap_crash fires AFTER the new predictors are built
+    but BEFORE install: the old version keeps serving untouched and
+    param_version never advances — a failed swap is a no-op."""
+    from mxnet_tpu.resilience import faultinject
+    net, params = toy_model
+    srv = ModelServer(max_delay_ms=2)
+    srv.add_model("toy", net.tojson(), params, {"data": (10,)},
+                  buckets=(1,))
+    x = np.random.RandomState(13).rand(1, 10).astype("float32")
+    before_out = srv.predict("toy", x, timeout=30)[0]
+    monkeypatch.setenv("MXTPU_FAULT_SPEC", "kind=swap_crash")
+    faultinject.reset()
+    with pytest.raises(faultinject.InjectedFault):
+        srv.swap_params(_perturbed(params), version="v2")
+    monkeypatch.delenv("MXTPU_FAULT_SPEC")
+    faultinject.reset()
+    after_out = srv.predict("toy", x, timeout=30)[0]
+    stats = srv.stats()
+    srv.close()
+    assert np.array_equal(np.asarray(after_out), np.asarray(before_out))
+    assert stats["param_version"] == "v0"
+
+
+# ---------------------------------------------------------------------------
+# fleet telemetry rollup
+# ---------------------------------------------------------------------------
+
+def _serve_rec(replica, version, lat, wall, n=2):
+    return {"kind": "serve", "replica": replica,
+            "param_version": version, "n_requests": n,
+            "n_samples": n, "occupancy": 0.5, "lat_ms": lat,
+            "wall_ms": wall}
+
+
+def test_fleet_report_rollup_and_skew():
+    records = [
+        _serve_rec(0, "v1", [10.0, 12.0], 1000.0),
+        _serve_rec(0, "v1", [11.0, 13.0], 2000.0),
+        _serve_rec(1, "v2", [30.0, 50.0], 1000.0, n=6),
+        _serve_rec(1, "v2", [40.0, 60.0], 3000.0, n=6),
+        {"kind": "serve", "model": "m"},       # unstamped: ignored
+        {"kind": "step", "replica": 0},        # wrong kind: ignored
+    ]
+    fl = fleet_report(records)
+    assert sorted(fl["replicas"]) == ["0", "1"]
+    r0, r1 = fl["replicas"]["0"], fl["replicas"]["1"]
+    assert r0["requests"] == 4 and r1["requests"] == 12
+    assert r0["param_version"] == "v1"
+    assert r1["latency_ms"]["p95"] > r0["latency_ms"]["p95"]
+    assert r0["qps"] == 4.0                    # 4 reqs over 1s span
+    assert fl["version_skew"] == {"v1": [0], "v2": [1]}
+    assert fl["straggler_gap_ms"] > 0
+    assert fl["balance_ratio"] == 1.5          # 12 / mean(8)
+    assert fl["requests"] == 16
+
+
+def test_fleet_report_empty_without_replica_stamps():
+    assert fleet_report([{"kind": "serve", "model": "m"}]) \
+        == {"replicas": {}}
+
+
+def test_build_report_carries_fleet_rollup():
+    from mxnet_tpu.observability import aggregate
+    records = [_serve_rec(0, "v1", [10.0], 1000.0),
+               _serve_rec(1, "v1", [12.0], 1500.0)]
+    # build_report needs rank-shaped records; serve records qualify
+    for i, rec in enumerate(records):
+        rec.update(run_id="r", rank=0, model="m", bucket=2)
+    report = aggregate.build_report(records)
+    assert sorted(report["fleet"]["replicas"]) == ["0", "1"]
+    from mxnet_tpu.observability.slo import telemetry_metrics
+    metrics = telemetry_metrics(report)
+    assert "fleet_straggler_gap_ms" in metrics
+    assert "fleet_balance_ratio" in metrics
+
+
+def test_set_fleet_context_stamps_serve_records(tmp_path, monkeypatch):
+    from mxnet_tpu.observability import events
+    from mxnet_tpu.serving import telemetry as tel
+    monkeypatch.setenv("MXTPU_TELEMETRY", "1")
+    monkeypatch.setenv("MXTPU_TELEMETRY_DIR", str(tmp_path))
+    events.refresh()
+    try:
+        tel.set_fleet_context(replica=3, param_version="v7")
+        tel.emit_batch("m", 4, 2, 2, 0.5, 0.1, 0, 1.0, 0.1, 0.5, 0.1,
+                       [3.0, 4.0])
+        events.flush()
+        recs = [json.loads(line)
+                for p in tmp_path.glob("events-rank*.jsonl")
+                for line in open(p) if line.strip()]
+        serve = [r for r in recs if r.get("kind") == "serve"]
+        assert serve and serve[-1]["replica"] == 3
+        assert serve[-1]["param_version"] == "v7"
+    finally:
+        tel._FLEET.update(replica=None, param_version=None)
+        monkeypatch.delenv("MXTPU_TELEMETRY")
+        monkeypatch.delenv("MXTPU_TELEMETRY_DIR")
+        events.refresh()
+
+
+def test_fleet_names_are_exported():
+    import mxnet_tpu.serving as serving
+    for name in ("FleetRouter", "FileKV", "ReplicaDead",
+                 "fleet_report", "set_fleet_context"):
+        assert hasattr(serving, name)

@@ -115,6 +115,7 @@ def render_serve(report, stream=sys.stdout):
     models = sv.get("models") or {}
     if not models:
         w("no serve events.\n")
+        render_fleet(report, stream=stream)
         return
     total = sv.get("total") or {}
     tlat = total.get("latency_ms") or {}
@@ -160,6 +161,37 @@ def render_serve(report, stream=sys.stdout):
                 m.get("dtype") or "-",
                 m.get("kernel_path") or "-",
                 phases.get("prefill", 0), phases.get("decode", 0)))
+    render_fleet(report, stream=stream)
+
+
+def render_fleet(report, stream=sys.stdout):
+    """The fleet rollup under the serving view: per-replica qps/p95/
+    occupancy/param-version plus the fleet-wide straggler gap, dispatch
+    balance, and version-skew map (docs/serving.md "Fleet")."""
+    w = stream.write
+    fl = report.get("fleet") or {}
+    replicas = fl.get("replicas") or {}
+    if not replicas:
+        return
+    w("fleet — %s replica(s)   straggler gap %s ms   balance %s\n" % (
+        len(replicas),
+        _fmt(fl.get("straggler_gap_ms"), width=8).strip(),
+        _fmt(fl.get("balance_ratio"), width=6).strip()))
+    w("%-8s %8s %8s %10s %10s %10s  %s\n" % (
+        "replica", "reqs", "qps", "p50 ms", "p95 ms", "occupancy",
+        "version"))
+    for idx, m in sorted(replicas.items(), key=lambda kv: kv[0]):
+        lat = m.get("latency_ms") or {}
+        w("%-8s %8s %8s %10s %10s %10s  %s\n" % (
+            idx, m.get("requests", 0),
+            _fmt(m.get("qps"), width=8).strip(),
+            _fmt(lat.get("p50"), width=10).strip(),
+            _fmt(lat.get("p95"), width=10).strip(),
+            _fmt(m.get("occupancy"), width=10).strip(),
+            m.get("param_version") or "?"))
+    skew = fl.get("version_skew") or {}
+    if len(skew) > 1:
+        w("VERSION SKEW: %s\n" % json.dumps(skew, sort_keys=True))
 
 
 def render_fault_timelines(records, before, after, stream=sys.stdout):
@@ -230,7 +262,12 @@ def main(argv=None):
             records = aggregate.read_events(args.directory)
         report = aggregate.build_report(records)
         if args.json:
-            doc = report.get("serve", {}) if args.serve else report
+            if args.serve:
+                doc = dict(report.get("serve", {}))
+                if report.get("fleet"):
+                    doc["fleet"] = report["fleet"]
+            else:
+                doc = report
             json.dump(doc, sys.stdout, indent=2, default=str)
             sys.stdout.write("\n")
         elif args.serve:

@@ -161,6 +161,22 @@ def parse_telemetry(path):
                           if m.get("kernel_path")})
             if kps:
                 overlap_cols["serve-kernel"] = ",".join(kps)
+            # fleet columns (docs/serving.md "Fleet"): replica count,
+            # fleet-wide straggler gap, dispatch balance, and the
+            # param-version set (string; >1 entry = version skew)
+            from mxnet_tpu.serving.telemetry import fleet_report
+            fl = fleet_report(records) or {}
+            if fl.get("replicas"):
+                overlap_cols["fleet-replicas"] = len(fl["replicas"])
+                if fl.get("straggler_gap_ms") is not None:
+                    overlap_cols["fleet-straggler-gap-ms"] = \
+                        fl["straggler_gap_ms"]
+                if fl.get("balance_ratio") is not None:
+                    overlap_cols["fleet-balance"] = fl["balance_ratio"]
+                skew = fl.get("version_skew") or {}
+                if skew:
+                    overlap_cols["fleet-versions"] = \
+                        ",".join(sorted(skew))
     except Exception:
         pass
     if not acc and any(c.startswith("serve-") for c in overlap_cols):

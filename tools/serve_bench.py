@@ -33,6 +33,19 @@ tokens of one sequence.  KV-cache 429s are retried after the server's
 ``retry_after_ms`` hint and counted in ``rejected_429`` — past
 saturation the bench demonstrates (rather than dies on) backpressure.
 
+``--fleet N`` benches the multi-replica router (docs/serving.md
+"Fleet"): N replica processes behind the FleetRouter, closed-loop load
+with a live weight hot-swap at the halfway mark (no drain).  The BENCH
+line becomes::
+
+    {"metric": "fleet_throughput_rps", "value": ..., "unit": "req/s",
+     "replicas": N, "balance_ratio": ..., "swap_pause_ms_p95": ...,
+     "swap_lowerings": 0, "version_skew": {"v2": [0, 1, ...]}, ...}
+
+``balance_ratio`` is max/mean per-replica request count (1.0 = the
+least-loaded dispatch spread perfectly); ``swap_lowerings`` must stay
+0 — the swap re-binds through the program registry, never re-compiles.
+
 ``lowerings_after_warmup`` comes from the executor program-registry
 counters: the AOT contract is that it stays 0 no matter how many
 requests run (the CI smoke asserts exactly that).  With telemetry on
@@ -330,6 +343,142 @@ def run_generate(args):
     return 0
 
 
+def run_fleet(args):
+    """Fleet drill (--fleet N): spawn N replica processes behind the
+    FleetRouter, drive closed-loop load over the toy MLP, hot-swap to
+    perturbed v2 params at the halfway mark WITHOUT drain, and print
+    one BENCH line: fleet throughput, per-replica dispatch balance
+    (max/mean requests; 1.0 = perfectly even), and the hot-swap
+    rotation-pause tail."""
+    import tempfile
+    import numpy as np
+    from mxnet_tpu import ndarray as nd
+    from mxnet_tpu.serving.fleet import launch_fleet
+
+    symbol, params, shapes = build_model(args)
+    if not isinstance(params, dict):
+        print("--fleet needs the toy MLP (no --checkpoint)",
+              file=sys.stderr)
+        return 2
+    input_name = next(iter(shapes))
+    tmp = tempfile.mkdtemp(prefix="serve_bench_fleet_")
+    sym_path = os.path.join(tmp, "bench-symbol.json")
+    with open(sym_path, "w") as fout:
+        fout.write(symbol)
+    v1_path = os.path.join(tmp, "bench-v1.params")
+    nd.save(v1_path, params)
+    v2_path = os.path.join(tmp, "bench-v2.params")
+    nd.save(v2_path, {k: nd.array(v.asnumpy() * 1.01 + 0.001)
+                      for k, v in params.items()})
+    spec_path = os.path.join(tmp, "fleet.json")
+    with open(spec_path, "w") as fout:
+        json.dump({"models": [{
+            "name": "bench", "symbol": sym_path, "params": v1_path,
+            "input_shapes": {k: list(v) for k, v in shapes.items()},
+            "histogram": None if args.buckets else args.sizes,
+            "buckets": args.buckets}],
+            "version": "v1",
+            "max_delay_ms": args.max_delay_ms,
+            "max_queue": args.max_queue}, fout)
+
+    router = launch_fleet(spec_path, n_replicas=args.fleet,
+                          directory=os.path.join(tmp, "fleet"),
+                          base_port=args.fleet_base_port)
+    try:
+        rng = np.random.RandomState(args.seed)
+        sizes = sample_sizes(args.sizes, args.requests, args.seed)
+        pool = {n: rng.rand(n, *shapes[input_name]).astype("float32")
+                for n in set(sizes)}
+        # warmup through every replica (untimed)
+        for _ in range(2 * args.fleet):
+            router.predict("bench", {input_name: pool[sizes[0]]},
+                           timeout=60.0)
+
+        swap_result = {}
+        halfway = threading.Event()
+
+        def swapper():
+            halfway.wait(timeout=300.0)
+            swap_result.update(router.swap(v2_path, version="v2"))
+
+        swap_thread = threading.Thread(target=swapper, daemon=True)
+        swap_thread.start()
+        lock = threading.Lock()
+        cursor = [0]
+        errors = []
+
+        def worker():
+            while True:
+                with lock:
+                    i = cursor[0]
+                    if i >= len(sizes):
+                        return
+                    cursor[0] += 1
+                if i == len(sizes) // 2:
+                    halfway.set()        # swap fires mid-load
+                try:
+                    router.predict(
+                        "bench", {input_name: pool[sizes[i]]},
+                        timeout=60.0)
+                except Exception as exc:
+                    errors.append(exc)
+                    return
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(args.concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        halfway.set()
+        swap_thread.join(timeout=300.0)
+        wall_s = time.perf_counter() - t0
+        st = router.stats()
+    finally:
+        router.close()
+    try:
+        from mxnet_tpu.observability import events as _events
+        _events.flush()
+    except Exception:
+        pass
+
+    per_replica = {i: r.get("requests", 0)
+                   for i, r in st["replicas"].items()}
+    counts = [c for c in per_replica.values() if c] or [0]
+    mean = sum(counts) / len(counts)
+    completed = args.requests - len(errors)
+    lowerings = sum(r.get("lowerings", 0)
+                    for r in (swap_result.get("replicas") or {}).values()
+                    if isinstance(r, dict))
+    out = {
+        "metric": "fleet_throughput_rps",
+        "value": round(completed / wall_s, 2) if wall_s > 0 else 0.0,
+        "unit": "req/s",
+        "mode": "fleet",
+        "replicas": args.fleet,
+        "requests": args.requests,
+        "completed": completed,
+        "errors": len(errors),
+        "rejected": st.get("rejected", 0),
+        "wall_s": round(wall_s, 3),
+        "balance_ratio": round(max(counts) / mean, 3) if mean else None,
+        "per_replica_requests": per_replica,
+        "swap_pause_ms_p95": st.get("swap_pause_ms_p95"),
+        "swap_lowerings": lowerings,
+        "version_skew": st.get("version_skew"),
+        "generation": st.get("generation"),
+    }
+    if errors:
+        out["first_error"] = repr(errors[0])
+    print(json.dumps(out, default=str))
+    if lowerings:
+        print("fleet swap performed %d new lowerings (want 0)"
+              % lowerings, file=sys.stderr)
+        return 1
+    return 1 if errors else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="serve_bench", description=__doc__,
@@ -381,10 +530,20 @@ def main(argv=None):
     gen.add_argument("--heads", type=int, default=4)
     gen.add_argument("--dim", type=int, default=64)
     gen.add_argument("--max-seq-len", type=int, default=64)
+    fl = ap.add_argument_group("fleet mode (docs/serving.md \"Fleet\")")
+    fl.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="spawn N replica processes behind the "
+                         "FleetRouter and bench through it (with a "
+                         "mid-run live weight hot-swap)")
+    fl.add_argument("--fleet-base-port", type=int, default=None,
+                    help="replica i listens on base+i "
+                         "(MXTPU_FLEET_BASE_PORT)")
     args = ap.parse_args(argv)
 
     if args.generate:
         return run_generate(args)
+    if args.fleet:
+        return run_fleet(args)
 
     import numpy as np
     from mxnet_tpu.serving import ModelServer
