@@ -158,8 +158,24 @@ def _parse_utc_ts(text):
 
 
 def _emit(payload):
+    _stamp_autotune(payload)
     sys.stdout.write(json.dumps(payload) + "\n")
     _emit_telemetry_summary(payload)
+
+
+def _stamp_autotune(payload):
+    """When a run is driven by ``tools/autotune.py --replay``, the
+    replay loop exports the manifest config id + manifest hash; stamp
+    every BENCH line with them so measured numbers join back to their
+    predicted row (docs/perf.md "Autotuning & chip windows").  No-op
+    outside a replay window — the keys are simply absent."""
+    cfg = os.environ.get("BENCH_AUTOTUNE_CONFIG_ID")
+    man = os.environ.get("BENCH_AUTOTUNE_MANIFEST_HASH")
+    if cfg:
+        payload.setdefault("autotune_config_id", cfg)
+    if man:
+        payload.setdefault("autotune_manifest_hash", man)
+    return payload
 
 
 def _stamp_run_id(payload):
@@ -571,20 +587,18 @@ def measure():
 
     # chip-free MXL-R cross-check: the analyzer's static roofline for
     # the same graph, printed next to the measured MFU and mirrored to
-    # the event log so the measured-vs-ceiling gap is trackable
-    static_ceiling = None
-    try:
-        from mxnet_tpu.analysis import static_mfu_ceiling
-        from mxnet_tpu.observability import counters as _counters
-        srep = static_mfu_ceiling(
-            sym, {"data": (global_batch, 3, 224, 224)},
-            device_kind=str(device_kind), compute_dtype=dtype or None)
-        static_ceiling = srep["mfu_ceiling"]
-        _counters.emit_static_roofline(
-            sym, {"data": (global_batch, 3, 224, 224)},
-            device_kind=str(device_kind), compute_dtype=dtype or None)
-    except Exception as exc:  # noqa: BLE001
-        notes.append("static roofline failed: %r" % exc)
+    # the event log so the measured-vs-ceiling gap is trackable —
+    # bench, mfu_audit and the autotuner all share this one summary
+    # path (analysis.roofline.static_ceiling_summary)
+    from mxnet_tpu.analysis import static_ceiling_summary
+    srep = static_ceiling_summary(
+        sym, {"data": (global_batch, 3, 224, 224)},
+        device_kind=str(device_kind), compute_dtype=dtype or None,
+        emit=True)
+    static_ceiling = srep.get("static_mfu_ceiling")
+    if srep.get("static_mfu_ceiling_error"):
+        notes.append("static roofline failed: %s"
+                     % srep["static_mfu_ceiling_error"])
 
     payload = {
         "metric": "resnet%d_train_images_per_sec" % num_layers,

@@ -111,7 +111,8 @@ from . import divergence as _divergence    # noqa: F401
 from .propagation import comm_report
 from .memory import peak_hbm_report, hbm_capacity_bytes
 from .tiling import register_kernel_spec, kernel_spec_issues
-from .roofline import roofline_report, static_mfu_ceiling
+from .roofline import (roofline_report, static_ceiling_summary,
+                       static_mfu_ceiling)
 from .distributed import collective_trace
 from .divergence import analyze_source_paths, collective_seam
 
@@ -121,6 +122,7 @@ __all__ = ["GraphIssue", "AnalysisContext", "Rule", "RULE_REGISTRY",
            "GraphLintWarning", "comm_report", "peak_hbm_report",
            "hbm_capacity_bytes", "register_kernel_spec",
            "kernel_spec_issues", "roofline_report", "static_mfu_ceiling",
+           "static_ceiling_summary",
            "collective_trace", "analyze_source_paths", "collective_seam"]
 
 

@@ -13,11 +13,20 @@ Traffic model: each op moves its inputs + outputs through HBM once
 per pass, priced at the compute dtype (the trainer casts to bf16 on
 TPU); MXU ops pay 3 passes in training, others 2, plus 24 bytes per
 trained parameter scalar (f32 grad write + optimizer state + master
-weight round-trip).  ResNet-50 b256: ~93 GB vs the compiler's 89.1 —
-the model is fusion-blind, so treat per-op bytes as an upper bound of
-what a well-fused program moves (XLA's own bytes-accessed counts some
-fusion operands more than once, which is why the small-batch column of
-docs/mfu_gap.md reads higher than this estimate).
+weight round-trip).  The raw per-op sum is fusion-blind, so training
+traffic is **calibrated against the compiled AOT rows in
+AOT_r05.json** with two terms: a fusion factor (XLA elides ~23% of
+naive per-op traffic once producers fuse into consumers) and a
+batch-independent staging term per trained parameter (the
+copy-start/copy-done alternate-memory traffic visible in the AOT
+entry-computation breakdown scales with the weight set, not the
+batch).  With the defaults (0.77 / 637 B per param) the v5e ResNet-50
+ceilings land at 0.19/0.30/0.33 for b64/b256/b512 vs the compiler's
+0.193/0.293/0.331.  Both knobs have env overrides
+(``MXTPU_ROOFLINE_FUSION_FACTOR`` /
+``MXTPU_ROOFLINE_STAGING_BYTES_PER_PARAM``); inference pricing stays
+uncalibrated (the fit is a training-step fit).  The raw sum is kept in
+the report as ``op_hbm_bytes_per_step``.
 
 Peaks come from bench.py's spec-sheet table
 (``_lookup_peak_tflops``/``_lookup_peak_hbm``, so lint and bench can
@@ -45,7 +54,8 @@ from .propagation import edge_shapes, fmt_bytes
 from .tiling import LANES, min_tile
 
 __all__ = ["roofline_report", "device_peaks", "resolve_compute_dtype",
-           "mxu_padding_waste", "static_mfu_ceiling"]
+           "mxu_padding_waste", "static_mfu_ceiling",
+           "static_ceiling_summary"]
 
 # training multipliers: an MXU op's backward is two more same-shape
 # matmuls (dgrad + wgrad); everything else pays one elementwise-ish
@@ -54,6 +64,13 @@ _TRAIN_PASSES_MXU = 3
 _TRAIN_PASSES_OTHER = 2
 # f32 grad write + optimizer state read/write + master weight round-trip
 _PARAM_UPDATE_BYTES = 24
+# training-traffic calibration vs the compiled AOT table (AOT_r05.json,
+# docs/mfu_gap.md): fraction of naive per-op bytes that survive XLA
+# fusion, and alternate-memory staging bytes per trained parameter
+# (batch-independent: the entry computation's copy-start/done pairs
+# move weights, not activations)
+_FUSION_FACTOR = 0.77
+_STAGING_BYTES_PER_PARAM = 637
 
 
 def _env_float(name, default):
@@ -173,6 +190,7 @@ def _op_costs(ctx):
             "compute_dtype": cost.get("compute_dtype"),
         })
     param_bytes = 0
+    param_count = 0
     if training:
         for node in ctx.variables():
             if node.name in ctx.data_names or node.name in ctx.label_names:
@@ -182,10 +200,11 @@ def _op_costs(ctx):
             shape = shapes.get((id(node), 0))
             if shape is None:
                 continue
-            param_bytes += int(_np.prod(shape, dtype=_np.int64)) \
-                * _PARAM_UPDATE_BYTES
+            param_count += int(_np.prod(shape, dtype=_np.int64))
+    param_bytes = param_count * _PARAM_UPDATE_BYTES
     facts = {"rows": rows, "complete": complete, "training": training,
-             "compute_dtype": compute_dtype, "param_bytes": param_bytes}
+             "compute_dtype": compute_dtype, "param_bytes": param_bytes,
+             "param_count": param_count}
     ctx.cache["roofline_costs"] = facts
     return facts
 
@@ -202,9 +221,29 @@ def roofline_report(ctx):
         return ctx.cache["roofline_report"]
     facts = _op_costs(ctx)
     flops = sum(r["flops"] for r in facts["rows"])
-    byts = sum(r["bytes"] for r in facts["rows"]) + facts["param_bytes"]
+    op_bytes = sum(r["bytes"] for r in facts["rows"])
+    calibration = None
+    if facts["training"] and ctx.target == "tpu":
+        # the AOT_r05.json fit (see module docstring): fused traffic +
+        # param-update round-trip + batch-independent staging
+        calibration = {
+            "fusion_factor": _env_float(
+                "MXTPU_ROOFLINE_FUSION_FACTOR", _FUSION_FACTOR),
+            "staging_bytes_per_param": _env_float(
+                "MXTPU_ROOFLINE_STAGING_BYTES_PER_PARAM",
+                _STAGING_BYTES_PER_PARAM),
+        }
+        byts = calibration["fusion_factor"] * op_bytes \
+            + facts["param_bytes"] \
+            + calibration["staging_bytes_per_param"] \
+            * facts["param_count"]
+    else:
+        byts = op_bytes + facts["param_bytes"]
     device_kind = resolve_device_kind(ctx)
-    peak_f, peak_b = device_peaks(device_kind)
+    base_dtype = facts["compute_dtype"]
+    peak_f, peak_b = device_peaks(
+        device_kind,
+        dtype=base_dtype if base_dtype in ("int8", "fp8") else None)
     # mixed-precision pricing: rows that declare their own compute
     # dtype (QuantizedDense -> int8/fp8) run at that dtype's peak, so
     # the graph's effective peak is flops-over-time across the mix
@@ -225,6 +264,9 @@ def roofline_report(ctx):
     report = {
         "flops_per_step": flops,
         "hbm_bytes_per_step": byts,
+        "op_hbm_bytes_per_step": op_bytes + facts["param_bytes"],
+        "calibration": calibration,
+        "param_count": facts["param_count"],
         "intensity": (flops / byts) if byts else None,
         "device_kind": device_kind,
         "peak_tflops": (peak_f / 1e12) if peak_f else None,
@@ -256,6 +298,43 @@ def static_mfu_ceiling(symbol, shapes, device_kind=None,
     ctx.compute_dtype = compute_dtype
     ctx.device_kind = device_kind
     return roofline_report(ctx)
+
+
+def static_ceiling_summary(symbol, shapes, device_kind=None,
+                           compute_dtype=None, grad_req=None,
+                           target="tpu", emit=False):
+    """The ONE static-ceiling summary path shared by bench.py,
+    tools/mfu_audit.py and the autotuner: flat ``static_*`` keys ready
+    to merge into a BENCH payload / audit row.  Never raises — analyzer
+    failures come back as ``static_mfu_ceiling_error``.  ``emit=True``
+    also mirrors the roofline to the telemetry counter stream
+    (``counters.emit_static_roofline``) so the measured-vs-ceiling gap
+    is trackable."""
+    try:
+        rep = static_mfu_ceiling(symbol, shapes, device_kind=device_kind,
+                                 compute_dtype=compute_dtype,
+                                 grad_req=grad_req, target=target)
+    except Exception as exc:  # noqa: BLE001 — callers print, not crash
+        return {"static_mfu_ceiling_error":
+                "%s: %s" % (type(exc).__name__, exc)}
+    out = {
+        "static_tflops_per_step": round(rep["flops_per_step"] / 1e12, 3),
+        "static_hbm_gb_per_step": round(
+            rep["hbm_bytes_per_step"] / 1e9, 3),
+        "static_mfu_ceiling": (round(rep["mfu_ceiling"], 4)
+                               if rep["mfu_ceiling"] is not None
+                               else None),
+        "static_bound": rep["bound"],
+    }
+    if emit:
+        try:
+            from ..observability import counters as _counters
+            _counters.emit_static_roofline(
+                symbol, shapes, device_kind=device_kind,
+                compute_dtype=compute_dtype)
+        except Exception:
+            pass
+    return out
 
 
 # ----------------------------------------------------------------------

@@ -17,6 +17,11 @@
 #                    an mxtop --json smoke over the drill's event dir
 #   TASK=perf        overlap unit suite + the 2-process overlap drill
 #                    (asserts overlap_ratio > 1.05, bit-identical math)
+#   TASK=autotune    chip-free config search (docs/perf.md "Autotuning
+#                    & chip windows"): byte-identical manifest
+#                    determinism on ResNet-50/v5e and the dp=2,tp=2
+#                    transformer, the v5e ranking pin (b512 first),
+#                    and the slo-gated replay over the pinned fixture
 #   TASK=serving     serving unit suite (planner/batcher/server + KV
 #                    cache + generation) + the serve_load and
 #                    serve_generate acceptance drills (>= 3x serial
@@ -147,6 +152,11 @@ print("kernel-tier MXL-K sweep OK "
     # MXL-D self-lint like elastic.py's
     JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
       mxnet_tpu/resilience/hotstate.py --fail-on=error --format=github
+    # the autotuner plans pod-wide chip windows (per-rank bench
+    # commands, sharding grammars, pruning verdicts) — its own source
+    # must stay divergence-clean under the MXL-D self-lint
+    JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+      mxnet_tpu/analysis/autotune.py --fail-on=error --format=github
     # the pre-fix PR-3 regression fixtures are expected-FAIL inputs:
     # MXL-D must keep flagging each with its documented rule id
     fx=tests/fixtures/divergence
@@ -308,6 +318,95 @@ assert ratio is not None and ratio > 1.05, rep["pod"]
 print("mxtop overlap_ratio %.3f OK" % ratio)
 '
     rm -rf "$TELDIR"
+    ;;
+  autotune)
+    # autotuner unit suite (docs/perf.md "Autotuning & chip windows"):
+    # the pinned v5e ceiling table, pruning-before-pricing, memoized
+    # sweeps, manifest determinism, the replay/correction loop
+    JAX_PLATFORMS=cpu python -m pytest tests/test_autotune.py -q
+    ATDIR="$(mktemp -d)"
+    # manifest determinism (snapshot assert): the same search inputs
+    # must produce byte-identical manifests.  Two fresh runs + cmp is
+    # the right snapshot — the provenance block pins the git commit,
+    # so a repo-committed byte snapshot would break on every merge.
+    JAX_PLATFORMS=cpu python tools/autotune.py --model resnet50 \
+      --device-kind v5e -o "$ATDIR/resnet.a.json"
+    JAX_PLATFORMS=cpu python tools/autotune.py --model resnet50 \
+      --device-kind v5e -o "$ATDIR/resnet.b.json"
+    cmp "$ATDIR/resnet.a.json" "$ATDIR/resnet.b.json"
+    echo "autotune manifest determinism OK (resnet50/v5e)"
+    # the v5e ranking pin: batch 512 (the 0.331 AOT ceiling) must rank
+    # above batch 256 (0.293) for ResNet-50, and the HBM-infeasible
+    # tail must have been pruned before pricing
+    python -c '
+import json, sys
+man = json.load(open(sys.argv[1]))
+top = man["configs"][0]
+assert top["config"]["batch"] == 512, top["config"]
+assert abs(top["predicted"]["mfu_ceiling"] - 0.331) < 0.01, top
+nxt = [e for e in man["configs"] if e["config"]["batch"] == 256][0]
+assert abs(nxt["predicted"]["mfu_ceiling"] - 0.293) < 0.01, nxt
+assert top["predicted"]["mfu_ceiling"] > nxt["predicted"]["mfu_ceiling"]
+assert top["bench_cmd"].startswith("BENCH_BATCH="), top["bench_cmd"]
+print("autotune v5e ranking pin OK: b512 %.4f > b256 %.4f"
+      % (top["predicted"]["mfu_ceiling"], nxt["predicted"]["mfu_ceiling"]))
+' "$ATDIR/resnet.a.json"
+    # dp=2,tp=2 transformer sweep: the SPMD axes must price (ICI bytes
+    # present) and the manifest must stay deterministic there too
+    JAX_PLATFORMS=cpu python tools/autotune.py --model transformer \
+      --space "sharding=dp2tp2;batch=8,16" -o "$ATDIR/tfm.a.json"
+    JAX_PLATFORMS=cpu python tools/autotune.py --model transformer \
+      --space "sharding=dp2tp2;batch=8,16" -o "$ATDIR/tfm.b.json"
+    cmp "$ATDIR/tfm.a.json" "$ATDIR/tfm.b.json"
+    python -c '
+import json, sys
+man = json.load(open(sys.argv[1]))
+assert man["configs"], man
+for e in man["configs"]:
+    assert e["config"]["sharding"] == "dp2tp2", e["config"]
+    assert e["predicted"]["ici_bytes"] and e["predicted"]["ici_bytes"] > 0, e
+print("autotune dp2tp2 transformer OK: %d configs, ici %.1f MB at top"
+      % (len(man["configs"]),
+         man["configs"][0]["predicted"]["ici_bytes"] / 1e6))
+' "$ATDIR/tfm.a.json"
+    # replay gate over the pinned fixture: the recorded chip-window
+    # payloads must pass the slo sentry clean against the committed
+    # BENCH_r05 baseline, fit a correction, and emit a corrected order
+    JAX_PLATFORMS=cpu python tools/autotune.py \
+      --replay "$ATDIR/resnet.a.json" \
+      --results tests/fixtures/autotune/replay_results.json \
+      --baseline BENCH_r05.json --fail-on-regression \
+      > "$ATDIR/replay.json"
+    python -c '
+import json, sys
+rep = json.load(open(sys.argv[1]))
+assert rep["regressions"] == 0, rep
+assert rep["correction"] and rep["correction"]["n"] >= 2, rep["correction"]
+assert rep["corrected_order"], rep
+ok = [r for r in rep["runs"] if r["status"] == "ok"]
+assert ok and all(r.get("slo_checked") for r in ok), rep["runs"]
+print("autotune replay gate OK: %d runs, correction a=%.3f"
+      % (len(ok), rep["correction"]["a"]))
+' "$ATDIR/replay.json"
+    # ...and a synthetic halved-throughput window must flag through the
+    # same gate (exit 1), like the observability benchdiff leg
+    python -c '
+import json, sys
+doc = json.load(open("tests/fixtures/autotune/replay_results.json"))
+for run in doc["runs"]:
+    run["value"] = run["value"] * 0.5
+    run["step_time_ms"] = run["step_time_ms"] * 2.0
+json.dump(doc, open(sys.argv[1], "w"))
+' "$ATDIR/regressed.json"
+    if JAX_PLATFORMS=cpu python tools/autotune.py \
+        --replay "$ATDIR/resnet.a.json" --results "$ATDIR/regressed.json" \
+        --baseline BENCH_r05.json --fail-on-regression \
+        > "$ATDIR/replay_bad.json"; then
+      echo "autotune replay FAILED to flag a halved-throughput window"
+      exit 1
+    fi
+    echo "autotune replay regression gate OK (clean passes, halved flags)"
+    rm -rf "$ATDIR"
     ;;
   serving)
     # serving stack (docs/serving.md): planner/batcher/server unit

@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""autotune: chip-free config search + chip-window replay driver
+(docs/perf.md "Autotuning & chip windows").
+
+Search mode prices a config grammar (batch / remat / sharding / dtype /
+bucket-MB / prefetch / serve blocks+buckets) against the chip-free
+MXL-R/MXL-M/MXL-K/MXL-D models in ``mxnet_tpu.analysis.autotune``,
+prunes infeasible candidates before pricing, and emits a
+**deterministic, provenance-stamped replay manifest**: the ordered
+top-K configs with predicted MFU / peak-HBM / ICI bytes and the exact
+``bench.py`` command line for each.  Same inputs -> byte-identical
+manifest.
+
+Replay mode walks a manifest through a scarce chip window: runs each
+config's bench command (``--execute``; stamps every BENCH line with
+the config id + manifest hash via ``BENCH_AUTOTUNE_*`` env), gates
+each result through the slo.py perf sentry against the committed
+BENCH trajectory, fits a measured-vs-predicted correction factor and
+re-ranks the remaining candidates mid-window.  Without ``--execute``
+it dry-runs (prints the commands); ``--results FILE`` replays a
+recorded result set (a JSON list of BENCH payloads) instead of
+touching hardware — the CI fixture path.
+
+Usage::
+
+    python tools/autotune.py --model resnet50 --device-kind v5e -o MANIFEST.json
+    python tools/autotune.py --model transformer --space "sharding=dp2tp2;batch=8,16"
+    python tools/autotune.py --replay MANIFEST.json                  # dry-run
+    python tools/autotune.py --replay MANIFEST.json --execute
+    python tools/autotune.py --replay MANIFEST.json --results RUNS.json \\
+        --fail-on-regression
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _git_commit():
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=10)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:
+        pass
+    return None
+
+
+def _search(args):
+    from mxnet_tpu.analysis import autotune as at
+    space = at.parse_space(args.space) if args.space \
+        else at.default_space(args.model)
+    result = at.search(args.model, device_kind=args.device_kind,
+                       space=space, hbm_gb=args.hbm_gb)
+    # provenance covers the search INPUTS (the output path / display
+    # flags must not break same-inputs -> byte-identical manifests)
+    manifest = at.build_manifest(
+        result, top_k=args.top_k,
+        provenance={"tool": "tools/autotune.py",
+                    "model": args.model,
+                    "device_kind": args.device_kind,
+                    "space_arg": args.space,
+                    "hbm_gb": args.hbm_gb,
+                    "top_k": args.top_k,
+                    "git_commit": _git_commit()})
+    text = at.canonical_json(manifest) + "\n"
+    if args.output:
+        with open(args.output, "w") as fout:
+            fout.write(text)
+    if args.json or not args.output:
+        sys.stdout.write(text)
+    if not args.json:
+        c = result["counts"]
+        sys.stderr.write(
+            "autotune: %s on %s — %d configs, %d priced, %d pruned "
+            "(%d symbol builds, %d analyses, %d memo hits)\n"
+            % (args.model, args.device_kind, c["total"], c["priced"],
+               c["pruned"], c["symbols_built"], c["analyses"],
+               c["memo_hits"]))
+        for e in manifest["configs"]:
+            cfg = e["config"]
+            sys.stderr.write(
+                "  #%d %s b%-5d remat=%-6s %s %s  mfu<=%.4f  "
+                "peak %.1f GB%s\n"
+                % (e["rank"], e["config_id"], cfg["batch"],
+                   cfg["remat"], cfg["sharding"], cfg["dtype"],
+                   e["predicted"]["mfu_ceiling"] or 0.0,
+                   e["predicted"]["peak_hbm_gb"] or 0.0,
+                   "  [pareto]" if e["pareto"] else ""))
+        for p in manifest["pruned"][:8]:
+            sys.stderr.write("  pruned %s: %s\n"
+                             % (p["config_id"], p["reason"]))
+    return 0
+
+
+def _run_bench(entry, manifest_hash, timeout):
+    """Execute one manifest bench command; returns the last BENCH JSON
+    payload on stdout, or None."""
+    cmd = "BENCH_AUTOTUNE_MANIFEST_HASH=%s %s" \
+        % (manifest_hash, entry["bench_cmd"])
+    try:
+        proc = subprocess.run(cmd, shell=True, capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None
+    payload = None
+    for line in (proc.stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                payload = json.loads(line)
+            except ValueError:
+                continue
+    return payload
+
+
+def _fixture_payload(entry, fixture, position):
+    """Match a recorded payload to a manifest entry: by config id when
+    stamped, else by rank-order position."""
+    for doc in fixture:
+        if doc.get("autotune_config_id") == entry["config_id"]:
+            return doc
+    return fixture[position] if position < len(fixture) else None
+
+
+def _replay(args):
+    from mxnet_tpu.analysis import autotune as at
+    from mxnet_tpu.observability import slo
+    try:
+        with open(args.replay) as fin:
+            manifest = json.load(fin)
+    except (OSError, ValueError) as exc:
+        sys.stderr.write("autotune: cannot read manifest %r: %s\n"
+                         % (args.replay, exc))
+        return 2
+    entries = list(manifest.get("configs") or [])
+    mhash = manifest.get("manifest_hash", "")
+    if not entries:
+        sys.stderr.write("autotune: manifest has no configs\n")
+        return 2
+
+    fixture = None
+    if args.results:
+        with open(args.results) as fin:
+            fixture = json.load(fin)
+        if isinstance(fixture, dict):
+            fixture = fixture.get("runs") or []
+    if not args.execute and fixture is None:
+        # dry run: the exact chip-window command sheet, in rank order
+        for e in entries:
+            print("BENCH_AUTOTUNE_MANIFEST_HASH=%s %s"
+                  % (mhash, e["bench_cmd"]))
+        return 0
+
+    spec = args.baseline or slo.baseline_spec()
+    trajectory = slo.load_trajectory(spec)
+    baseline = trajectory[-1][1] if trajectory else None
+    noise = slo.trajectory_noise(trajectory) if trajectory else {}
+
+    runs, pairs = [], []
+    regressed = 0
+    position = 0
+    remaining = list(entries)
+    while remaining:
+        entry = remaining.pop(0)
+        if fixture is not None:
+            payload = _fixture_payload(entry, fixture, position)
+        else:
+            payload = _run_bench(entry, mhash, args.timeout)
+        position += 1
+        run = {"config_id": entry["config_id"], "rank": entry["rank"],
+               "predicted_mfu_ceiling":
+               entry["predicted"].get("mfu_ceiling")}
+        if payload is None:
+            run["status"] = "no_result"
+            runs.append(run)
+            continue
+        run["status"] = "ok"
+        run["measured_mfu"] = payload.get("mfu")
+        run["metric"] = payload.get("metric")
+        run["value"] = payload.get("value")
+        if run["measured_mfu"] is not None and \
+                run["predicted_mfu_ceiling"] is not None:
+            run["mfu_gap"] = round(
+                run["predicted_mfu_ceiling"] - run["measured_mfu"], 4)
+            pairs.append((run["predicted_mfu_ceiling"],
+                          run["measured_mfu"]))
+        # slo gate: every real number joins the regression-guarded
+        # trajectory from the first run of the window
+        if baseline:
+            metrics = slo._bench_metrics(payload)
+            if metrics:
+                regressions, checked = slo.compare(
+                    metrics, baseline, noise=noise)
+                run["slo_checked"] = len(checked)
+                run["slo_regressions"] = regressions
+                regressed += len(regressions)
+        runs.append(run)
+        # mid-window re-rank: fit measured-vs-predicted, reorder what
+        # has not run yet
+        corr = at.fit_correction(pairs)
+        if corr:
+            remaining = at.rerank(remaining, corr)
+
+    corr = at.fit_correction(pairs)
+    report = {"manifest_hash": mhash,
+              "model": manifest.get("model"),
+              "baseline": spec if baseline else None,
+              "runs": runs,
+              "correction": corr,
+              "corrected_order": [e["config_id"] for e in
+                                  at.rerank(entries, corr)] if corr
+              else [e["config_id"] for e in entries],
+              "regressions": regressed}
+    text = json.dumps(report, indent=1, sort_keys=True) + "\n"
+    if args.report:
+        with open(args.report, "w") as fout:
+            fout.write(text)
+    sys.stdout.write(text)
+    if regressed and args.fail_on_regression:
+        sys.stderr.write("autotune: %d slo regression(s) in replay\n"
+                         % regressed)
+        return 1
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="autotune", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--model", default="resnet50",
+                    help="resnetNN or transformer (default resnet50)")
+    ap.add_argument("--device-kind", default="v5e")
+    ap.add_argument("--space", default=None,
+                    help='grammar string, e.g. "batch=64,512;'
+                         'remat=none,blocks;sharding=dp1,dp2tp2"')
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="override the device HBM budget")
+    ap.add_argument("--top-k", type=int, default=8)
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the replay manifest here")
+    ap.add_argument("--json", action="store_true",
+                    help="manifest JSON only on stdout (no summary)")
+    ap.add_argument("--replay", default=None, metavar="MANIFEST",
+                    help="drive a chip window from a manifest")
+    ap.add_argument("--execute", action="store_true",
+                    help="actually run the bench commands (default: "
+                         "dry-run print)")
+    ap.add_argument("--results", default=None,
+                    help="replay from a recorded JSON result list "
+                         "instead of running (CI fixture path)")
+    ap.add_argument("--report", default=None,
+                    help="write the replay report JSON here")
+    ap.add_argument("--baseline", default=None,
+                    help="slo baseline file/glob (default: "
+                         "$MXTPU_SLO_BASELINE, then BENCH_*.json)")
+    ap.add_argument("--fail-on-regression", action="store_true")
+    ap.add_argument("--timeout", type=float, default=1800.0,
+                    help="per-run timeout for --execute")
+    args = ap.parse_args(argv)
+    if args.replay:
+        return _replay(args)
+    return _search(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,18 +111,11 @@ def audit(batch, layers, dtype):
     # Chip-free cross-check: the analyzer's MXL-R roofline prices the
     # same graph without lowering anything — agreement with the compiled
     # cost analysis above validates the static model (docs/mfu_gap.md).
-    try:
-        from mxnet_tpu.analysis import static_mfu_ceiling
-        rep = static_mfu_ceiling(sym, {"data": (batch, 3, 224, 224)},
-                                 compute_dtype=dtype)
-        out["static_tflops_per_step"] = round(
-            rep["flops_per_step"] / 1e12, 3)
-        out["static_mfu_ceiling"] = (round(rep["mfu_ceiling"], 3)
-                                     if rep["mfu_ceiling"] is not None
-                                     else None)
-        out["static_bound"] = rep["bound"]
-    except Exception as exc:          # audit must not die on analyzer bugs
-        out["static_mfu_ceiling_error"] = str(exc)
+    # Shared summary path with bench.py / the autotuner; it never
+    # raises, so the audit can't die on analyzer bugs.
+    from mxnet_tpu.analysis import static_ceiling_summary
+    out.update(static_ceiling_summary(
+        sym, {"data": (batch, 3, 224, 224)}, compute_dtype=dtype))
     return out
 
 

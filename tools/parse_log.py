@@ -179,8 +179,25 @@ def parse_telemetry(path):
                         ",".join(sorted(skew))
     except Exception:
         pass
-    if not acc and any(c.startswith("serve-") for c in overlap_cols):
-        # serving-only event stream (serve_bench/mxserve): one summary row
+    # run-global bench columns (docs/perf.md "Autotuning & chip
+    # windows"): the predicted-vs-measured MFU gap (static ceiling −
+    # measured, from the bench summary record) and the autotune
+    # manifest config id a replay window stamped on the run.  The id
+    # is a string column, like serve-dtype / serve-kernel.
+    for rec in records:
+        if rec.get("kind") != "summary" or rec.get("source") != "bench":
+            continue
+        if rec.get("mfu") is not None and \
+                rec.get("static_mfu_ceiling") is not None:
+            overlap_cols["mfu-gap"] = round(
+                float(rec["static_mfu_ceiling"]) - float(rec["mfu"]), 4)
+        if rec.get("autotune_config_id"):
+            overlap_cols["autotune-config-id"] = \
+                str(rec["autotune_config_id"])
+    if not acc and (any(c.startswith("serve-") for c in overlap_cols)
+                    or "mfu-gap" in overlap_cols
+                    or "autotune-config-id" in overlap_cols):
+        # serving-/bench-only event stream: one summary row
         acc[0] = {"steps": 0, "dur_ms": [], "sps": []}
     rows = {}
     for ep, row in acc.items():
