@@ -59,6 +59,12 @@ MXL-D003  error     collective under rank-conditional control flow
 MXL-D004  error     rank-divergent value flows into a coordinated path
 MXL-D005  error     collective gated on rank-divergent control flow
 MXL-D006  warning   unbalanced collective on an exception edge
+MXL-Q001  error     shared attribute raced across threads w/o lock
+MXL-Q002  error     lock-order cycle (potential deadlock)
+MXL-Q003  warning   blocking call while holding a lock
+MXL-Q004  warning   thread started without registry or join path
+MXL-Q005  error     host-callback mutates step-path state unsynced
+MXL-Q006  warning   condition wait without predicate re-check loop
 ========  ========  ==================================================
 
 The MXL-P/M/C families only activate with SPMD context: pass ``mesh``
@@ -85,6 +91,14 @@ pass over Python source, activated by ``source_paths`` (the CLI's
 functions with ``base.collective_seam``; suppress intentional
 divergence with ``# mxl: rank-divergent-ok (MXL-D00x)``.
 
+The MXL-Q family is the concurrency lint (concurrency.py, docs/
+graph_lint.md): a source-level race/deadlock/blocking-under-lock pass
+over the threaded runtime, activated by ``source_paths`` (the CLI's
+``--concurrency``).  Mark dynamic thread entries with
+``base.thread_entry``; suppress intentional sharing with
+``# mxl: thread-shared-ok (MXL-Q00x)``.  The runtime witness for
+Q002 is ``observability.locktrace`` (``MXTPU_LOCKCHECK=1``).
+
 Suppress per node with the ``__lint_ignore__`` attr (comma-separated
 rule ids, or ``all``).
 """
@@ -108,6 +122,7 @@ from . import tiling as _tiling      # noqa: F401
 from . import roofline as _roofline  # noqa: F401
 from . import distributed as _distributed  # noqa: F401
 from . import divergence as _divergence    # noqa: F401
+from . import concurrency as _concurrency  # noqa: F401
 from .propagation import comm_report
 from .memory import peak_hbm_report, hbm_capacity_bytes
 from .tiling import register_kernel_spec, kernel_spec_issues
@@ -115,6 +130,7 @@ from .roofline import (roofline_report, static_ceiling_summary,
                        static_mfu_ceiling)
 from .distributed import collective_trace
 from .divergence import analyze_source_paths, collective_seam
+from .concurrency import analyze_concurrency_paths, thread_entry
 
 __all__ = ["GraphIssue", "AnalysisContext", "Rule", "RULE_REGISTRY",
            "register_rule", "run_rules", "format_issues", "SEVERITIES",
@@ -123,7 +139,8 @@ __all__ = ["GraphIssue", "AnalysisContext", "Rule", "RULE_REGISTRY",
            "hbm_capacity_bytes", "register_kernel_spec",
            "kernel_spec_issues", "roofline_report", "static_mfu_ceiling",
            "static_ceiling_summary",
-           "collective_trace", "analyze_source_paths", "collective_seam"]
+           "collective_trace", "analyze_source_paths", "collective_seam",
+           "analyze_concurrency_paths", "thread_entry"]
 
 
 class GraphLintWarning(UserWarning):

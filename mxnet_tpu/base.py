@@ -19,7 +19,7 @@ import numpy as _np
 __all__ = [
     "MXNetError", "NotSupportedForTPU", "mx_real_t", "mx_uint",
     "dtype_np_to_mx", "dtype_mx_to_np", "string_types", "numeric_types",
-    "collective_seam",
+    "collective_seam", "thread_entry",
 ]
 
 
@@ -46,6 +46,28 @@ def collective_seam(fn=None, **_meta):
     is exempt from MXL-D005.  Lives in base.py (a leaf module) so
     kvstore/parallel/resilience can mark their seams without importing
     the analysis package.  See docs/graph_lint.md (MXL-D).
+    """
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def thread_entry(fn=None, **_meta):
+    """Runtime no-op marker: this function is a thread entry point — its
+    body runs on a thread other than the one that constructed the object
+    (a ``threading.Thread`` target, a pool/launcher callback, a signal or
+    atexit handler).
+
+    The MXL-Q concurrency lint (``analysis/concurrency.py``) reads the
+    decorator from the source: attributes and module globals the function
+    touches are treated as shared across threads, so unsynchronized
+    writes that also appear on another thread's path are MXL-Q001/Q005.
+    Most entries are inferred automatically from ``Thread(target=...)``
+    and ``.submit(...)`` sites; the decorator exists for entries wired up
+    dynamically (registries, dispatch tables) that the AST pass cannot
+    see.  Lives in base.py (a leaf module) so serving/resilience/io can
+    mark their entries without importing the analysis package.  See
+    docs/graph_lint.md (MXL-Q).
     """
     if fn is None:
         return lambda f: f

@@ -62,6 +62,9 @@ def _register_prefetcher(it):
 
 def _stop_producers():
     global _SHUTTING_DOWN
+    # GIL-atomic monotonic flag (False -> True once, at interpreter
+    # exit); producers poll it, a stale read only delays shutdown by
+    # one iteration  # mxl: thread-shared-ok (MXL-Q001)
     _SHUTTING_DOWN = True
     for p in list(_LIVE_PREFETCHERS or ()):
         try:
@@ -411,15 +414,25 @@ class PrefetchingIter(DataIter):
             if not self.started or _SHUTTING_DOWN:
                 break
             try:
+                # the Event handshake IS the synchronization: slot i is
+                # only touched by the side holding its turn (producer
+                # after data_taken, consumer after data_ready)
+                # mxl: thread-shared-ok (MXL-Q001)
                 self.next_batch[i] = self.iters[i].next()
             except StopIteration:
                 self.next_batch[i] = None
+            # Event.clear is itself thread-safe; the list holding the
+            # events is never resized after __init__
+            # mxl: thread-shared-ok (MXL-Q001)
             self.data_taken[i].clear()
             self.data_ready[i].set()
 
     def _start_threads(self):
         if _SHUTTING_DOWN or self._closed:
             return
+        # GIL-atomic bool flag: producers re-check it after every
+        # data_taken handshake, so a stale read costs one extra batch,
+        # never a torn value  # mxl: thread-shared-ok (MXL-Q001)
         self.started = True
         self.prefetch_threads = [
             threading.Thread(target=self._prefetch_func, args=[i], daemon=True)

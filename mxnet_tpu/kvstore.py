@@ -115,6 +115,9 @@ class KVStore(object):
         for k, vals in zip(keys, groups):
             if k in self._store:
                 raise MXNetError("key %r already initialized" % (k,))
+            # init() happens-before any push/pull: the async FIFO
+            # worker only sees _store after a later submit()
+            # mxl: thread-shared-ok (MXL-Q001)
             self._store[k] = NDArray(vals[0].data)
 
     def push(self, key, value, priority=0):
@@ -304,6 +307,9 @@ class KVStore(object):
             return merged
         import numpy as _onp
         seq = self._ar_seq
+        # allreduce runs either inline or on the single async FIFO
+        # worker, never both at once — the mode is fixed per store
+        # mxl: thread-shared-ok (MXL-Q001)
         self._ar_seq += 1
         host = _onp.asarray(jax.device_get(merged))
         client.key_value_set("mxtpu_ar/%d/%d" % (seq, self.rank),
@@ -374,6 +380,9 @@ class KVStore(object):
     # -- updater / optimizer ----------------------------------------------
     def set_updater(self, updater):
         """Parity: kvstore.py _set_updater."""
+        # configured before training pushes work onto the async FIFO;
+        # a later swap takes effect on the next submitted bucket
+        # mxl: thread-shared-ok (MXL-Q001)
         self._updater = updater
 
     _set_updater = set_updater
@@ -663,6 +672,9 @@ def _collective_sum(value):
 
     if "mesh" not in _CSUM_CACHE:
         mesh = _csum_mesh()
+        # idempotent memo: a concurrent double-build computes the same
+        # mesh/jit twice, last write wins harmlessly
+        # mxl: thread-shared-ok (MXL-Q001)
         _CSUM_CACHE["mesh"] = mesh
         _CSUM_CACHE["sum"] = jax.jit(
             lambda x: jnp.sum(x, axis=0),

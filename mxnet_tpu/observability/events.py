@@ -137,7 +137,10 @@ class EventLog(object):
     def emit(self, kind, step=None, **fields):
         """Append one record.  No serialization, no IO — a tuple append
         plus a length check; the flusher thread does the rest."""
-        self._buf.append((kind, step, time.time(), fields))
+        # lock-free by design: list.append is GIL-atomic and flush()
+        # drains via a single swap, so emitters never wait on json/IO
+        self._buf.append(  # mxl: thread-shared-ok (MXL-Q001)
+            (kind, step, time.time(), fields))
         if kind == "fault":
             self.last_fault = {"step": step, "wall_ms": None}
             self.last_fault.update(fields)
@@ -212,6 +215,9 @@ class EventLog(object):
     def close(self):
         self._stop.set()
         self._wake.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
         try:
             self.flush()
         finally:

@@ -36,12 +36,14 @@ import sys
 import tempfile
 import threading
 import time
+import traceback
 
 from . import trace as _trace
 
 __all__ = ["depth", "get", "reset", "note", "collective_begin",
            "collective_end", "pending_collectives", "dump",
-           "set_liveness_probe", "dump_dir", "FlightRecorder"]
+           "set_liveness_probe", "dump_dir", "thread_stacks",
+           "FlightRecorder"]
 
 _DEFAULT_DEPTH = 512
 
@@ -67,6 +69,29 @@ def dump_dir():
     if events.enabled():
         return events.telemetry_dir()
     return os.path.join(tempfile.gettempdir(), "mxtpu-flight")
+
+
+def thread_stacks():
+    """Every live thread's current frames — the "who is holding the
+    wedged lock" half of a watchdog postmortem.  Pairs
+    ``sys._current_frames()`` with ``threading.enumerate()`` so each
+    stack carries the thread's name/daemon flag; threads the interpreter
+    knows but :mod:`threading` doesn't (C-spawned) appear by ident
+    only."""
+    frames = sys._current_frames()
+    by_ident = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        t = by_ident.get(ident)
+        out.append({
+            "name": t.name if t is not None else "<non-python>",
+            "ident": ident,
+            "daemon": bool(t.daemon) if t is not None else None,
+            "current": ident == threading.get_ident(),
+            "stack": "".join(traceback.format_stack(frame)),
+        })
+    out.sort(key=lambda rec: (not rec["current"], rec["name"]))
+    return out
 
 
 class FlightRecorder(object):
@@ -136,6 +161,10 @@ class FlightRecorder(object):
                 doc["absent_ranks"] = sorted(self._probe())
             except Exception:
                 doc["absent_ranks"] = None
+        try:
+            doc["threads"] = thread_stacks()
+        except Exception:
+            doc["threads"] = None
         return doc
 
     def dump(self, reason, directory=None, extra=None):

@@ -216,6 +216,9 @@ class DevicePrefetcher(object):
         if isinstance(item, _Raised):
             self._join()
             raise item.exc
+        # single consumer owns the counter; the producer only reads it
+        # for span step labels, where staleness is harmless
+        # mxl: thread-shared-ok (MXL-Q001)
         self._n += 1
         return item
 

@@ -72,6 +72,9 @@ class _TorchModule(OperatorProperty):
         finally:
             if was_training:
                 self.module.train()
+        # idempotent memo: the probe is deterministic for a shape, so a
+        # callback-thread/step-path double-fill writes the same tuple
+        # mxl: thread-shared-ok (MXL-Q005)
         self._shape_cache[in_shape] = tuple(out.shape)
         return self._shape_cache[in_shape]
 

@@ -458,8 +458,13 @@ class FleetRouter(object):
 
     def _write_verdict(self, members, reason, from_world):
         from ..resilience import elastic as _elastic
-        self._generation += 1
-        verdict = {"generation": self._generation,
+        # both the dispatch pool (swap) and the heartbeat thread land
+        # here; an unguarded += would let two verdicts share a
+        # generation number
+        with self._cv:
+            self._generation += 1
+            generation = self._generation
+        verdict = {"generation": generation,
                    "world_size": len(members),
                    "members": sorted(members),
                    "reason": reason,
@@ -475,7 +480,8 @@ class FleetRouter(object):
 
     @property
     def generation(self):
-        return self._generation
+        with self._lock:
+            return self._generation
 
     # -- admission -----------------------------------------------------
 
@@ -639,8 +645,10 @@ class FleetRouter(object):
             self._respawn_replica(rep)
 
     def _respawn_replica(self, rep):
+        with self._cv:
+            generation = self._generation
         try:
-            proc, client = self._spawner(rep.index, self._generation)
+            proc, client = self._spawner(rep.index, generation)
         except Exception as exc:
             rep.reason = "respawn failed: %r" % (exc,)
             return
@@ -747,8 +755,8 @@ class FleetRouter(object):
             out["queue_depth"] = len(self._queue) + sum(
                 r.inflight for r in self._replicas.values())
             pauses = list(self._swap_pause_ms)
+            out["generation"] = self._generation
         out["max_queue"] = self.max_queue
-        out["generation"] = self._generation
         out["replicas"] = reps
         out["version_skew"] = {v: sorted(idxs)
                                for v, idxs in sorted(skew.items())}
@@ -796,11 +804,17 @@ class FleetRouter(object):
             except TimeoutError:
                 pass
         with self._cv:
+            # the heartbeat loop polls this GIL-atomic monotonic flag
+            # unlocked; a stale read costs one 0.5 s beat, never a
+            # torn value  # mxl: thread-shared-ok (MXL-Q001)
             self._stop = True
             self._accepting = False
             self._cv.notify_all()
         for t in self._threads:
             t.join(timeout=2.0)
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=2.0)
+            self._health_thread = None
         for rep in self._replicas.values():
             if rep.proc is not None:
                 try:
@@ -1092,6 +1106,9 @@ def run_replica(spec_path, index, port, host="127.0.0.1"):
                                 make_replica_handler(srv, int(index)))
 
     def shutdown(_sig, _frm):
+        # deliberate fire-and-forget: httpd.shutdown() must run off the
+        # signal frame (it joins serve_forever), and the process exits
+        # right after it fires  # mxl: thread-shared-ok (MXL-Q004)
         _threading.Thread(target=httpd.shutdown, daemon=True).start()
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)

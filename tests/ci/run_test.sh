@@ -172,6 +172,27 @@ print("kernel-tier MXL-K sweep OK "
         echo "FIXTURE $file missing $rule:"; echo "$out"; exit 1; }
       echo "fixture $file flagged with $rule (expected-fail OK)"
     done
+    # concurrency self-lint (docs/graph_lint.md MXL-Q): the threaded
+    # serving/resilience/observability runtime must carry zero
+    # error-severity race / lock-order / callback-context findings
+    # (intentional lock-free handshakes are thread-shared-ok annotated
+    # with their happens-before argument)
+    JAX_PLATFORMS=cpu python tools/mxlint.py --concurrency \
+      mxnet_tpu --fail-on=error --format=github
+    # the pre-fix concurrency regression fixtures are expected-FAIL
+    # inputs: MXL-Q must keep flagging each with its documented rule id
+    qx=tests/fixtures/concurrency
+    for f in "$qx/torch_callback_race.py:MXL-Q005" \
+             "$qx/prefetcher_shutdown_race.py:MXL-Q001"; do
+      file="${f%:*}"; rule="${f##*:}"
+      if out=$(JAX_PLATFORMS=cpu python tools/mxlint.py --concurrency \
+          "$file" --fail-on=error --format=github); then
+        echo "FIXTURE NOT FLAGGED: $file"; exit 1
+      fi
+      echo "$out" | grep -q "$rule" || {
+        echo "FIXTURE $file missing $rule:"; echo "$out"; exit 1; }
+      echo "fixture $file flagged with $rule (expected-fail OK)"
+    done
     ;;
   python)
     make -s all || echo "native build unavailable; python fallback"
@@ -191,6 +212,13 @@ print("kernel-tier MXL-K sweep OK "
     MXTPU_NIGHTLY=1 python -m pytest tests/test_nightly_dist.py -x -q
     ;;
   resilience)
+    # the whole leg runs under the lock-discipline sanitizer
+    # (docs/graph_lint.md "MXL-Q"): every package lock records
+    # per-thread acquisition order, and a lock-order inversion anywhere
+    # in the sentinel/watchdog/elastic threads fails the suite as a
+    # structured ResilienceError(kind="lock_order") instead of an
+    # intermittent hang
+    export MXTPU_LOCKCHECK=1
     # fault-injection matrix (docs/resilience.md): injected NaN/hang/
     # ckpt-crash/dead-node faults must each hit their recovery path,
     # plus the kill-one-worker resume smoke
@@ -409,6 +437,11 @@ json.dump(doc, open(sys.argv[1], "w"))
     rm -rf "$ATDIR"
     ;;
   serving)
+    # the whole leg runs under the lock-discipline sanitizer — the
+    # batcher/fleet/router threads are the most lock-dense code in the
+    # tree; a lock-order inversion fails as a structured error instead
+    # of a flaky hang (docs/graph_lint.md "MXL-Q")
+    export MXTPU_LOCKCHECK=1
     # serving stack (docs/serving.md): planner/batcher/server unit
     # suite, then the acceptance drill — continuous batching must beat
     # the serial batch-1 Predictor >= 3x at bounded p95 with zero

@@ -23,3 +23,11 @@ jax.config.update("jax_platforms", "cpu")
 
 # fp64 for numeric-gradient checks (reference CPU tests run fp64 numpy refs)
 jax.config.update("jax_enable_x64", True)
+
+# MXTPU_LOCKCHECK=1 (serving/resilience CI legs): patch the lock
+# factories BEFORE any package module builds its runtime state, so
+# every package lock is traced and a live lock-order inversion raises
+# ResilienceError(kind="lock_order") instead of deadlocking the suite.
+from mxnet_tpu.observability import locktrace as _locktrace  # noqa: E402
+
+_locktrace.maybe_install()

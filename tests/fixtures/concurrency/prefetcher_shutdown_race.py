@@ -1,0 +1,49 @@
+"""Regression fixture: the pre-fix PR-8 PrefetchingIter shutdown race
+(io.py before the review fix).
+
+The prefetcher spawns a producer thread that writes the staged batch
+attribute in a loop.  The pre-fix ``reset()`` / ``close()`` cleared
+that same attribute and flipped the shutdown flag from the main
+thread WITHOUT the event handshake (and without joining the
+producer): a producer mid-``next()`` could re-stage a batch after the
+reset wiped it, resurrecting a consumed batch — or the process could
+exit while the producer still touched a half-torn-down iterator.
+
+MXL-Q must flag this with **MXL-Q001** (attribute written on the
+producer thread and accessed on the main path with no common lock)
+and **MXL-Q004** (the spawned producer is never joined or registered).
+This file is lint input only — never imported by the framework or the
+tests (``Prefetcher`` here is a stand-in for
+``mxnet_tpu.io.PrefetchingIter``).
+"""
+import threading
+
+
+class Prefetcher(object):
+    def __init__(self, it):
+        self._it = it
+        self._staged = None
+        self._shutdown = False
+        # BUG (MXL-Q004): the producer is started but never joined and
+        # never handed to a registry — close() just flips a flag and
+        # hopes the daemon thread notices before teardown.
+        threading.Thread(target=self._produce, daemon=True).start()
+
+    def _produce(self):
+        # producer thread: writes the staged slot with no lock
+        while not self._shutdown:
+            self._staged = next(self._it)
+
+    def next(self):
+        # main path: consumes the same slot, also unlocked — a reset
+        # racing _produce can resurrect an already-consumed batch
+        batch, self._staged = self._staged, None
+        return batch
+
+    def reset(self):
+        # BUG (MXL-Q001): main-thread wipe of producer-owned state
+        self._staged = None
+        self._it = iter(self._it)
+
+    def close(self):
+        self._shutdown = True

@@ -1,0 +1,39 @@
+"""Regression fixture: the pre-fix PR-13 torch host-callback race
+(plugin/torch_bridge.py before the review fix).
+
+The torch op wrapper runs its ``forward`` body inside
+``jax.pure_callback`` — i.e. on XLA's host-callback worker threads, of
+which there can be several when the op appears in a pmapped/sharded
+computation.  The pre-fix code mutated a plain dict
+(``self._stats``) from that callback body while the training loop's
+step path read and reset the same dict from the main thread, with no
+lock on either side: counters were lost and, under CPython dict
+resize, a concurrent read could see a half-populated view.
+
+MXL-Q must flag this with **MXL-Q005** (host-callback body mutates
+state that a step-path method accesses, no common lock).  This file is
+lint input only — never imported by the framework or the tests
+(``TorchOp`` here is a stand-in for
+``mxnet_tpu.plugin.torch_bridge.TorchOpWrapper``).
+"""
+
+
+class TorchOp(object):
+    host_callback = True    # forward runs inside jax.pure_callback
+
+    def __init__(self):
+        self._stats = {}
+
+    def forward(self, x):
+        # BUG: executed on the callback worker thread(s); mutates the
+        # shared stats dict with no lock while report()/reset_stats()
+        # read and clear it from the step path.
+        self._stats["calls"] = self._stats.get("calls", 0) + 1
+        return x
+
+    def report(self):
+        # step-path read of the same dict, also unlocked
+        return dict(self._stats)
+
+    def reset_stats(self):
+        self._stats = {}

@@ -1,0 +1,746 @@
+"""MXL-Q concurrency lint (analysis/concurrency.py) + the
+MXTPU_LOCKCHECK runtime lock-discipline sanitizer
+(observability/locktrace.py): race / lock-order / blocking-under-lock
+/ thread-leak / callback-context / condition-wait rules, the marker
+vocabulary, the two historical regression fixtures, and the live
+inversion witness."""
+import os
+import threading
+
+import pytest
+
+from mxnet_tpu.analysis.concurrency import analyze_concurrency_paths
+from mxnet_tpu.base import thread_entry
+from mxnet_tpu.observability import locktrace
+from mxnet_tpu.resilience import ResilienceError
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "concurrency")
+
+
+def _rules(findings):
+    return sorted({f["rule"] for f in findings})
+
+
+def _lint(tmp_path, code, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(code)
+    return analyze_concurrency_paths([str(p)], root=str(tmp_path))
+
+
+# ----------------------------------------------------------------------
+# Q001: shared-attribute race
+# ----------------------------------------------------------------------
+def test_q001_thread_write_main_read(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._work)\n"
+        "        self._t.start()\n"
+        "    def _work(self):\n"
+        "        self._latest = 1\n"
+        "    def read(self):\n"
+        "        return self._latest\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"))
+    assert "MXL-Q001" in _rules(fs)
+    hit = [f for f in fs if f["rule"] == "MXL-Q001"][0]
+    assert "_latest" in hit["message"]
+    assert hit["anchor"].endswith(":C._work")
+
+
+def test_q001_clean_with_common_lock(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._work)\n"
+        "        self._t.start()\n"
+        "    def _work(self):\n"
+        "        with self._lock:\n"
+        "            self._latest = 1\n"
+        "    def read(self):\n"
+        "        with self._lock:\n"
+        "            return self._latest\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"))
+    assert _rules(fs) == []
+
+
+def test_q001_main_main_not_flagged(tmp_path):
+    # two unlocked accessors, but no thread entry anywhere: single-
+    # threaded class, nothing to race with
+    fs = _lint(tmp_path, (
+        "class C:\n"
+        "    def set(self, v):\n"
+        "        self._v = v\n"
+        "    def get(self):\n"
+        "        return self._v\n"))
+    assert _rules(fs) == []
+
+
+def test_q001_executor_submit_counts_as_thread(tmp_path):
+    fs = _lint(tmp_path, (
+        "class C:\n"
+        "    def kick(self, pool):\n"
+        "        return pool.submit(self._work)\n"
+        "    def _work(self):\n"
+        "        self._result = 42\n"
+        "    def read(self):\n"
+        "        return self._result\n"))
+    assert "MXL-Q001" in _rules(fs)
+
+
+def test_q001_helper_called_only_under_lock_is_clean(tmp_path):
+    # the write lives in a helper scanned with held=∅, but every call
+    # site holds the lock: effective_locks() must credit it
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._work)\n"
+        "        self._t.start()\n"
+        "    def _work(self):\n"
+        "        with self._lock:\n"
+        "            self._bump()\n"
+        "    def _bump(self):\n"
+        "        self._n = 1\n"
+        "    def read(self):\n"
+        "        with self._lock:\n"
+        "            return self._n\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"))
+    assert _rules(fs) == []
+
+
+def test_q001_module_global(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "STATE = {}\n"
+        "def _work():\n"
+        "    global STATE\n"
+        "    STATE = {'x': 1}\n"
+        "def start():\n"
+        "    t = threading.Thread(target=_work)\n"
+        "    t.start()\n"
+        "    return t\n"
+        "def read():\n"
+        "    return STATE\n"))
+    assert "MXL-Q001" in _rules(fs)
+
+
+def test_q001_init_writes_exempt(tmp_path):
+    # __init__ runs before the thread exists: publication via
+    # constructor is the universal safe idiom, never flagged
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._latest = None\n"
+        "        self._t = threading.Thread(target=self._work)\n"
+        "        self._t.start()\n"
+        "    def _work(self):\n"
+        "        x = self._latest\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"))
+    assert "MXL-Q001" not in _rules(fs)
+
+
+def test_q001_mutator_call_is_a_write(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._work)\n"
+        "        self._t.start()\n"
+        "    def _work(self):\n"
+        "        self._out.append(1)\n"
+        "    def drain(self):\n"
+        "        return list(self._out)\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"))
+    assert "MXL-Q001" in _rules(fs)
+
+
+# ----------------------------------------------------------------------
+# Q002: lock-order cycle
+# ----------------------------------------------------------------------
+def test_q002_two_lock_inversion(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def fwd(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def rev(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"))
+    assert "MXL-Q002" in _rules(fs)
+
+
+def test_q002_three_lock_ring(tmp_path):
+    # a->b, b->c, c->a: no two-lock inversion anywhere, only the ring
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "        self._c = threading.Lock()\n"
+        "    def ab(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def bc(self):\n"
+        "        with self._b:\n"
+        "            with self._c:\n"
+        "                pass\n"
+        "    def ca(self):\n"
+        "        with self._c:\n"
+        "            with self._a:\n"
+        "                pass\n"))
+    assert "MXL-Q002" in _rules(fs)
+
+
+def test_q002_consistent_order_clean(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"))
+    assert "MXL-Q002" not in _rules(fs)
+
+
+def test_q002_cross_method_via_call(tmp_path):
+    # fwd holds a and CALLS a method that takes b; rev takes b then a:
+    # the edge must flow through the one-hop call graph
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def fwd(self):\n"
+        "        with self._a:\n"
+        "            self._inner()\n"
+        "    def _inner(self):\n"
+        "        with self._b:\n"
+        "            pass\n"
+        "    def rev(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"))
+    assert "MXL-Q002" in _rules(fs)
+
+
+# ----------------------------------------------------------------------
+# Q003: blocking call under lock
+# ----------------------------------------------------------------------
+def test_q003_sleep_under_lock(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading, time\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def poll(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.5)\n"))
+    assert "MXL-Q003" in _rules(fs)
+
+
+def test_q003_future_result_under_lock(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def wait_done(self, fut):\n"
+        "        with self._lock:\n"
+        "            return fut.result()\n"))
+    assert "MXL-Q003" in _rules(fs)
+
+
+def test_q003_sleep_outside_lock_clean(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading, time\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def poll(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "        time.sleep(0.5)\n"))
+    assert "MXL-Q003" not in _rules(fs)
+
+
+def test_q003_condition_wait_on_held_lock_exempt(tmp_path):
+    # cv.wait() RELEASES the lock it waits on: the canonical pattern
+    # must not be called "blocking under lock"
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "    def take(self):\n"
+        "        with self._cv:\n"
+        "            while not self._ready:\n"
+        "                self._cv.wait()\n"))
+    assert "MXL-Q003" not in _rules(fs)
+
+
+def test_q003_nonblocking_queue_get_clean(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def drain(self):\n"
+        "        with self._lock:\n"
+        "            return self._queue.get(block=False)\n"))
+    assert "MXL-Q003" not in _rules(fs)
+
+
+# ----------------------------------------------------------------------
+# Q004: thread leak
+# ----------------------------------------------------------------------
+def test_q004_unjoined_thread(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._work).start()\n"
+        "    def _work(self):\n"
+        "        pass\n"))
+    assert "MXL-Q004" in _rules(fs)
+
+
+def test_q004_joined_thread_clean(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._work)\n"
+        "        self._t.start()\n"
+        "    def _work(self):\n"
+        "        pass\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"))
+    assert "MXL-Q004" not in _rules(fs)
+
+
+def test_q004_swap_alias_join_credited(tmp_path):
+    # the idiomatic teardown: t, self._t = self._t, None; t.join()
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._work)\n"
+        "        self._t.start()\n"
+        "    def _work(self):\n"
+        "        pass\n"
+        "    def close(self):\n"
+        "        t, self._t = self._t, None\n"
+        "        if t is not None:\n"
+        "            t.join(timeout=2.0)\n"))
+    assert "MXL-Q004" not in _rules(fs)
+
+
+def test_q004_registry_call_credited(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        t = threading.Thread(target=self._work)\n"
+        "        t.start()\n"
+        "        _register_producer(t)\n"
+        "    def _work(self):\n"
+        "        pass\n"))
+    assert "MXL-Q004" not in _rules(fs)
+
+
+# ----------------------------------------------------------------------
+# Q005: callback-context violation
+# ----------------------------------------------------------------------
+def test_q005_pure_callback_mutation(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "class C:\n"
+        "    def run(self, x):\n"
+        "        return jax.pure_callback(self._cb, x, x)\n"
+        "    def _cb(self, x):\n"
+        "        self._count = self._count + 1\n"
+        "        return x\n"
+        "    def report(self):\n"
+        "        return self._count\n"))
+    assert "MXL-Q005" in _rules(fs)
+
+
+def test_q005_host_callback_class_attr(tmp_path):
+    # host_callback = True marks forward/backward as callback roots
+    # (the torch_bridge idiom)
+    fs = _lint(tmp_path, (
+        "class Op:\n"
+        "    host_callback = True\n"
+        "    def forward(self, x):\n"
+        "        self._cache[x.shape] = x\n"
+        "        return x\n"
+        "    def stats(self):\n"
+        "        return len(self._cache)\n"))
+    assert "MXL-Q005" in _rules(fs)
+
+
+def test_q005_locked_callback_clean(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class Op:\n"
+        "    host_callback = True\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def forward(self, x):\n"
+        "        with self._lock:\n"
+        "            self._cache[x.shape] = x\n"
+        "        return x\n"
+        "    def stats(self):\n"
+        "        with self._lock:\n"
+        "            return len(self._cache)\n"))
+    assert "MXL-Q005" not in _rules(fs)
+
+
+# ----------------------------------------------------------------------
+# Q006: condition wait without predicate re-check
+# ----------------------------------------------------------------------
+def test_q006_bare_wait(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "    def take(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.wait()\n"
+        "            return self._item\n"))
+    assert "MXL-Q006" in _rules(fs)
+
+
+def test_q006_while_predicate_clean(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "    def take(self):\n"
+        "        with self._cv:\n"
+        "            while self._item is None:\n"
+        "                self._cv.wait()\n"
+        "            return self._item\n"))
+    assert "MXL-Q006" not in _rules(fs)
+
+
+# ----------------------------------------------------------------------
+# markers: @thread_entry and # mxl: thread-shared-ok
+# ----------------------------------------------------------------------
+def test_thread_entry_decorator_is_noop():
+    @thread_entry
+    def f():
+        return 7
+
+    @thread_entry(daemon=True)
+    def g():
+        return 8
+
+    assert f() == 7 and g() == 8
+
+
+def test_thread_entry_decorator_marks_context(tmp_path):
+    # no Thread(...) call in sight — the decorator alone must tag
+    # _work as a thread root so the race is visible
+    fs = _lint(tmp_path, (
+        "from mxnet_tpu.base import thread_entry\n"
+        "class C:\n"
+        "    @thread_entry\n"
+        "    def _work(self):\n"
+        "        self._latest = 1\n"
+        "    def read(self):\n"
+        "        return self._latest\n"))
+    assert "MXL-Q001" in _rules(fs)
+
+
+def test_suppression_marker_on_line(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._work)\n"
+        "        self._t.start()\n"
+        "    def _work(self):\n"
+        "        self._latest = 1  # mxl: thread-shared-ok\n"
+        "    def read(self):\n"
+        "        return self._latest\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"))
+    assert "MXL-Q001" not in _rules(fs)
+
+
+def test_suppression_marker_rule_filtered(tmp_path):
+    # suppressing a DIFFERENT rule must not hide the Q001 finding
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._work)\n"
+        "        self._t.start()\n"
+        "    def _work(self):\n"
+        "        self._latest = 1  # mxl: thread-shared-ok (MXL-Q003)\n"
+        "    def read(self):\n"
+        "        return self._latest\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"))
+    assert "MXL-Q001" in _rules(fs)
+
+
+def test_suppression_marker_on_def(tmp_path):
+    fs = _lint(tmp_path, (
+        "import threading\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._work)\n"
+        "        self._t.start()\n"
+        "    # mxl: thread-shared-ok (MXL-Q001)\n"
+        "    def _work(self):\n"
+        "        self._latest = 1\n"
+        "    def read(self):\n"
+        "        return self._latest\n"
+        "    def close(self):\n"
+        "        self._t.join()\n"))
+    assert "MXL-Q001" not in _rules(fs)
+
+
+def test_parse_error_is_a_warning_finding(tmp_path):
+    fs = _lint(tmp_path, "def broken(:\n", name="broken.py")
+    assert len(fs) == 1
+    assert fs[0]["rule"] == "MXL-Q001"
+    assert "cannot parse" in fs[0]["message"]
+
+
+# ----------------------------------------------------------------------
+# historical regression fixtures
+# ----------------------------------------------------------------------
+def test_fixture_torch_callback_race():
+    fs = analyze_concurrency_paths(
+        [os.path.join(FIXTURES, "torch_callback_race.py")], root=ROOT)
+    assert "MXL-Q005" in _rules(fs)
+    hit = [f for f in fs if f["rule"] == "MXL-Q005"][0]
+    assert "_stats" in hit["message"]
+
+
+def test_fixture_prefetcher_shutdown_race():
+    fs = analyze_concurrency_paths(
+        [os.path.join(FIXTURES, "prefetcher_shutdown_race.py")],
+        root=ROOT)
+    rules = _rules(fs)
+    assert "MXL-Q001" in rules and "MXL-Q004" in rules
+    q1 = [f for f in fs if f["rule"] == "MXL-Q001"]
+    assert any("_staged" in f["message"] for f in q1)
+
+
+def test_framework_self_lint_clean():
+    # the acceptance gate: the shipped package carries no MXL-Q
+    # findings (real fixes + audited annotations)
+    pkg = os.path.join(ROOT, "mxnet_tpu")
+    fs = analyze_concurrency_paths([pkg], root=ROOT)
+    assert fs == [], [(f["rule"], f["anchor"], f["line"]) for f in fs]
+
+
+# ----------------------------------------------------------------------
+# mxlint CLI family plumbing
+# ----------------------------------------------------------------------
+def test_mxlint_concurrency_family(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "mxlint", os.path.join(ROOT, "tools", "mxlint.py"))
+    mxlint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mxlint)
+    p = tmp_path / "racy.py"
+    p.write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._work).start()\n"
+        "    def _work(self):\n"
+        "        self._x = 1\n"
+        "    def read(self):\n"
+        "        return self._x\n")
+    _label, issues, _ctx = mxlint.lint_sources(
+        [str(p)], None, [], families=["MXL-Q*"])
+    rules = {i.rule_id for i in issues}
+    assert "MXL-Q001" in rules and "MXL-Q004" in rules
+    # the distributed family alone must NOT surface Q findings
+    _label, issues_d, _ctx = mxlint.lint_sources(
+        [str(p)], None, [], families=["MXL-D*"])
+    assert {i.rule_id for i in issues_d} == set()
+    # --select wildcard narrows within the family
+    _label, issues_sel, _ctx = mxlint.lint_sources(
+        [str(p)], ["MXL-Q004"], [])
+    assert {i.rule_id for i in issues_sel} == {"MXL-Q004"}
+
+
+# ----------------------------------------------------------------------
+# runtime sanitizer: observability/locktrace.py
+# ----------------------------------------------------------------------
+@pytest.fixture
+def traced():
+    was = locktrace.installed()
+    locktrace.install()
+    locktrace.reset_order_graph()
+    yield
+    locktrace.reset_order_graph()
+    if not was:
+        locktrace.uninstall()
+
+
+def test_locktrace_live_inversion(traced):
+    a = threading.Lock()
+    b = threading.Lock()     # NB: distinct creation lines — the graph
+    # keys locks by site, same-line locks coalesce into one node
+    with a:
+        with b:
+            pass
+    with pytest.raises(ResilienceError) as exc:
+        with b:
+            with a:
+                pass
+    assert exc.value.kind == "lock_order"
+    assert "inversion" in str(exc.value)
+    # the failed acquire must not leave `a` wedged
+    assert a.acquire(blocking=False)
+    a.release()
+
+
+def test_locktrace_consistent_order_ok(traced):
+    a = threading.Lock()
+    b = threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert len(locktrace.order_edges()) == 1
+
+
+def test_locktrace_rlock_reentrancy_no_edge(traced):
+    r = threading.RLock()
+    with r:
+        with r:
+            pass
+    assert locktrace.order_edges() == []
+
+
+def test_locktrace_condition_wait_releases(traced):
+    # a condition wait must not pin the cv's lock into order edges
+    # against locks taken by the notifier
+    cv = threading.Condition(threading.Lock())
+    other = threading.Lock()
+    ready = []
+
+    def notifier():
+        with other:
+            pass
+        with cv:
+            ready.append(1)
+            cv.notify()
+
+    t = threading.Thread(target=notifier)
+    with cv:
+        t.start()
+        while not ready:
+            cv.wait(timeout=5.0)
+    t.join()
+    # now take (cv's lock -> other) on this thread: if wait() had NOT
+    # released through the traced path, bookkeeping would still show
+    # cv held during notifier's `other` and this would look inverted
+    with cv:
+        with other:
+            pass
+
+
+def test_locktrace_uninstall_restores():
+    was = locktrace.installed()
+    locktrace.install()
+    assert threading.Lock is locktrace.TracedLock
+    if not was:
+        locktrace.uninstall()
+        assert threading.Lock is locktrace._ORIG_LOCK
+        assert not locktrace.installed()
+
+
+def test_locktrace_cross_thread_edges(traced):
+    # the graph is process-global: the two opposing orders never
+    # interleave, they run SEQUENTIALLY on two different threads, and
+    # the second still trips
+    a = threading.Lock()
+    b = threading.Lock()
+    caught = []
+
+    def fwd():
+        with a:
+            with b:
+                pass
+
+    def rev():
+        try:
+            with b:
+                with a:
+                    pass
+        except ResilienceError as e:
+            caught.append(e)
+
+    t1 = threading.Thread(target=fwd)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=rev)
+    t2.start()
+    t2.join()
+    assert len(caught) == 1 and caught[0].kind == "lock_order"
+
+
+# ----------------------------------------------------------------------
+# flight recorder: per-thread stacks in the postmortem
+# ----------------------------------------------------------------------
+def test_flight_snapshot_has_thread_stacks():
+    from mxnet_tpu.observability import flight
+    ev = threading.Event()
+    t = threading.Thread(target=ev.wait, name="wedged-worker")
+    t.start()
+    try:
+        doc = flight.FlightRecorder(depth=8).snapshot(reason="test")
+        ths = doc["threads"]
+        names = [x["name"] for x in ths]
+        assert "wedged-worker" in names
+        assert ths[0]["current"] is True     # snapshotting thread first
+        wedged = [x for x in ths if x["name"] == "wedged-worker"][0]
+        assert "wait" in wedged["stack"]
+        assert wedged["daemon"] is False
+    finally:
+        ev.set()
+        t.join()

@@ -34,10 +34,19 @@ files and directories among the positional targets are source-linted:
   python tools/mxlint.py --all-models --distributed --world-size 4
   python tools/mxlint.py --distributed mxnet_tpu --fail-on=error
 
+The concurrency family (MXL-Q) is the thread-safety lint over the same
+source targets: shared-attribute races, lock-order cycles, blocking
+under lock, thread leaks, host-callback violations, missing wait
+re-check loops.  ``--concurrency`` turns it on (combine with
+``--distributed`` to run both source families in one sweep):
+
+  python tools/mxlint.py --concurrency mxnet_tpu --fail-on=error
+  python tools/mxlint.py --concurrency --distributed mxnet_tpu
+
 ``--diff [REV]`` lints only what a change touches — changed symbol
 JSONs, the models whose builders changed, and changed framework .py
-files (rank-divergence pass) — the fast pre-merge step ahead of the
-full sweep (REV defaults to HEAD):
+files (rank-divergence pass; plus MXL-Q with ``--concurrency``) — the
+fast pre-merge step ahead of the full sweep (REV defaults to HEAD):
 
   python tools/mxlint.py --diff origin/main --fail-on=error
 
@@ -210,14 +219,16 @@ def lint_model(name, kwargs, shapes, target, select, skip, **spmd):
     return "model:%s" % name, issues, (ctx_out[0] if ctx_out else None)
 
 
-def lint_sources(paths, select, skip, world_size=None):
-    """Run the rank-divergence pass (MXL-D004..006) over .py files and
+def lint_sources(paths, select, skip, world_size=None, families=None):
+    """Run the source-reading pass families over .py files and
     directories; returns the same (label, issues, ctx) triple shape.
-    Defaults to the MXL-D family — the only rules that read source."""
+    ``families`` picks the default rule set when no --select is given:
+    MXL-D* (rank divergence), MXL-Q* (concurrency), or both."""
     from mxnet_tpu.analysis import analyze
     issues = analyze(None, source_paths=list(paths),
                      world_size=world_size,
-                     select=(select or ["MXL-D*"]), skip=skip)
+                     select=(select or families or ["MXL-D*"]),
+                     skip=skip)
     return "sources", issues, None
 
 
@@ -441,6 +452,12 @@ def main(argv=None):
                          "collective-trace diff on graphs (D001..003) "
                          "and the rank-divergence source pass "
                          "(D004..006) on .py targets")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="enable the MXL-Q concurrency family over "
+                         ".py source targets: shared-state races, "
+                         "lock-order cycles, blocking under lock, "
+                         "thread leaks, callback-context violations, "
+                         "wait-loop hygiene")
     ap.add_argument("--world-size", type=int, default=None,
                     metavar="N",
                     help="simulated pod size for the trace diff "
@@ -555,8 +572,14 @@ def main(argv=None):
             targets.append(lint_file(path, shapes, args.target, select,
                                      skip, **spmd))
         if source_paths:
+            families = []
+            if args.distributed or not args.concurrency:
+                families.append("MXL-D*")
+            if args.concurrency:
+                families.append("MXL-Q*")
             targets.append(lint_sources(source_paths, select, skip,
-                                        world_size=world_size))
+                                        world_size=world_size,
+                                        families=families))
         sweep = list(MODEL_SWEEP) if args.all_models else []
         for name in args.model:
             row = next((r for r in MODEL_SWEEP if r[0] == name),
