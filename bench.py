@@ -159,6 +159,7 @@ def _parse_utc_ts(text):
 
 def _emit(payload):
     _stamp_autotune(payload)
+    _stamp_retrace(payload)
     sys.stdout.write(json.dumps(payload) + "\n")
     _emit_telemetry_summary(payload)
 
@@ -175,6 +176,27 @@ def _stamp_autotune(payload):
         payload.setdefault("autotune_config_id", cfg)
     if man:
         payload.setdefault("autotune_manifest_hash", man)
+    return payload
+
+
+def _stamp_retrace(payload):
+    """When the retrace sentry is on (``MXTPU_RETRACE_SENTRY=1``),
+    stamp the post-warmup retrace count and the divergent-ingredient
+    names into every BENCH line so benchdiff (slo.py DIRECTIONS) flags
+    any nonzero value.  No-op with the sentry off — keys are simply
+    absent."""
+    try:
+        from mxnet_tpu.observability import retrace as _retrace
+        if not _retrace.installed():
+            return payload
+        st = _retrace.stats()
+        payload.setdefault("retraces_after_warmup",
+                           st["retraces_after_warmup"])
+        payload.setdefault("retrace_attributions",
+                           [",".join(a["divergent"])
+                            for a in st["attributions"]])
+    except Exception:
+        pass
     return payload
 
 
@@ -437,6 +459,13 @@ def measure():
     forced = os.environ.get("BENCH_FORCE_PLATFORM")
     if forced:
         jax.config.update("jax_platforms", forced)
+    # MXTPU_RETRACE_SENTRY=1: _stamp_retrace adds the attributed
+    # post-warmup retrace count to every BENCH line
+    try:
+        from mxnet_tpu.observability import retrace as _retrace_sentry
+        _retrace_sentry.maybe_install()
+    except Exception:
+        pass
     from mxnet_tpu.models import resnet
     from mxnet_tpu import optimizer as opt_mod
     from mxnet_tpu.parallel import make_mesh

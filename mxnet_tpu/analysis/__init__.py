@@ -65,6 +65,12 @@ MXL-Q003  warning   blocking call while holding a lock
 MXL-Q004  warning   thread started without registry or join path
 MXL-Q005  error     host-callback mutates step-path state unsynced
 MXL-Q006  warning   condition wait without predicate re-check loop
+MXL-X001  error     python control flow on a tracer in a traced scope
+MXL-X002  error     unstable cache-key ingredient (id/order/env read)
+MXL-X003  error     jit/lower constructed on a per-request/step path
+MXL-X004  warning   bare python scalar crosses the trace boundary
+MXL-X005  error     unbucketed dynamic shape fed to an AOT table
+MXL-X006  error     donated buffer reused after donation
 ========  ========  ==================================================
 
 The MXL-P/M/C families only activate with SPMD context: pass ``mesh``
@@ -99,6 +105,17 @@ over the threaded runtime, activated by ``source_paths`` (the CLI's
 ``# mxl: thread-shared-ok (MXL-Q00x)``.  The runtime witness for
 Q002 is ``observability.locktrace`` (``MXTPU_LOCKCHECK=1``).
 
+The MXL-X family is the retrace-stability lint (retrace.py, docs/
+graph_lint.md): a source-level pass proving the zero-steady-state-
+lowerings contract — no per-value retraces, stable compile-cache
+keys, no hot-path jit construction, bucket-routed AOT serving —
+activated by ``source_paths`` (the CLI's ``--retrace``).  Mark
+indirectly-traced functions with ``base.traced_scope``; suppress
+intentional hazards with ``# mxl: retrace-ok (MXL-X00x)``.  The
+runtime witness is ``observability.retrace``
+(``MXTPU_RETRACE_SENTRY=1``), which counts and attributes every
+post-warmup lowering.
+
 Suppress per node with the ``__lint_ignore__`` attr (comma-separated
 rule ids, or ``all``).
 """
@@ -123,6 +140,7 @@ from . import roofline as _roofline  # noqa: F401
 from . import distributed as _distributed  # noqa: F401
 from . import divergence as _divergence    # noqa: F401
 from . import concurrency as _concurrency  # noqa: F401
+from . import retrace as _retrace          # noqa: F401
 from .propagation import comm_report
 from .memory import peak_hbm_report, hbm_capacity_bytes
 from .tiling import register_kernel_spec, kernel_spec_issues
@@ -131,6 +149,7 @@ from .roofline import (roofline_report, static_ceiling_summary,
 from .distributed import collective_trace
 from .divergence import analyze_source_paths, collective_seam
 from .concurrency import analyze_concurrency_paths, thread_entry
+from .retrace import analyze_retrace_paths, traced_scope
 
 __all__ = ["GraphIssue", "AnalysisContext", "Rule", "RULE_REGISTRY",
            "register_rule", "run_rules", "format_issues", "SEVERITIES",
@@ -140,7 +159,8 @@ __all__ = ["GraphIssue", "AnalysisContext", "Rule", "RULE_REGISTRY",
            "kernel_spec_issues", "roofline_report", "static_mfu_ceiling",
            "static_ceiling_summary",
            "collective_trace", "analyze_source_paths", "collective_seam",
-           "analyze_concurrency_paths", "thread_entry"]
+           "analyze_concurrency_paths", "thread_entry",
+           "analyze_retrace_paths", "traced_scope"]
 
 
 class GraphLintWarning(UserWarning):

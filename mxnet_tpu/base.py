@@ -19,7 +19,7 @@ import numpy as _np
 __all__ = [
     "MXNetError", "NotSupportedForTPU", "mx_real_t", "mx_uint",
     "dtype_np_to_mx", "dtype_mx_to_np", "string_types", "numeric_types",
-    "collective_seam", "thread_entry",
+    "collective_seam", "thread_entry", "traced_scope",
 ]
 
 
@@ -68,6 +68,30 @@ def thread_entry(fn=None, **_meta):
     see.  Lives in base.py (a leaf module) so serving/resilience/io can
     mark their entries without importing the analysis package.  See
     docs/graph_lint.md (MXL-Q).
+    """
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def traced_scope(fn=None, **_meta):
+    """Runtime no-op marker: this function's body is traced by jax
+    (``jax.jit``/``pjit``/``pallas_call``) — its Python statements run
+    ONCE per distinct abstract signature, and anything the body reads
+    from the host (environment variables, mutable globals, wall clock)
+    is baked into the compiled program.
+
+    The MXL-X retrace-stability lint (``analysis/retrace.py``) reads
+    the decorator from the source: decorated functions are audited as
+    traced scopes (python control flow on tensor-derived values is
+    MXL-X001, an environment read inside the body is MXL-X002) even
+    when the ``jax.jit(...)`` call that traces them lives in another
+    file and the AST pass cannot see the connection.  Most traced
+    scopes are inferred automatically from same-file ``jax.jit``/
+    ``pallas_call`` sites; the decorator exists for the indirect ones.
+    Lives in base.py (a leaf module) so executor/kernels/serving can
+    mark their traces without importing the analysis package.  See
+    docs/graph_lint.md (MXL-X).
     """
     if fn is None:
         return lambda f: f

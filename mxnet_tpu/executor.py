@@ -196,6 +196,26 @@ def program_registry_stats():
     return stats
 
 
+def _bind_env_fingerprint(validate_mode):
+    """Host state a program build bakes in beyond (symbol, group2ctx):
+    the compute dtype, the backward-mirror envs read by
+    ``_mirror_segments``, and the active validation-rules fingerprint.
+    Folded into both the per-symbol ``_jit_cache`` key and (via
+    ``ctx_key``) the global ``_PROGRAM_REGISTRY`` key so a flag flip
+    between binds lowers a fresh program instead of reusing a stale one
+    (MXL-X002: every baked ingredient must be a key ingredient)."""
+    import os
+    if validate_mode == "off":
+        rules = ("off",)
+    else:
+        from .analysis import RULE_REGISTRY
+        rules = (validate_mode,) + tuple(sorted(RULE_REGISTRY))
+    return (os.environ.get("MXNET_COMPUTE_DTYPE", ""),
+            os.environ.get("MXNET_BACKWARD_DO_MIRROR", ""),
+            os.environ.get("MXNET_BACKWARD_MIRROR_STEP", ""),
+            rules)
+
+
 def _lookup_program(symbol, ctx_key, group2ctx):
     import os
     from .parallel import overlap as _overlap
@@ -463,12 +483,18 @@ class Executor:
         else:
             self.outputs = [None] * len(self._out_names)
 
-        # The traced program is a pure function of (symbol, group2ctx) — NOT
-        # of this executor — and is cached on the symbol so every executor
-        # bound to the same graph shares one compile cache (the analog of
-        # GraphStoragePool sharing; also what makes repeated bind cheap).
+        # The traced program is a pure function of (symbol, group2ctx,
+        # baked host flags) — NOT of this executor — and is cached on the
+        # symbol so every executor bound to the same graph shares one
+        # compile cache (the analog of GraphStoragePool sharing; also what
+        # makes repeated bind cheap).  The key folds in every env/flag the
+        # build actually bakes (compute dtype, the backward-mirror envs
+        # read by _mirror_segments) plus the validation-rules fingerprint,
+        # so a flag flip between binds cannot reuse a stale program.
         # Caching bound methods here would pin the first executor's buffers.
-        cache_key = tuple(sorted((k, str(v)) for k, v in self._group2ctx.items()))
+        cache_key = (tuple(sorted((k, str(v))
+                                  for k, v in self._group2ctx.items())),
+                     _bind_env_fingerprint(self._validate_mode))
         cache = getattr(symbol, "_jit_cache", None)
         if cache is None:
             cache = symbol._jit_cache = {}
@@ -485,7 +511,7 @@ class Executor:
         self._n_fwd_bwd = 0
         self._n_fused_step = 0
         self._n_monitored_compiled = 0
-        self._fused_cache = None  # (optimizer id, jitted step)
+        self._fused_cache = None  # (optimizer fingerprint, jitted step)
 
     def _validate_bind(self, args, args_grad, grad_req, aux_states):
         """Run the static analyzer with full bind context and apply the
@@ -740,14 +766,28 @@ class Executor:
         return wrt_names, jax.jit(step, donate_argnums=(3,))
 
     def _get_fused(self, optimizer):
-        """(wrt_names, jitted step) for this optimizer, cached by
-        (optimizer identity, compute dtype) — an MXNET_COMPUTE_DTYPE
-        change between fits must not reuse a stale jit."""
+        """(wrt_names, jitted step) for this optimizer, cached by a
+        value fingerprint over exactly what _build_fused_step bakes:
+        optimizer class, hyperparameter scalars (minus the per-step
+        update counters, which mutate every step and would defeat the
+        cache), the per-param multiplier maps, and the compute dtype.
+        An id()-keyed cache would miss for a fresh-but-identical
+        optimizer (needless relower of the whole fused step) and could
+        falsely hit on a gc-recycled id (stale program, wrong
+        hyperparameters) — MXL-X002."""
         import os
-        key = (id(optimizer), os.environ.get("MXNET_COMPUTE_DTYPE", ""))
+        from .parallel import overlap as _overlap
+        hypers = {k: v for k, v in sorted(vars(optimizer).items())
+                  if isinstance(v, (int, float, bool, str, type(None)))
+                  and k not in ("num_update", "begin_num_update")}
+        key = _overlap.cache_key(
+            type(optimizer).__name__, hypers,
+            getattr(optimizer, "lr_mult", None),
+            getattr(optimizer, "wd_mult", None),
+            getattr(optimizer, "idx2name", None),
+            os.environ.get("MXNET_COMPUTE_DTYPE", ""))
         if self._fused_cache is None or self._fused_cache[0] != key:
-            self._fused_cache = (key, self._build_fused_step(optimizer),
-                                 optimizer)
+            self._fused_cache = (key, self._build_fused_step(optimizer))
         return self._fused_cache[1]
 
     def fused_step(self, optimizer, states, num_update, **kwargs):

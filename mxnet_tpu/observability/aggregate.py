@@ -429,6 +429,23 @@ def build_report(records, now=None):
         fl = None
     if fl and fl.get("replicas"):
         out["fleet"] = fl
+    # retrace rollup (docs/perf.md, observability/retrace.py): the
+    # sentry's attributed post-warmup lowerings — count, the divergent
+    # cache-key ingredients, and the requesting sites.  Present only
+    # when "retrace" records exist, i.e. the contract was violated.
+    retraces = [r for r in records if r.get("kind") == "retrace"]
+    if retraces:
+        divergent = {}
+        for r in retraces:
+            for ingredient in (r.get("divergent") or ["unknown"]):
+                divergent[ingredient] = divergent.get(ingredient, 0) \
+                    + int(r.get("n") or 1)
+        out["retrace"] = {
+            "count": sum(int(r.get("n") or 1) for r in retraces),
+            "divergent": dict(sorted(divergent.items())),
+            "sites": sorted({r.get("site") for r in retraces
+                             if r.get("site")})[:8],
+        }
     return out
 
 

@@ -39,9 +39,12 @@ __all__ = ["enabled", "telemetry_dir", "run_id", "rank", "get",
 #: generation stamps — docs/resilience.md "Elasticity"); "serve"
 #: records are one-per-dispatched-batch serving telemetry
 #: (docs/serving.md — queue_wait/pack/device/unpack phases, occupancy,
-#: padding waste, per-request latencies)
+#: padding waste, per-request latencies); "retrace" records are the
+#: retrace sentry's attributed post-warmup lowerings (docs/perf.md,
+#: observability/retrace.py — the divergent cache-key ingredient, the
+#: requesting site, component diffs)
 KINDS = ("step", "span", "counter", "fault", "ckpt", "collective",
-         "summary", "elastic", "serve")
+         "summary", "elastic", "serve", "retrace")
 
 _FLUSH_INTERVAL_S = 1.0
 _HIGH_WATER = 256            # buffered records that trigger an early flush

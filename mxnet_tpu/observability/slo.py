@@ -36,7 +36,7 @@ import os
 from . import events
 from .counters import rel_spread
 
-__all__ = ["DIRECTIONS", "baseline_spec", "load_bench",
+__all__ = ["DIRECTIONS", "ZERO_ALERT", "baseline_spec", "load_bench",
            "load_trajectory", "telemetry_metrics", "trajectory_noise",
            "compare", "emit_regressions"]
 
@@ -67,7 +67,20 @@ DIRECTIONS = {
     "fleet_balance_ratio": "up",
     "fleet_swap_pause_ms_p95": "up",
     "fleet_straggler_gap_ms": "up",
+    # retrace sentry (observability/retrace.py): the steady-state
+    # contract is exactly zero, so these sit in ZERO_ALERT too — any
+    # nonzero value against a zero baseline flags regardless of the
+    # relative-threshold math
+    "retraces_after_warmup": "up",
+    "lowerings_after_warmup": "up",
+    "swap_lowerings": "up",
 }
+
+#: zero-contract metrics: the baseline is exactly 0 by design, so the
+#: relative-delta machinery (undefined at base==0) is replaced by "any
+#: nonzero current value is a regression"
+ZERO_ALERT = ("retraces_after_warmup", "lowerings_after_warmup",
+              "swap_lowerings")
 
 #: default regression floor (relative) and noise multiplier
 MIN_REL = 0.10
@@ -85,7 +98,9 @@ def _bench_metrics(parsed):
     out = {}
     for key in ("step_time_ms", "allreduce_time_ms", "allreduce_gbps",
                 "transformer_step_ms", "transformer_tokens_per_sec",
-                "module_path_images_per_sec", "mfu"):
+                "module_path_images_per_sec", "mfu",
+                "retraces_after_warmup", "lowerings_after_warmup",
+                "swap_lowerings"):
         if parsed.get(key) is not None:
             out[key] = float(parsed[key])
     if parsed.get("value") is not None \
@@ -183,6 +198,9 @@ def telemetry_metrics(report):
             float(fleet["straggler_gap_ms"])
     if fleet.get("balance_ratio") is not None:
         out["fleet_balance_ratio"] = float(fleet["balance_ratio"])
+    retrace = report.get("retrace") or {}
+    if retrace.get("count") is not None:
+        out["retraces_after_warmup"] = float(retrace["count"])
     return out
 
 
@@ -214,6 +232,15 @@ def compare(current, baseline, noise=None, min_rel=MIN_REL,
             continue
         base, cur = float(baseline[metric]), float(current[metric])
         if base == 0.0:
+            if metric in ZERO_ALERT and cur > 0.0 and direction == "up":
+                # zero-contract metric: no relative threshold exists —
+                # the contract IS the zero, so any count regresses
+                finding = {"metric": metric, "current": cur,
+                           "baseline": base, "delta_pct": None,
+                           "threshold_pct": 0.0,
+                           "direction": direction, "regression": True}
+                checked.append(finding)
+                regressions.append(finding)
             continue
         thr = max(float(min_rel), float(sigma) * noise.get(metric, 0.0))
         delta = (cur - base) / abs(base)

@@ -629,7 +629,10 @@ def compile_cache_put(key, value):
 def note_lowering(n=1):
     """Count one fresh trace/lower — the thing the cache exists to
     avoid; tests assert this stays flat across a second identical
-    bind."""
+    bind.  The retrace sentry (``observability.retrace``,
+    ``MXTPU_RETRACE_SENTRY=1``) wraps this function: after a serving
+    warmup boundary every call is counted as a contract violation and
+    attributed to the divergent cache-key ingredient."""
     with _CACHE_LOCK:
         _STATS["lowerings"] += n
 

@@ -281,6 +281,8 @@ class GenerationEngine(object):
         exists before the first request.  Warmup inputs point every
         table slot at the trash block and run at position 0, so the
         real pools are never touched (outputs are discarded)."""
+        from ..observability import retrace as _retrace
+        _retrace.warmup_begin()   # legit compile phase: sentry disarms
         mb = self.cache.config.blocks_per_seq
         for S, pred in self._prefill.items():
             self.run_async(pred, {
@@ -295,6 +297,7 @@ class GenerationEngine(object):
                 "seq_pos": _np.zeros((B,), _np.float32),
                 "block_table": _np.zeros((B, mb), _np.float32)})
         _np.asarray(outs[0])          # block: warmup fully materialized
+        _retrace.warmup_boundary()    # steady state: zero lowerings now
 
     # -- admission / lifecycle --------------------------------------------
 

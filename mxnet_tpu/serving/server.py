@@ -164,8 +164,10 @@ class ModelServer(object):
         ``"1:100,8:20"``) for the planner; ``buckets=`` skips planning.
         """
         from ..predictor import Predictor
+        from ..observability import retrace as _retrace
         if name in self._entries:
             raise MXNetError("model %r already added" % name)
+        _retrace.warmup_begin()   # legit compile phase: sentry disarms
         input_shapes = {nm: tuple(int(d) for d in shape)
                         for nm, shape in input_shapes.items()}
         env_buckets = _os.environ.get("MXTPU_SERVE_BUCKETS")
@@ -225,6 +227,7 @@ class ModelServer(object):
         from ..executor import program_registry_stats
         self._entries[name] = entry
         self._warmup[name] = program_registry_stats()["lowerings"]
+        _retrace.warmup_boundary()   # steady state: zero lowerings now
         self._batcher.register(entry)
         return plan
 
@@ -246,8 +249,10 @@ class ModelServer(object):
         zero lowerings.  Returns the engine (its ``prompt_plan``/
         ``decode_plan`` carry the planner ledgers)."""
         from .generate import GenerationEngine, GenerativeEntry
+        from ..observability import retrace as _retrace
         if name in self._entries:
             raise MXNetError("model %r already added" % name)
+        _retrace.warmup_begin()   # legit compile phase: sentry disarms
         engine = GenerationEngine(
             params=params, vocab_size=vocab_size, num_layers=num_layers,
             num_heads=num_heads, dim=dim, **engine_kwargs)
@@ -255,6 +260,7 @@ class ModelServer(object):
         from ..executor import program_registry_stats
         self._entries[name] = entry
         self._warmup[name] = program_registry_stats()["lowerings"]
+        _retrace.warmup_boundary()   # steady state: zero lowerings now
         self._batcher.register(entry)
         return engine
 

@@ -193,6 +193,28 @@ print("kernel-tier MXL-K sweep OK "
         echo "FIXTURE $file missing $rule:"; echo "$out"; exit 1; }
       echo "fixture $file flagged with $rule (expected-fail OK)"
     done
+    # retrace-stability self-lint (docs/graph_lint.md MXL-X): the
+    # traced/jitted surface must carry zero error-severity retrace
+    # findings — tensor-dependent host branching, unstable cache-key
+    # ingredients, per-step jit construction, unbucketed AOT shapes,
+    # and donated-buffer reuse all break the zero-steady-state-
+    # lowerings contract the serving benches assert at runtime
+    JAX_PLATFORMS=cpu python tools/mxlint.py --retrace \
+      mxnet_tpu --fail-on=error --format=github
+    # the pre-fix retrace regression fixture (the PR-17 id()-keyed
+    # fused-step cache bug) is an expected-FAIL input: MXL-X must keep
+    # flagging it with its documented rule id
+    rx=tests/fixtures/retrace
+    for f in "$rx/id_keyed_program_cache.py:MXL-X002"; do
+      file="${f%:*}"; rule="${f##*:}"
+      if out=$(JAX_PLATFORMS=cpu python tools/mxlint.py --retrace \
+          "$file" --fail-on=error --format=github); then
+        echo "FIXTURE NOT FLAGGED: $file"; exit 1
+      fi
+      echo "$out" | grep -q "$rule" || {
+        echo "FIXTURE $file missing $rule:"; echo "$out"; exit 1; }
+      echo "fixture $file flagged with $rule (expected-fail OK)"
+    done
     ;;
   python)
     make -s all || echo "native build unavailable; python fallback"
@@ -219,6 +241,12 @@ print("kernel-tier MXL-K sweep OK "
     # structured ResilienceError(kind="lock_order") instead of an
     # intermittent hang
     export MXTPU_LOCKCHECK=1
+    # ...and under the retrace sentry (docs/graph_lint.md "MXL-X"):
+    # every post-warmup lowering is counted and attributed to the
+    # divergent cache-key ingredient, so a recovery path that silently
+    # re-lowers steady-state programs surfaces as a structured
+    # "retrace" telemetry event instead of a latency mystery
+    export MXTPU_RETRACE_SENTRY=1
     # fault-injection matrix (docs/resilience.md): injected NaN/hang/
     # ckpt-crash/dead-node faults must each hit their recovery path,
     # plus the kill-one-worker resume smoke
@@ -442,6 +470,13 @@ json.dump(doc, open(sys.argv[1], "w"))
     # tree; a lock-order inversion fails as a structured error instead
     # of a flaky hang (docs/graph_lint.md "MXL-Q")
     export MXTPU_LOCKCHECK=1
+    # ...and under the retrace sentry (docs/graph_lint.md "MXL-X"):
+    # after each model's warmup boundary every unexpected lowering is
+    # counted and attributed to its divergent cache-key ingredient —
+    # the zero-steady-state-lowerings contract becomes an observable,
+    # not a hope.  serve_bench stamps retraces_after_warmup into its
+    # BENCH line below, which must stay 0
+    export MXTPU_RETRACE_SENTRY=1
     # serving stack (docs/serving.md): planner/batcher/server unit
     # suite, then the acceptance drill — continuous batching must beat
     # the serial batch-1 Predictor >= 3x at bounded p95 with zero
@@ -471,6 +506,7 @@ json.dump(doc, open(sys.argv[1], "w"))
 import json, sys
 rep = json.loads(sys.stdin.readlines()[-1])
 assert rep["lowerings_after_warmup"] == 0, rep
+assert rep.get("retraces_after_warmup", 0) == 0, rep
 assert rep["completed"] == 200 and rep["errors"] == 0, rep
 assert rep["latency_ms"]["p95"] is not None, rep
 assert 0.0 < rep["occupancy"] <= 1.0, rep

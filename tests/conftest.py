@@ -31,3 +31,12 @@ jax.config.update("jax_enable_x64", True)
 from mxnet_tpu.observability import locktrace as _locktrace  # noqa: E402
 
 _locktrace.maybe_install()
+
+# MXTPU_RETRACE_SENTRY=1 (serving/resilience CI legs): wrap the
+# lowering counter and the program-registry miss path so every
+# post-warmup lowering is counted and attributed to the divergent
+# cache-key ingredient (the zero-steady-state-lowerings contract's
+# runtime witness — docs/perf.md, analysis MXL-X).
+from mxnet_tpu.observability import retrace as _retrace  # noqa: E402
+
+_retrace.maybe_install()

@@ -1,0 +1,32 @@
+"""Expected-FAIL fixture for MXL-X002: id()-keyed compiled-program cache.
+
+Distilled from the pre-fix ``Executor._get_fused`` (PR 17): the fused
+optimizer step was cached under ``(id(optimizer), compute_dtype)``.
+Object identity is recycled after gc — a fresh-but-identical optimizer
+misses the cache and relowers the whole fused step (needless retrace),
+while a recycled id can falsely hit and run a stale program with the
+wrong hyperparameters.  The fix keys on a value fingerprint
+(``overlap.cache_key`` over the baked hyperparameters) instead.
+
+The TASK=lint CI loop asserts ``mxlint --retrace`` flags this file with
+MXL-X002; if the lint ever goes blind to it, the loop fails.
+"""
+import os
+
+import jax
+
+
+class FusedStepCache:
+    def __init__(self):
+        self._cache = None  # (key, jitted step)
+
+    def _build_step(self, optimizer):
+        def step(states, grads, lr):
+            return [s + g * lr for s, g in zip(states, grads)]
+        return jax.jit(step)
+
+    def get_fused(self, optimizer):
+        key = (id(optimizer), os.environ.get("MXNET_COMPUTE_DTYPE", ""))
+        if self._cache is None or self._cache[0] != key:
+            self._cache = (key, self._build_step(optimizer))
+        return self._cache[1]

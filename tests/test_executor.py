@@ -246,3 +246,42 @@ def test_interpret_matches_compiled():
     exe.set_monitor_callback(None)
     assert seen, "monitor path did not run eagerly"
     np.testing.assert_allclose(interpreted, compiled, rtol=2e-5, atol=2e-6)
+
+
+def test_program_cache_refreshes_on_env_flip(monkeypatch):
+    """The per-symbol program cache key folds in the baked host flags
+    (compute dtype etc. — executor._bind_env_fingerprint): a flag flip
+    between binds must NOT reuse a stale program, and flipping back
+    must reuse the original (MXL-X002 regression)."""
+    monkeypatch.delenv("MXNET_COMPUTE_DTYPE", raising=False)
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(0), data=(4, 20))
+    p1 = exe._program
+    monkeypatch.setenv("MXNET_COMPUTE_DTYPE", "bfloat16")
+    exe2 = net.simple_bind(mx.cpu(0), data=(4, 20))
+    assert exe2._program is not p1
+    monkeypatch.setenv("MXNET_COMPUTE_DTYPE", "")
+    exe3 = net.simple_bind(mx.cpu(0), data=(4, 20))
+    assert exe3._program is p1
+
+
+def test_fused_step_cache_keys_on_values_not_identity():
+    """_get_fused regression (MXL-X002): the fused-step cache must hit
+    for a fresh-but-identical optimizer (value fingerprint, not id()),
+    rebuild when a hyperparameter actually changes, and ignore the
+    per-step update counters that mutate every step."""
+    net = _mlp()
+    exe = net.simple_bind(mx.cpu(0), data=(4, 20))
+    f1 = exe._get_fused(mx.optimizer.SGD(learning_rate=0.1))
+    # a different instance with identical hyperparameters: cache hit
+    assert exe._get_fused(mx.optimizer.SGD(learning_rate=0.1)) is f1
+    # the per-step counter churns every update — it must not miss
+    counting = mx.optimizer.SGD(learning_rate=0.1)
+    counting.num_update = 99
+    assert exe._get_fused(counting) is f1
+    # a real hyperparameter change rebuilds
+    f2 = exe._get_fused(mx.optimizer.SGD(learning_rate=0.2))
+    assert f2 is not f1
+    f3 = exe._get_fused(mx.optimizer.SGD(learning_rate=0.2,
+                                         momentum=0.9))
+    assert f3 is not f2

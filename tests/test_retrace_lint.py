@@ -1,0 +1,635 @@
+"""MXL-X retrace-stability lint (analysis/retrace.py) + the
+MXTPU_RETRACE_SENTRY runtime retrace sentry (observability/retrace.py):
+traced-scope control flow, cache-key hygiene, per-step jit
+construction, weak-type leaks, bucket routing, donation reuse, the
+historical regression fixture, and the live attribution witness —
+including the deliberate bucket-bypass drill that must name the
+divergent cache-key ingredient."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.analysis.retrace import analyze_retrace_paths
+from mxnet_tpu.base import traced_scope
+from mxnet_tpu.observability import retrace
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "retrace")
+
+
+def _rules(findings):
+    return sorted({f["rule"] for f in findings})
+
+
+def _lint(tmp_path, code, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(code)
+    return analyze_retrace_paths([str(p)], root=str(tmp_path))
+
+
+# ----------------------------------------------------------------------
+# X001: python control flow on tensor-derived values in traced scopes
+# ----------------------------------------------------------------------
+def test_x001_if_on_tracer(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+        "g = jax.jit(f)\n"))
+    assert "MXL-X001" in _rules(fs)
+    hit = [f for f in fs if f["rule"] == "MXL-X001"][0]
+    assert hit["anchor"].endswith(":f")
+    assert "`if`" in hit["message"]
+
+
+def test_x001_static_argnames_exempt(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "def f(x, n):\n"
+        "    if n > 2:\n"
+        "        return x * n\n"
+        "    return x\n"
+        "g = jax.jit(f, static_argnames='n')\n"))
+    assert _rules(fs) == []
+
+
+def test_x001_shape_facts_are_static(tmp_path):
+    # shape/dtype reads are host facts even on a tracer: branching on
+    # them re-specializes legitimately at trace time, never per value
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "def f(x):\n"
+        "    if x.shape[0] > 2:\n"
+        "        return x[:2]\n"
+        "    return x\n"
+        "g = jax.jit(f)\n"))
+    assert _rules(fs) == []
+
+
+def test_x001_host_coercion_and_item(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    a = float(x)\n"
+        "    b = x.item()\n"
+        "    return a + b\n"))
+    hits = [f for f in fs if f["rule"] == "MXL-X001"]
+    assert len(hits) == 2
+
+
+def test_x001_traced_scope_decorator(tmp_path):
+    # the base.traced_scope marker covers partial-wrapped / indirect
+    # kernels the lexical jit inference can't see
+    fs = _lint(tmp_path, (
+        "from mxnet_tpu.base import traced_scope\n"
+        "@traced_scope\n"
+        "def kernel(ref):\n"
+        "    while ref > 0:\n"
+        "        ref = ref - 1\n"))
+    assert "MXL-X001" in _rules(fs)
+
+
+def test_x001_name_collision_resolved_lexically(tmp_path):
+    # a host-side method named `step` must NOT inherit tracedness from
+    # an unrelated nested `step` def jitted inside a builder
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "def _build():\n"
+        "    def step(x):\n"
+        "        if x > 0:\n"
+        "            return x\n"
+        "        return -x\n"
+        "    return jax.jit(step)\n"
+        "class Trainer:\n"
+        "    def step(self, loss):\n"
+        "        return float(loss)\n"))
+    assert len(fs) == 1
+    assert fs[0]["rule"] == "MXL-X001"
+    assert fs[0]["anchor"].endswith("_build.step")
+
+
+def test_traced_scope_marker_is_noop():
+    @traced_scope
+    def f(x):
+        return x + 1
+    assert f(2) == 3
+
+    @traced_scope(grid=(4,))
+    def g(x):
+        return x * 2
+    assert g(2) == 4
+
+
+# ----------------------------------------------------------------------
+# X002: unstable cache-key ingredients
+# ----------------------------------------------------------------------
+def test_x002_id_key_feeding_cache(tmp_path):
+    fs = _lint(tmp_path, (
+        "class C:\n"
+        "    def get(self, opt):\n"
+        "        key = (id(opt),)\n"
+        "        if key in self._cache:\n"
+        "            return self._cache[key]\n"
+        "        self._cache[key] = self._build(opt)\n"
+        "        return self._cache[key]\n"))
+    assert "MXL-X002" in _rules(fs)
+    assert "id()" in fs[0]["message"]
+
+
+def test_x002_id_in_per_invocation_map_clean(tmp_path):
+    # id()-keyed edge maps over LIVE graph nodes, scoped to one call,
+    # are fine — the analysis passes use them; only keys that feed a
+    # persistent cache/registry store are audited
+    fs = _lint(tmp_path, (
+        "def edge_shapes(nodes):\n"
+        "    shapes = {}\n"
+        "    for n in nodes:\n"
+        "        key = (id(n), 0)\n"
+        "        shapes[key] = n.out\n"
+        "    return shapes\n"))
+    assert _rules(fs) == []
+
+
+def test_x002_unsorted_items_in_cache_key(tmp_path):
+    fs = _lint(tmp_path, (
+        "from mxnet_tpu.parallel.overlap import cache_key\n"
+        "def k(cfg):\n"
+        "    return cache_key(tuple(cfg.items()))\n"))
+    assert "MXL-X002" in _rules(fs)
+    assert "iteration order" in fs[0]["message"]
+
+
+def test_x002_sorted_launders_iteration_order(tmp_path):
+    fs = _lint(tmp_path, (
+        "from mxnet_tpu.parallel.overlap import cache_key\n"
+        "def k(cfg):\n"
+        "    return cache_key(tuple(sorted(cfg.items())))\n"))
+    assert _rules(fs) == []
+
+
+def test_x002_env_read_in_traced_body(tmp_path):
+    fs = _lint(tmp_path, (
+        "import os, jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if os.environ.get('MXNET_COMPUTE_DTYPE') == 'bfloat16':\n"
+        "        return x\n"
+        "    return x * 2\n"))
+    assert "MXL-X002" in _rules(fs)
+    hit = [f for f in fs if f["rule"] == "MXL-X002"][0]
+    assert "baked at trace time" in hit["message"]
+
+
+# ----------------------------------------------------------------------
+# X003: per-step jit construction bypassing the program registry
+# ----------------------------------------------------------------------
+def test_x003_jit_on_request_path(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "class S:\n"
+        "    def handle_request(self, fn, x):\n"
+        "        f = jax.jit(fn)\n"
+        "        return f(x)\n"))
+    assert "MXL-X003" in _rules(fs)
+
+
+def test_x003_builder_exempt(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "class S:\n"
+        "    def _build_program(self, fn):\n"
+        "        return jax.jit(fn)\n"))
+    assert _rules(fs) == []
+
+
+def test_x003_memo_guard_exempt(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "class S:\n"
+        "    def predict(self, fn, x):\n"
+        "        if self._f is None:\n"
+        "            self._f = jax.jit(fn)\n"
+        "        return self._f(x)\n"))
+    assert _rules(fs) == []
+
+
+def test_x003_registry_caller_exempt(tmp_path):
+    # a function that routes through the registry API IS the cached
+    # path — its jit call only runs on a genuine miss
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "def dispatch(symbol, key, g2c):\n"
+        "    prog = compile_cache_get(key)\n"
+        "    if prog is None:\n"
+        "        prog = jax.jit(symbol)\n"
+        "    return prog\n"))
+    assert _rules(fs) == []
+
+
+def test_x003_jit_in_loop(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "def collect(fns):\n"
+        "    out = []\n"
+        "    for fn in fns:\n"
+        "        out.append(jax.jit(fn))\n"
+        "    return out\n"))
+    assert "MXL-X003" in _rules(fs)
+    assert "inside a loop" in fs[0]["message"]
+
+
+def test_x003_aot_lower_on_hot_path(tmp_path):
+    fs = _lint(tmp_path, (
+        "def prefill(self, prog, batch):\n"
+        "    return prog.lower(batch).compile()\n"))
+    assert "MXL-X003" in _rules(fs)
+
+
+def test_x003_str_lower_not_confused(tmp_path):
+    # zero-arg .lower() is string casing, not AOT lowering
+    fs = _lint(tmp_path, (
+        "def handle(self, name):\n"
+        "    return name.lower()\n"))
+    assert _rules(fs) == []
+
+
+# ----------------------------------------------------------------------
+# X004: weak-type python scalar across the trace boundary
+# ----------------------------------------------------------------------
+def test_x004_bare_scalar_to_jit_entry(tmp_path):
+    fs = _lint(tmp_path, (
+        "class E:\n"
+        "    def run(self, x):\n"
+        "        return self._jit_forward(0.5, x)\n"))
+    assert "MXL-X004" in _rules(fs)
+
+
+def test_x004_jit_bound_local_name(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "def step(x, lr):\n"
+        "    return x * lr\n"
+        "jit_step = jax.jit(step)\n"
+        "def drive(x, lr):\n"
+        "    return jit_step(x, float(lr))\n"))
+    assert "MXL-X004" in _rules(fs)
+
+
+def test_x004_wrapped_scalar_clean(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "class E:\n"
+        "    def run(self, x, lr):\n"
+        "        return self._jit_forward(jnp.float32(lr), x)\n"))
+    assert _rules(fs) == []
+
+
+# ----------------------------------------------------------------------
+# X005: dynamic shapes into AOT program tables without bucket routing
+# ----------------------------------------------------------------------
+def test_x005_raw_len_indexes_program_table(tmp_path):
+    fs = _lint(tmp_path, (
+        "class G:\n"
+        "    def prefill(self, tokens):\n"
+        "        n = len(tokens)\n"
+        "        return self._prefill[n]\n"))
+    assert "MXL-X005" in _rules(fs)
+
+
+def test_x005_bucket_routing_clean(tmp_path):
+    fs = _lint(tmp_path, (
+        "class G:\n"
+        "    def prefill(self, tokens):\n"
+        "        b = self._planner.prefill_bucket(len(tokens))\n"
+        "        return self._prefill[b]\n"))
+    assert _rules(fs) == []
+
+
+def test_x005_table_iteration_clean(tmp_path):
+    # warming every program in the table iterates it — the loop target
+    # is by construction a bucketed size
+    fs = _lint(tmp_path, (
+        "class G:\n"
+        "    def probe(self):\n"
+        "        for b in self._prefill:\n"
+        "            self._prefill[b]\n"))
+    assert _rules(fs) == []
+
+
+# ----------------------------------------------------------------------
+# X006: donated buffer reuse
+# ----------------------------------------------------------------------
+def test_x006_donated_read_after_call(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "def donate_once(fn, state, x):\n"
+        "    f = jax.jit(fn, donate_argnums=(0,))\n"
+        "    out = f(state, x)\n"
+        "    return state + out\n"))
+    assert "MXL-X006" in _rules(fs)
+    assert "'state'" in fs[0]["message"]
+
+
+def test_x006_rebind_from_result_clean(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "def donate_once(fn, state, x):\n"
+        "    f = jax.jit(fn, donate_argnums=(0,))\n"
+        "    state = f(state, x)\n"
+        "    return state\n"))
+    assert _rules(fs) == []
+
+
+# ----------------------------------------------------------------------
+# suppression markers + parse errors
+# ----------------------------------------------------------------------
+def test_suppression_marker_on_line(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)  # mxl: retrace-ok (MXL-X001)\n"))
+    assert _rules(fs) == []
+
+
+def test_suppression_marker_on_def(tmp_path):
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "# mxl: retrace-ok\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)\n"))
+    assert _rules(fs) == []
+
+
+def test_suppression_marker_rule_filtered(tmp_path):
+    # a marker for a DIFFERENT rule must not eat the finding
+    fs = _lint(tmp_path, (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)  # mxl: retrace-ok (MXL-X005)\n"))
+    assert "MXL-X001" in _rules(fs)
+
+
+def test_parse_error_is_a_warning_finding(tmp_path):
+    fs = _lint(tmp_path, "def broken(:\n", name="broken.py")
+    assert len(fs) == 1
+    assert fs[0]["rule"] == "MXL-X001"
+    assert fs[0].get("severity") == "warning"
+    assert "cannot parse" in fs[0]["message"]
+
+
+# ----------------------------------------------------------------------
+# historical regression fixture + self-lint
+# ----------------------------------------------------------------------
+def test_fixture_id_keyed_program_cache():
+    fs = analyze_retrace_paths(
+        [os.path.join(FIXTURES, "id_keyed_program_cache.py")],
+        root=ROOT)
+    rules = _rules(fs)
+    assert "MXL-X002" in rules
+    hit = [f for f in fs if f["rule"] == "MXL-X002"][0]
+    assert hit["anchor"].endswith("FusedStepCache.get_fused")
+
+
+def test_framework_self_lint_clean():
+    # the acceptance gate: the shipped package carries no MXL-X
+    # findings (real fixes + audited annotations)
+    pkg = os.path.join(ROOT, "mxnet_tpu")
+    fs = analyze_retrace_paths([pkg], root=ROOT)
+    assert fs == [], [(f["rule"], f["anchor"], f["line"]) for f in fs]
+
+
+# ----------------------------------------------------------------------
+# mxlint CLI family plumbing
+# ----------------------------------------------------------------------
+def test_mxlint_retrace_family(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "mxlint", os.path.join(ROOT, "tools", "mxlint.py"))
+    mxlint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mxlint)
+    p = tmp_path / "retracy.py"
+    p.write_text(
+        "import jax\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+        "g = jax.jit(f)\n")
+    _label, issues, _ctx = mxlint.lint_sources(
+        [str(p)], None, [], families=["MXL-X*"])
+    assert "MXL-X001" in {i.rule_id for i in issues}
+    # the distributed family alone must NOT surface X findings
+    _label, issues_d, _ctx = mxlint.lint_sources(
+        [str(p)], None, [], families=["MXL-D*"])
+    assert {i.rule_id for i in issues_d} == set()
+    # --select narrows to one rule id
+    _label, issues_sel, _ctx = mxlint.lint_sources(
+        [str(p)], ["MXL-X001"], [])
+    assert {i.rule_id for i in issues_sel} == {"MXL-X001"}
+
+
+# ----------------------------------------------------------------------
+# runtime sentry: observability/retrace.py
+# ----------------------------------------------------------------------
+@pytest.fixture
+def sentry():
+    was = retrace.installed()
+    retrace.install()
+    retrace.reset()
+    yield
+    retrace.reset()
+    if not was:
+        retrace.uninstall()
+
+
+def _net(hidden):
+    # odd hidden sizes keep each test's graph fingerprint unique, so
+    # the global program registry can't satisfy it from another test
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    return sym.FullyConnected(net, num_hidden=3, name="fc2")
+
+
+def _bind(net):
+    exe = net.simple_bind(mx.cpu(0), data=(2, 7))
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            arr[:] = np.ones(arr.shape, dtype=np.float32) * 0.01
+    return exe
+
+
+def test_sentry_install_uninstall_restores():
+    from mxnet_tpu.parallel import overlap as _overlap
+    from mxnet_tpu import executor as _executor
+    was = retrace.installed()
+    if was:
+        retrace.uninstall()
+    orig_note = _overlap.note_lowering
+    orig_lookup = _executor._lookup_program
+    retrace.install()
+    assert _overlap.note_lowering is not orig_note
+    assert _executor._lookup_program is not orig_lookup
+    retrace.uninstall()
+    assert _overlap.note_lowering is orig_note
+    assert _executor._lookup_program is orig_lookup
+    if was:
+        retrace.install()
+
+
+def test_sentry_maybe_install_env_gated():
+    was = retrace.installed()
+    if was:
+        retrace.uninstall()
+    try:
+        assert retrace.maybe_install({}) is False
+        assert not retrace.installed()
+        assert retrace.maybe_install({"MXTPU_RETRACE_SENTRY": "1"})
+        assert retrace.installed()
+    finally:
+        retrace.uninstall()
+        if was:
+            retrace.install()
+
+
+def test_sentry_warmup_lowerings_not_counted(sentry):
+    retrace.warmup_begin()
+    _bind(_net(37))
+    st = retrace.stats()
+    assert st["lowerings_seen"] >= 1
+    assert st["retraces_after_warmup"] == 0
+    assert not st["armed"]
+
+
+def test_sentry_steady_state_is_quiet(sentry):
+    retrace.warmup_begin()
+    net = _net(41)
+    _bind(net)
+    retrace.warmup_boundary()
+    assert retrace.armed()
+    # rebinding the SAME graph in the same env is a registry hit
+    _bind(net)
+    st = retrace.stats()
+    assert st["retraces_after_warmup"] == 0
+    assert st["attributions"] == []
+
+
+def test_sentry_bucket_bypass_names_graph_fingerprint(sentry):
+    # the acceptance drill: warm one program, arm, then sneak a NOVEL
+    # symbol past the bucket tables — the sentry must not just count
+    # the lowering but name the divergent cache-key ingredient
+    retrace.warmup_begin()
+    _bind(_net(43))
+    retrace.warmup_boundary()
+    _bind(_net(47))                     # the bypass: unwarmed graph
+    st = retrace.stats()
+    assert st["retraces_after_warmup"] >= 1
+    att = st["attributions"][0]
+    assert att["divergent"] == ["graph_fingerprint"]
+    detail = att["detail"]["graph_fingerprint"]
+    assert detail["incoming"] != detail["closest_seen"]
+    assert att["site"]
+
+
+def test_sentry_env_flip_names_compute_dtype(sentry, monkeypatch):
+    monkeypatch.delenv("MXNET_COMPUTE_DTYPE", raising=False)
+    retrace.warmup_begin()
+    net = _net(53)
+    _bind(net)
+    retrace.warmup_boundary()
+    monkeypatch.setenv("MXNET_COMPUTE_DTYPE", "bfloat16")
+    _bind(net)                          # same graph, flipped env
+    st = retrace.stats()
+    assert st["retraces_after_warmup"] >= 1
+    assert "compute_dtype" in st["attributions"][0]["divergent"]
+
+
+def test_sentry_unregistered_lowering_attributed(sentry):
+    # a lowering that never went through the program registry (a
+    # hot-path jax.jit — MXL-X003's runtime shape) has no incoming key
+    # to diff; the sentry blames the bypass itself and names the site
+    from mxnet_tpu.parallel import overlap as _overlap
+    retrace.warmup_boundary()
+    _overlap.note_lowering()
+    st = retrace.stats()
+    assert st["retraces_after_warmup"] == 1
+    att = st["attributions"][0]
+    assert att["divergent"] == ["outside_program_registry"]
+    assert "test_retrace_lint" in att["site"]
+
+
+def test_sentry_warmup_begin_disarms_for_swap(sentry):
+    retrace.warmup_boundary()
+    assert retrace.armed()
+    retrace.warmup_begin()
+    assert not retrace.armed()
+    from mxnet_tpu.parallel import overlap as _overlap
+    _overlap.note_lowering()
+    assert retrace.stats()["retraces_after_warmup"] == 0
+
+
+def test_sentry_never_arms_when_not_installed():
+    was = retrace.installed()
+    if was:
+        retrace.uninstall()
+    try:
+        retrace.warmup_boundary()
+        assert not retrace.armed()
+    finally:
+        if was:
+            retrace.install()
+
+
+# ----------------------------------------------------------------------
+# telemetry rollup + SLO pricing of the retrace counters
+# ----------------------------------------------------------------------
+def _mk(kind, rank, wall_ms, **f):
+    return dict(run_id="r", rank=rank, kind=kind, wall_ms=wall_ms,
+                step=f.pop("step", None), **f)
+
+
+def test_aggregate_retrace_rollup():
+    from mxnet_tpu.observability import aggregate
+    recs = [
+        _mk("step", 0, 1000, step=0, dur_ms=10.0),
+        _mk("retrace", 0, 1001, divergent=["graph_fingerprint"],
+            site="a.py:10", n=1),
+        _mk("retrace", 0, 1002, divergent=["graph_fingerprint"],
+            site="a.py:10", n=2),
+        _mk("retrace", 1, 1003, divergent=["compute_dtype", "ctx_key"],
+            site="b.py:20", n=1),
+    ]
+    rep = aggregate.build_report(recs)
+    rt = rep["retrace"]
+    assert rt["count"] == 4
+    assert rt["divergent"] == {"graph_fingerprint": 3,
+                               "compute_dtype": 1, "ctx_key": 1}
+    assert rt["sites"] == ["a.py:10", "b.py:20"]
+
+
+def test_slo_zero_alert_prices_retraces():
+    from mxnet_tpu.observability import slo
+    regs, checked = slo.compare({"retraces_after_warmup": 2.0},
+                                {"retraces_after_warmup": 0.0})
+    assert len(regs) == 1
+    assert regs[0]["metric"] == "retraces_after_warmup"
+    assert regs[0]["regression"] is True
+    # a clean run against the zero baseline stays quiet
+    regs0, _ = slo.compare({"retraces_after_warmup": 0.0},
+                           {"retraces_after_warmup": 0.0})
+    assert regs0 == []
+
+
+def test_slo_telemetry_metrics_reads_retrace_count():
+    from mxnet_tpu.observability import slo
+    out = slo.telemetry_metrics({"pod": {}, "retrace": {"count": 3}})
+    assert out["retraces_after_warmup"] == 3.0

@@ -43,9 +43,22 @@ re-check loops.  ``--concurrency`` turns it on (combine with
   python tools/mxlint.py --concurrency mxnet_tpu --fail-on=error
   python tools/mxlint.py --concurrency --distributed mxnet_tpu
 
+The retrace family (MXL-X) is the trace-stability lint over the same
+source targets, proving the zero-steady-state-lowerings contract:
+python control flow on tensor-derived values inside traced scopes,
+unstable cache-key ingredients (id(), unsorted dict/set iteration,
+env reads baked into a trace), per-request jit/lower construction
+that bypasses the program registry, weak-type scalar leaks across the
+trace boundary, unbucketed dynamic shapes on AOT tables, and
+donated-buffer reuse.  ``--retrace`` turns it on (families compose):
+
+  python tools/mxlint.py --retrace mxnet_tpu --fail-on=error
+  python tools/mxlint.py --retrace --concurrency mxnet_tpu
+
 ``--diff [REV]`` lints only what a change touches — changed symbol
 JSONs, the models whose builders changed, and changed framework .py
-files (rank-divergence pass; plus MXL-Q with ``--concurrency``) — the
+files (rank-divergence pass; plus MXL-Q with ``--concurrency`` and
+MXL-X with ``--retrace``) — the
 fast pre-merge step ahead of the full sweep (REV defaults to HEAD):
 
   python tools/mxlint.py --diff origin/main --fail-on=error
@@ -223,7 +236,8 @@ def lint_sources(paths, select, skip, world_size=None, families=None):
     """Run the source-reading pass families over .py files and
     directories; returns the same (label, issues, ctx) triple shape.
     ``families`` picks the default rule set when no --select is given:
-    MXL-D* (rank divergence), MXL-Q* (concurrency), or both."""
+    MXL-D* (rank divergence), MXL-Q* (concurrency), MXL-X* (retrace
+    stability), or any combination."""
     from mxnet_tpu.analysis import analyze
     issues = analyze(None, source_paths=list(paths),
                      world_size=world_size,
@@ -458,6 +472,13 @@ def main(argv=None):
                          "lock-order cycles, blocking under lock, "
                          "thread leaks, callback-context violations, "
                          "wait-loop hygiene")
+    ap.add_argument("--retrace", action="store_true",
+                    help="enable the MXL-X retrace-stability family "
+                         "over .py source targets: traced control "
+                         "flow on tensors, unstable cache-key "
+                         "ingredients, per-request jit construction, "
+                         "weak-type scalar leaks, unbucketed AOT "
+                         "shapes, donated-buffer reuse")
     ap.add_argument("--world-size", type=int, default=None,
                     metavar="N",
                     help="simulated pod size for the trace diff "
@@ -573,10 +594,12 @@ def main(argv=None):
                                      skip, **spmd))
         if source_paths:
             families = []
-            if args.distributed or not args.concurrency:
+            if args.distributed or not (args.concurrency or args.retrace):
                 families.append("MXL-D*")
             if args.concurrency:
                 families.append("MXL-Q*")
+            if args.retrace:
+                families.append("MXL-X*")
             targets.append(lint_sources(source_paths, select, skip,
                                         world_size=world_size,
                                         families=families))

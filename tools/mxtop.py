@@ -105,6 +105,7 @@ def render(report, stream=sys.stdout):
                 rec.get("kind"),
                 rec.get("fault") or rec.get("event") or rec.get("phase")
                 or rec.get("path") or ""))
+    render_retrace(report, stream=stream)
 
 
 def render_serve(report, stream=sys.stdout):
@@ -162,6 +163,25 @@ def render_serve(report, stream=sys.stdout):
                 m.get("kernel_path") or "-",
                 phases.get("prefill", 0), phases.get("decode", 0)))
     render_fleet(report, stream=stream)
+    render_retrace(report, stream=stream)
+
+
+def render_retrace(report, stream=sys.stdout):
+    """Steady-state retrace attributions from the runtime sentry
+    (``MXTPU_RETRACE_SENTRY=1``): count of post-warmup lowerings plus
+    the divergent cache-key ingredient histogram.  Nonzero here means
+    the zero-steady-state-lowerings contract broke."""
+    rt = report.get("retrace") or {}
+    if not rt.get("count"):
+        return
+    w = stream.write
+    w("RETRACE — %s post-warmup lowering(s)   divergent: %s\n" % (
+        rt["count"],
+        "  ".join("%s×%s" % (k, v)
+                  for k, v in sorted((rt.get("divergent") or {}).items()))
+        or "?"))
+    for site in rt.get("sites") or []:
+        w("      at %s\n" % site)
 
 
 def render_fleet(report, stream=sys.stdout):

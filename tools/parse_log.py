@@ -194,8 +194,21 @@ def parse_telemetry(path):
         if rec.get("autotune_config_id"):
             overlap_cols["autotune-config-id"] = \
                 str(rec["autotune_config_id"])
+    # retrace-sentry columns (docs/perf.md "Compile cache"): count of
+    # post-warmup lowerings plus the divergent cache-key ingredients
+    # the sentry attributed them to (string column, comma-joined like
+    # serve-kernel); absent when the run saw zero steady-state retraces
+    retraces = [r for r in records if r.get("kind") == "retrace"]
+    if retraces:
+        overlap_cols["retraces"] = sum(
+            int(r.get("n") or 1) for r in retraces)
+        divergent = sorted({ingredient for r in retraces
+                            for ingredient in (r.get("divergent") or [])})
+        if divergent:
+            overlap_cols["retrace-divergent"] = ",".join(divergent)
     if not acc and (any(c.startswith("serve-") for c in overlap_cols)
                     or "mfu-gap" in overlap_cols
+                    or "retraces" in overlap_cols
                     or "autotune-config-id" in overlap_cols):
         # serving-/bench-only event stream: one summary row
         acc[0] = {"steps": 0, "dur_ms": [], "sps": []}

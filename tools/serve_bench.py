@@ -66,6 +66,26 @@ sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".."))
 
 
+def _stamp_retrace(out):
+    """Stamp the retrace sentry's verdict into a BENCH payload: the
+    post-warmup retrace count plus the divergent-ingredient names of
+    each attribution.  Keys are absent when the sentry is off
+    (``MXTPU_RETRACE_SENTRY=1`` enables it), so benchdiff only
+    compares runs that measured them."""
+    try:
+        from mxnet_tpu.observability import retrace as _retrace
+        if not _retrace.installed():
+            return
+        st = _retrace.stats()
+        out.setdefault("retraces_after_warmup",
+                       st["retraces_after_warmup"])
+        out.setdefault("retrace_attributions",
+                       [",".join(a["divergent"])
+                        for a in st["attributions"]])
+    except Exception:
+        pass
+
+
 def build_model(args):
     """(symbol_json, params dict, per-sample input shapes, input name)."""
     import mxnet_tpu as mx
@@ -333,6 +353,7 @@ def run_generate(args):
         out["logits_cosine_min"] = round(logits_cos, 7)
     if errors:
         out["first_error"] = repr(errors[0])
+    _stamp_retrace(out)
     print(json.dumps(out, default=str))
     if errors:
         return 1
@@ -471,6 +492,7 @@ def run_fleet(args):
     }
     if errors:
         out["first_error"] = repr(errors[0])
+    _stamp_retrace(out)
     print(json.dumps(out, default=str))
     if lowerings:
         print("fleet swap performed %d new lowerings (want 0)"
@@ -539,6 +561,11 @@ def main(argv=None):
                     help="replica i listens on base+i "
                          "(MXTPU_FLEET_BASE_PORT)")
     args = ap.parse_args(argv)
+
+    # MXTPU_RETRACE_SENTRY=1: attribute any post-warmup lowering in the
+    # BENCH line (the CLI equivalent of the conftest hook)
+    from mxnet_tpu.observability import retrace as _retrace
+    _retrace.maybe_install()
 
     if args.generate:
         return run_generate(args)
@@ -611,6 +638,7 @@ def main(argv=None):
     }
     if errors:
         out["first_error"] = repr(errors[0])
+    _stamp_retrace(out)
     print(json.dumps(out, default=str))
     return 1 if errors else 0
 
