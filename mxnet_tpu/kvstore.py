@@ -90,6 +90,7 @@ class KVStore(object):
         self._updater = None
         self._barrier_before_exit = True
         self._created = _now()
+        self._dead_hold = {"last": [], "since": None}  # KV-blip hold
         self._ar_seq = 0         # kv-fallback allreduce round counter
         self._async = None       # lazy overlap.AsyncLauncher (push_async)
         self._bucket = []        # pending (key, merged) grads
@@ -412,10 +413,16 @@ class KVStore(object):
         ``node_id`` narrows the check to one rank (None = all workers).
         ``timeout`` defaults to 5 heartbeat intervals — enough slack
         for RPC jitter and modest cross-host clock skew.  Returns
-        ``[]`` for non-dist stores; every rank when the coordination
-        service itself is unreachable (the cluster is lost — restart
-        watchdogs must fire rather than read a healthy empty list).
-        Injected ``dead_node`` faults report the highest ``n`` ranks
+        ``[]`` for non-dist stores.
+
+        "KV unreachable" is NOT "ranks dead": while the coordination
+        service itself does not answer, this holds the last verdict
+        for up to ``timeout`` seconds (a blip must not fabricate
+        deaths), then re-raises the structured
+        :class:`~mxnet_tpu.resilience.netkv.KVUnreachable` so restart
+        watchdogs fire on the real condition — a lost coordination
+        plane — rather than reading every rank as dead.  Injected
+        ``dead_node`` faults report the highest ``n`` ranks
         (synthesized identities — the injector knows a count, not
         names).
         """
@@ -440,7 +447,24 @@ class KVStore(object):
             return []
         ranks = [node_id] if node_id is not None \
             else range(self.num_workers)
-        return scan_dead_ranks(client, ranks, self._created, timeout)
+        from .resilience.netkv import KVUnreachable
+        try:
+            dead = scan_dead_ranks(client, ranks, self._created,
+                                   timeout)
+        except KVUnreachable:
+            since = self._dead_hold["since"]
+            if since is None:
+                since = _now()
+                self._dead_hold["since"] = since
+            if _now() - since <= timeout:
+                held = self._dead_hold["last"]
+                return [r for r in held if r == node_id] \
+                    if node_id is not None else list(held)
+            raise                   # outage outlived the grace window
+        self._dead_hold["since"] = None
+        if node_id is None:
+            self._dead_hold["last"] = list(dead)
+        return dead
 
     def num_dead_nodes(self, node_id=None, timeout=None):
         """Count of stale workers (parity:
@@ -529,17 +553,34 @@ def scan_dead_ranks(client, ranks, created, timeout, prefix=_HB_PREFIX):
     """Sorted members of ``ranks`` whose ``<prefix><rank>`` heartbeat
     stamp is stale or missing — the liveness scan shared by
     :meth:`KVStore.dead_nodes` (jax coordination client) and the fleet
-    serving router (:class:`mxnet_tpu.serving.fleet.FileKV`).  ``client``
-    is anything with ``key_value_dir_get``; ``created`` is the scanner's
+    serving router (any ``resilience.netkv.CoordKV``).  ``client`` is
+    anything with ``key_value_dir_get``; ``created`` is the scanner's
     own start time (missing stamps only count as dead once the peer has
     had ``timeout`` seconds since then to write one — the startup-grace
-    rule).  An unreachable KV reports every rank dead: the coordination
-    plane itself is gone and restart watchdogs must fire rather than
-    read a healthy empty list."""
+    rule).
+
+    An unreachable KV raises a structured
+    :class:`~mxnet_tpu.resilience.netkv.KVUnreachable` — it NEVER
+    reports ranks dead.  "The coordination plane did not answer" says
+    nothing about any rank; translating it into deaths is how a
+    2-second network blip becomes a fleet-wide shrink.  Callers hold
+    their last verdict within their grace window and escalate past it
+    (docs/resilience.md "KV fault discipline")."""
     try:
         entries = dict(client.key_value_dir_get(prefix))
-    except Exception:
-        return sorted(ranks)
+    except Exception as exc:
+        from .resilience.netkv import KVUnreachable
+        if isinstance(exc, KVUnreachable):
+            raise
+        try:
+            from . import observability as _obs
+            _obs.emit("fault", fault="kv_unreachable", op="dir",
+                      backend=type(client).__name__, error=repr(exc))
+        except Exception:
+            pass
+        raise KVUnreachable(
+            "heartbeat scan: kv backend %s unreachable: %r"
+            % (type(client).__name__, exc), op="dir")
     now = _now()
     dead = []
     for r in ranks:
@@ -758,7 +799,12 @@ def _start_heartbeat(client=None, rank=None):
                 client.key_value_set(key, repr(_time.time()),
                                      allow_overwrite=True)
             except Exception:
-                return       # cluster shut down
+                # KV blip (partition, flap, coordinator restart):
+                # keep trying — a thread that exits here never stamps
+                # again, so a healed 5 s partition would read as this
+                # rank dead forever after.  A genuinely torn-down
+                # cluster ends the loop via the stop event instead.
+                pass
             # Event.wait, not sleep: _stop_heartbeat returns promptly
             # instead of waiting out the remainder of an interval
             stop.wait(_HB_INTERVAL)

@@ -42,6 +42,41 @@ def _client():
     return _dist_client()
 
 
+# KV fault discipline (docs/resilience.md): a coordination blip while
+# reading summaries/heartbeat ages HOLDS the last good view instead of
+# fabricating an empty pod — the same hold-the-verdict rule
+# kvstore.dead_nodes applies — and telemeters the outage edge once
+_HOLD = {"summaries": {}, "hb_ages": {}, "down": False}
+
+
+def _kv_held(name, exc):
+    """One read failed: note the outage once, serve the held copy."""
+    if not _HOLD["down"]:
+        _HOLD["down"] = True
+        try:
+            import mxnet_tpu.observability as _obs
+            _obs.emit("fault", fault="kv_unreachable",
+                      scope="telemetry_aggregate", op=name,
+                      error=repr(exc))
+        except Exception:
+            pass
+    return dict(_HOLD[name])
+
+
+def _kv_good(name, value):
+    """A read answered: refresh the held copy, close the outage."""
+    _HOLD[name] = dict(value)
+    if _HOLD["down"]:
+        _HOLD["down"] = False
+        try:
+            import mxnet_tpu.observability as _obs
+            _obs.emit("fault", fault="kv_recovered",
+                      scope="telemetry_aggregate", op=name)
+        except Exception:
+            pass
+    return value
+
+
 # ----------------------------------------------------------------------
 # live path (coordination-service KV)
 # ----------------------------------------------------------------------
@@ -82,8 +117,8 @@ def collect_summaries():
         return {}
     try:
         entries = dict(client.key_value_dir_get(TEL_PREFIX))
-    except Exception:
-        return {}
+    except Exception as exc:  # unreachable KV: hold the last view
+        return _kv_held("summaries", exc)
     out = {}
     for key, val in entries.items():
         try:
@@ -92,7 +127,7 @@ def collect_summaries():
             out[rank] = json.loads(val)
         except (ValueError, TypeError):
             continue
-    return out
+    return _kv_good("summaries", out)
 
 
 def heartbeat_ages(num_workers=None, now=None):
@@ -107,17 +142,20 @@ def heartbeat_ages(num_workers=None, now=None):
         return {}
     try:
         entries = dict(client.key_value_dir_get(_HB_PREFIX))
-    except Exception:
-        return {}
-    now = _now() if now is None else now
-    ages = {}
-    for key, stamp in entries.items():
-        try:
-            rank = int(key[len(_HB_PREFIX):]) if key.startswith(_HB_PREFIX) \
-                else int(key.rsplit("/", 1)[-1])
-            ages[rank] = round(now - float(stamp), 3)
-        except (ValueError, TypeError):
-            continue
+    except Exception as exc:  # unreachable KV: hold the last ages —
+        ages = _kv_held("hb_ages", exc)   # never "everyone silent"
+    else:
+        now = _now() if now is None else now
+        ages = {}
+        for key, stamp in entries.items():
+            try:
+                rank = int(key[len(_HB_PREFIX):]) \
+                    if key.startswith(_HB_PREFIX) \
+                    else int(key.rsplit("/", 1)[-1])
+                ages[rank] = round(now - float(stamp), 3)
+            except (ValueError, TypeError):
+                continue
+        _kv_good("hb_ages", ages)
     if num_workers:
         for rank in range(int(num_workers)):
             ages.setdefault(rank, None)

@@ -29,6 +29,12 @@ silent hang inside a collective.  This package supplies the pieces:
   shard directory) so a re-mesh resumes from peer RAM with zero
   checkpoint reads, degrading to the versioned checkpoint on any
   missing/corrupt shard.
+- **coordination-plane loss** → :mod:`.netkv`: the pluggable
+  coordination KV (``MXTPU_KV_URL``: file- or TCP-backed) behind one
+  ``CoordKV`` surface, wrapped in ``ResilientKV`` fault discipline —
+  bounded retries, then a structured ``kv_unreachable`` that holds
+  the last liveness verdict instead of fabricating deaths — plus the
+  expiring leader ``Lease`` the fleet routers elect through.
 - **testability** → :mod:`.faultinject`: a deterministic fault
   injector (env ``MXTPU_FAULT_SPEC``) that plants NaN grads,
   checkpoint-write crashes, slow/hung steps, and dead-node reports at
@@ -167,6 +173,10 @@ def sentinel_enabled(default=False):
 from .faultinject import (FaultSpec, FaultInjector, InjectedFault,  # noqa: E402
                           parse_fault_spec, maybe_fault, injector,
                           poison_nan)
+from . import netkv  # noqa: E402
+from .netkv import (CoordKV, FileKV, TcpKV, TcpKVServer,  # noqa: E402
+                    ResilientKV, Lease, KVUnreachable, KeyExists,
+                    KeyAbsent, connect_kv)
 from .watchdog import Watchdog, run_with_timeout  # noqa: E402
 from .retry import RetryPolicy, retry_call  # noqa: E402
 from .sentinel import Sentinel  # noqa: E402
@@ -177,6 +187,9 @@ from .hotstate import HotStateUnavailable  # noqa: E402
 
 __all__ = [
     "elastic", "hotstate", "HotStateUnavailable",
+    "netkv", "CoordKV", "FileKV", "TcpKV", "TcpKVServer",
+    "ResilientKV", "Lease", "KVUnreachable", "KeyExists", "KeyAbsent",
+    "connect_kv",
     "EXIT_RESTART", "ResilienceError", "exit_for_restart",
     "install_excepthook",
     "step_timeout_s", "retry_max", "ckpt_keep", "sentinel_enabled",

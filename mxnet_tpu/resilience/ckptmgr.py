@@ -35,6 +35,18 @@ _STEP_FMT = "step_%08d"
 _STEP_RE = _re.compile(r"^step_(\d{8})$")
 _TMP_RE = _re.compile(r"^tmp\.")
 
+#: the fleet's versioned-params pointer (mirrors serving.fleet, which
+#: this layer must not import) — the leader router watches this key
+#: when MXTPU_FLEET_SWAP_ON_COMMIT=1 and runs a drainless swap
+_SWAP_PTR_KEY = "mxtpu_fleet/params_ptr"
+
+
+def swap_on_commit():
+    """``MXTPU_FLEET_SWAP_ON_COMMIT``: publish every committed
+    checkpoint as the serving fleet's params pointer?  Default off."""
+    return _os.environ.get("MXTPU_FLEET_SWAP_ON_COMMIT", "").strip() \
+        .lower() in ("1", "true", "on", "yes")
+
 
 def _fsync_dir(path):
     """Make directory entries durable (best-effort on exotic fs)."""
@@ -184,8 +196,36 @@ class CheckpointManager(object):
                 self.prune()
             _barrier("mxtpu_ckpt_done_%d" % step)
         _emit_ckpt("commit", step, final)
+        if _is_coordinator() and swap_on_commit():
+            self._publish_swap_pointer(step, final)
         self.logger.info("checkpoint committed: %s", final)
         return final
+
+    def _publish_swap_pointer(self, step, path):
+        """``MXTPU_FLEET_SWAP_ON_COMMIT=1``: publish the committed
+        checkpoint as the fleet's versioned-params pointer
+        (coordinator only, best-effort — a dead coordination plane
+        must not turn a durable save into a crash).  The leader router
+        watches the key and runs a drainless hot-swap against it
+        (docs/serving.md "Swap on commit")."""
+        import json
+        try:
+            from .netkv import connect_kv
+            root = _os.environ.get("MXTPU_FLEET_DIR") or \
+                _os.path.join(_os.getcwd(), "mxtpu_fleet")
+            kv = connect_kv(default_root=_os.path.join(root, "kv"))
+            try:
+                kv.key_value_set(_SWAP_PTR_KEY, json.dumps(
+                    {"params": path, "version": _STEP_FMT % int(step),
+                     "step": int(step)}, sort_keys=True))
+            finally:
+                kv.close()
+            _emit_ckpt("swap_pointer", step, path)
+        except Exception as exc:  # noqa: BLE001 - best-effort publish
+            self.logger.warning(
+                "swap-on-commit pointer publish failed for step %d "
+                "(%s); the fleet keeps serving the old version",
+                step, exc)
 
     def restore(self, abstract_tree, step=None):
         """Restore ``step`` (default: latest committed).

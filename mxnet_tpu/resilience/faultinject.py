@@ -33,6 +33,15 @@ on demand at the seams the runtime already passes through:
   per-bucket Predictors and installing them (kind ``swap_crash``:
   raise :class:`InjectedFault`; the old param version must keep
   serving — a failed swap is a no-op, not an outage)
+- ``kv_op`` — every ``ResilientKV`` operation (kinds ``kv_partition``:
+  fail every op for ``seconds``, default 5; ``kv_flap``: alternate
+  fail/ok per call; ``kv_slow``: sleep ``seconds`` before the op) —
+  the coordination-plane outages behind the KV fault discipline
+  (docs/resilience.md): a blip must hold the last liveness verdict,
+  never fabricate deaths
+- ``router_death`` — fleet router health tick (kind ``router_death``:
+  returned to the router, which hard-kills its own process — the
+  drillable half of "standby takes over within one lease period")
 
 Faults are described by ``MXTPU_FAULT_SPEC``, a ``;``-separated list
 of ``:``-separated ``key=value`` clauses (docs/resilience.md):
@@ -67,6 +76,10 @@ KIND_SEAMS = {
     "buddy_loss": "buddy_loss",
     "replica_death": "replica_death",
     "swap_crash": "swap_install",
+    "kv_partition": "kv_op",
+    "kv_slow": "kv_op",
+    "kv_flap": "kv_op",
+    "router_death": "router_death",
 }
 
 _KNOWN_KINDS = frozenset(KIND_SEAMS)
@@ -204,10 +217,11 @@ def maybe_fault(seam, step=None, rank=None):
     """Fire a matching fault at this seam, if any.
 
     Side effects by kind: ``ckpt_crash``/``crash``/``snapshot_crash``/
-    ``swap_crash`` raise :class:`InjectedFault`; ``hang``/``slow``
-    sleep (``seconds``, defaulting to 3600 for hang / 1 for slow).
-    Kinds the caller must act on itself (``nan``, ``dead_node``,
-    ``corrupt``, ``buddy_loss``, ``replica_death``) are returned.
+    ``swap_crash`` raise :class:`InjectedFault`; ``hang``/``slow``/
+    ``kv_slow`` sleep (``seconds``, defaulting to 3600 for hang / 1
+    otherwise).  Kinds the caller must act on itself (``nan``,
+    ``dead_node``, ``corrupt``, ``buddy_loss``, ``replica_death``,
+    ``kv_partition``, ``kv_flap``, ``router_death``) are returned.
     Returns the spec that fired, or None.  Near-zero cost when no spec
     is set.
     """
@@ -223,7 +237,7 @@ def maybe_fault(seam, step=None, rank=None):
                      "swap_crash"):
         raise InjectedFault(
             "injected %s at seam=%s step=%s" % (spec.kind, seam, step))
-    if spec.kind in ("hang", "slow"):
+    if spec.kind in ("hang", "slow", "kv_slow"):
         _time.sleep(spec.seconds if spec.seconds is not None
                     else (3600.0 if spec.kind == "hang" else 1.0))
     return spec

@@ -40,8 +40,9 @@ from .generate import (GenerationEngine, GenerativeEntry, TokenStream,
 from .server import ModelServer, checkpoint_files
 from .telemetry import (emit_batch, serve_report, fleet_report,
                         set_fleet_context)
-from .fleet import (FileKV, FleetRouter, HTTPReplicaClient,
-                    ReplicaDead, launch_fleet, run_replica)
+from .fleet import (FileKV, FleetClient, FleetRouter,
+                    HTTPReplicaClient, NotLeader, ReplicaDead,
+                    adopt_fleet, connect_kv, launch_fleet, run_replica)
 
 __all__ = [
     "BucketPlan", "bucket_for", "model_matmul_dims", "parse_buckets",
@@ -53,6 +54,7 @@ __all__ = [
     "generation_mats",
     "ModelServer", "checkpoint_files",
     "emit_batch", "serve_report", "fleet_report", "set_fleet_context",
-    "FileKV", "FleetRouter", "HTTPReplicaClient", "ReplicaDead",
+    "FileKV", "FleetClient", "FleetRouter", "HTTPReplicaClient",
+    "NotLeader", "ReplicaDead", "adopt_fleet", "connect_kv",
     "launch_fleet", "run_replica",
 ]

@@ -62,13 +62,18 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..observability import trace as _trace
+from ..resilience.netkv import (FileKV, KVUnreachable, KeyAbsent,
+                                Lease, connect_kv)
 from .batcher import ServerBusy, Future, max_queue as _serve_max_queue, \
     max_delay_ms as _serve_max_delay_ms
 
-__all__ = ["FileKV", "FleetRouter", "ReplicaDead", "HTTPReplicaClient",
-           "run_replica", "fleet_dir", "fleet_replicas",
-           "fleet_max_queue", "fleet_base_port", "fleet_hb_timeout_s",
-           "fleet_ledger_path", "fleet_generation"]
+__all__ = ["FileKV", "FleetRouter", "FleetClient", "ReplicaDead",
+           "NotLeader", "HTTPReplicaClient", "run_replica",
+           "launch_fleet", "adopt_fleet", "connect_kv", "fleet_dir",
+           "fleet_replicas", "fleet_max_queue", "fleet_base_port",
+           "fleet_hb_timeout_s", "fleet_ledger_path",
+           "fleet_generation", "fleet_routers", "fleet_tenants",
+           "fleet_lease_ttl_s"]
 
 
 # ----------------------------------------------------------------------
@@ -163,80 +168,122 @@ def fleet_ledger_path(directory=None):
     return _os.path.join(fleet_dir(directory), "LEDGER.json")
 
 
+def fleet_routers(explicit=None):
+    """``MXTPU_FLEET_ROUTERS``: comma-separated front-door URLs a
+    :class:`FleetClient` fails over between (default: the single
+    local router on ``MXTPU_FLEET_PORT``)."""
+    if explicit is not None:
+        return [str(u).rstrip("/") for u in explicit]
+    raw = _os.environ.get("MXTPU_FLEET_ROUTERS")
+    if raw:
+        return [u.strip().rstrip("/") for u in raw.split(",")
+                if u.strip()]
+    port = int(_os.environ.get("MXTPU_FLEET_PORT", "8930"))
+    return ["http://127.0.0.1:%d" % port]
+
+
+def fleet_router_id(explicit=None):
+    """``MXTPU_FLEET_ROUTER_ID``: this router's lease identity
+    (default ``r<pid>`` — unique per process, stable per restart of a
+    supervised router that pins the env var)."""
+    return explicit or _os.environ.get("MXTPU_FLEET_ROUTER_ID") or \
+        "r%d" % _os.getpid()
+
+
+def fleet_lease_ttl_s(explicit=None):
+    """``MXTPU_FLEET_LEASE_TTL_S``: leader-lease TTL (default 3 s).
+    Standby takeover happens within one TTL of leader death; the
+    leader renews at a third of it."""
+    if explicit is not None:
+        return float(explicit)
+    try:
+        return float(_os.environ.get("MXTPU_FLEET_LEASE_TTL_S", "3"))
+    except ValueError:
+        return 3.0
+
+
+def fleet_tenants(explicit=None):
+    """``MXTPU_FLEET_TENANTS``: per-tenant admission budgets —
+    ``name:rate:burst[:weight]`` clauses separated by ``;``, e.g.
+    ``teamA:50:100:3;teamB:10:20:1``.  ``rate`` is requests/second
+    refill, ``burst`` the token-bucket depth, ``weight`` the fair-
+    dequeue share (default 1).  Unset/empty: no tenant lanes — the
+    fleet behaves exactly as before (one FIFO, global bound only)."""
+    raw = explicit if explicit is not None \
+        else _os.environ.get("MXTPU_FLEET_TENANTS", "")
+    tenants = {}
+    for clause in (raw or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        parts = clause.split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                "MXTPU_FLEET_TENANTS clause %r: want "
+                "name:rate:burst[:weight]" % clause)
+        name = parts[0].strip()
+        tenants[name] = {"rate": float(parts[1]),
+                         "burst": float(parts[2]),
+                         "weight": max(1, int(parts[3]))
+                         if len(parts) == 4 else 1}
+    return tenants
+
+
+class _TokenBucket(object):
+    """Deterministic token bucket: ``burst`` depth, ``rate``/s refill
+    computed on demand from the monotonic clock (no refill thread).
+    Caller holds the router lock."""
+
+    __slots__ = ("rate", "burst", "tokens", "stamp")
+
+    def __init__(self, rate, burst):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.stamp = _time.monotonic()
+
+    def take(self):
+        """Consume one token; False (and no consumption) when empty."""
+        now = _time.monotonic()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.stamp) * self.rate)
+        self.stamp = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+    def retry_after_ms(self):
+        if self.rate <= 0:
+            return None
+        return max(1.0, (1.0 - self.tokens) / self.rate * 1e3)
+
+
 # ----------------------------------------------------------------------
-# FileKV: the coordination-service client surface over a directory
+# coordination KV: surface + backends now live in resilience/netkv.py
+# (FileKV re-exported above for compatibility); the router picks its
+# backend with MXTPU_KV_URL via connect_kv()
 # ----------------------------------------------------------------------
-class FileKV(object):
-    """File-backed key-value client with the jax coordination-service
-    method surface (``key_value_set`` / ``key_value_dir_get`` /
-    ``blocking_key_value_get`` / ``key_value_delete``).
+_FLEET_VIEW_KEY = "mxtpu_fleet/view"
+_SWAP_PTR_KEY = "mxtpu_fleet/params_ptr"
 
-    jax.distributed pins a fixed world for the life of a cluster and
-    dies with its coordinator — exactly wrong for a serving fleet whose
-    whole point is replicas dying and respawning under a long-lived
-    router.  A directory of atomically-renamed files gives the same
-    contract the heartbeat/dead-scan machinery needs (last-write-wins
-    set, prefix scan, polling get) with no process holding the state
-    hostage.  Keys are URL-quoted into flat filenames, so the
-    ``mxtpu_hb/<rank>`` keys the shared stamping thread writes need no
-    translation.
-    """
 
-    def __init__(self, root):
-        self.root = _os.fspath(root)
-        _os.makedirs(self.root, exist_ok=True)
+class NotLeader(MXNetError):
+    """A standby router was asked for a leader-only action (swap,
+    verdict-writing).  Front doors answer 409 with the leader hint so
+    clients re-aim instead of mutating through the wrong router."""
 
-    def _fname(self, key):
-        from urllib.parse import quote
-        return _os.path.join(self.root, quote(key, safe=""))
+    def __init__(self, action, router_id=None, leader=None):
+        self.action = action
+        self.router_id = router_id
+        self.leader = leader
+        super(NotLeader, self).__init__(
+            "router %s is standby: %s is leader-only (leader: %s)"
+            % (router_id, action, leader or "unknown"))
 
-    def key_value_set(self, key, value, allow_overwrite=True):
-        path = self._fname(key)
-        if not allow_overwrite and _os.path.exists(path):
-            raise ValueError("key %r already set" % key)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fout:
-            fout.write(str(value))
-        _os.rename(tmp, path)       # atomic: readers see old or new
-
-    def key_value_dir_get(self, prefix):
-        from urllib.parse import unquote
-        out = []
-        try:
-            names = _os.listdir(self.root)
-        except OSError:
-            return out
-        for name in names:
-            if name.endswith(".tmp"):
-                continue
-            key = unquote(name)
-            if not key.startswith(prefix):
-                continue
-            try:
-                with open(_os.path.join(self.root, name)) as fin:
-                    out.append((key, fin.read()))
-            except OSError:
-                continue            # deleted between listdir and open
-        return out
-
-    def blocking_key_value_get(self, key, timeout_ms):
-        deadline = _time.monotonic() + timeout_ms / 1e3
-        path = self._fname(key)
-        while True:
-            try:
-                with open(path) as fin:
-                    return fin.read()
-            except OSError:
-                if _time.monotonic() > deadline:
-                    raise TimeoutError("key %r not set within %d ms"
-                                       % (key, timeout_ms))
-                _time.sleep(0.02)
-
-    def key_value_delete(self, key):
-        try:
-            _os.unlink(self._fname(key))
-        except OSError:
-            pass
+    def to_dict(self):
+        return {"error": "not_leader", "action": self.action,
+                "router_id": self.router_id, "leader": self.leader}
 
 
 class ReplicaDead(MXNetError):
@@ -387,14 +434,15 @@ class _Replica(object):
 
 
 class _Work(object):
-    __slots__ = ("model", "inputs", "n", "trace_id", "future",
-                 "t_arrival")
+    __slots__ = ("model", "inputs", "n", "trace_id", "tenant",
+                 "future", "t_arrival")
 
-    def __init__(self, model, inputs, n, trace_id):
+    def __init__(self, model, inputs, n, trace_id, tenant=None):
         self.model = model
         self.inputs = inputs
         self.n = n
         self.trace_id = trace_id
+        self.tenant = tenant
         self.future = Future()
         self.t_arrival = _time.perf_counter()
 
@@ -415,7 +463,8 @@ class FleetRouter(object):
 
     def __init__(self, clients, kv=None, max_queue=None,
                  hb_timeout_s=None, directory=None, spawner=None,
-                 respawn=None, threads=None, rebind_wait_s=15.0):
+                 respawn=None, threads=None, rebind_wait_s=15.0,
+                 router_id=None, lease_ttl_s=None, tenants=None):
         self._replicas = {i: _Replica(i, c)
                           for i, c in enumerate(clients)}
         self._kv = kv
@@ -426,9 +475,26 @@ class FleetRouter(object):
         self._spawner = spawner
         self._respawn = fleet_respawn() if respawn is None else respawn
         self._rebind_wait_s = float(rebind_wait_s)
-        self._queue = _deque()
         self._lock = _threading.Lock()
         self._cv = _threading.Condition(self._lock)
+        # per-tenant admission lanes (docstring + docs/serving.md): one
+        # FIFO per configured tenant plus the unbudgeted default lane;
+        # with no tenants the cycle is just ["default"] — dequeue order
+        # is then bit-for-bit the old single-FIFO behavior
+        cfgs = fleet_tenants(tenants)
+        default_weight = cfgs.pop("default", {"weight": 1})["weight"]
+        self._tenants = {
+            name: {"bucket": _TokenBucket(cfg["rate"], cfg["burst"]),
+                   "weight": cfg["weight"], "admitted": 0,
+                   "rejected": 0}
+            for name, cfg in cfgs.items()}
+        self._lanes = {"default": _deque()}
+        for name in self._tenants:
+            self._lanes[name] = _deque()
+        self._rr = ["default"] * max(1, default_weight) + \
+            [name for name in sorted(self._tenants)
+             for _ in range(self._tenants[name]["weight"])]
+        self._rr_pos = 0
         self._accepting = True
         self._stop = False
         self._created = _time.time()
@@ -439,6 +505,24 @@ class FleetRouter(object):
         self._swap_pause_ms = []
         led = self._read_ledger()
         self._generation = int(led.get("generation", 0)) if led else 0
+        # leader lease (docs/serving.md "Networked fleet"): with a KV,
+        # N routers share the fleet and elect one writer; without one
+        # (unit fleets) this router is its own leader, as before
+        self.router_id = fleet_router_id(router_id)
+        self._lease = None
+        self._takeovers = 0
+        self._kv_fault_since = None
+        self._scan_hold_until = 0.0
+        self._swap_ptr_seen = None
+        if self._kv is not None:
+            self._lease = Lease(self._kv, self.router_id,
+                                ttl_s=fleet_lease_ttl_s(lease_ttl_s))
+            try:
+                self._lease.poll()  # synchronous first election
+                self._swap_ptr_seen = self._kv.blocking_key_value_get(
+                    _SWAP_PTR_KEY, 50)   # pre-existing ptr: no swap
+            except (KeyAbsent, KVUnreachable, OSError):
+                pass
         for _ in range(router_threads(threads)):
             t = _threading.Thread(target=self._dispatch_loop,
                                   daemon=True, name="mxfleet-dispatch")
@@ -485,23 +569,44 @@ class FleetRouter(object):
 
     # -- admission -----------------------------------------------------
 
-    def aggregate_depth(self):
-        """Fleet-wide pending work: router queue + total in-flight."""
-        with self._lock:
-            return len(self._queue) + sum(r.inflight for r in
-                                          self._replicas.values())
+    def _queued(self):
+        """Total router-queued work across lanes (caller holds lock)."""
+        return sum(len(q) for q in self._lanes.values())
 
-    def submit(self, model, inputs, n=None, trace_id=None):
+    def aggregate_depth(self):
+        """Fleet-wide pending work: router lanes + total in-flight."""
+        with self._lock:
+            return self._queued() + sum(r.inflight for r in
+                                        self._replicas.values())
+
+    def submit(self, model, inputs, n=None, trace_id=None,
+               tenant=None):
         """Admit one request fleet-wide; returns a Future.  429 against
-        the AGGREGATE depth (never one replica's), 503 when draining —
-        both as structured :class:`ServerBusy`."""
+        the AGGREGATE depth (never one replica's) — or against the
+        TENANT's token budget when ``tenant`` names a configured lane
+        (``MXTPU_FLEET_TENANTS``; a hot tenant 429s against ITS bucket
+        while siblings keep flowing) — 503 when draining, all as
+        structured :class:`ServerBusy`.  Unknown/absent tenants ride
+        the unbudgeted default lane."""
         if trace_id is None and _trace.enabled():
             trace_id = _trace.new_id()
+        lane = tenant if tenant in self._tenants else "default"
         with self._cv:
             if not self._accepting:
                 raise ServerBusy(model, 0, 0, code=503,
                                  reason="draining")
-            depth = len(self._queue) + sum(
+            if lane != "default":
+                ten = self._tenants[lane]
+                if not ten["bucket"].take():
+                    ten["rejected"] += 1
+                    self._stats["rejected"] += 1
+                    raise ServerBusy(
+                        model, len(self._lanes[lane]),
+                        int(ten["bucket"].burst),
+                        retry_after_ms=ten["bucket"].retry_after_ms(),
+                        reason="tenant budget",
+                        extra={"tenant": lane})
+            depth = self._queued() + sum(
                 r.inflight for r in self._replicas.values())
             if 0 < self.max_queue <= depth:
                 self._stats["rejected"] += 1
@@ -512,8 +617,10 @@ class FleetRouter(object):
                     retry_after_ms=_serve_max_delay_ms(),
                     reason="fleet queue full",
                     extra={"replicas_ready": ready})
-            work = _Work(model, inputs, n, trace_id)
-            self._queue.append(work)
+            work = _Work(model, inputs, n, trace_id, tenant=tenant)
+            if lane != "default":
+                self._tenants[lane]["admitted"] += 1
+            self._lanes[lane].append(work)
             self._cv.notify()
         return work.future
 
@@ -559,16 +666,32 @@ class FleetRouter(object):
             rep.inflight -= 1
             self._cv.notify()
 
+    def _next_work(self):
+        """Weighted-fair dequeue over tenant lanes (caller holds the
+        lock): walk the weight-expanded cycle from a rotating cursor
+        and pop the first non-empty lane.  A tenant with weight 3
+        appears 3x in the cycle and gets 3x the dequeue share under
+        contention; with no tenants the cycle is ["default"] and this
+        is a plain FIFO popleft."""
+        n = len(self._rr)
+        for off in range(n):
+            lane = self._rr[(self._rr_pos + off) % n]
+            q = self._lanes[lane]
+            if q:
+                self._rr_pos = (self._rr_pos + off + 1) % n
+                return q.popleft()
+        return None
+
     def _dispatch_loop(self):
         while True:
             with self._cv:
-                while not self._queue and not self._stop:
+                while not self._queued() and not self._stop:
                     self._cv.wait(0.05)
-                if not self._queue:
+                work = self._next_work()
+                if work is None:
                     if self._stop:
                         return
                     continue
-                work = self._queue.popleft()
             self._dispatch_one(work)
 
     def _dispatch_one(self, work):
@@ -623,8 +746,22 @@ class FleetRouter(object):
 
     # -- health / lifecycle --------------------------------------------
 
+    def _is_leader(self):
+        """kv-less routers (unit fleets) are their own leader."""
+        return self._lease is None or self._lease.leading
+
+    def _leader_hint(self):
+        """Best-effort current leader id (for 409 bodies / stats)."""
+        if self._lease is None:
+            return self.router_id
+        rec = self._lease.peek()
+        return rec["holder"] if rec else None
+
     def _on_replica_death(self, rep, reason):
-        """Mark dead once, write the shrink verdict, maybe respawn."""
+        """Mark dead once; the LEADER also writes the shrink verdict
+        and respawns.  A standby only stops routing there — the leader
+        scans the same heartbeats and owns the ledger, so a standby
+        verdict would double-bump the generation."""
         with self._cv:
             if rep.state == "dead":
                 return
@@ -634,6 +771,8 @@ class FleetRouter(object):
             alive = [r.index for r in self._replicas.values()
                      if r.state != "dead"]
             from_world = len(alive) + 1
+        if not self._is_leader():
+            return
         self._write_verdict(alive, "replica_death", from_world)
         if rep.proc is not None:
             try:
@@ -659,36 +798,227 @@ class FleetRouter(object):
         # the health loop promotes it to ready once /healthz answers
 
     def _health_loop(self):
-        from ..kvstore import scan_dead_ranks
+        from ..resilience.faultinject import maybe_fault
         while not self._stop:
             _time.sleep(0.5)
-            with self._lock:
-                live = [r.index for r in self._replicas.values()
-                        if r.state in ("ready", "rebinding")]
-                starting = [r for r in self._replicas.values()
-                            if r.state == "starting"]
-            if live:
+            if self._stop:
+                return
+            # drillable router death (faultinject kind=router_death):
+            # hard-exit mid-tick — standbys must take over within one
+            # lease TTL, clients fail over between front doors
+            if maybe_fault("router_death") is not None:
+                _os._exit(43)
+            if self._lease is not None:
+                was = self._lease.leading
+                leading = self._lease.poll()
+                if leading and not was:
+                    self._on_takeover()
+                elif was and not leading:
+                    self._emit_role("stepdown")
+                if not leading:
+                    self._standby_tick()
+                    continue
+            self._leader_tick()
+
+    def _emit_role(self, event):
+        from .. import observability as _obs
+        with self._lock:
+            gen = self._generation
+        _obs.emit("elastic", event="router_%s" % event, tier="serve",
+                  router_id=self.router_id, generation=gen)
+        _obs.flush()
+
+    def _on_takeover(self):
+        """A standby won the lease: adopt the ledger's generation (the
+        dead leader may have written verdicts we never mirrored) and
+        give heartbeat scanning one timeout of grace — this router's
+        view starts cold and the fleet may be mid-recovery."""
+        try:
+            led = self._read_ledger()
+        except Exception:
+            led = None
+        with self._cv:
+            if led and int(led.get("generation", 0)) > self._generation:
+                self._generation = int(led.get("generation", 0))
+            self._takeovers += 1
+            self._scan_hold_until = _time.monotonic() + self._hb_timeout
+        self._emit_role("takeover")
+
+    def _note_kv_fault(self):
+        """KV went unreachable mid-scan: hold the last verdict (the KV
+        fault discipline, docs/resilience.md) — replicas keep serving,
+        no deaths are invented, and the hold is telemetered once."""
+        with self._lock:
+            first = self._kv_fault_since is None
+            if first:
+                self._kv_fault_since = _time.monotonic()
+        if first:
+            from .. import observability as _obs
+            _obs.emit("fault", fault="kv_hold", scope="fleet_router",
+                      router_id=self.router_id)
+            _obs.flush()
+
+    def _note_kv_ok(self):
+        """KV answered again: stamps may be as stale as the outage was
+        long, so skip heartbeat verdicts for one timeout while the
+        stamping threads catch back up."""
+        with self._lock:
+            healed = self._kv_fault_since is not None
+            if healed:
+                self._kv_fault_since = None
+                self._scan_hold_until = (_time.monotonic()
+                                         + self._hb_timeout)
+        if healed:
+            from .. import observability as _obs
+            _obs.emit("fault", fault="kv_hold_released",
+                      scope="fleet_router", router_id=self.router_id)
+            _obs.flush()
+
+    def _leader_tick(self):
+        from ..kvstore import scan_dead_ranks
+        with self._lock:
+            live = [r.index for r in self._replicas.values()
+                    if r.state in ("ready", "rebinding")]
+            starting = [r for r in self._replicas.values()
+                        if r.state == "starting"]
+            lost = [r for r in self._replicas.values()
+                    if r.state == "dead" and r.proc is None]
+            hold = _time.monotonic() < self._scan_hold_until
+        dead = []
+        if live:
+            try:
                 dead = scan_dead_ranks(self._kv, live, self._created,
                                        self._hb_timeout)
-            else:
-                dead = []
-            for idx in dead:
-                self._on_replica_death(self._replicas[idx],
-                                       "heartbeat stale")
-            for rep in starting:
-                # a respawned replica joins rotation when it answers
-                # health checks (its heartbeat follows)
+            except KVUnreachable:
+                self._note_kv_fault()
+                return
+        self._note_kv_ok()
+        if hold:
+            dead = []
+        for idx in dead:
+            self._on_replica_death(self._replicas[idx],
+                                   "heartbeat stale")
+        for rep in starting:
+            # a respawned replica joins rotation when it answers
+            # health checks (its heartbeat follows)
+            try:
+                ok = rep.client.healthz()
+            except Exception:
+                ok = False
+            if ok:
+                with self._cv:
+                    if rep.state == "starting":
+                        rep.state = "ready"
+                alive = [r.index for r in self._replicas.values()
+                         if r.state != "dead"]
+                self._write_verdict(alive, "grow", len(alive) - 1)
+        for rep in lost:
+            # a replica WE never spawned (adopted fleet / verdict
+            # mirrored while standing by) that answers health checks
+            # again is a live survivor — fenced stale incarnations
+            # exited and can't answer
+            try:
+                ok = rep.client.healthz()
+            except Exception:
+                ok = False
+            if ok:
+                with self._cv:
+                    if rep.state == "dead":
+                        rep.state = "ready"
+                        rep.reason = None
+                alive = [r.index for r in self._replicas.values()
+                         if r.state != "dead"]
+                self._write_verdict(alive, "grow", len(alive) - 1)
+        self._publish_view()
+        self._check_swap_ptr()
+
+    def _publish_view(self):
+        """Leader publishes the fleet view (replica states, generation,
+        applied params pointer) for standbys to reconcile from."""
+        with self._lock:
+            doc = {"leader": self.router_id,
+                   "generation": self._generation,
+                   "params_ptr": self._swap_ptr_seen,
+                   "replicas": {
+                       str(i): {"state": r.state, "port": r.port,
+                                "param_version": r.param_version}
+                       for i, r in self._replicas.items()}}
+        try:
+            self._kv.key_value_set(_FLEET_VIEW_KEY,
+                                   _json.dumps(doc, sort_keys=True))
+        except (KVUnreachable, OSError):
+            pass                    # best-effort: next tick republishes
+
+    def _standby_tick(self):
+        """Standby: serve reads off the leader-published view — adopt
+        its generation, mirror replica verdicts (probing health before
+        resurrecting), and track the applied params pointer so a later
+        takeover doesn't re-run an already-applied swap."""
+        try:
+            raw = self._kv.blocking_key_value_get(_FLEET_VIEW_KEY, 50)
+        except (KeyAbsent, KVUnreachable, OSError):
+            return
+        try:
+            view = _json.loads(raw)
+        except (TypeError, ValueError):
+            return
+        with self._cv:
+            if int(view.get("generation", 0)) > self._generation:
+                self._generation = int(view.get("generation", 0))
+            if view.get("params_ptr") is not None:
+                self._swap_ptr_seen = view["params_ptr"]
+        for key, info in (view.get("replicas") or {}).items():
+            try:
+                rep = self._replicas[int(key)]
+            except (KeyError, ValueError):
+                continue
+            state = info.get("state")
+            if state == "dead" and rep.state in ("ready", "rebinding"):
+                with self._cv:
+                    if rep.state in ("ready", "rebinding"):
+                        rep.state = "dead"
+                        rep.reason = "leader verdict"
+            elif state == "ready" and rep.state == "dead":
                 try:
                     ok = rep.client.healthz()
                 except Exception:
                     ok = False
                 if ok:
                     with self._cv:
-                        if rep.state == "starting":
+                        if rep.state == "dead":
                             rep.state = "ready"
-                    alive = [r.index for r in self._replicas.values()
-                             if r.state != "dead"]
-                    self._write_verdict(alive, "grow", len(alive) - 1)
+                            rep.reason = None
+
+    def _check_swap_ptr(self):
+        """``MXTPU_FLEET_SWAP_ON_COMMIT`` consumer: when the checkpoint
+        manager publishes a new versioned-params pointer, the LEADER
+        runs one drainless swap against it — one attempt per published
+        version (a failed swap shows in the version-skew map, never a
+        retry storm)."""
+        try:
+            raw = self._kv.blocking_key_value_get(_SWAP_PTR_KEY, 50)
+        except (KeyAbsent, KVUnreachable, OSError):
+            return
+        with self._lock:
+            if raw == self._swap_ptr_seen:
+                return
+            self._swap_ptr_seen = raw
+        try:
+            doc = _json.loads(raw)
+            params = doc["params"]
+            version = doc.get("version")
+        except (TypeError, ValueError, KeyError):
+            return
+        from .. import observability as _obs
+        _obs.emit("elastic", event="swap_on_commit", tier="serve",
+                  router_id=self.router_id, version=version)
+        try:
+            self.swap(params, version=version)
+        except Exception as exc:
+            _obs.emit("fault", fault="swap_on_commit_failed",
+                      router_id=self.router_id, version=version,
+                      error=repr(exc))
+            _obs.flush()
 
     # -- live weight hot-swap ------------------------------------------
 
@@ -700,7 +1030,14 @@ class FleetRouter(object):
         plus the pause distribution; a replica whose swap fails keeps
         serving the OLD version and shows up in the version-skew map
         rather than taking the fleet down.
+
+        Leader-only when the fleet runs a lease: standbys raise
+        :class:`NotLeader` (the front door answers 409 with the leader
+        hint so clients re-aim).
         """
+        if not self._is_leader():
+            raise NotLeader("swap", router_id=self.router_id,
+                            leader=self._leader_hint())
         results = {}
         with self._lock:
             order = sorted(i for i, r in self._replicas.items()
@@ -731,14 +1068,16 @@ class FleetRouter(object):
             results[idx] = dict(res, swap_pause_ms=round(pause_ms, 3))
         with self._lock:
             self._stats["swaps"] += 1
+            pauses = list(self._swap_pause_ms)
         return {"replicas": results, "version": version,
-                "swap_pause_ms": list(self._swap_pause_ms)}
+                "swap_pause_ms": pauses}
 
     # -- introspection / shutdown --------------------------------------
 
     def stats(self):
         """Router counters + per-replica state + the version-skew map
-        (which replica serves which param version)."""
+        (which replica serves which param version) + role/lease and the
+        per-tenant admission rollup."""
         from ..observability.counters import percentile
         with self._lock:
             reps = {}
@@ -752,11 +1091,26 @@ class FleetRouter(object):
                                 "reason": r.reason}
                 skew.setdefault(r.param_version or "?", []).append(i)
             out = dict(self._stats)
-            out["queue_depth"] = len(self._queue) + sum(
+            out["queue_depth"] = self._queued() + sum(
                 r.inflight for r in self._replicas.values())
             pauses = list(self._swap_pause_ms)
             out["generation"] = self._generation
+            out["takeovers"] = self._takeovers
+            out["kv_held"] = self._kv_fault_since is not None
+            tenants = {
+                name: {"queued": len(self._lanes[name]),
+                       "weight": t["weight"],
+                       "admitted": t["admitted"],
+                       "rejected": t["rejected"],
+                       "tokens": round(t["bucket"].tokens, 3)}
+                for name, t in sorted(self._tenants.items())}
         out["max_queue"] = self.max_queue
+        out["router_id"] = self.router_id
+        out["role"] = "leader" if self._is_leader() else "standby"
+        if self._lease is not None:
+            out["lease"] = self._lease.stats()
+        if tenants:
+            out["tenants"] = tenants
         out["replicas"] = reps
         out["version_skew"] = {v: sorted(idxs)
                                for v, idxs in sorted(skew.items())}
@@ -784,8 +1138,8 @@ class FleetRouter(object):
         with self._cv:
             self._accepting = False
             self._cv.notify_all()
-            while self._queue or any(r.inflight for r in
-                                     self._replicas.values()):
+            while self._queued() or any(r.inflight for r in
+                                        self._replicas.values()):
                 if _time.monotonic() > deadline:
                     raise TimeoutError("fleet drain: work still queued")
                 self._cv.wait(0.05)
@@ -815,6 +1169,10 @@ class FleetRouter(object):
         if self._health_thread is not None:
             self._health_thread.join(timeout=2.0)
             self._health_thread = None
+        if self._lease is not None:
+            # hand the lease over NOW so a standby leads in one poll
+            # instead of one TTL
+            self._lease.release()
         for rep in self._replicas.values():
             if rep.proc is not None:
                 try:
@@ -832,6 +1190,109 @@ class FleetRouter(object):
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+# ----------------------------------------------------------------------
+# front-door client: failover between router addresses
+# ----------------------------------------------------------------------
+class FleetClient(object):
+    """Client over N router front doors (``MXTPU_FLEET_ROUTERS``).
+
+    Sticky with failover: requests keep going to the router that
+    answered last; a TRANSPORT failure (connect refused/reset — never
+    a 4xx/5xx answer) rotates to the next address and retries the
+    request there.  An answering router is a healthy router: 429/503
+    bodies raise the same structured :class:`ServerBusy` the single-
+    router path does, and a 409 ``not_leader`` on :meth:`swap` re-aims
+    at the next address until the leader answers.  Predict is safe to
+    retry across routers — the router dispatches to idempotent model
+    replicas."""
+
+    def __init__(self, routers=None, timeout=30.0):
+        self.routers = fleet_routers(routers)
+        self.timeout = float(timeout)
+        self._idx = 0
+        self.failovers = 0
+
+    @staticmethod
+    def _hostport(url):
+        rest = url.split("://", 1)[-1].rstrip("/")
+        host, _, port = rest.partition(":")
+        return host, int(port or 80)
+
+    def _request(self, method, path, body=None, headers=None,
+                 timeout=None):
+        """One HTTP round-trip with address failover; returns
+        ``(status, payload)`` from the first router that ANSWERS."""
+        import http.client
+        last = None
+        for off in range(len(self.routers)):
+            i = (self._idx + off) % len(self.routers)
+            host, port = self._hostport(self.routers[i])
+            conn = http.client.HTTPConnection(
+                host, port, timeout=timeout or self.timeout)
+            try:
+                conn.request(method, path, body=body,
+                             headers=dict(headers or {}))
+                resp = conn.getresponse()
+                payload = resp.read()
+            except OSError as exc:
+                last = exc
+                if off + 1 < len(self.routers):
+                    self.failovers += 1
+                continue
+            finally:
+                conn.close()
+            self._idx = i
+            return resp.status, payload
+        raise MXNetError("fleet: no router reachable (%s): %r"
+                         % (", ".join(self.routers), last))
+
+    def predict(self, model, inputs, n=None, tenant=None,
+                trace_id=None, timeout=None):
+        headers = {"Content-Type": "application/x-npz",
+                   "X-MXTPU-Model": model}
+        if n is not None:
+            headers["X-MXTPU-N"] = str(int(n))
+        if tenant:
+            headers["X-MXTPU-Tenant"] = str(tenant)
+        if trace_id:
+            headers["X-MXTPU-Trace"] = str(trace_id)
+        status, payload = self._request(
+            "POST", "/v1/predict", body=encode_arrays(inputs),
+            headers=headers, timeout=timeout)
+        if status in (429, 503):
+            HTTPReplicaClient._raise_busy(status, payload)
+        if status != 200:
+            raise MXNetError("fleet predict -> %d: %s"
+                             % (status, payload[:200]))
+        arrays = decode_arrays(payload)
+        return [arrays[k] for k in sorted(arrays)]
+
+    def stats(self):
+        status, payload = self._request("GET", "/v1/stats")
+        if status != 200:
+            raise MXNetError("fleet stats -> %d" % status)
+        return _json.loads(payload.decode())
+
+    def swap(self, params, version=None):
+        body = _json.dumps({"params": _os.fspath(params),
+                            "version": version}).encode()
+        last = None
+        for _ in range(len(self.routers)):
+            status, payload = self._request(
+                "POST", "/v1/swap", body=body,
+                headers={"Content-Type": "application/json"},
+                timeout=max(self.timeout, 120.0))
+            doc = _json.loads(payload.decode() or "{}")
+            if status == 409:       # standby: re-aim at the next door
+                last = doc
+                self._idx = (self._idx + 1) % len(self.routers)
+                continue
+            if status != 200:
+                raise MXNetError("fleet swap -> %d: %s" % (status, doc))
+            return doc
+        raise NotLeader("swap", leader=(last or {}).get("leader"))
 
 
 # ----------------------------------------------------------------------
@@ -863,20 +1324,29 @@ def spawn_replica(spec_path, index, port, directory, generation=0,
 
 def launch_fleet(spec_path, n_replicas=None, directory=None,
                  base_port=None, host="127.0.0.1", max_queue=None,
-                 respawn=None, startup_timeout_s=90.0, extra_env=None):
+                 respawn=None, startup_timeout_s=90.0, extra_env=None,
+                 kv_url=None, router_id=None, lease_ttl_s=None,
+                 tenants=None):
     """Spawn N replicas + the router over them; returns the router.
 
     Writes generation 0 into the fleet ledger, spawns each replica
     with its index/port/generation, waits for every ``/healthz``, and
-    wires the health loop to the shared :class:`FileKV` the replicas
-    heartbeat into.  The router's spawner closure re-uses the same
-    recipe for grow-back respawns (at the then-current generation).
+    wires the health loop to the shared coordination KV the replicas
+    heartbeat into — ``MXTPU_KV_URL``/``kv_url`` picks the backend
+    (file-backed by default, ``tcp://`` for a networked fleet); the
+    replicas inherit the same URL through the environment.  The
+    router's spawner closure re-uses the same recipe for grow-back
+    respawns (at the then-current generation).
     """
     directory = fleet_dir(directory)
     n = fleet_replicas(n_replicas)
     base = fleet_base_port(base_port)
     _os.makedirs(directory, exist_ok=True)
-    kv = FileKV(_os.path.join(directory, "kv"))
+    kv = connect_kv(url=kv_url,
+                    default_root=_os.path.join(directory, "kv"))
+    if kv_url:
+        extra_env = dict(extra_env or {})
+        extra_env.setdefault("MXTPU_KV_URL", kv_url)
     from ..resilience import elastic as _elastic
     if _elastic.read_ledger(path=fleet_ledger_path(directory)) is None:
         _elastic.write_ledger(
@@ -914,9 +1384,47 @@ def launch_fleet(spec_path, n_replicas=None, directory=None,
 
     router = FleetRouter(clients, kv=kv, max_queue=max_queue,
                          directory=directory, spawner=spawner,
-                         respawn=respawn)
+                         respawn=respawn, router_id=router_id,
+                         lease_ttl_s=lease_ttl_s, tenants=tenants)
     for i, proc in enumerate(procs):
         router._replicas[i].proc = proc
+        router._replicas[i].port = base + i
+    return router
+
+
+def adopt_fleet(n_replicas=None, directory=None, base_port=None,
+                host="127.0.0.1", max_queue=None, kv_url=None,
+                router_id=None, lease_ttl_s=None, tenants=None,
+                spec_path=None, respawn=None):
+    """Build a router OVER an already-running fleet: no replica
+    spawning, no ledger seeding, no process ownership.
+
+    This is how standby routers come up (``mxfleet serve --adopt``):
+    N processes call this against the same KV and replica ports; the
+    expiring lease decides which one leads.  ``spec_path`` (optional)
+    arms the respawn spawner so a standby that takes over can still
+    grow the fleet back after a replica death; without it the adopted
+    router never spawns (``respawn`` is forced off)."""
+    directory = fleet_dir(directory)
+    n = fleet_replicas(n_replicas)
+    base = fleet_base_port(base_port)
+    _os.makedirs(directory, exist_ok=True)
+    kv = connect_kv(url=kv_url,
+                    default_root=_os.path.join(directory, "kv"))
+    clients = [HTTPReplicaClient(host, base + i) for i in range(n)]
+    spawner = None
+    if spec_path is not None:
+        def spawner(index, generation):
+            proc = spawn_replica(spec_path, index, base + index,
+                                 directory, generation=generation,
+                                 host=host)
+            return proc, HTTPReplicaClient(host, base + index)
+    router = FleetRouter(
+        clients, kv=kv, max_queue=max_queue, directory=directory,
+        spawner=spawner,
+        respawn=False if spec_path is None else respawn,
+        router_id=router_id, lease_ttl_s=lease_ttl_s, tenants=tenants)
+    for i in range(n):
         router._replicas[i].port = base + i
     return router
 
@@ -1098,7 +1606,11 @@ def run_replica(spec_path, index, port, host="127.0.0.1"):
                            param_version=spec.get("version") or "v0")
     srv = _build_replica_server(spec)
 
-    kv = FileKV(_os.path.join(directory, "kv"))
+    # heartbeat into the same coordination backend the router scans
+    # (MXTPU_KV_URL, inherited from the launcher) — through the
+    # ResilientKV discipline, so a KV blip retries instead of
+    # silently ending the stamping thread
+    kv = connect_kv(default_root=_os.path.join(directory, "kv"))
     _kvstore._start_heartbeat(client=kv, rank=index)
 
     from http.server import ThreadingHTTPServer

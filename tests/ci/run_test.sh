@@ -27,7 +27,10 @@
 #                    serve_generate acceptance drills (>= 3x serial
 #                    batch-1; decode == full forward; structured KV
 #                    429s; zero lowerings after warmup) +
-#                    serve_bench/mxtop smoke in both modes
+#                    serve_bench/mxtop smoke in both modes + the
+#                    networked-fleet chaos drill (KV partition +
+#                    leader-router SIGKILL, zero client errors) and
+#                    an mxkv TCP-server smoke
 set -e
 cd "$(dirname "$0")/../.."
 
@@ -98,6 +101,11 @@ case "${TASK:-python}" in
     # so a sweep-config change can never silently drop it
     JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
       mxnet_tpu/serving/fleet.py --fail-on=error --format=github
+    # the coordination KV + lease (docs/serving.md "Networked fleet")
+    # sits under every cross-process verdict the fleet makes — pinned
+    # explicitly like fleet.py so the sweep can never drop it
+    JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+      mxnet_tpu/resilience/netkv.py --fail-on=error --format=github
     # generative serving's cache allocator + engine make per-process
     # admission and scheduling decisions (block budgets, prefill/decode
     # alternation) — pinned explicitly on top of the directory sweep so
@@ -179,6 +187,12 @@ print("kernel-tier MXL-K sweep OK "
     # with their happens-before argument)
     JAX_PLATFORMS=cpu python tools/mxlint.py --concurrency \
       mxnet_tpu --fail-on=error --format=github
+    # the networked fleet's lock-densest files (router lease/takeover,
+    # KV connection handling, bget parking) — pinned on top of the
+    # directory sweep so a sweep-config change can never drop them
+    JAX_PLATFORMS=cpu python tools/mxlint.py --concurrency \
+      mxnet_tpu/resilience/netkv.py mxnet_tpu/serving/fleet.py \
+      --fail-on=error --format=github
     # the pre-fix concurrency regression fixtures are expected-FAIL
     # inputs: MXL-Q must keep flagging each with its documented rule id
     qx=tests/fixtures/concurrency
@@ -201,6 +215,11 @@ print("kernel-tier MXL-K sweep OK "
     # lowerings contract the serving benches assert at runtime
     JAX_PLATFORMS=cpu python tools/mxlint.py --retrace \
       mxnet_tpu --fail-on=error --format=github
+    # the networked-fleet swap path re-aims AOT programs at new params
+    # mid-serve — pin its files so MXL-X always prices them
+    JAX_PLATFORMS=cpu python tools/mxlint.py --retrace \
+      mxnet_tpu/resilience/netkv.py mxnet_tpu/serving/fleet.py \
+      --fail-on=error --format=github
     # the pre-fix retrace regression fixture (the PR-17 id()-keyed
     # fused-step cache bug) is an expected-FAIL input: MXL-X must keep
     # flagging it with its documented rule id
@@ -492,6 +511,38 @@ json.dump(doc, open(sys.argv[1], "w"))
     # verdict in the fleet ledger (all asserted inside the drill)
     JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
     JAX_PLATFORMS=cpu python tests/nightly/serve_load_fleet.py
+    # networked-fleet stack (docs/serving.md "Networked fleet"): the
+    # KV backend-parity + lease + fault-discipline unit suite runs
+    # file:// and tcp:// through one contract, then the chaos drill —
+    # 3 replica processes + 2 router doors over a tcp:// KV survive a
+    # 5s KV partition AND SIGKILL of the leader router with zero
+    # client-visible errors, zero fabricated death verdicts (the
+    # partition must HOLD the last liveness verdict, not invent
+    # deaths), a lease takeover, client address failover, a converged
+    # swap-on-commit to v2 (bit-identical outputs), and bounded p95
+    # (all asserted inside the drill)
+    JAX_PLATFORMS=cpu python -m pytest tests/test_netkv.py -q
+    JAX_PLATFORMS=cpu python tests/nightly/serve_fleet_net.py
+    # mxkv smoke: the standalone TCP KV server must answer the CLI
+    # client ops (ping/set/get/dir/del) over tcp://
+    MXKV_URL="tcp://127.0.0.1:8979"
+    python tools/mxkv.py serve --port 8979 &
+    MXKV_PID=$!
+    for _ in $(seq 1 50); do
+      python tools/mxkv.py --kv "$MXKV_URL" ping >/dev/null 2>&1 \
+        && break
+      sleep 0.2
+    done
+    python tools/mxkv.py --kv "$MXKV_URL" ping | grep -q '"ok": true'
+    python tools/mxkv.py --kv "$MXKV_URL" set smoke/k v1
+    test "$(python tools/mxkv.py --kv "$MXKV_URL" get smoke/k)" = "v1"
+    python tools/mxkv.py --kv "$MXKV_URL" dir smoke/ | grep -q "^smoke/k"
+    python tools/mxkv.py --kv "$MXKV_URL" del smoke/k
+    if python tools/mxkv.py --kv "$MXKV_URL" get smoke/k 2>/dev/null; then
+      echo "mxkv: deleted key still readable"; exit 1
+    fi
+    kill "$MXKV_PID"; wait "$MXKV_PID" 2>/dev/null || true
+    echo "mxkv smoke OK"
     # generative acceptance drill (docs/serving.md "Generation"):
     # decode == full forward, zero lowerings, structured 429 under KV
     # pressure while running decodes finish, bounded p95 TTFT

@@ -1,0 +1,435 @@
+"""Networked-fleet chaos drill: KV partition + leader-router SIGKILL.
+
+The acceptance run for docs/serving.md "Networked fleet" (wired as the
+CI multi-process drill in tests/ci/run_test.sh TASK=serving).  The
+parent embeds a :class:`TcpKVServer` (the coordination plane), spawns
+REPLICAS real replica processes heartbeating into it over
+``MXTPU_KV_URL=tcp://``, and TWO router front-door processes
+(``mxfleet serve --adopt``) that elect a leader through the expiring
+KV lease.  A :class:`FleetClient` drives closed-loop load across both
+front doors while the drill injects, in order:
+
+1. **A 5 s KV partition** (server-side: every connection accepted and
+   dropped) at ~1/3 of the run.  The KV fault discipline must hold:
+   routers hold their last liveness verdict (``kv_held`` in stats),
+   ZERO death verdicts are fabricated, the ledger stays empty, and the
+   serving datapath — which never touches the KV — keeps answering.
+2. **SIGKILL of the leader router** (no drain, no goodbye) after the
+   partition heals.  The standby must take the lease within a few
+   TTLs; clients fail over between front doors with ZERO visible
+   errors.
+3. **Swap-on-commit leg**: the surviving leader applies a
+   versioned-params pointer published into the KV (the
+   ``MXTPU_FLEET_SWAP_ON_COMMIT`` consumer path) — every replica ends
+   on v2 and fleet outputs are bit-identical to a local v2 Predictor.
+4. **p95 SLO gate** — client-observed p95 bounded by the closed-loop
+   single-door term plus a takeover allowance.
+
+Prints one JSON line with every figure.  Exit codes: 0 OK, 4 = an
+expectation failed.
+
+Run:  JAX_PLATFORMS=cpu python tests/nightly/serve_fleet_net.py
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx                                  # noqa: E402
+from mxnet_tpu import ndarray as nd                     # noqa: E402
+from mxnet_tpu.resilience import elastic                # noqa: E402
+from mxnet_tpu.resilience.netkv import (                # noqa: E402
+    TcpKV, TcpKVServer)
+from mxnet_tpu.serving.fleet import (                   # noqa: E402
+    _SWAP_PTR_KEY, FleetClient, HTTPReplicaClient, fleet_ledger_path,
+    spawn_replica)
+
+N_REQUESTS = int(os.environ.get("FLEET_NET_REQUESTS", "240"))
+CONCURRENCY = int(os.environ.get("FLEET_NET_CONCURRENCY", "8"))
+MAX_DELAY_MS = float(os.environ.get("FLEET_NET_MAX_DELAY_MS", "25"))
+REPLICAS = int(os.environ.get("FLEET_NET_REPLICAS", "3"))
+BASE_PORT = int(os.environ.get("FLEET_NET_BASE_PORT", "8981"))
+ROUTER_PORTS = (BASE_PORT + REPLICAS + 1, BASE_PORT + REPLICAS + 2)
+PARTITION_S = float(os.environ.get("FLEET_NET_PARTITION_S", "5"))
+LEASE_TTL_S = 2.0
+FEATURES = 64
+BUCKETS = (1, 8)
+MXFLEET = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "..", "..", "tools", "mxfleet.py")
+
+
+def fail(msg, report):
+    report["failed"] = msg
+    print(json.dumps(report, default=str), flush=True)
+    print("serve_fleet_net FAILED: %s" % msg, file=sys.stderr,
+          flush=True)
+    os._exit(4)
+
+
+def _wait_http(client, proc, what, deadline):
+    while True:
+        try:
+            if client.healthz():
+                return
+        except Exception:
+            pass
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError("%s exited with %s during startup"
+                               % (what, proc.returncode))
+        if time.monotonic() > deadline:
+            raise RuntimeError("%s not healthy in time" % what)
+        time.sleep(0.1)
+
+
+def _spawn_router(router_id, port, kv_url, fleet_dir):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, MXFLEET, "serve", "--adopt",
+           "--kv", kv_url, "--router-id", router_id,
+           "--port", str(port), "--replicas", str(REPLICAS),
+           "--base-port", str(BASE_PORT), "--dir", fleet_dir,
+           "--lease-ttl", str(LEASE_TTL_S)]
+    return subprocess.Popen(cmd, env=env)
+
+
+def _router_stats(port, timeout=10.0):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    try:
+        conn.request("GET", "/v1/stats")
+        resp = conn.getresponse()
+        return json.loads(resp.read().decode())
+    finally:
+        conn.close()
+
+
+def _leader_port(report, deadline_s=30.0):
+    """Poll both doors until exactly one claims the lease."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        roles = {}
+        for port in ROUTER_PORTS:
+            try:
+                roles[port] = _router_stats(port).get("role")
+            except Exception:
+                pass
+        leaders = [p for p, r in roles.items() if r == "leader"]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.25)
+    fail("no unique leader elected: %s" % roles, report)
+
+
+def main():
+    net = mx.models.get_mlp(num_classes=10, hidden=(64, 32))
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, FEATURES))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    arg_params, aux_params = mod.get_params()
+    v1 = {"arg:" + k: v for k, v in arg_params.items()}
+    v1.update({"aux:" + k: v for k, v in aux_params.items()})
+    v2 = {k: nd.array(v.asnumpy() * 1.25 + 0.01) for k, v in v1.items()}
+    v2_np = {k: v.asnumpy() for k, v in v2.items()}
+
+    tmp = tempfile.mkdtemp(prefix="fleet_net_")
+    fleet_dir = os.path.join(tmp, "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+    sym_path = os.path.join(tmp, "net-symbol.json")
+    with open(sym_path, "w") as fout:
+        fout.write(net.tojson())
+    v1_path = os.path.join(tmp, "net-v1.params")
+    nd.save(v1_path, v1)
+    v2_path = os.path.join(tmp, "net-v2.params")
+    nd.save(v2_path, v2)
+    spec_path = os.path.join(tmp, "fleet.json")
+    with open(spec_path, "w") as fout:
+        json.dump({"models": [{
+            "name": "net", "symbol": sym_path, "params": v1_path,
+            "input_shapes": {"data": [FEATURES]},
+            "buckets": list(BUCKETS)}],
+            "version": "v1", "max_delay_ms": MAX_DELAY_MS}, fout)
+
+    # local batch-time reference for the latency bound
+    rng = np.random.RandomState(11)
+    xb = rng.rand(max(BUCKETS), FEATURES).astype("float32")
+    ref_pred = mx.Predictor(net.tojson(),
+                            {k: v.asnumpy() for k, v in v1.items()},
+                            {"data": xb.shape})
+    ref_pred.forward(data=xb)
+    times = []
+    for _ in range(20):
+        t = time.perf_counter()
+        ref_pred.forward(data=xb)
+        times.append(time.perf_counter() - t)
+    batch_ms = sorted(times)[len(times) // 2] * 1e3
+
+    report = {"metric": "fleet_net_drill", "replicas": REPLICAS,
+              "requests": N_REQUESTS, "concurrency": CONCURRENCY,
+              "partition_s": PARTITION_S}
+
+    # 1. the coordination plane: an embedded TCP KV
+    kvsrv = TcpKVServer(port=0).start()
+    kv_url = kvsrv.url
+    report["kv_url"] = kv_url
+
+    procs = []
+    routers = []
+    try:
+        # 2. replicas, heartbeating over tcp://
+        clients = []
+        for i in range(REPLICAS):
+            procs.append(spawn_replica(
+                spec_path, i, BASE_PORT + i, fleet_dir,
+                extra_env={"MXTPU_KV_URL": kv_url,
+                           "JAX_PLATFORMS": "cpu"}))
+            clients.append(HTTPReplicaClient("127.0.0.1",
+                                             BASE_PORT + i))
+        deadline = time.monotonic() + 300.0
+        for i, client in enumerate(clients):
+            _wait_http(client, procs[i], "replica %d" % i, deadline)
+
+        # 3. two router front doors over the same KV + fleet
+        for rid, port in zip(("r1", "r2"), ROUTER_PORTS):
+            routers.append((rid, port,
+                            _spawn_router(rid, port, kv_url,
+                                          fleet_dir)))
+        deadline = time.monotonic() + 120.0
+        for rid, port, proc in routers:
+            _wait_http(HTTPReplicaClient("127.0.0.1", port), proc,
+                       "router %s" % rid, deadline)
+        leader0 = _leader_port(report)
+        report["first_leader_port"] = leader0
+
+        fc = FleetClient(routers=["http://127.0.0.1:%d" % p
+                                  for p in ROUTER_PORTS], timeout=60.0)
+        x1 = rng.rand(1, FEATURES).astype("float32")
+        rtts = []
+        for _ in range(4 * REPLICAS):
+            t = time.perf_counter()
+            fc.predict("net", {"data": x1}, timeout=60.0)
+            rtts.append((time.perf_counter() - t) * 1e3)
+        rtt_ms = sorted(rtts)[len(rtts) // 2]
+
+        partition_at = N_REQUESTS // 3
+        cursor, lock = [0], threading.Lock()
+        errors, lat_ms = [], []
+        partition_fired = threading.Event()
+        partition_over = threading.Event()
+        kv_held_seen = []
+        killed = threading.Event()
+        kill_info = {}
+
+        def do_partition():
+            kvsrv.partition(PARTITION_S)
+            partition_fired.set()
+            t_end = time.monotonic() + PARTITION_S
+            # sample router stats mid-partition: the leader must be
+            # HOLDING (kv_held), not inventing deaths
+            time.sleep(PARTITION_S / 2)
+            for port in ROUTER_PORTS:
+                try:
+                    st = _router_stats(port, timeout=5.0)
+                    kv_held_seen.append(
+                        {"port": port, "kv_held": st.get("kv_held"),
+                         "generation": st.get("generation"),
+                         "states": sorted(
+                             r["state"] for r in
+                             st.get("replicas", {}).values())})
+                except Exception:
+                    pass
+            time.sleep(max(0.0, t_end - time.monotonic()) + 1.0)
+            partition_over.set()
+
+        def do_kill():
+            # only after the partition heals: the drill separates the
+            # two faults so each assertion is attributable
+            partition_over.wait(timeout=60.0)
+            port = _leader_port(report)
+            proc = next(p for rid, prt, p in routers if prt == port)
+            kill_info["port"] = port
+            proc.kill()                # SIGKILL, mid-whatever
+            killed.set()
+
+        def worker():
+            while True:
+                with lock:
+                    i = cursor[0]
+                    if i >= N_REQUESTS:
+                        return
+                    cursor[0] += 1
+                if i == partition_at:
+                    threading.Thread(target=do_partition,
+                                     daemon=True).start()
+                    threading.Thread(target=do_kill,
+                                     daemon=True).start()
+                t = time.perf_counter()
+                try:
+                    out = fc.predict("net", {"data": x1}, timeout=60.0)
+                    assert out[0].shape == (1, 10), out[0].shape
+                except Exception as exc:
+                    errors.append(exc)
+                    return
+                lat_ms.append((time.perf_counter() - t) * 1e3)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(CONCURRENCY)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_s = time.perf_counter() - t0
+
+        # the load may finish before the kill thread fires: keep the
+        # client loop's invariants but let both faults land
+        partition_over.wait(timeout=PARTITION_S + 60.0)
+        killed.wait(timeout=60.0)
+
+        # takeover: the surviving door must hold the lease
+        survivor = next(prt for rid, prt, p in routers
+                        if prt != kill_info.get("port"))
+        takeover_deadline = time.monotonic() + 10 * LEASE_TTL_S
+        st = None
+        while time.monotonic() < takeover_deadline:
+            try:
+                st = _router_stats(survivor)
+                if st.get("role") == "leader":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.25)
+        if not st or st.get("role") != "leader":
+            fail("survivor on %d never took the lease: %s"
+                 % (survivor, (st or {}).get("role")), report)
+
+        # post-takeover traffic: aim the sticky cursor at the DEAD
+        # door first so the address-failover path provably runs even
+        # if the closed loop drained before the kill landed
+        fc._idx = next(i for i, u in enumerate(fc.routers)
+                       if u.endswith(":%d" % kill_info["port"]))
+        for _ in range(5):
+            fc.predict("net", {"data": x1}, timeout=60.0)
+
+        # 4. swap-on-commit leg: publish the pointer, leader applies
+        kvc = TcpKV(kvsrv.host, kvsrv.port, timeout_s=5.0)
+        kvc.key_value_set(_SWAP_PTR_KEY, json.dumps(
+            {"params": v2_path, "version": "v2"}, sort_keys=True))
+        swap_deadline = time.monotonic() + 120.0
+        skew = None
+        while time.monotonic() < swap_deadline:
+            st = _router_stats(survivor)
+            skew = st.get("version_skew") or {}
+            if sorted(skew.get("v2", [])) == list(range(REPLICAS)):
+                break
+            time.sleep(0.5)
+        fleet_out = fc.predict("net", {"data": x1}, timeout=60.0)
+        final_stats = _router_stats(survivor)
+    finally:
+        for rid, port, proc in routers:
+            try:
+                proc.terminate()
+                proc.wait(timeout=10)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        for proc in procs:
+            try:
+                proc.terminate()
+                proc.wait(timeout=10)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        kvsrv.stop()
+
+    lat_sorted = sorted(lat_ms)
+    p95 = lat_sorted[int(0.95 * (len(lat_sorted) - 1))] \
+        if lat_sorted else None
+    # closed-loop single-door tail + one failover/takeover allowance
+    bound_ms = MAX_DELAY_MS + 2.0 * batch_ms \
+        + 2.0 * CONCURRENCY * rtt_ms + 2e3 * LEASE_TTL_S
+    led = elastic.read_ledger(path=fleet_ledger_path(fleet_dir))
+    report.update({
+        "value": round(len(lat_ms) / wall_s, 1) if wall_s else 0,
+        "unit": "req/s",
+        "wall_s": round(wall_s, 3),
+        "completed": len(lat_ms),
+        "errors": len(errors),
+        "p95_ms": round(p95, 3) if p95 is not None else None,
+        "p95_bound_ms": round(bound_ms, 3),
+        "single_batch_ms": round(batch_ms, 3),
+        "warm_rtt_ms": round(rtt_ms, 3),
+        "client_failovers": fc.failovers,
+        "killed_router_port": kill_info.get("port"),
+        "survivor_port": survivor,
+        "kv_held_samples": kv_held_seen,
+        "takeovers": final_stats.get("takeovers"),
+        "generation": final_stats.get("generation"),
+        "version_skew": final_stats.get("version_skew"),
+        "ledger": led,
+    })
+
+    if errors:
+        fail("client-visible errors: %r (partition + router kill must "
+             "be absorbed)" % errors[0], report)
+    if len(lat_ms) != N_REQUESTS:
+        fail("completed %d != %d requested"
+             % (len(lat_ms), N_REQUESTS), report)
+    if not partition_fired.is_set():
+        fail("KV partition never fired", report)
+    if not killed.is_set():
+        fail("leader kill never fired", report)
+    # zero false deaths: no replica died, so the ledger must carry no
+    # replica_death verdict and the generation must never have moved
+    if led and led.get("reason") == "replica_death":
+        fail("KV partition fabricated a death verdict: %s" % (led,),
+             report)
+    if int(final_stats.get("generation") or 0) != 0:
+        fail("generation %s moved with every replica alive"
+             % final_stats.get("generation"), report)
+    states = sorted(r["state"] for r in
+                    (final_stats.get("replicas") or {}).values())
+    if states != ["ready"] * REPLICAS:
+        fail("replica states %s: all must be ready" % states, report)
+    held = [s for s in kv_held_seen if s.get("kv_held")]
+    if not held:
+        fail("no router reported kv_held during the partition "
+             "(samples: %s)" % kv_held_seen, report)
+    if any(s["generation"] for s in kv_held_seen):
+        fail("generation moved DURING the partition: %s"
+             % kv_held_seen, report)
+    if fc.failovers < 1:
+        fail("client never failed over between front doors", report)
+    if sorted((final_stats.get("version_skew") or {}).get("v2", [])) \
+            != list(range(REPLICAS)):
+        fail("swap-on-commit never converged: skew %s"
+             % final_stats.get("version_skew"), report)
+    ref = mx.Predictor(net.tojson(), v2_np,
+                       {"data": x1.shape}).forward(data=x1)[0]
+    if not np.array_equal(np.asarray(fleet_out[0]), np.asarray(ref)):
+        fail("post-swap fleet output differs from local v2 predictor",
+             report)
+    if p95 is None or p95 > bound_ms:
+        fail("p95 %.3f ms exceeds bound %.3f ms"
+             % (p95 or -1, bound_ms), report)
+    print(json.dumps(report, default=str), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

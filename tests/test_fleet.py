@@ -22,10 +22,13 @@ import mxnet_tpu as mx
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.executor import program_registry_stats
 from mxnet_tpu.kvstore import scan_dead_ranks
+from mxnet_tpu.resilience.netkv import CoordKV, KVUnreachable
 from mxnet_tpu.serving import ModelServer, ServerBusy
-from mxnet_tpu.serving.fleet import (FileKV, FleetRouter, ReplicaDead,
+from mxnet_tpu.serving.fleet import (_SWAP_PTR_KEY, FileKV, FleetRouter,
+                                     NotLeader, ReplicaDead,
                                      decode_arrays, encode_arrays,
-                                     fleet_ledger_path, fleet_max_queue)
+                                     fleet_ledger_path, fleet_max_queue,
+                                     fleet_routers, fleet_tenants)
 from mxnet_tpu.serving.telemetry import fleet_report
 
 
@@ -68,6 +71,21 @@ def test_filekv_keys_with_slashes_are_flat_files(tmp_path):
 # liveness: the dead_nodes scan rule over a FileKV
 # ---------------------------------------------------------------------------
 
+@pytest.fixture(params=["file", "tcp"])
+def any_kv(request, tmp_path):
+    """A coordination KV over both backends — the router/heartbeat/
+    ledger machinery must behave identically on file:// and tcp://."""
+    if request.param == "file":
+        yield FileKV(tmp_path / "kv")
+        return
+    from mxnet_tpu.resilience.netkv import TcpKV, TcpKVServer
+    srv = TcpKVServer(port=0).start()
+    try:
+        yield TcpKV(srv.host, srv.port, timeout_s=2.0)
+    finally:
+        srv.stop()
+
+
 def test_scan_dead_ranks_fresh_vs_stale(tmp_path, monkeypatch):
     from mxnet_tpu import kvstore as kvmod
     kv = FileKV(tmp_path / "kv")
@@ -81,11 +99,11 @@ def test_scan_dead_ranks_fresh_vs_stale(tmp_path, monkeypatch):
     assert dead == [1, 2]                      # grace expired for 2
 
 
-def test_router_health_loop_uses_shared_scan(tmp_path, monkeypatch):
+def test_router_health_loop_uses_shared_scan(tmp_path, any_kv):
     """A replica whose heartbeat goes stale is marked dead by the
-    router's health loop — the same machinery dead_nodes uses."""
-    from mxnet_tpu import kvstore as kvmod
-    kv = FileKV(tmp_path / "kv")
+    router's health loop — the same machinery dead_nodes uses, over
+    file:// and tcp:// alike."""
+    kv = any_kv
     now = time.time()
     kv.key_value_set("mxtpu_hb/0", str(now + 1000))  # forever fresh
     kv.key_value_set("mxtpu_hb/1", str(now - 1000))  # long stale
@@ -544,5 +562,368 @@ def test_set_fleet_context_stamps_serve_records(tmp_path, monkeypatch):
 def test_fleet_names_are_exported():
     import mxnet_tpu.serving as serving
     for name in ("FleetRouter", "FileKV", "ReplicaDead",
-                 "fleet_report", "set_fleet_context"):
+                 "fleet_report", "set_fleet_context", "FleetClient",
+                 "NotLeader", "adopt_fleet", "connect_kv"):
         assert hasattr(serving, name)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant admission lanes
+# ---------------------------------------------------------------------------
+
+def test_fleet_tenants_parsing(monkeypatch):
+    monkeypatch.setenv("MXTPU_FLEET_TENANTS",
+                       "teamA:50:100:3;teamB:10:20")
+    cfg = fleet_tenants()
+    assert cfg["teamA"] == {"rate": 50.0, "burst": 100.0, "weight": 3}
+    assert cfg["teamB"] == {"rate": 10.0, "burst": 20.0, "weight": 1}
+    assert fleet_tenants("") == {}
+    with pytest.raises(ValueError):
+        fleet_tenants("teamA:50")              # missing burst
+    with pytest.raises(ValueError):
+        fleet_tenants("a:1:2:3:4:5")           # too many fields
+
+
+def test_hot_tenant_429s_while_default_flows(tmp_path):
+    """A tenant over ITS token budget gets a structured 429 (reason
+    "tenant budget") while the default lane keeps flowing — noisy
+    neighbors burn their own bucket, never the fleet's door."""
+    ok = _OkClient()
+    router = FleetRouter([ok], max_queue=64, directory=str(tmp_path),
+                         respawn=False, threads=1,
+                         tenants="teamA:0.001:2")
+    try:
+        for _ in range(2):                     # burst=2 admitted
+            router.submit("m", {"x": np.zeros(1)}, n=1,
+                          tenant="teamA").result(timeout=10)
+        with pytest.raises(ServerBusy) as exc:
+            router.submit("m", {"x": np.zeros(1)}, n=1, tenant="teamA")
+        busy = exc.value
+        assert busy.code == 429
+        assert busy.reason == "tenant budget"
+        assert busy.to_dict()["tenant"] == "teamA"
+        assert busy.limit == 2                 # the tenant's burst,
+        assert busy.retry_after_ms is not None # not the fleet queue
+        # siblings and the default lane are untouched by teamA's burn
+        router.submit("m", {"x": np.zeros(1)}, n=1).result(timeout=10)
+        st = router.stats()
+        assert st["tenants"]["teamA"]["admitted"] == 2
+        assert st["tenants"]["teamA"]["rejected"] == 1
+    finally:
+        router.close(drain=False)
+
+
+def test_unknown_tenant_rides_default_lane(tmp_path):
+    router = FleetRouter([_OkClient()], max_queue=8,
+                         directory=str(tmp_path), respawn=False,
+                         threads=1, tenants="teamA:100:100")
+    try:
+        # a tenant nobody configured is not rejected — it shares the
+        # unbudgeted default lane
+        router.submit("m", {"x": np.zeros(1)}, n=1,
+                      tenant="stranger").result(timeout=10)
+        st = router.stats()
+        assert st["tenants"]["teamA"]["admitted"] == 0
+    finally:
+        router.close(drain=False)
+
+
+def test_weighted_fair_dequeue_order(tmp_path):
+    """Weight 3 vs 1 under contention: the dispatch order follows the
+    weight-expanded cycle (a,a,a,b,...) deterministically."""
+    client = _BlockingClient()
+    router = FleetRouter([client], max_queue=64,
+                         directory=str(tmp_path), respawn=False,
+                         threads=1, tenants="a:100:100:3;b:100:100:1")
+    try:
+        futs = [router.submit("occupy", {"x": np.zeros(1)}, n=1)]
+        deadline = time.time() + 10
+        while time.time() < deadline:          # occupy is in flight:
+            st = router.stats()                # everything else queues
+            if st["replicas"]["0"]["inflight"] == 1:
+                break
+            time.sleep(0.02)
+        for i in range(6):
+            futs.append(router.submit("a", {"x": np.zeros(1)}, n=1,
+                                      tenant="a"))
+        for i in range(2):
+            futs.append(router.submit("b", {"x": np.zeros(1)}, n=1,
+                                      tenant="b"))
+        client.release.set()
+        for f in futs:
+            f.result(timeout=30)
+        assert client.calls == ["occupy",
+                                "a", "a", "a", "b", "a", "a", "a", "b"]
+    finally:
+        router.close(drain=False)
+
+
+def test_no_tenant_config_keeps_single_fifo(tmp_path):
+    """Without MXTPU_FLEET_TENANTS the router is bit-for-bit the old
+    single-FIFO front door: no tenant rollup, plain arrival order."""
+    client = _BlockingClient()
+    router = FleetRouter([client], max_queue=64,
+                         directory=str(tmp_path), respawn=False,
+                         threads=1)
+    try:
+        assert router._rr == ["default"]
+        futs = [router.submit("m%d" % i, {"x": np.zeros(1)}, n=1,
+                              tenant="ignored-%d" % i)
+                for i in range(5)]
+        client.release.set()
+        for f in futs:
+            f.result(timeout=30)
+        assert client.calls == ["m%d" % i for i in range(5)]
+        assert "tenants" not in router.stats()
+    finally:
+        router.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# leader lease: N routers over one KV
+# ---------------------------------------------------------------------------
+
+def _fresh_stamps(kv, n):
+    now = time.time()
+    for i in range(n):
+        kv.key_value_set("mxtpu_hb/%d" % i, str(now + 1000))
+
+
+def _wait_for(predicate, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_standby_rejects_swap_and_takes_over_on_leader_exit(tmp_path,
+                                                            any_kv):
+    """Two routers, one KV: the second stands by, answers swap with a
+    structured NotLeader naming the leader, and takes over within the
+    health-loop cadence once the leader releases the lease."""
+    kv = any_kv
+    _fresh_stamps(kv, 1)
+    a = FleetRouter([_OkClient()], kv=kv, max_queue=8,
+                    directory=str(tmp_path / "a"), respawn=False,
+                    threads=1, router_id="a", lease_ttl_s=2.0)
+    b = None
+    try:
+        assert a.stats()["role"] == "leader"
+        b = FleetRouter([_OkClient()], kv=kv, max_queue=8,
+                        directory=str(tmp_path / "b"), respawn=False,
+                        threads=1, router_id="b", lease_ttl_s=2.0)
+        assert b.stats()["role"] == "standby"
+        assert b.stats()["lease"]["holder"] == "b"
+        with pytest.raises(NotLeader) as exc:
+            b.swap("/dev/null", version="v2")
+        doc = exc.value.to_dict()
+        assert doc == {"error": "not_leader", "action": "swap",
+                       "router_id": "b", "leader": "a"}
+        # standbys still serve reads: predict works on either router
+        b.predict("m", {"x": np.zeros(1)}, n=1, timeout=10)
+        a.close(drain=False)                   # releases the lease
+        assert _wait_for(
+            lambda: b.stats()["role"] == "leader"), \
+            "standby never took over after leader exit"
+        st = b.stats()
+        assert st["takeovers"] == 1
+        res = b.swap("/dev/null", version="v2") # leader-only op now ok
+        assert res["replicas"][0]["version"] == "v2"
+    finally:
+        if b is not None:
+            b.close(drain=False)
+
+
+def test_standby_mirrors_leader_death_verdicts(tmp_path):
+    """The leader writes the shrink verdict ONCE; the standby adopts
+    it from the published view (no double generation bump) and stops
+    routing to the dead replica."""
+    kv = FileKV(tmp_path / "kv")
+    now = time.time()
+    kv.key_value_set("mxtpu_hb/0", str(now + 1000))   # fresh
+    kv.key_value_set("mxtpu_hb/1", str(now - 1000))   # long stale
+    shared_dir = str(tmp_path / "fleet")
+    a = FleetRouter([_OkClient(), _OkClient()], kv=kv, max_queue=8,
+                    hb_timeout_s=5.0, directory=shared_dir,
+                    respawn=False, threads=1, router_id="a",
+                    lease_ttl_s=2.0)
+    b = FleetRouter([_OkClient(), _OkClient()], kv=kv, max_queue=8,
+                    hb_timeout_s=5.0, directory=shared_dir,
+                    respawn=False, threads=1, router_id="b",
+                    lease_ttl_s=2.0)
+    try:
+        assert _wait_for(lambda: b.stats()["replicas"]["1"]["state"]
+                         == "dead"), "standby never mirrored verdict"
+        st_a, st_b = a.stats(), b.stats()
+        assert st_a["role"] == "leader" and st_b["role"] == "standby"
+        assert st_b["replicas"]["1"]["reason"] == "leader verdict"
+        from mxnet_tpu.resilience import elastic
+        led = elastic.read_ledger(path=fleet_ledger_path(shared_dir))
+        assert led["generation"] == 1          # one verdict, not two
+        assert st_a["generation"] == 1
+        assert st_b["generation"] == 1         # adopted, not re-bumped
+    finally:
+        b.close(drain=False)
+        a.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# KV fault discipline in the router (the ISSUE's named regression)
+# ---------------------------------------------------------------------------
+
+class _PartitionableKV(CoordKV):
+    """FileKV wrapper whose ``down`` flag simulates a KV partition."""
+
+    def __init__(self, root):
+        self.kv = FileKV(root)
+        self.down = False
+
+    def _gate(self):
+        if self.down:
+            raise KVUnreachable("injected partition", op="test")
+
+    def key_value_set(self, key, value, allow_overwrite=True):
+        self._gate()
+        self.kv.key_value_set(key, value, allow_overwrite)
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        self._gate()
+        return self.kv.blocking_key_value_get(key, timeout_ms)
+
+    def key_value_dir_get(self, prefix):
+        self._gate()
+        return self.kv.key_value_dir_get(prefix)
+
+    def key_value_delete(self, key):
+        self._gate()
+        self.kv.key_value_delete(key)
+
+
+def test_kv_partition_mid_scan_never_fabricates_deaths(tmp_path):
+    """THE regression: a KV partition mid-scan must hold the last
+    verdict — zero death verdicts, zero generation bumps, replicas keep
+    serving — and heal cleanly when the KV answers again."""
+    kv = _PartitionableKV(tmp_path / "kv")
+    _fresh_stamps(kv, 2)
+    router = FleetRouter([_OkClient(), _OkClient()], kv=kv,
+                         max_queue=8, hb_timeout_s=5.0,
+                         directory=str(tmp_path), respawn=False,
+                         threads=1, lease_ttl_s=60.0)
+    try:
+        assert _wait_for(lambda: not router.stats()["kv_held"],
+                         timeout=5.0)
+        kv.down = True                         # partition mid-scan
+        assert _wait_for(lambda: router.stats()["kv_held"]), \
+            "router never noticed the partition"
+        time.sleep(1.2)                        # several held ticks
+        st = router.stats()
+        assert st["replicas"]["0"]["state"] == "ready"
+        assert st["replicas"]["1"]["state"] == "ready"
+        assert st["generation"] == 0           # no verdict fabricated
+        assert st["role"] == "leader"          # lease held through blip
+        from mxnet_tpu.resilience import elastic
+        assert not elastic.read_ledger(
+            path=fleet_ledger_path(str(tmp_path)))
+        # the serving path never depended on the KV: requests flow
+        router.predict("m", {"x": np.zeros(1)}, n=1, timeout=10)
+        kv.down = False                        # heal
+        assert _wait_for(lambda: not router.stats()["kv_held"]), \
+            "router never released the hold after heal"
+        st = router.stats()
+        assert st["replicas"]["0"]["state"] == "ready"
+        assert st["generation"] == 0
+    finally:
+        router.close(drain=False)
+
+
+def test_scan_dead_ranks_raises_structured_on_unreachable(tmp_path):
+    """scan_dead_ranks NEVER answers 'all dead' for a dead KV — it
+    raises KVUnreachable (both for structured and for generic backend
+    failures)."""
+    kv = _PartitionableKV(tmp_path / "kv")
+    kv.down = True
+    with pytest.raises(KVUnreachable):
+        scan_dead_ranks(kv, [0, 1, 2], created=0.0, timeout=5.0)
+
+    class _BrokenKV(object):
+        def key_value_dir_get(self, prefix):
+            raise OSError("stale NFS handle")
+
+    with pytest.raises(KVUnreachable) as exc:
+        scan_dead_ranks(_BrokenKV(), [0, 1], created=0.0, timeout=5.0)
+    assert exc.value.kind == "kv_unreachable"
+
+
+# ---------------------------------------------------------------------------
+# swap on checkpoint commit
+# ---------------------------------------------------------------------------
+
+def test_leader_applies_published_swap_pointer_once(tmp_path):
+    """The leader watches mxtpu_fleet/params_ptr and runs ONE drainless
+    swap per published version — re-reading the same pointer never
+    re-swaps."""
+    kv = FileKV(tmp_path / "kv")
+    _fresh_stamps(kv, 1)
+    router = FleetRouter([_OkClient()], kv=kv, max_queue=8,
+                         directory=str(tmp_path), respawn=False,
+                         threads=1, lease_ttl_s=60.0)
+    try:
+        kv.key_value_set(_SWAP_PTR_KEY, json.dumps(
+            {"params": "/dev/null", "version": "v9"}))
+        assert _wait_for(
+            lambda: router.stats()["replicas"]["0"]["param_version"]
+            == "v9"), "leader never applied the published pointer"
+        assert router.stats()["swaps"] == 1
+        time.sleep(1.2)                        # more health ticks
+        assert router.stats()["swaps"] == 1    # single-flight per version
+        kv.key_value_set(_SWAP_PTR_KEY, json.dumps(
+            {"params": "/dev/null", "version": "v10"}))
+        assert _wait_for(
+            lambda: router.stats()["replicas"]["0"]["param_version"]
+            == "v10"), "new pointer version never applied"
+        assert router.stats()["swaps"] == 2
+    finally:
+        router.close(drain=False)
+
+
+def test_ckptmgr_commit_publishes_swap_pointer(tmp_path, monkeypatch):
+    """MXTPU_FLEET_SWAP_ON_COMMIT=1: a committed checkpoint publishes
+    the versioned-params pointer into the fleet KV; default off writes
+    nothing."""
+    from mxnet_tpu.resilience.ckptmgr import CheckpointManager
+    from mxnet_tpu.resilience.netkv import KeyAbsent
+    fleet_dir = tmp_path / "mxtpu_fleet"
+    monkeypatch.setenv("MXTPU_FLEET_DIR", str(fleet_dir))
+    monkeypatch.delenv("MXTPU_KV_URL", raising=False)
+    tree = {"w": np.arange(8, dtype=np.float32)}
+    kv = FileKV(fleet_dir / "kv")
+
+    monkeypatch.delenv("MXTPU_FLEET_SWAP_ON_COMMIT", raising=False)
+    mgr = CheckpointManager(str(tmp_path / "run"), keep=0,
+                            payload_format="host")
+    mgr.save(tree, 1)
+    with pytest.raises(KeyAbsent):             # off by default
+        kv.blocking_key_value_get(_SWAP_PTR_KEY, 60)
+
+    monkeypatch.setenv("MXTPU_FLEET_SWAP_ON_COMMIT", "1")
+    final = mgr.save(tree, 3)
+    doc = json.loads(kv.blocking_key_value_get(_SWAP_PTR_KEY, 1000))
+    assert doc["params"] == final
+    assert doc["step"] == 3
+    assert doc["version"] == "step_%08d" % 3
+
+
+# ---------------------------------------------------------------------------
+# front-door failover config
+# ---------------------------------------------------------------------------
+
+def test_fleet_routers_env_parsing(monkeypatch):
+    monkeypatch.delenv("MXTPU_FLEET_ROUTERS", raising=False)
+    monkeypatch.delenv("MXTPU_FLEET_PORT", raising=False)
+    assert fleet_routers() == ["http://127.0.0.1:8930"]
+    monkeypatch.setenv("MXTPU_FLEET_ROUTERS",
+                       "http://r1:8930, http://r2:8931")
+    assert fleet_routers() == ["http://r1:8930", "http://r2:8931"]
+    assert fleet_routers(["http://x:1"]) == ["http://x:1"]

@@ -26,13 +26,27 @@ drain (zero new lowerings, through the program registry).
     # fleet stats: per-replica state + version skew + router counters
     python tools/mxfleet.py stats
 
+Networked fleet (docs/serving.md "Networked fleet"): point the fleet
+at a TCP coordination KV and run N router processes — the expiring
+lease elects one leader (verdicts, respawn, swap); standbys serve
+reads and take over within one lease TTL:
+
+    python tools/mxkv.py serve --port 8940 &
+    python tools/mxfleet.py serve --spec fleet.json \
+        --kv tcp://127.0.0.1:8940 --router-id r1 --port 8930
+    python tools/mxfleet.py serve --adopt --kv tcp://127.0.0.1:8940 \
+        --router-id r2 --port 8950          # standby front door
+
 Front-door endpoints (router):
-    POST /v1/predict   JSON {"model", "inputs"} -> {"outputs": ...}
-                       (429 = fleet queue full, AGGREGATE depth;
-                        503 = draining; both ServerBusy dicts)
+    POST /v1/predict   JSON {"model", "inputs", "tenant"?} ->
+                       {"outputs": ...} (429 = fleet queue full,
+                        AGGREGATE depth, or the named tenant's token
+                        budget; 503 = draining; ServerBusy dicts)
     POST /v1/swap      {"params": path, "version": v} -> per-replica
                        results incl. each replica's lowerings delta
-    GET  /v1/stats     router stats + per-replica /v1/stats rollup
+                       (409 not_leader + leader hint on a standby)
+    GET  /v1/stats     router stats (role/lease/tenants) + per-replica
+                       /v1/stats rollup
     POST /v1/drain     stop admission fleet-wide, flush, drain replicas
     GET  /healthz      200 once all replicas answered startup checks
 
@@ -129,20 +143,42 @@ def make_front_handler(router):
 
         def _predict(self):
             import numpy as np
-            from mxnet_tpu.serving.fleet import ReplicaDead
+            from mxnet_tpu.serving.fleet import (ReplicaDead,
+                                                 decode_arrays,
+                                                 encode_arrays)
+            # two dialects on one door: JSON {"model", "inputs"}
+            # (mxserve-compatible, human-curlable) and npz bodies with
+            # X-MXTPU-* headers (FleetClient — arrays never transit
+            # JSON); the reply mirrors the request's dialect
+            npz = "npz" in (self.headers.get("Content-Type") or "")
             try:
                 length = int(self.headers.get("Content-Length") or 0)
-                doc = json.loads(self.rfile.read(length) or b"{}")
-                model = doc.get("model")
-                inputs = doc["inputs"]
-                if isinstance(inputs, dict):
-                    inputs = {k: np.asarray(v, dtype="float32")
-                              for k, v in inputs.items()}
+                raw = self.rfile.read(length)
+                if npz:
+                    inputs = decode_arrays(raw)
+                    model = self.headers.get("X-MXTPU-Model")
+                    n_raw = self.headers.get("X-MXTPU-N")
+                    n = int(n_raw) if n_raw else None
+                    trace_id = self.headers.get("X-MXTPU-Trace") or None
+                    timeout = 30.0
                 else:
-                    inputs = np.asarray(inputs, dtype="float32")
-                outs = router.predict(
-                    model, inputs,
-                    timeout=float(doc.get("timeout") or 30))
+                    doc = json.loads(raw or b"{}")
+                    model = doc.get("model")
+                    inputs = doc["inputs"]
+                    if isinstance(inputs, dict):
+                        inputs = {k: np.asarray(v, dtype="float32")
+                                  for k, v in inputs.items()}
+                    else:
+                        inputs = np.asarray(inputs, dtype="float32")
+                    n = None
+                    trace_id = self.headers.get("X-MXTPU-Trace") or None
+                    timeout = float(doc.get("timeout") or 30)
+                tenant = (None if npz else doc.get("tenant")) \
+                    or self.headers.get("X-MXTPU-Tenant") or None
+                outs = router.submit(model, inputs, n=n,
+                                     trace_id=trace_id,
+                                     tenant=tenant).result(
+                    timeout=timeout)
             except ServerBusy as busy:
                 hdrs = []
                 if busy.retry_after_ms:
@@ -161,16 +197,31 @@ def make_front_handler(router):
                 self._reply(500, {"error": "internal",
                                   "reason": str(exc)})
                 return
+            if npz:
+                body = encode_arrays(
+                    {"out%03d" % i: o for i, o in enumerate(outs)})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-npz")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             self._reply(200, {"model": model,
                               "n": int(outs[0].shape[0]),
                               "outputs": [o.tolist() for o in outs]})
 
         def _swap(self):
+            from mxnet_tpu.serving.fleet import NotLeader
             try:
                 length = int(self.headers.get("Content-Length") or 0)
                 doc = json.loads(self.rfile.read(length) or b"{}")
                 res = router.swap(doc["params"],
                                   version=doc.get("version"))
+            except NotLeader as nl:
+                # standby front door: 409 + leader hint so the client
+                # re-aims instead of mutating through the wrong router
+                self._reply(409, nl.to_dict())
+                return
             except (KeyError, ValueError, TypeError) as exc:
                 self._reply(400, {"error": "bad_request",
                                   "reason": str(exc)})
@@ -185,12 +236,29 @@ def make_front_handler(router):
 
 
 def cmd_serve(args):
-    from mxnet_tpu.serving.fleet import launch_fleet
-    router = launch_fleet(args.spec, n_replicas=args.replicas,
-                          directory=args.dir, base_port=args.base_port,
-                          max_queue=args.max_queue,
-                          respawn=None if args.respawn is None
-                          else bool(args.respawn))
+    from mxnet_tpu.serving.fleet import adopt_fleet, launch_fleet
+    if args.adopt:
+        router = adopt_fleet(
+            n_replicas=args.replicas, directory=args.dir,
+            base_port=args.base_port, max_queue=args.max_queue,
+            kv_url=args.kv, router_id=args.router_id,
+            lease_ttl_s=args.lease_ttl, tenants=args.tenants,
+            spec_path=args.spec,
+            respawn=None if args.respawn is None
+            else bool(args.respawn))
+    elif args.spec is None:
+        sys.stderr.write("mxfleet: serve needs --spec "
+                         "(or --adopt over a running fleet)\n")
+        return 2
+    else:
+        router = launch_fleet(
+            args.spec, n_replicas=args.replicas,
+            directory=args.dir, base_port=args.base_port,
+            max_queue=args.max_queue,
+            respawn=None if args.respawn is None
+            else bool(args.respawn),
+            kv_url=args.kv, router_id=args.router_id,
+            lease_ttl_s=args.lease_ttl, tenants=args.tenants)
     from http.server import ThreadingHTTPServer
     port = args.port or int(os.environ.get("MXTPU_FLEET_PORT", "8930"))
     httpd = ThreadingHTTPServer((args.host, port),
@@ -201,10 +269,12 @@ def cmd_serve(args):
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)
 
-    n = len(router.stats()["replicas"])
+    stats = router.stats()
     sys.stderr.write(
-        "mxfleet: %d replica(s) up, front door http://%s:%d "
-        "(generation %d)\n" % (n, args.host, port, router.generation))
+        "mxfleet: %d replica(s), front door http://%s:%d "
+        "(router %s, %s, generation %d)\n"
+        % (len(stats["replicas"]), args.host, port,
+           stats["router_id"], stats["role"], router.generation))
     try:
         httpd.serve_forever()
     finally:
@@ -249,6 +319,21 @@ def cmd_stats(args):
           % (doc.get("generation"), doc.get("queue_depth"),
              doc.get("max_queue"), doc.get("requests"),
              doc.get("rejected"), doc.get("failed")))
+    if doc.get("router_id"):
+        lease = doc.get("lease") or {}
+        print("  router %s: %s  takeovers=%s%s"
+              % (doc["router_id"], doc.get("role"),
+                 doc.get("takeovers", 0),
+                 "  [KV HELD]" if doc.get("kv_held") else ""))
+        if lease:
+            print("  lease: holder=%s ttl=%ss"
+                  % (lease.get("holder"), lease.get("ttl_s")))
+    for name, ten in sorted((doc.get("tenants") or {}).items()):
+        print("  tenant %-12s queued=%-4s admitted=%-6s "
+              "rejected=%-5s tokens=%s w=%s"
+              % (name, ten.get("queued"), ten.get("admitted"),
+                 ten.get("rejected"), ten.get("tokens"),
+                 ten.get("weight")))
     for idx, rep in sorted(doc.get("replicas", {}).items()):
         print("  replica %s: %-9s inflight=%-3s requests=%-6s "
               "version=%s" % (idx, rep.get("state"),
@@ -277,8 +362,26 @@ def main(argv=None):
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     sp = sub.add_parser("serve", help="launch replicas + router")
-    sp.add_argument("--spec", required=True,
-                    help="fleet spec JSON (models/shapes/buckets)")
+    sp.add_argument("--spec", default=None,
+                    help="fleet spec JSON (models/shapes/buckets); "
+                         "required unless --adopt (where it only arms "
+                         "respawn)")
+    sp.add_argument("--adopt", action="store_true",
+                    help="router-only: adopt an already-running fleet "
+                         "(standby front door; the lease elects the "
+                         "leader)")
+    sp.add_argument("--kv", default=None,
+                    help="coordination backend URL (MXTPU_KV_URL): "
+                         "file:///path or tcp://host:port")
+    sp.add_argument("--router-id", default=None,
+                    help="lease identity (MXTPU_FLEET_ROUTER_ID, "
+                         "default r<pid>)")
+    sp.add_argument("--lease-ttl", type=float, default=None,
+                    help="leader-lease TTL seconds "
+                         "(MXTPU_FLEET_LEASE_TTL_S, default 3)")
+    sp.add_argument("--tenants", default=None,
+                    help="per-tenant budgets name:rate:burst[:weight]"
+                         ";... (MXTPU_FLEET_TENANTS)")
     sp.add_argument("-n", "--replicas", type=int, default=None,
                     help="replica count (MXTPU_FLEET_REPLICAS)")
     sp.add_argument("--dir", default=None,
