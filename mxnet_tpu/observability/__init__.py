@@ -16,7 +16,7 @@ telemetry is off, so the default path pays one cached boolean check.
 from __future__ import annotations
 
 from . import (events, spans, counters, aggregate, phases, trace,
-               flight, slo, locktrace, retrace)
+               flight, slo, locktrace, retrace, metrics, sloengine)
 from .events import (enabled, emit, flush, refresh, run_id, last_fault,
                      EventLog)
 from .phases import PHASES, TRAIN_PHASES, SERVE_PHASES
@@ -29,7 +29,7 @@ from .aggregate import (publish_summary, collect_summaries,
 
 __all__ = [
     "events", "spans", "counters", "aggregate", "phases", "trace",
-    "flight", "slo", "locktrace", "retrace",
+    "flight", "slo", "locktrace", "retrace", "metrics", "sloengine",
     "enabled", "emit", "flush", "refresh", "run_id", "last_fault",
     "EventLog",
     "PHASES", "TRAIN_PHASES", "SERVE_PHASES",

@@ -185,22 +185,39 @@ def _median(vals):
     return 0.5 * (vals[mid - 1] + vals[mid])
 
 
+def _pod_merged_sketch(per_rank):
+    """Exact pod step-time distribution: the merge of every rank's
+    published ``step_sketch`` — bit-identical to one sketch fed all
+    ranks' streams.  None when no rank published one (old-format
+    summaries), which keeps the legacy median/max math as fallback."""
+    from .metrics import QuantileSketch
+    sketches = [QuantileSketch.from_dict(s.get("step_sketch"))
+                for s in per_rank.values() if s.get("step_sketch")]
+    sketches = [sk for sk in sketches if sk is not None and sk.count]
+    return QuantileSketch.merged(sketches) if sketches else None
+
+
 def _pod_rollup(per_rank):
     """Pod-level figures from per-rank summary dicts (shared by the
-    live and post-hoc paths)."""
+    live and post-hoc paths).  When ranks publish step sketches the
+    pod p50/p95 are EXACT (merged distribution); otherwise the legacy
+    approximation (median of rank p50s / max of rank p95s) applies."""
     means = [s["step_ms_mean"] for s in per_rank.values()
              if s.get("step_ms_mean") is not None]
+    merged = _pod_merged_sketch(per_rank)
     pod = {
         "ranks": len(per_rank),
         "steps": max([s.get("last_step") or 0
                       for s in per_rank.values()] or [0]),
-        "step_ms_p50": _median([s.get("step_ms_p50") for s in
-                                per_rank.values()
-                                if s.get("step_ms_p50") is not None]),
-        "step_ms_p95": max([s.get("step_ms_p95") for s in
-                            per_rank.values()
-                            if s.get("step_ms_p95") is not None] or
-                           [None], key=lambda v: v or 0),
+        "step_ms_p50": round(merged.percentile(50), 3) if merged
+        else _median([s.get("step_ms_p50") for s in
+                      per_rank.values()
+                      if s.get("step_ms_p50") is not None]),
+        "step_ms_p95": round(merged.percentile(95), 3) if merged
+        else max([s.get("step_ms_p95") for s in
+                  per_rank.values()
+                  if s.get("step_ms_p95") is not None] or
+                 [None], key=lambda v: v or 0),
         "samples_per_sec": round(sum(
             s.get("samples_per_sec") or 0 for s in per_rank.values()), 2)
         or None,
@@ -380,17 +397,36 @@ def build_report(records, now=None):
         elif kind == "counter" and rec.get("name") == "trainer_cost":
             if rec.get("mfu") is not None:
                 state.setdefault("_mfus", []).append(float(rec["mfu"]))
+            if rec.get("step_sketch"):
+                # the emitter's own cumulative sketch: newest wins (a
+                # sketch is monotone, so the last one is the union)
+                state["_pub_sketch"] = rec["step_sketch"]
 
+    from .metrics import QuantileSketch
     summaries = {}
     for rank, state in per_rank.items():
         durs = state.pop("_durs")
         sps = state.pop("_sps")
         mfus = state.pop("_mfus", [])
+        pub = state.pop("_pub_sketch", None)
         s = dict(state)
+        # per-rank step-time distribution: the rank's own published
+        # sketch when it emitted one, else the step records folded
+        # into a fresh sketch — either way percentiles come from the
+        # sketch, and the dict rides along so _pod_rollup merges
+        # rank distributions exactly
+        sketch = QuantileSketch.from_dict(pub) if pub else None
+        if sketch is None and durs:
+            sketch = QuantileSketch(
+                alpha=counters.StepStats.SKETCH_ALPHA)
+            sketch.extend(durs)
         if durs:
             s["step_ms_mean"] = round(sum(durs) / len(durs), 3)
-            s["step_ms_p50"] = round(counters.percentile(durs, 50), 3)
-            s["step_ms_p95"] = round(counters.percentile(durs, 95), 3)
+        if sketch is not None and sketch.count:
+            s["step_ms_p50"] = round(sketch.percentile(50), 3)
+            s["step_ms_p95"] = round(sketch.percentile(95), 3)
+            s.setdefault("step_ms_mean", round(sketch.mean(), 3))
+            s["step_sketch"] = sketch.to_dict()
         if sps:
             s["samples_per_sec"] = round(sps[-1], 2)
         elif durs and s.get("step_ms_mean"):
@@ -483,6 +519,27 @@ def build_report(records, now=None):
             "divergent": dict(sorted(divergent.items())),
             "sites": sorted({r.get("site") for r in retraces
                              if r.get("site")})[:8],
+        }
+    # SLO rollup (observability/sloengine.py): alert edges and scale
+    # recommendations, when the live engine emitted any — what the
+    # mxtop SLO pane renders post-hoc
+    alerts = [r for r in records if r.get("kind") == "slo_alert"]
+    recos = [r for r in records if r.get("kind") == "counter"
+             and r.get("name") == "slo_recommendation"]
+    if alerts or recos:
+        fires = [r for r in alerts if r.get("edge") == "fire"]
+        active = {}
+        for r in alerts:        # wall-clock order: last edge wins
+            key = "%s/%s" % (r.get("metric"), r.get("tier"))
+            active[key] = r.get("edge") == "fire"
+        out["slo"] = {
+            "alerts": len(fires),
+            "page_alerts": len([r for r in fires
+                                if r.get("tier") == "page"]),
+            "active": sorted(k for k, v in active.items() if v),
+            "last_alert": alerts[-1] if alerts else None,
+            "recommendations": len(recos),
+            "last_recommendation": recos[-1] if recos else None,
         }
     return out
 

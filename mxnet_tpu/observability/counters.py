@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 
 from . import events
+from .metrics import QuantileSketch, registry as _metrics_registry
 
 __all__ = ["percentile", "rel_spread", "StepStats", "global_stats",
            "reset", "peak_tflops", "mfu", "collective_bytes",
@@ -52,25 +53,37 @@ def rel_spread(values):
 
 
 class StepStats(object):
-    """Step-time EMA + bounded-window percentiles + throughput.
+    """Step-time EMA + sketch-backed percentiles + throughput.
 
-    ``observe`` is the hot call: one deque append + one multiply-add.
-    ``snapshot`` derives the report fields (percentiles sort the
-    window — call it at logging cadence, not per step).
+    ``observe`` is the hot call: one sketch bucket increment + one
+    multiply-add (previously a deque append whose ``snapshot`` sorted a
+    512-sample window — O(n·log n) per snapshot and unmergeable across
+    ranks).  The :class:`~.metrics.QuantileSketch` backing p50/p95 is
+    bounded-memory, bit-exactly mergeable, and rides along in the
+    snapshot (``step_sketch``) so pod aggregation merges rank
+    distributions instead of averaging per-rank percentiles.  A tighter
+    ``alpha`` than the registry default keeps the snapshot numbers
+    within 0.5% of the raw-sample truth.  The process singleton also
+    mirrors timings into the live metrics registry (``mxtpu_step_ms``)
+    so training shares the /metrics + SLO path with serving.
     """
 
-    def __init__(self, batch_size=None, window=512, ema_decay=0.9):
-        from collections import deque
+    SKETCH_ALPHA = 0.005
+
+    def __init__(self, batch_size=None, window=512, ema_decay=0.9,
+                 feed_registry=False):
         self.batch_size = batch_size
-        self.window = deque(maxlen=int(window))
+        del window                   # kept in the signature for compat
+        self.sketch = QuantileSketch(alpha=self.SKETCH_ALPHA)
         self.ema_decay = float(ema_decay)
         self.ema_s = None
         self.steps = 0
         self.last_step = None
+        self._feed_registry = bool(feed_registry)
 
     def observe(self, dur_s, step=None, batch_size=None):
         dur_s = float(dur_s)
-        self.window.append(dur_s)
+        self.sketch.add(dur_s * 1e3)
         self.ema_s = dur_s if self.ema_s is None else (
             self.ema_decay * self.ema_s + (1.0 - self.ema_decay) * dur_s)
         self.steps += 1
@@ -78,21 +91,32 @@ class StepStats(object):
             self.last_step = step
         if batch_size is not None:
             self.batch_size = batch_size
+        if self._feed_registry:
+            try:
+                _metrics_registry().histogram(
+                    "mxtpu_step_ms",
+                    help="training step wall time (ms)",
+                ).observe(dur_s * 1e3)
+            except Exception:
+                pass
 
     def snapshot(self):
         """Dict of derived figures (the compact per-rank summary the
-        aggregator publishes)."""
+        aggregator publishes).  Same public fields as ever; p50/p95 now
+        come from the sketch, and ``step_sketch`` carries the full
+        serialized distribution for exact cross-rank merging."""
         out = {"steps": self.steps, "last_step": self.last_step}
         if self.ema_s is not None:
             out["step_ms_ema"] = round(self.ema_s * 1e3, 3)
-        if self.window:
-            vals = list(self.window)
-            out["step_ms_p50"] = round(percentile(vals, 50) * 1e3, 3)
-            out["step_ms_p95"] = round(percentile(vals, 95) * 1e3, 3)
-            mean = sum(vals) / len(vals)
-            out["step_ms_mean"] = round(mean * 1e3, 3)
+        if self.sketch.count:
+            out["step_ms_p50"] = round(self.sketch.percentile(50), 3)
+            out["step_ms_p95"] = round(self.sketch.percentile(95), 3)
+            mean = self.sketch.mean()
+            out["step_ms_mean"] = round(mean, 3)
             if self.batch_size and mean > 0:
-                out["samples_per_sec"] = round(self.batch_size / mean, 2)
+                out["samples_per_sec"] = round(
+                    self.batch_size / (mean / 1e3), 2)
+            out["step_sketch"] = self.sketch.to_dict()
         return out
 
 
@@ -100,9 +124,10 @@ _GLOBAL = {"stats": None}
 
 
 def global_stats():
-    """The process-wide StepStats the built-in wiring feeds."""
+    """The process-wide StepStats the built-in wiring feeds (the
+    singleton also mirrors into the live metrics registry)."""
     if _GLOBAL["stats"] is None:
-        _GLOBAL["stats"] = StepStats()
+        _GLOBAL["stats"] = StepStats(feed_registry=True)
     return _GLOBAL["stats"]
 
 
@@ -192,6 +217,11 @@ def emit_trainer_counters(trainer, step_time_s=None):
         if util is not None:
             fields["mfu"] = round(util, 4)
         fields["step_time_s"] = round(float(step_time_s), 6)
+    stats = _GLOBAL["stats"]
+    if stats is not None and stats.sketch.count:
+        # the full step-time distribution rides along so pod rollups
+        # merge rank sketches exactly instead of averaging percentiles
+        fields["step_sketch"] = stats.sketch.to_dict()
     if fields:
         events.emit("counter", step=getattr(trainer, "num_update", None),
                     name="trainer_cost", **fields)

@@ -42,9 +42,12 @@ __all__ = ["enabled", "telemetry_dir", "run_id", "rank", "get",
 #: padding waste, per-request latencies); "retrace" records are the
 #: retrace sentry's attributed post-warmup lowerings (docs/perf.md,
 #: observability/retrace.py — the divergent cache-key ingredient, the
-#: requesting site, component diffs)
+#: requesting site, component diffs); "slo_alert" records are the live
+#: SLO engine's burn-rate alert edges (observability/sloengine.py —
+#: tier, fire/clear, per-window burns; flight-ring automatic like
+#: every emit)
 KINDS = ("step", "span", "counter", "fault", "ckpt", "collective",
-         "summary", "elastic", "serve", "retrace")
+         "summary", "elastic", "serve", "retrace", "slo_alert")
 
 _FLUSH_INTERVAL_S = 1.0
 _HIGH_WATER = 256            # buffered records that trigger an early flush

@@ -62,6 +62,11 @@ DIRECTIONS = {
     "overlap_ratio": "down",
     "mfu": "down",
     "allreduce_gbps": "down",
+    # open-loop traffic realism (serve_bench --arrival / --tenant-mix):
+    # achieved completion rate under the shaped offered load, and the
+    # offered-minus-achieved deficit fraction (0 = the server kept up)
+    "serve_achieved_rps": "down",
+    "serve_rate_deficit": "up",
     # fleet serving (serve_bench --fleet / docs/serving.md "Fleet")
     "fleet_rps": "down",
     "fleet_balance_ratio": "up",
@@ -116,6 +121,15 @@ def _bench_metrics(parsed):
             p95 = (parsed.get(src) or {}).get("p95")
             if p95 is not None:
                 out[dst] = float(p95)
+    if parsed.get("achieved_rate") is not None:
+        # serve_bench open-loop BENCH line (--arrival): achieved vs
+        # offered rate — the traffic-realism pair benchdiff prices
+        out["serve_achieved_rps"] = float(parsed["achieved_rate"])
+        offered = parsed.get("offered_rate")
+        if offered:
+            out["serve_rate_deficit"] = round(max(
+                0.0, (float(offered) - float(parsed["achieved_rate"]))
+                / float(offered)), 4)
     if parsed.get("value") is not None \
             and parsed.get("metric") == "fleet_throughput_rps":
         # serve_bench --fleet BENCH line: fleet throughput plus the

@@ -424,6 +424,11 @@ class ContinuousBatcher(object):
         the unpack worker.  Runs on ONE thread, so batches reach the
         device in pack order."""
         try:
+            # latency seam for the burn-rate drill: kind=slow:
+            # seam=serve_dispatch sleeps here, inflating every request
+            # in the batch exactly as a slow device would
+            from ..resilience.faultinject import maybe_fault
+            maybe_fault("serve_dispatch")
             handle = batch.entry.launch(payload, batch.bucket)
         except BaseException as exc:
             if batch.phase is not None:

@@ -20,6 +20,8 @@ import os as _os
 
 from ..observability import events
 from ..observability.counters import percentile
+from ..observability.metrics import QuantileSketch, \
+    registry as _metrics_registry
 from ..observability.phases import SERVE_PHASES
 
 __all__ = ["emit_batch", "serve_report", "fleet_report",
@@ -106,6 +108,53 @@ def emit_batch(model, bucket, n_requests, n_samples, occupancy,
         queue_wait_ms=_r(queue_wait_ms), pack_ms=_r(pack_ms),
         device_ms=_r(device_ms), unpack_ms=_r(unpack_ms),
         lat_ms=[_r(v) for v in lat_ms or ()], **extra)
+    _feed_registry(model, n_requests, queue_depth, occupancy, lat_ms,
+                   phase=phase, tokens=tokens,
+                   kv_occupancy=kv_occupancy, ttft_ms=ttft_ms,
+                   itl_ms=itl_ms)
+
+
+def _feed_registry(model, n_requests, queue_depth, occupancy, lat_ms,
+                   phase=None, tokens=None, kv_occupancy=None,
+                   ttft_ms=None, itl_ms=None):
+    """Mirror one batch into the live metrics registry — always on
+    (unlike the event log): the /metrics door and the SLO engine read
+    these regardless of MXTPU_TELEMETRY.  Per batch, not per request,
+    so the cost is a handful of sketch increments."""
+    try:
+        reg = _metrics_registry()
+        reg.counter("mxtpu_serve_requests_total",
+                    help="requests completed").inc(int(n_requests))
+        reg.counter("mxtpu_serve_batches_total",
+                    help="batches dispatched").inc()
+        reg.gauge("mxtpu_serve_queue_depth",
+                  help="scheduler queue depth").set(int(queue_depth))
+        reg.gauge("mxtpu_serve_occupancy",
+                  help="last batch bucket occupancy").set(
+                      float(occupancy))
+        if lat_ms:
+            hist = reg.histogram("mxtpu_serve_latency_ms",
+                                 help="request end-to-end latency (ms)")
+            for v in lat_ms:
+                hist.observe(float(v))
+        if phase is not None:
+            if tokens:
+                reg.counter("mxtpu_serve_tokens_total",
+                            help="tokens generated").inc(int(tokens))
+            if kv_occupancy is not None:
+                hw = reg.gauge("mxtpu_serve_kv_occupancy_hw",
+                               help="KV-block occupancy high water")
+                hw.set(max(hw.value, float(kv_occupancy)))
+            for vals, name in ((ttft_ms, "mxtpu_serve_ttft_ms"),
+                               (itl_ms, "mxtpu_serve_itl_ms")):
+                if vals:
+                    hist = reg.histogram(
+                        name, help="per-sequence %s (ms)"
+                        % name.rsplit("_", 2)[-2])
+                    for v in vals:
+                        hist.observe(float(v))
+    except Exception:
+        pass                     # metrics must never fail a batch
 
 
 def _r(v, nd=3):
@@ -135,7 +184,8 @@ def serve_report(records):
             continue
         model = rec.get("model") or "?"
         m = per.setdefault(model, dict(
-            {"requests": 0, "samples": 0, "batches": 0, "_lat": [],
+            {"requests": 0, "samples": 0, "batches": 0,
+             "_lat": QuantileSketch(),
              "_occ": [], "_waste": [], "queue_depth_max": 0,
              "buckets": {}, "tokens": 0, "_kv": [], "_ttft": [],
              "_itl": [], "phases": {}},
@@ -177,7 +227,7 @@ def serve_report(records):
         spans[model] = (min(lo, wall), max(hi, wall))
 
     models = {}
-    all_lat = []
+    all_lat = []                 # per-model sketches; total = merge
     all_ttft, all_itl, total_tokens = [], [], 0
     total = {"requests": 0, "samples": 0, "batches": 0}
     for model, m in sorted(per.items()):
@@ -208,11 +258,11 @@ def serve_report(records):
         for key, field in (("_occ", "occupancy"),
                            ("_waste", "padding_waste")) + _PHASE_FIELDS:
             out[field] = _mean(m.pop(key))
-        if lat:
-            out["latency_ms"] = {"p50": _r(percentile(lat, 50)),
-                                 "p95": _r(percentile(lat, 95)),
-                                 "p99": _r(percentile(lat, 99)),
-                                 "mean": _mean(lat)}
+        if lat.count:
+            out["latency_ms"] = {"p50": _r(lat.percentile(50)),
+                                 "p95": _r(lat.percentile(95)),
+                                 "p99": _r(lat.percentile(99)),
+                                 "mean": _r(lat.mean())}
         span = spans.get(model)
         if span and span[1] > span[0]:
             out["qps"] = round(m["requests"] / ((span[1] - span[0]) / 1e3),
@@ -225,15 +275,18 @@ def serve_report(records):
             if m["phases"]:
                 out["tokens_per_sec"] = None
         models[model] = out
-        all_lat.extend(lat)
+        all_lat.append(lat)
         for k in ("requests", "samples", "batches"):
             total[k] += m[k]
 
-    if all_lat:
-        total["latency_ms"] = {"p50": _r(percentile(all_lat, 50)),
-                               "p95": _r(percentile(all_lat, 95)),
-                               "p99": _r(percentile(all_lat, 99)),
-                               "mean": _mean(all_lat)}
+    merged_lat = QuantileSketch.merged(all_lat)
+    if merged_lat.count:
+        # exact: the merge of per-model sketches answers the same
+        # quantiles as one sketch fed every model's stream
+        total["latency_ms"] = {"p50": _r(merged_lat.percentile(50)),
+                               "p95": _r(merged_lat.percentile(95)),
+                               "p99": _r(merged_lat.percentile(99)),
+                               "mean": _r(merged_lat.mean())}
     lo = min(s[0] for s in spans.values()) if spans else None
     hi = max(s[1] for s in spans.values()) if spans else None
     if lo is not None and hi > lo:
@@ -265,7 +318,10 @@ def fleet_report(records):
     single-process runs → ``{"replicas": {}}``).  Each replica entry
     carries ``requests``, ``batches``, ``qps`` (over that replica's
     own wall span), ``latency_ms`` {p50, p95}, ``occupancy``, and
-    ``param_version`` (last seen).  Fleet-wide: ``straggler_gap_ms``
+    ``param_version`` (last seen).  Fleet-wide: ``latency_ms`` — the
+    **exact sketch-merge** of the per-replica latency distributions
+    (bit-identical to one sketch fed the concatenated streams; never
+    an average of per-replica percentiles), ``straggler_gap_ms``
     (max p95 − median p95 across replicas — the serving analog of the
     training straggler gap), ``balance_ratio`` (max requests / mean
     requests; 1.0 = perfectly level), and ``version_skew``
@@ -277,7 +333,8 @@ def fleet_report(records):
         if rec.get("kind") != "serve" or rec.get("replica") is None:
             continue
         r = int(rec["replica"])
-        m = per.setdefault(r, {"requests": 0, "batches": 0, "_lat": [],
+        m = per.setdefault(r, {"requests": 0, "batches": 0,
+                               "_lat": QuantileSketch(),
                                "_occ": [], "_walls": [],
                                "param_version": None})
         m["requests"] += int(rec.get("n_requests") or 0)
@@ -292,6 +349,7 @@ def fleet_report(records):
     if not per:
         return {"replicas": {}}
     replicas, p95s, reqs = {}, [], []
+    sketches = []
     skew = {}
     for r, m in sorted(per.items()):
         lat = m.pop("_lat")
@@ -300,10 +358,11 @@ def fleet_report(records):
         out = {"requests": m["requests"], "batches": m["batches"],
                "param_version": m["param_version"],
                "occupancy": _mean(occ)}
-        if lat:
-            out["latency_ms"] = {"p50": _r(percentile(lat, 50)),
-                                 "p95": _r(percentile(lat, 95))}
-            p95s.append(percentile(lat, 95))
+        if lat.count:
+            out["latency_ms"] = {"p50": _r(lat.percentile(50)),
+                                 "p95": _r(lat.percentile(95))}
+            p95s.append(lat.percentile(95))
+            sketches.append(lat)
         span = (max(walls) - min(walls)) / 1e3 if len(walls) > 1 else 0.0
         out["qps"] = round(m["requests"] / span, 2) if span > 0 else None
         replicas[str(r)] = out
@@ -312,6 +371,12 @@ def fleet_report(records):
     fleet = {"replicas": replicas,
              "version_skew": {v: sorted(rs)
                               for v, rs in sorted(skew.items())}}
+    merged = QuantileSketch.merged(sketches)
+    if merged.count:
+        fleet["latency_ms"] = {"p50": _r(merged.percentile(50)),
+                               "p95": _r(merged.percentile(95)),
+                               "p99": _r(merged.percentile(99)),
+                               "mean": _r(merged.mean())}
     if p95s:
         fleet["straggler_gap_ms"] = _r(
             max(p95s) - percentile(p95s, 50))

@@ -303,8 +303,10 @@ print("kernel-tier MXL-K sweep OK "
   observability)
     # telemetry suite (docs/observability.md): event-log semantics, the
     # <2% enabled-overhead bound, and the 2-process acceptance drill
-    # (sentinel -> watchdog -> ckpt must land in the merged report)
-    JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py -q
+    # (sentinel -> watchdog -> ckpt must land in the merged report);
+    # plus the quantile-sketch/registry and SLO-engine unit suites
+    JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py \
+      tests/test_metrics.py tests/test_sloengine.py -q
     # end-to-end CLI smoke: a real 2-worker run's event dir must render
     # through mxtop --json with a nonempty pod rollup
     TELDIR="$(mktemp -d)"
@@ -372,6 +374,13 @@ print(json.dumps({"step_time_ms": doc["parsed"]["step_time_ms"] * 1.2}))
       exit 1
     fi
     echo "benchdiff gate OK (clean run passes, +20% regression flags)"
+    # live SLO drill (docs/observability.md "Live metrics & SLO
+    # engine"): /metrics exposition smoke (Prometheus-parseable,
+    # counters monotone across two scrapes), then the burn-rate drill —
+    # bursty open-loop traffic must stay quiet clean and must page +
+    # recommend_grow within the fast window under an injected
+    # serve_dispatch latency fault (asserted inside the drill)
+    JAX_PLATFORMS=cpu python tests/nightly/serve_slo_drill.py
     ;;
   perf)
     # overlap machinery (docs/perf.md "Overlap"): prefetcher/bucketing/

@@ -1,0 +1,254 @@
+"""SLO-engine acceptance drill: live /metrics + burn-rate alerting.
+
+The CI leg for ISSUE 19 (wired in tests/ci/run_test.sh
+TASK=observability), all on the virtual CPU mesh:
+
+1. **Exposition smoke** — a real mxserve HTTP door in-process; two
+   ``GET /metrics`` scrapes around a burst of traffic must parse as
+   Prometheus text and every ``_total`` counter must be monotone
+   non-decreasing (requests_total strictly increases).
+2. **Clean control** — bursty open-loop traffic (serve_bench's
+   arrival shaper) against a healthy server, the SLO engine
+   evaluating continuously: **zero** alerts, **zero** scale
+   recommendations.  A drill that only proves the alert fires proves
+   nothing — the control proves it stays quiet.
+3. **Burn-rate drill** — the same traffic with an injected latency
+   fault (``kind=slow:seam=serve_dispatch`` via the standard
+   MXTPU_FAULT_SPEC seams): a **page-tier** ``slo_alert`` must fire
+   within the fast window (+ grace) of fault onset, and exactly the
+   fault run must write a generation-stamped ``recommend_grow``
+   under ``mxtpu_slo/`` in the (fake) coordination KV.
+
+Prints one JSON line with every figure.  Exit codes: 0 OK, 4 = an
+expectation failed.
+
+Run:  JAX_PLATFORMS=cpu python tests/nightly/serve_slo_drill.py
+"""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "tools"))
+
+import mxnet_tpu as mx                                   # noqa: E402
+from mxnet_tpu.resilience import faultinject             # noqa: E402
+from mxnet_tpu.serving import ModelServer                # noqa: E402
+from mxnet_tpu.observability import metrics as _metrics  # noqa: E402
+from mxnet_tpu.observability.sloengine import (          # noqa: E402
+    SLO_PREFIX, SloEngine, parse_specs)
+from serve_bench import arrival_offsets                  # noqa: E402
+
+FEATURES = 32
+RATE = float(os.environ.get("SLO_DRILL_RATE", "40"))
+PHASE_S = float(os.environ.get("SLO_DRILL_PHASE_S", "6"))
+#: SLO windows scaled for CI wall-clock: fast=2s, slow=4s pair
+SPEC = ("metric=mxtpu_serve_latency_ms:target=100:budget=0.02:"
+        "fast=2:slow=4:tfast=4:tslow=8:hold=2:min_n=8")
+SLOW_S = 0.25          # injected dispatch latency — 2.5x the target
+
+
+def fail(msg, report):
+    report["failed"] = msg
+    print(json.dumps(report, default=str), flush=True)
+    print("serve_slo_drill FAILED: %s" % msg, file=sys.stderr,
+          flush=True)
+    os._exit(4)
+
+
+class FakeKV(object):
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self.store[key] = value
+
+
+def build_server():
+    net = mx.models.get_mlp(num_classes=10, hidden=(32, 32))
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, FEATURES))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    arg_params, aux_params = mod.get_params()
+    params = {"arg:" + k: v for k, v in arg_params.items()}
+    params.update({"aux:" + k: v for k, v in aux_params.items()})
+    srv = ModelServer(max_delay_ms=5.0)
+    srv.add_model("toy", net.tojson(), params,
+                  {"data": (FEATURES,)}, buckets=(1, 8))
+    return srv
+
+
+def drive_bursty(srv, x, seconds, seed):
+    """Open-loop bursty arrivals at RATE req/s for ``seconds``; every
+    completed batch feeds the live registry via serving telemetry."""
+    offs = arrival_offsets("bursty", RATE, int(RATE * seconds), seed,
+                           param=2.0)
+    futs, errs = [], [0]
+    t0 = time.perf_counter()
+    for off in offs:
+        delay = t0 + off - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            futs.append(srv.submit("toy", {"data": x}))
+        except Exception:
+            errs[0] += 1
+    for f in futs:
+        try:
+            f.result(timeout=60)
+        except Exception:
+            errs[0] += 1
+    return len(futs), errs[0]
+
+
+def main():
+    report = {"drill": "serve_slo"}
+    srv = build_server()
+    rng = np.random.RandomState(3)
+    x = rng.rand(1, FEATURES).astype("float32")
+    srv.submit("toy", {"data": x}).result(timeout=60)    # warm compile
+
+    # -- 1. exposition smoke over a real HTTP door ---------------------
+    from http.server import ThreadingHTTPServer
+    import mxserve
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                mxserve.make_handler(srv))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    def scrape():
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/metrics" % port, timeout=10) as r:
+            ctype = r.headers.get("Content-Type", "")
+            return r.read().decode(), ctype
+
+    text1, ctype = scrape()
+    if not ctype.startswith("text/plain"):
+        fail("bad /metrics content-type %r" % ctype, report)
+    rows1 = _metrics.parse_prometheus(text1)
+    for _ in range(20):
+        srv.submit("toy", {"data": x}).result(timeout=60)
+    text2, _ = scrape()
+    rows2 = _metrics.parse_prometheus(text2)
+    c1 = {(n, tuple(sorted(l.items()))): v for n, l, v in rows1
+          if n.endswith("_total")}
+    c2 = {(n, tuple(sorted(l.items()))): v for n, l, v in rows2
+          if n.endswith("_total")}
+    if not c1:
+        fail("no counters in /metrics", report)
+    for key, v1 in c1.items():
+        if c2.get(key, 0) < v1:
+            fail("counter %s went backwards: %s -> %s"
+                 % (key, v1, c2.get(key)), report)
+    req_key = ("mxtpu_serve_requests_total", ())
+    if c2[req_key] < c1[req_key] + 20:
+        fail("requests_total did not advance across scrapes", report)
+    report["scrape_samples"] = len(rows2)
+    report["requests_total"] = c2[req_key]
+
+    # -- 2. clean control: bursty load, engine quiet -------------------
+    _metrics.reset_registry()
+    kv = FakeKV()
+    eng = SloEngine(specs=parse_specs(SPEC), kv=kv, source="drill")
+    alerts = []
+    stop = threading.Event()
+
+    def poll():
+        while not stop.wait(0.25):
+            alerts.extend(eng.evaluate())
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    n, errs = drive_bursty(srv, x, PHASE_S, seed=7)
+    time.sleep(1.0)                       # let the engine see the tail
+    stop.set()
+    poller.join(timeout=5)
+    report["control_requests"] = n
+    report["control_errors"] = errs
+    report["control_alerts"] = len(alerts)
+    if alerts:
+        fail("clean control raised %d alert(s): %r"
+             % (len(alerts), alerts[0]), report)
+    # sustained near-zero burn legitimately writes recommend_shrink
+    # (the fleet IS oversized for a drill's trickle) — but a healthy
+    # run must never recommend growth
+    ctl_recos = [json.loads(v) for k, v in kv.store.items()
+                 if k.startswith(SLO_PREFIX + "reco-")]
+    report["control_shrinks"] = len(
+        [r for r in ctl_recos if r["action"] == "recommend_shrink"])
+    if any(r["action"] == "recommend_grow" for r in ctl_recos):
+        fail("clean control recommended growth: %s"
+             % ctl_recos, report)
+
+    # -- 3. fault run: injected latency must page + recommend_grow ----
+    _metrics.reset_registry()
+    kv = FakeKV()
+    eng = SloEngine(specs=parse_specs(SPEC), kv=kv, source="drill")
+    os.environ["MXTPU_FAULT_SPEC"] = (
+        "kind=slow:seam=serve_dispatch:seconds=%g:sticky=1" % SLOW_S)
+    faultinject.reset()
+    alerts = []
+    stop = threading.Event()
+    fault_t0 = time.perf_counter()
+
+    def poll2():
+        while not stop.wait(0.25):
+            for a in eng.evaluate():
+                a["_seen_s"] = time.perf_counter() - fault_t0
+                alerts.append(a)
+
+    poller = threading.Thread(target=poll2, daemon=True)
+    poller.start()
+    n, errs = drive_bursty(srv, x, PHASE_S, seed=11)
+    time.sleep(1.0)
+    stop.set()
+    poller.join(timeout=5)
+    os.environ.pop("MXTPU_FAULT_SPEC", None)
+    faultinject.reset()
+
+    pages = [a for a in alerts
+             if a["tier"] == "page" and a["edge"] == "fire"]
+    report["fault_requests"] = n
+    report["fault_errors"] = errs
+    report["fault_alerts"] = len(alerts)
+    report["page_fires"] = len(pages)
+    if not pages:
+        fail("fault run raised no page-tier alert", report)
+    # "within the fast window": the page must land within slow + fast
+    # + poll grace of fault onset (the slow window has to fill first)
+    first_s = pages[0]["_seen_s"]
+    report["page_latency_s"] = round(first_s, 2)
+    budget_s = 4.0 + 2.0 + 2.0
+    if first_s > budget_s:
+        fail("page fired %.1fs after onset (budget %.1fs)"
+             % (first_s, budget_s), report)
+    recos = [json.loads(v) for k, v in kv.store.items()
+             if k.startswith(SLO_PREFIX + "reco-")]
+    grows = [r for r in recos if r["action"] == "recommend_grow"]
+    report["recommend_grow"] = len(grows)
+    if len(grows) != 1:
+        fail("expected exactly one recommend_grow, got %d"
+             % len(grows), report)
+    if SLO_PREFIX + "latest" not in kv.store:
+        fail("mxtpu_slo/latest not written", report)
+    if grows[0]["gen"] != 1 or grows[0]["metric"] != \
+            "mxtpu_serve_latency_ms":
+        fail("malformed recommendation: %r" % grows[0], report)
+
+    srv.close()
+    httpd.shutdown()
+    report["ok"] = True
+    print(json.dumps(report, default=str), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,205 @@
+"""Quantile-sketch and metrics-registry tests (ISSUE 19).
+
+Covers the DDSketch-style relative-error guarantee across six orders
+of magnitude, the exact/associative merge (bit-identical quantiles AND
+bit-identical serialized bytes versus the concatenated stream),
+serialization round-trips, empty/single-sample edges, the windowed
+histogram ring, registry get-or-create semantics, and the Prometheus
+text render/parse pair that mxtop --watch and CI scrape.
+"""
+import json
+import math
+import random
+
+import pytest
+
+from mxnet_tpu.observability import metrics as m
+from mxnet_tpu.observability.metrics import (
+    Counter, Gauge, Histogram, MetricsRegistry, QuantileSketch,
+    parse_prometheus, render_prometheus, windows)
+
+
+# ---------------------------------------------------------------- sketch
+
+def test_relative_error_across_six_orders_of_magnitude():
+    rng = random.Random(11)
+    # values spanning 1e-2 .. 1e4 — six decades in one stream
+    vals = [10 ** rng.uniform(-2, 4) for _ in range(20000)]
+    sk = QuantileSketch(alpha=0.01)
+    sk.extend(vals)
+    vals.sort()
+    for q in (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 0.999):
+        exact = vals[min(len(vals) - 1, int(q * len(vals)))]
+        est = sk.quantile(q)
+        assert abs(est - exact) / exact <= 0.011, (q, est, exact)
+
+
+def test_merge_matches_concatenated_stream_bit_identically():
+    rng = random.Random(5)
+    vals = [rng.lognormvariate(3.0, 1.5) for _ in range(9000)]
+    whole = QuantileSketch()
+    whole.extend(vals)
+    parts = [QuantileSketch() for _ in range(7)]
+    for i, v in enumerate(vals):
+        parts[i % 7].add(v)
+    merged = QuantileSketch.merged(parts)
+    # quantiles depend only on integer bucket counts: exact equality,
+    # not approx — this is the fleet-rollup correctness contract
+    for q in (0.5, 0.9, 0.95, 0.99):
+        assert merged.quantile(q) == whole.quantile(q)
+    assert merged.to_dict()["b"] == whole.to_dict()["b"]
+    assert merged.count == whole.count
+
+
+def test_merge_is_associative_and_order_independent():
+    rng = random.Random(3)
+    parts = []
+    for _ in range(5):
+        sk = QuantileSketch()
+        sk.extend(rng.expovariate(0.01) for _ in range(500))
+        parts.append(sk)
+    ab_c = QuantileSketch.merged(
+        [QuantileSketch.merged(parts[:2]), QuantileSketch.merged(parts[2:])])
+    reversed_merge = QuantileSketch.merged(list(reversed(parts)))
+    # the quantile state (integer bucket counts, count, extrema) is
+    # exactly associative; only the float running sum — which feeds
+    # mean, never quantiles — depends on addition order
+    da, db = ab_c.to_dict(), reversed_merge.to_dict()
+    sa, sb = da.pop("s"), db.pop("s")
+    assert da == db
+    assert sa == pytest.approx(sb, rel=1e-12)
+    for q in (0.5, 0.95, 0.99):
+        assert ab_c.quantile(q) == reversed_merge.quantile(q)
+
+
+def test_serialize_round_trip_is_exact():
+    sk = QuantileSketch()
+    sk.extend([0.001, 1.0, 250.0, 1e6, 0.0, -3.5])
+    back = QuantileSketch.from_json(sk.to_json())
+    assert back.to_json() == sk.to_json()
+    assert back.count == sk.count
+    assert back.quantile(0.5) == sk.quantile(0.5)
+    assert back.min == sk.min and back.max == sk.max
+
+
+def test_serialization_is_deterministic():
+    a, b = QuantileSketch(), QuantileSketch()
+    for v in (5.0, 17.0, 0.2):
+        a.add(v)
+    for v in (0.2, 5.0, 17.0):            # insertion order differs
+        b.add(v)
+    assert a.to_json() == b.to_json()
+
+
+def test_empty_and_single_sample_edges():
+    empty = QuantileSketch()
+    assert len(empty) == 0
+    assert empty.quantile(0.5) is None
+    assert empty.mean() is None
+    assert empty.count_above(1.0) == 0
+    one = QuantileSketch()
+    one.add(42.0)
+    assert one.count == 1
+    assert one.quantile(0.0) == pytest.approx(42.0, rel=0.011)
+    assert one.quantile(1.0) == pytest.approx(42.0, rel=0.011)
+    assert one.mean() == 42.0
+
+
+def test_zero_and_negative_values():
+    sk = QuantileSketch()
+    sk.extend([0.0, 0.0, -10.0, 10.0])
+    assert sk.count == 4
+    assert sk.min == -10.0 and sk.max == 10.0
+    back = QuantileSketch.from_json(sk.to_json())
+    assert back.to_json() == sk.to_json()
+
+
+def test_count_above_threshold():
+    sk = QuantileSketch()
+    sk.extend([1.0] * 90 + [1000.0] * 10)
+    bad = sk.count_above(250.0)
+    assert bad == 10
+
+
+def test_bounded_memory_collapses_buckets():
+    sk = QuantileSketch(alpha=0.001, max_buckets=64)
+    rng = random.Random(1)
+    sk.extend(10 ** rng.uniform(-3, 6) for _ in range(5000))
+    assert len(sk.buckets) <= 64
+    assert sk.count == 5000
+
+
+# ------------------------------------------------------------- registry
+
+def test_registry_get_or_create_and_counter_monotone():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs", labels={"model": "a"})
+    assert reg.counter("reqs", labels={"model": "a"}) is c
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_and_callback():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth")
+    g.set(3.0)
+    assert g.value == 3.0
+    live = reg.gauge("live", fn=lambda: 7.5)
+    assert live.value == 7.5
+
+
+def test_histogram_windows(monkeypatch):
+    clock = [1000.0]
+    h = Histogram("lat_ms", windows_s=(10, 60))
+    for i in range(60):
+        h.observe(float(i + 1), now=clock[0])
+        clock[0] += 1.0
+    recent = h.window_sketch(10, now=clock[0])
+    full = h.window_sketch(60, now=clock[0])
+    assert recent.count <= 10 + 1
+    assert full.count > recent.count
+    # recent window only saw the large tail values
+    assert recent.quantile(0.5) > full.quantile(0.5)
+
+
+def test_windows_env_parse(monkeypatch):
+    monkeypatch.setenv("MXTPU_METRICS_WINDOWS", "5,30,120")
+    assert windows() == (5, 30, 120)
+    monkeypatch.delenv("MXTPU_METRICS_WINDOWS")
+    assert windows() == m.DEFAULT_WINDOWS
+
+
+# ----------------------------------------------------------- exposition
+
+def test_render_and_parse_prometheus_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("mxtpu_reqs_total").inc(12)
+    reg.gauge("mxtpu_depth", labels={"model": "echo"}).set(4)
+    h = reg.histogram("mxtpu_lat_ms")
+    for v in (5.0, 10.0, 200.0):
+        h.observe(v, now=100.0)
+    text = render_prometheus(reg, now=101.0)
+    assert "# TYPE mxtpu_reqs_total counter" in text
+    assert "# TYPE mxtpu_lat_ms summary" in text
+    rows = parse_prometheus(text)
+    byname = {}
+    for name, labels, value in rows:
+        byname.setdefault(name, []).append((labels, value))
+    assert byname["mxtpu_reqs_total"][0][1] == 12.0
+    assert byname["mxtpu_depth"][0][0] == {"model": "echo"}
+    assert any(l.get("quantile") == "0.95"
+               for l, _ in byname["mxtpu_lat_ms"])
+    count = [v for l, v in byname["mxtpu_lat_ms_count"]][0]
+    assert count == 3.0
+
+
+def test_singleton_registry_reset():
+    m.reset_registry()
+    reg = m.registry()
+    assert m.registry() is reg
+    reg.counter("x").inc()
+    m.reset_registry()
+    assert m.registry() is not reg

@@ -597,6 +597,13 @@ def test_enabled_overhead_under_2_percent(monkeypatch, tmp_path):
     assert ratio < 1.02, \
         "telemetry overhead %.1f%% (hook %.1fus on a %.2fms step)" \
         % ((ratio - 1) * 100, cost_s * 1e6, step_s * 1e3)
+    # the bound above was measured WITH the metrics registry live:
+    # global StepStats feeds the mxtpu_step_ms histogram on every
+    # record_step, so prove the registry actually saw the samples
+    from mxnet_tpu.observability import metrics as _metrics
+    fed = sum(h.cumulative.count
+              for h in _metrics.registry().histograms("mxtpu_step_ms"))
+    assert fed >= 2000
 
 
 # ----------------------------------------------------------------------
@@ -689,3 +696,128 @@ def test_dist_telemetry_drill(tmp_path):
          tel_dir], capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr
     assert "step-ms" in proc.stdout
+
+
+# ----------------------------------------------------------------------
+# ISSUE 19: sketch-backed StepStats + exact fleet/pod sketch merges
+# ----------------------------------------------------------------------
+def test_step_stats_sketch_backing():
+    """StepStats percentiles come from a mergeable sketch and the
+    snapshot carries the serialized sketch for pod rollups."""
+    from mxnet_tpu.observability.metrics import QuantileSketch
+    st = counters.StepStats(batch_size=8)
+    for i in range(50):
+        st.observe(0.010 + 0.0001 * i, step=i)
+    snap = st.snapshot()
+    assert "step_sketch" in snap
+    back = QuantileSketch.from_dict(snap["step_sketch"])
+    assert back.count == 50
+    assert back.quantile(0.5) == pytest.approx(snap["step_ms_p50"],
+                                               abs=1e-3)
+
+
+def test_fleet_rollup_sketch_merge_exact():
+    """Acceptance: the fleet-wide latency percentiles are the EXACT
+    sketch-merge of per-replica streams — identical to one sketch fed
+    the concatenated stream, never an average of percentiles."""
+    from mxnet_tpu.observability.metrics import QuantileSketch
+    from mxnet_tpu.serving.telemetry import fleet_report
+    import random
+    rng = random.Random(19)
+    recs, all_lats = [], []
+    t = 1000.0
+    for replica in range(3):
+        for batch in range(20):
+            lats = [rng.lognormvariate(3.0, 0.8) for _ in range(8)]
+            all_lats.extend(lats)
+            recs.append(dict(kind="serve", replica=replica,
+                             model="echo", n_requests=len(lats),
+                             lat_ms=lats, wall_ms=t))
+            t += 10.0
+    fl = fleet_report(recs)
+    assert len(fl["replicas"]) == 3
+    whole = QuantileSketch()
+    whole.extend(all_lats)
+    lat = fl["latency_ms"]
+    assert lat["p50"] == round(whole.percentile(50), 3)
+    assert lat["p95"] == round(whole.percentile(95), 3)
+    assert lat["p99"] == round(whole.percentile(99), 3)
+
+
+def test_pod_rollup_merges_step_sketches():
+    """build_report's pod p50/p95 come from merging per-rank step
+    sketches — identical to one sketch over every rank's durations."""
+    from mxnet_tpu.observability.metrics import QuantileSketch
+    recs = []
+    t = 1000
+    durs = {0: 10.0, 1: 30.0}
+    for step in range(20):
+        for rank in (0, 1):
+            recs.append(_mk("step", rank, t + rank, step=step,
+                            dur_ms=durs[rank]))
+        t += 40
+    report = aggregate.build_report(recs)
+    whole = QuantileSketch(alpha=counters.StepStats.SKETCH_ALPHA)
+    for rank in (0, 1):
+        whole.extend([durs[rank]] * 20)
+    assert report["pod"]["step_ms_p50"] == \
+        pytest.approx(whole.percentile(50), abs=1e-3)
+    assert report["pod"]["step_ms_p95"] == \
+        pytest.approx(whole.percentile(95), abs=1e-3)
+    for s in report["per_rank"].values():
+        assert "step_sketch" in s
+
+
+def test_build_report_slo_rollup_and_mxtop_pane():
+    """slo_alert / slo_recommendation records roll up into
+    report['slo'] and mxtop renders the SLO pane from it."""
+    import io
+    recs = [
+        _mk("step", 0, 1000, step=0, dur_ms=10.0),
+        _mk("slo_alert", 0, 1010, metric="mxtpu_serve_latency_ms",
+            tier="page", edge="fire", target=250.0, budget=0.01,
+            threshold_burn=14.0, windows_s=[60, 10],
+            burns={"60": 31.2, "10": 48.0}, at=1.01, source="mxserve"),
+        _mk("counter", 0, 1011, name="slo_recommendation",
+            action="recommend_grow", gen=1,
+            metric="mxtpu_serve_latency_ms", reason="page-tier burn"),
+        _mk("slo_alert", 0, 1050, metric="mxtpu_serve_latency_ms",
+            tier="page", edge="clear", target=250.0, budget=0.01,
+            threshold_burn=14.0, windows_s=[60, 10],
+            burns={"60": 0.4, "10": 0.0}, at=1.05, source="mxserve"),
+    ]
+    report = aggregate.build_report(recs)
+    slo = report["slo"]
+    assert slo["alerts"] == 1            # fire edges only
+    assert slo["page_alerts"] == 1
+    assert slo["active"] == []           # the clear closed it
+    assert slo["last_alert"]["edge"] == "clear"
+    assert slo["recommendations"] == 1
+    assert slo["last_recommendation"]["action"] == "recommend_grow"
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    import mxtop
+    buf = io.StringIO()
+    mxtop.render_slo(report, stream=buf)
+    text = buf.getvalue()
+    assert "SLO" in text
+    assert "recommend_grow" in text
+
+
+def test_metrics_exposition_from_serving_telemetry(monkeypatch):
+    """The always-on serving feed: emit_batch lands in the registry
+    and render_prometheus exposes it (what GET /metrics serves)."""
+    from mxnet_tpu.observability import metrics as _metrics
+    from mxnet_tpu.serving import telemetry as stel
+    _metrics.reset_registry()
+    stel.emit_batch(model="echo", bucket=8, n_requests=4, n_samples=8,
+                    occupancy=0.5, padding_waste=0.5, queue_depth=2,
+                    queue_wait_ms=1.0, pack_ms=0.1, device_ms=4.0,
+                    unpack_ms=0.1, lat_ms=[5.0, 9.0, 12.0, 30.0])
+    text = _metrics.render_prometheus()
+    rows = _metrics.parse_prometheus(text)
+    vals = {(n, tuple(sorted(l.items()))): v for n, l, v in rows}
+    assert vals[("mxtpu_serve_requests_total", ())] == 4.0
+    assert vals[("mxtpu_serve_batches_total", ())] == 1.0
+    assert vals[("mxtpu_serve_queue_depth", ())] == 2.0
+    assert any(n == "mxtpu_serve_latency_ms" for n, _, _ in rows)
+    _metrics.reset_registry()

@@ -1,0 +1,255 @@
+"""SLO-engine tests (ISSUE 19): spec grammar, the burn-rate matrix
+(breach fires the page pair fast, recovery clears with hysteresis,
+steady in-budget load never alerts, thin windows give no verdict), and
+the generation-stamped grow/shrink recommendations written to the
+coordination KV.  Every clock is injected — no sleeps, no wall time.
+"""
+import json
+
+import pytest
+
+from mxnet_tpu.observability import events
+from mxnet_tpu.observability import metrics as m
+from mxnet_tpu.observability import sloengine as se
+from mxnet_tpu.observability.sloengine import (
+    SLO_PREFIX, SloEngine, SloSpec, parse_specs)
+
+
+@pytest.fixture(autouse=True)
+def _pristine(monkeypatch):
+    monkeypatch.delenv("MXTPU_TELEMETRY", raising=False)
+    monkeypatch.delenv("MXTPU_SLO_SPEC", raising=False)
+    monkeypatch.delenv("MXTPU_METRICS_WINDOWS", raising=False)
+    events.refresh()
+    m.reset_registry()
+    se.reset_engine()
+    yield
+    events.refresh()
+    m.reset_registry()
+    se.reset_engine()
+
+
+class FakeKV(object):
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self.store[key] = value
+
+
+# ------------------------------------------------------------- grammar
+
+def test_parse_inline_spec_with_defaults():
+    specs = parse_specs("metric=mxtpu_serve_latency_ms:target=250")
+    assert len(specs) == 1
+    sp = specs[0]
+    assert sp.metric == "mxtpu_serve_latency_ms"
+    assert sp.target == 250.0
+    assert sp.budget == 0.01
+    assert sp.page == 14.0 and sp.ticket == 2.0
+    assert sp.fast == 10 and sp.slow == 60
+    assert sp.tfast == 60 and sp.tslow == 300
+    assert sp.hold == 3 and sp.clear == 0.5 and sp.min_n == 10
+
+
+def test_parse_multiple_specs_and_overrides():
+    specs = parse_specs(
+        "metric=a:target=1:budget=0.05:page=10:fast=5:slow=30;"
+        "metric=b:target=2:hold=1:min_n=2")
+    assert [s.metric for s in specs] == ["a", "b"]
+    assert specs[0].budget == 0.05 and specs[0].fast == 5
+    assert specs[1].hold == 1 and specs[1].min_n == 2
+
+
+def test_parse_spec_file(tmp_path):
+    f = tmp_path / "slo.spec"
+    f.write_text("# objectives\n"
+                 "metric=lat:target=100\n"
+                 "metric=ttft:target=50:budget=0.02\n")
+    specs = parse_specs(str(f))
+    assert [s.metric for s in specs] == ["lat", "ttft"]
+    assert specs[1].budget == 0.02
+
+
+def test_parse_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_specs("metric=a")                    # no target
+    with pytest.raises(ValueError):
+        parse_specs("metric=a:target=1:junk")      # token without =
+    with pytest.raises(ValueError):
+        SloSpec("a", 1.0, budget=1.5)              # budget out of range
+    assert parse_specs("") == []
+    assert parse_specs(None) == []
+
+
+# ---------------------------------------------------------- the matrix
+
+def _engine(kv=None, **spec_kw):
+    """Engine over a private registry with one latency objective:
+    target 100ms, 1% budget, page 14x over (slow=60, fast=10),
+    ticket 2x over (tslow=300, tfast=60), hold=2 for short tests."""
+    reg = m.MetricsRegistry()
+    spec = SloSpec("lat_ms", 100.0, hold=spec_kw.pop("hold", 2),
+                   **spec_kw)
+    eng = SloEngine(specs=[spec], reg=reg, kv=kv, source="test")
+    hist = reg.histogram("lat_ms", windows_s=(10, 60, 300, 3600))
+    return eng, hist, spec
+
+
+def _feed(hist, t0, seconds, bad_frac, per_sec=10):
+    """per_sec samples/s for `seconds`; bad_frac of each second's
+    samples land above the 100ms target."""
+    for s in range(int(seconds)):
+        now = t0 + s
+        nbad = int(round(per_sec * bad_frac))
+        for i in range(per_sec - nbad):
+            hist.observe(10.0, now=now)
+        for i in range(nbad):
+            hist.observe(500.0, now=now)
+    return t0 + seconds
+
+
+def test_steady_in_budget_load_never_alerts():
+    eng, hist, _ = _engine()
+    # 0.5% bad against a 1% budget = burn 0.5 — inside budget
+    t = _feed(hist, 1000.0, 400, bad_frac=0.005, per_sec=200)
+    fired = []
+    for k in range(20):
+        fired.extend(eng.evaluate(now=t + k))
+    assert fired == []
+    st = eng.state(now=t)
+    assert not st["specs"][0]["tiers"]["page"]["active"]
+    assert not st["specs"][0]["tiers"]["ticket"]["active"]
+
+
+def test_breach_fires_page_within_fast_window():
+    eng, hist, _ = _engine()
+    t = _feed(hist, 1000.0, 60, bad_frac=0.0)      # healthy baseline
+    assert eng.evaluate(now=t) == []
+    # fault: 50% bad = burn 50x — both page windows blow past 14x
+    # within ~the fast window of traffic
+    t = _feed(hist, t, 30, bad_frac=0.5)
+    alerts = eng.evaluate(now=t)
+    kinds = {(a["tier"], a["edge"]) for a in alerts}
+    assert ("page", "fire") in kinds
+    page = [a for a in alerts if a["tier"] == "page"][0]
+    assert page["metric"] == "lat_ms"
+    assert page["windows_s"] == [60, 10]
+    assert all(b >= 14.0 for b in page["burns"].values())
+    # refiring is edge-triggered: a second evaluate emits nothing new
+    assert eng.evaluate(now=t) == []
+
+
+def test_recovery_clears_with_hysteresis_hold():
+    eng, hist, spec = _engine(hold=2)
+    t = _feed(hist, 1000.0, 30, bad_frac=0.5)
+    assert any(a["edge"] == "fire" for a in eng.evaluate(now=t))
+    # recovery: clean traffic long enough to flush both pair windows
+    t = _feed(hist, t, 70, bad_frac=0.0)
+    first = eng.evaluate(now=t)
+    assert first == []                 # hold=2: first clean eval holds
+    second = eng.evaluate(now=t + 1)
+    assert any(a["edge"] == "clear" and a["tier"] == "page"
+               for a in second)
+    assert not eng.state(now=t + 1)["specs"][0]["tiers"]["page"]["active"]
+
+
+def test_relapse_resets_clear_streak():
+    eng, hist, _ = _engine(hold=2)
+    t = _feed(hist, 1000.0, 30, bad_frac=0.5)
+    eng.evaluate(now=t)
+    t = _feed(hist, t, 70, bad_frac=0.0)
+    assert eng.evaluate(now=t) == []   # streak 1 of 2
+    t = _feed(hist, t, 15, bad_frac=0.5)   # relapse
+    assert eng.evaluate(now=t) == []   # still active, streak reset
+    t = _feed(hist, t, 70, bad_frac=0.0)
+    eng.evaluate(now=t)
+    cleared = eng.evaluate(now=t + 1)
+    assert any(a["edge"] == "clear" for a in cleared)
+
+
+def test_thin_window_gives_no_verdict():
+    eng, hist, _ = _engine()
+    for i in range(5):                 # 5 samples < min_n=10
+        hist.observe(500.0, now=1000.0 + i)
+    assert eng.evaluate(now=1005.0) == []
+    st = eng.state(now=1005.0)
+    assert st["specs"][0]["burns"]["10"]["burn"] is None
+
+
+def test_missing_histogram_is_silent():
+    reg = m.MetricsRegistry()
+    eng = SloEngine(specs=[SloSpec("nope", 1.0)], reg=reg)
+    assert eng.evaluate(now=1000.0) == []
+
+
+# ----------------------------------------------------- recommendations
+
+def test_page_fire_writes_recommend_grow():
+    kv = FakeKV()
+    eng, hist, _ = _engine(kv=kv)
+    t = _feed(hist, 1000.0, 30, bad_frac=0.5)
+    eng.evaluate(now=t)
+    latest = json.loads(kv.store[SLO_PREFIX + "latest"])
+    assert latest["action"] == "recommend_grow"
+    assert latest["gen"] == 1
+    assert latest["metric"] == "lat_ms"
+    assert latest["source"] == "test"
+    assert SLO_PREFIX + "reco-lat_ms-00001" in kv.store
+    # one fire -> exactly one recommendation
+    assert len(kv.store) == 2
+
+
+def test_sustained_idle_writes_recommend_shrink_once():
+    kv = FakeKV()
+    eng, hist, _ = _engine(kv=kv)
+    # real traffic, zero bad: burn 0 <= IDLE_BURN on the slow window
+    t = _feed(hist, 1000.0, 350, bad_frac=0.0, per_sec=5)
+    for k in range(SloEngine.IDLE_HOLD + 3):
+        eng.evaluate(now=t + k)
+    recos = [json.loads(v) for k, v in kv.store.items()
+             if k.startswith(SLO_PREFIX + "reco-")]
+    assert len(recos) == 1
+    assert recos[0]["action"] == "recommend_shrink"
+    assert recos[0]["gen"] == 1
+
+
+def test_kv_failure_is_swallowed():
+    class BadKV(object):
+        def key_value_set(self, *a, **kw):
+            raise OSError("kv down")
+    eng, hist, _ = _engine(kv=BadKV())
+    t = _feed(hist, 1000.0, 30, bad_frac=0.5)
+    alerts = eng.evaluate(now=t)       # alert still fires
+    assert any(a["edge"] == "fire" for a in alerts)
+
+
+def test_alert_events_reach_the_event_log(monkeypatch, tmp_path):
+    d = str(tmp_path / "tel")
+    monkeypatch.setenv("MXTPU_TELEMETRY", "1")
+    monkeypatch.setenv("MXTPU_TELEMETRY_DIR", d)
+    events.refresh()
+    eng, hist, _ = _engine()
+    t = _feed(hist, 1000.0, 30, bad_frac=0.5)
+    eng.evaluate(now=t)
+    recs = []
+    import glob
+    for path in glob.glob(d + "/events-rank*.jsonl"):
+        with open(path) as fin:
+            recs.extend(json.loads(ln) for ln in fin if ln.strip())
+    kinds = {r["kind"] for r in recs}
+    assert "slo_alert" in kinds
+    alert = [r for r in recs if r["kind"] == "slo_alert"][0]
+    assert alert["tier"] == "page" and alert["edge"] == "fire"
+
+
+def test_maybe_start_requires_spec(monkeypatch):
+    assert se.maybe_start() is None
+    monkeypatch.setenv("MXTPU_SLO_SPEC", "metric=lat:target=9")
+    eng = se.maybe_start(source="door")
+    try:
+        assert eng is not None
+        assert eng.source == "door"
+        assert [s.metric for s in eng.specs] == ["lat"]
+    finally:
+        eng.stop()

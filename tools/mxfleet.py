@@ -47,6 +47,10 @@ Front-door endpoints (router):
                        (409 not_leader + leader hint on a standby)
     GET  /v1/stats     router stats (role/lease/tenants) + per-replica
                        /v1/stats rollup
+    GET  /metrics      Prometheus text from the live registry + router
+                       gauges (queue depth, lease/leader state, replica
+                       count, per-tenant admission); MXTPU_METRICS=0
+                       disables
     POST /v1/drain     stop admission fleet-wide, flush, drain replicas
     GET  /healthz      200 once all replicas answered startup checks
 
@@ -120,6 +124,23 @@ def make_front_handler(router):
                 doc = router.stats()
                 doc["replica_stats"] = router.replica_stats()
                 self._reply(200, doc)
+            elif self.path == "/metrics":
+                from mxnet_tpu.observability.metrics import \
+                    exposition_enabled
+                if not exposition_enabled():
+                    self._reply(404, {"error": "not_found",
+                                      "path": self.path})
+                    return
+                sys.path.insert(0, os.path.dirname(
+                    os.path.abspath(__file__)))
+                from mxserve import metrics_text
+                body = metrics_text(stats=router.stats()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._reply(404, {"error": "not_found",
                                   "path": self.path})
@@ -259,6 +280,12 @@ def cmd_serve(args):
             else bool(args.respawn),
             kv_url=args.kv, router_id=args.router_id,
             lease_ttl_s=args.lease_ttl, tenants=args.tenants)
+    # MXTPU_SLO_SPEC set -> evaluate burn rates live in the router
+    # process, writing recommendations through the fleet's own KV
+    from mxnet_tpu.observability import sloengine as _sloengine
+    _sloengine.maybe_start(source="mxfleet",
+                           kv=getattr(router, "_kv", None))
+
     from http.server import ThreadingHTTPServer
     port = args.port or int(os.environ.get("MXTPU_FLEET_PORT", "8930"))
     httpd = ThreadingHTTPServer((args.host, port),

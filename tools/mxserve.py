@@ -26,7 +26,16 @@ Endpoints:
                        (requires a --generative model; KV-cache
                        exhaustion returns 429 with blocks_free)
     GET  /v1/stats     ModelServer.stats() JSON
+    GET  /metrics      Prometheus text exposition from the live metrics
+                       registry (latency/TTFT/ITL sketches, queue depth,
+                       occupancy, KV-block high water) + server stats
+                       gauges; disable with MXTPU_METRICS=0
     GET  /healthz      200 "ok"
+
+With ``MXTPU_SLO_SPEC`` set, the live SLO engine
+(docs/observability.md "Live metrics & SLO engine") evaluates burn
+rates in-process and emits ``slo_alert`` events + advisory scale
+recommendations while the door serves.
 
 Backpressure surfaces as real HTTP 429 (queue full — or, for
 ``/v1/generate``, KV-cache block exhaustion with ``blocks_free`` in
@@ -127,10 +136,57 @@ def build_server(args):
     return srv
 
 
+def metrics_text(srv=None, stats=None):
+    """The /metrics body: refresh server-stats gauges into the live
+    registry, then render the Prometheus text exposition.  Shared by
+    the mxserve and mxfleet doors (``stats`` wins when given)."""
+    from mxnet_tpu.observability import metrics as _metrics
+    reg = _metrics.registry()
+    try:
+        st = stats if stats is not None else srv.stats()
+    except Exception:
+        st = {}
+    for key, name, help_text in (
+            ("requests", "mxtpu_stats_requests", "server stats: "
+             "requests completed"),
+            ("rejected", "mxtpu_stats_rejected", "server stats: "
+             "requests rejected (backpressure)"),
+            ("queue_depth", "mxtpu_stats_queue_depth", "server stats: "
+             "current queue depth"),
+            ("occupancy", "mxtpu_stats_occupancy", "server stats: "
+             "mean bucket occupancy"),
+            ("generation", "mxtpu_fleet_generation", "fleet ledger "
+             "generation"),
+            ("leader", "mxtpu_fleet_leader", "1 when this router "
+             "holds the leader lease")):
+        val = st.get(key)
+        if isinstance(val, bool):
+            val = int(val)
+        if isinstance(val, (int, float)):
+            reg.gauge(name, help=help_text).set(val)
+    replicas = st.get("replicas")
+    if isinstance(replicas, dict):
+        reg.gauge("mxtpu_fleet_replicas",
+                  help="live replica count").set(len(replicas))
+    tenants = st.get("tenants")
+    if isinstance(tenants, dict):
+        for tenant, tstats in sorted(tenants.items()):
+            if isinstance(tstats, dict):
+                for field, name in (
+                        ("admitted", "mxtpu_tenant_admitted"),
+                        ("rejected", "mxtpu_tenant_rejected")):
+                    if isinstance(tstats.get(field), (int, float)):
+                        reg.gauge(name, help="per-tenant admission",
+                                  labels={"tenant": tenant}).set(
+                                      tstats[field])
+    return _metrics.render_prometheus(reg)
+
+
 def make_handler(srv):
     from http.server import BaseHTTPRequestHandler
     from mxnet_tpu.base import MXNetError
     from mxnet_tpu.serving import ServerBusy
+    from mxnet_tpu.observability.metrics import exposition_enabled
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -154,6 +210,14 @@ def make_handler(srv):
                 self._reply(200, {"status": "ok"})
             elif self.path == "/v1/stats":
                 self._reply(200, srv.stats())
+            elif self.path == "/metrics" and exposition_enabled():
+                body = metrics_text(srv).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._reply(404, {"error": "not_found", "path": self.path})
 
@@ -277,6 +341,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     srv = build_server(args)
+
+    # MXTPU_SLO_SPEC set -> evaluate burn rates live in this process
+    from mxnet_tpu.observability import sloengine as _sloengine
+    _sloengine.maybe_start(source="mxserve")
 
     from http.server import ThreadingHTTPServer
     httpd = ThreadingHTTPServer((args.host, args.port), make_handler(srv))

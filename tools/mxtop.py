@@ -11,9 +11,18 @@ heartbeat ages, and the fault/checkpoint incident timeline.
     python tools/mxtop.py /scratch/telemetry --json     # machine-readable
     python tools/mxtop.py /scratch/telemetry --fault    # timeline around
                                                         # each incident
+    python tools/mxtop.py --watch http://host:8911      # live /metrics
+                                                        # refresh from a
+                                                        # serving door
 
 ``--json`` prints exactly one JSON document (the aggregate.build_report
 dict) so CI can assert on it.
+
+``--watch URL`` polls ``GET /metrics`` on an mxserve/mxfleet door and
+renders the live registry (the same sketches the SLO engine reads) —
+no telemetry dir needed.  When ``slo_alert`` events exist in a dir
+view, the SLO pane shows the objective, per-window burn rates, the
+last alert, and the last scale recommendation.
 """
 from __future__ import annotations
 
@@ -105,6 +114,7 @@ def render(report, stream=sys.stdout):
                 rec.get("kind"),
                 rec.get("fault") or rec.get("event") or rec.get("phase")
                 or rec.get("path") or ""))
+    render_slo(report, stream=stream)
     render_retrace(report, stream=stream)
 
 
@@ -163,6 +173,7 @@ def render_serve(report, stream=sys.stdout):
                 m.get("kernel_path") or "-",
                 phases.get("prefill", 0), phases.get("decode", 0)))
     render_fleet(report, stream=stream)
+    render_slo(report, stream=stream)
     render_retrace(report, stream=stream)
 
 
@@ -214,6 +225,100 @@ def render_fleet(report, stream=sys.stdout):
         w("VERSION SKEW: %s\n" % json.dumps(skew, sort_keys=True))
 
 
+def render_slo(report, stream=sys.stdout):
+    """The SLO pane (pod and serve views): alert counts, currently
+    active tiers, the last alert's objective + per-window burns, and
+    the last scale recommendation — the live engine's trail
+    (observability/sloengine.py)."""
+    slo = report.get("slo") or {}
+    if not slo:
+        return
+    w = stream.write
+    w("SLO — %s alert(s) (%s page)   active: %s   recommendations: %s\n"
+      % (slo.get("alerts", 0), slo.get("page_alerts", 0),
+         " ".join(slo.get("active") or []) or "none",
+         slo.get("recommendations", 0)))
+    last = slo.get("last_alert")
+    if last:
+        burns = last.get("burns") or {}
+        w("      last alert: %s %s %s   objective %s<=%s budget %s   "
+          "burn %s\n" % (
+              last.get("tier", "?"), last.get("edge", "?"),
+              last.get("metric", "?"), last.get("metric", "?"),
+              _fmt(last.get("target"), width=6).strip(),
+              last.get("budget"),
+              "  ".join("%ss=%sx" % (k, v)
+                        for k, v in sorted(burns.items(),
+                                           key=lambda kv: int(kv[0])))))
+    reco = slo.get("last_recommendation")
+    if reco:
+        w("      last recommendation: %s gen %s (%s)\n" % (
+            reco.get("action", "?"), reco.get("gen", "?"),
+            reco.get("reason", "")))
+
+
+def run_watch(url, interval, follow):
+    """--watch: poll GET /metrics on a serving door and render the
+    live registry — counters/gauges verbatim, histogram summaries as
+    one row per metric (p50/p95/p99/count plus per-window p95s)."""
+    import urllib.request
+    from mxnet_tpu.observability.metrics import parse_prometheus
+    while True:
+        try:
+            with urllib.request.urlopen(url.rstrip("/") + "/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+        except Exception as exc:
+            sys.stderr.write("mxtop: scrape failed: %r\n" % (exc,))
+            return 1
+        rows = parse_prometheus(text)
+        if follow:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write("mxtop --watch %s — %d sample(s)\n"
+                         % (url, len(rows)))
+        hists, scalars = {}, []
+        for name, labels, value in rows:
+            if "quantile" in labels or name.endswith(("_count", "_sum")):
+                base = name
+                for suffix in ("_window", "_count", "_sum"):
+                    if base.endswith(suffix):
+                        base = base[:-len(suffix)]
+                key = "q" + labels["quantile"] if "quantile" in labels \
+                    else name.rsplit("_", 1)[-1]
+                if labels.get("window"):
+                    key = "w%s_p95" % labels["window"]
+                hists.setdefault(base, {})[key] = value
+            else:
+                scalars.append((name, labels, value))
+        for name, labels, value in scalars:
+            lbl = " ".join("%s=%s" % kv for kv in sorted(labels.items()))
+            sys.stdout.write("  %-38s %12s  %s\n"
+                             % (name, _fmt(value, width=12).strip(),
+                                lbl))
+        if hists:
+            sys.stdout.write("  %-30s %10s %10s %10s %10s  %s\n" % (
+                "histogram", "p50", "p95", "p99", "count", "window p95s"))
+            for base, vals in sorted(hists.items()):
+                wins = "  ".join(
+                    "%s=%s" % (k[1:-4], _fmt(v, width=8).strip())
+                    for k, v in sorted(
+                        vals.items(),
+                        key=lambda kv: (len(kv[0]), kv[0]))
+                    if k.startswith("w") and k.endswith("_p95"))
+                sys.stdout.write("  %-30s %10s %10s %10s %10s  %s\n" % (
+                    base,
+                    _fmt(vals.get("q0.5"), width=10).strip(),
+                    _fmt(vals.get("q0.95"), width=10).strip(),
+                    _fmt(vals.get("q0.99"), width=10).strip(),
+                    int(vals.get("count", 0)), wins))
+        if not follow:
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 def render_fault_timelines(records, before, after, stream=sys.stdout):
     w = stream.write
     hits = [i for i, r in enumerate(records)
@@ -245,7 +350,11 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="mxtop", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("directory", help="telemetry dir (MXTPU_TELEMETRY_DIR)")
+    ap.add_argument("directory", nargs="?",
+                    help="telemetry dir (MXTPU_TELEMETRY_DIR)")
+    ap.add_argument("--watch", metavar="URL",
+                    help="poll GET /metrics on an mxserve/mxfleet door "
+                         "and render the live registry (no dir needed)")
     ap.add_argument("--json", action="store_true",
                     help="print the report as one JSON document")
     ap.add_argument("--follow", action="store_true",
@@ -260,6 +369,10 @@ def main(argv=None):
                     help="events before/after each fault (--fault)")
     args = ap.parse_args(argv)
 
+    if args.watch:
+        return run_watch(args.watch, args.interval, args.follow)
+    if not args.directory:
+        ap.error("directory is required unless --watch is given")
     if not os.path.isdir(args.directory):
         sys.stderr.write("mxtop: no such directory: %s\n" % args.directory)
         return 2

@@ -206,9 +206,42 @@ def parse_telemetry(path):
                             for ingredient in (r.get("divergent") or [])})
         if divergent:
             overlap_cols["retrace-divergent"] = ",".join(divergent)
+    # SLO-engine columns (docs/observability.md "Live metrics & SLO
+    # engine"): alert count as "N (tier/metric,...)" — a string column
+    # like serve-kernel — plus the worst observed burn rate as
+    # "metric@window=burn" and the last arrival shape a serve_bench
+    # open-loop run stamped into its summary record
+    alerts = [r for r in records if r.get("kind") == "slo_alert"]
+    if alerts:
+        fired = sorted({"%s/%s" % (r.get("tier"), r.get("metric"))
+                        for r in alerts if r.get("edge") == "fire"})
+        overlap_cols["slo-alerts"] = "%d (%s)" % (
+            len([r for r in alerts if r.get("edge") == "fire"]),
+            ",".join(fired)) if fired else "0"
+        worst = None
+        for r in alerts:
+            for win, burn in (r.get("burns") or {}).items():
+                if burn is None:
+                    continue
+                if worst is None or float(burn) > worst[2]:
+                    worst = (r.get("metric"), win, float(burn))
+        if worst:
+            overlap_cols["burn-rate"] = "%s@%ss=%.1fx" % worst
+    for rec in records:
+        if rec.get("kind") != "summary" \
+                or rec.get("source") != "serve_bench":
+            continue
+        bench = rec.get("bench") or {}
+        if bench.get("arrival"):
+            overlap_cols["arrival"] = str(bench["arrival"])
+            if bench.get("achieved_rate") is not None:
+                overlap_cols["achieved-rps"] = \
+                    float(bench["achieved_rate"])
     if not acc and (any(c.startswith("serve-") for c in overlap_cols)
                     or "mfu-gap" in overlap_cols
                     or "retraces" in overlap_cols
+                    or "slo-alerts" in overlap_cols
+                    or "arrival" in overlap_cols
                     or "autotune-config-id" in overlap_cols):
         # serving-/bench-only event stream: one summary row
         acc[0] = {"steps": 0, "dur_ms": [], "sps": []}

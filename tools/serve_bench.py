@@ -14,9 +14,25 @@ Modes:
     closed  (default) ``--concurrency`` workers, each submits its next
             request the moment the previous one completes — measures
             sustainable throughput.
-    open    requests arrive on a fixed ``--rate`` schedule regardless of
+    open    requests arrive on a ``--rate`` schedule regardless of
             completions — measures latency under offered load (and how
             the 429 backpressure behaves past saturation).
+
+Open-loop arrivals default to a fixed period but ``--arrival`` shapes
+them like production traffic (mean offered rate stays ``--rate``):
+
+    poisson    memoryless exponential inter-arrival gaps
+    bursty     on-off square wave (period ``--arrival-param``, default
+               2 s): the ON half arrives at 2x rate, the OFF half idles
+    diurnal    sinusoidal rate modulation (one compressed "day" per
+               ``--arrival-param`` seconds, default 10)
+    heavytail  lognormal think times (sigma ``--arrival-param``,
+               default 1.5) — a few huge gaps, many tiny ones
+
+``--tenant-mix "name:frac;..."`` assigns each request a tenant drawn
+from the mix; the BENCH line stamps the arrival process, the offered
+vs achieved rate, and the per-tenant request counts so benchdiff and
+the burn-rate drill see traffic shape, not just totals.
 
 ``--generate`` switches the bench to the generative workload: a small
 decoder-only LM served through ``add_generative_model`` under a mixed
@@ -119,6 +135,77 @@ def sample_sizes(dist, count, seed):
     return [rng.choices(sizes, weights=weights)[0] for _ in range(count)]
 
 
+ARRIVALS = ("fixed", "poisson", "bursty", "diurnal", "heavytail")
+
+
+def arrival_offsets(arrival, rate, count, seed, param=None):
+    """Absolute submit offsets (seconds from t0) for ``count`` open-loop
+    arrivals at mean rate ``rate``, shaped by ``arrival``.  Every
+    process normalizes to the same mean offered rate, so ``--arrival``
+    changes burstiness, never the offered load.  Deterministic in
+    ``seed``."""
+    import math
+    if rate <= 0:
+        return [0.0] * count
+    rng = random.Random(seed)
+    mean_gap = 1.0 / rate
+    if arrival == "poisson":
+        gaps = [rng.expovariate(rate) for _ in range(count)]
+    elif arrival == "bursty":
+        # on-off square wave: ON half of each period arrives at 2x
+        # rate, OFF half idles — mean stays `rate`
+        period = float(param or 2.0)
+        offs, t = [], 0.0
+        while len(offs) < count:
+            phase = t % period
+            if phase < period / 2.0:
+                offs.append(t)
+                t += rng.expovariate(2.0 * rate)
+            else:
+                t += (period - phase)    # skip to the next ON window
+        return offs[:count]
+    elif arrival == "diurnal":
+        # sinusoidal modulation: one compressed "day" per `period`
+        # seconds, rate swinging 0.2x..1.8x around the mean
+        period = float(param or 10.0)
+        offs, t = [], 0.0
+        for _ in range(count):
+            offs.append(t)
+            inst = rate * (1.0 + 0.8 * math.sin(
+                2.0 * math.pi * t / period))
+            t += rng.expovariate(max(inst, 0.05 * rate))
+        return offs
+    elif arrival == "heavytail":
+        # lognormal think times normalized to the mean gap: most gaps
+        # tiny, a few huge — the tail that breaks fixed-rate tuning
+        sigma = float(param or 1.5)
+        mu = math.log(mean_gap) - sigma * sigma / 2.0
+        gaps = [rng.lognormvariate(mu, sigma) for _ in range(count)]
+    else:                                # fixed (legacy default)
+        return [i * mean_gap for i in range(count)]
+    offs, t = [], 0.0
+    for g in gaps:
+        offs.append(t)
+        t += g
+    return offs
+
+
+def parse_tenant_mix(raw):
+    """``"name:frac;..."`` -> ordered (names, weights); None when
+    unset.  Fractions are weights — they need not sum to 1."""
+    if not raw:
+        return None
+    names, weights = [], []
+    for part in raw.replace(",", ";").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, frac = part.partition(":")
+        names.append(name.strip())
+        weights.append(float(frac or 1.0))
+    return (names, weights) if names else None
+
+
 def run_closed(srv, model, inputs_for, sizes, concurrency):
     """Closed loop: each worker's next request waits on its previous."""
     lock = threading.Lock()
@@ -147,18 +234,31 @@ def run_closed(srv, model, inputs_for, sizes, concurrency):
     return time.perf_counter() - t0, 0, errors
 
 
-def run_open(srv, model, inputs_for, sizes, rate):
-    """Open loop: fixed-rate arrivals; 429 rejections are counted, not
-    retried (the generator models clients that back off)."""
+def run_open(srv, model, inputs_for, sizes, rate, arrival="fixed",
+             arrival_param=None, seed=7, tenant_mix=None):
+    """Open loop: arrivals on the ``--arrival``-shaped schedule; 429
+    rejections are counted, not retried (the generator models clients
+    that back off).  Returns (wall_s, rejected, errors, info) where
+    info carries the arrival stamp + per-tenant counts for BENCH."""
     from mxnet_tpu.serving import ServerBusy
     futures, rejected, errors = [], 0, []
-    period = 1.0 / rate if rate > 0 else 0.0
+    offsets = arrival_offsets(arrival, rate, len(sizes), seed,
+                              param=arrival_param)
+    tenants = None
+    tenant_counts = {}
+    if tenant_mix:
+        names, weights = tenant_mix
+        rng = random.Random(seed + 1)
+        tenants = [rng.choices(names, weights=weights)[0]
+                   for _ in sizes]
     t0 = time.perf_counter()
     for i, n in enumerate(sizes):
-        target = t0 + i * period
-        delay = target - time.perf_counter()
+        delay = (t0 + offsets[i]) - time.perf_counter()
         if delay > 0:
             time.sleep(delay)
+        if tenants is not None:
+            tenant_counts[tenants[i]] = \
+                tenant_counts.get(tenants[i], 0) + 1
         try:
             futures.append(srv.submit(model, inputs_for(n)))
         except ServerBusy:
@@ -168,7 +268,14 @@ def run_open(srv, model, inputs_for, sizes, rate):
             fut.result(timeout=60.0)
         except Exception as exc:
             errors.append(exc)
-    return time.perf_counter() - t0, rejected, errors
+    wall_s = time.perf_counter() - t0
+    span = offsets[-1] if offsets and offsets[-1] > 0 else wall_s
+    info = {"arrival": arrival,
+            "offered_rate": round(len(sizes) / span, 2)
+            if span > 0 else None}
+    if tenant_counts:
+        info["tenants"] = dict(sorted(tenant_counts.items()))
+    return wall_s, rejected, errors, info
 
 
 def build_lm(args):
@@ -510,7 +617,16 @@ def main(argv=None):
     ap.add_argument("--concurrency", type=int, default=8,
                     help="closed-loop worker count")
     ap.add_argument("--rate", type=float, default=200.0,
-                    help="open-loop arrival rate (req/s)")
+                    help="open-loop mean arrival rate (req/s)")
+    ap.add_argument("--arrival", choices=ARRIVALS, default="fixed",
+                    help="open-loop arrival process (mean rate stays "
+                         "--rate; shapes burstiness)")
+    ap.add_argument("--arrival-param", type=float, default=None,
+                    help="process knob: bursty/diurnal period seconds, "
+                         "heavytail sigma")
+    ap.add_argument("--tenant-mix", default=None,
+                    help='per-tenant request mix "name:frac;..." '
+                         "(stamped into BENCH)")
     ap.add_argument("--sizes", default="1:60,2:25,4:10,8:5",
                     help='request-size distribution "n:weight,..."')
     ap.add_argument("--buckets", default=None,
@@ -599,12 +715,16 @@ def main(argv=None):
     from mxnet_tpu.executor import program_registry_stats
     lowerings_at_warmup = program_registry_stats()["lowerings"]
 
+    open_info = {}
     if args.mode == "closed":
         wall_s, rejected, errors = run_closed(
             srv, "bench", inputs_for, sizes, args.concurrency)
     else:
-        wall_s, rejected, errors = run_open(
-            srv, "bench", inputs_for, sizes, args.rate)
+        wall_s, rejected, errors, open_info = run_open(
+            srv, "bench", inputs_for, sizes, args.rate,
+            arrival=args.arrival, arrival_param=args.arrival_param,
+            seed=args.seed,
+            tenant_mix=parse_tenant_mix(args.tenant_mix))
 
     stats = srv.stats()
     lowerings_after = program_registry_stats()["lowerings"] \
@@ -636,9 +756,25 @@ def main(argv=None):
         "batches": stats.get("batches"),
         "lowerings_after_warmup": lowerings_after,
     }
+    if args.mode == "open":
+        # traffic-shape stamp: the arrival process, the rate the
+        # schedule actually offered, and the rate the server achieved
+        # — the offered-vs-achieved gap IS the saturation signal
+        out.update(open_info)
+        out["achieved_rate"] = out["value"]
+        if args.tenant_mix:
+            out["tenant_mix"] = args.tenant_mix
     if errors:
         out["first_error"] = repr(errors[0])
     _stamp_retrace(out)
+    # mirror the BENCH payload into the event log (when telemetry is
+    # on) so parse_log/mxtop gain the arrival/traffic-shape columns
+    try:
+        from mxnet_tpu.observability import events as _events
+        _events.emit("summary", source="serve_bench", bench=out)
+        _events.flush()
+    except Exception:
+        pass
     print(json.dumps(out, default=str))
     return 1 if errors else 0
 
