@@ -71,6 +71,14 @@ MXL-X003  error     jit/lower constructed on a per-request/step path
 MXL-X004  warning   bare python scalar crosses the trace boundary
 MXL-X005  error     unbucketed dynamic shape fed to an AOT table
 MXL-X006  error     donated buffer reused after donation
+MXL-E001  error     pipeline stage compute imbalance
+MXL-E002  warning   pipeline bubble fraction above bound
+MXL-E003  error     cross-stage back-edge (deadlock under 1F1B)
+MXL-E004  error     per-stage activation-stash HBM overflow
+MXL-E005  warning   stage-boundary transfer cannot hide under compute
+MXL-E006  error     expert count not divisible by the ep axis
+MXL-E007  warning   capacity factor risks dropping tokens
+MXL-E008  info      expert all-to-all priced per rank
 ========  ========  ==================================================
 
 The MXL-P/M/C families only activate with SPMD context: pass ``mesh``
@@ -116,6 +124,15 @@ runtime witness is ``observability.retrace``
 (``MXTPU_RETRACE_SENTRY=1``), which counts and attributes every
 post-warmup lowering.
 
+The MXL-E family is the schedule lint (schedule.py, docs/
+graph_lint.md): a static simulator pricing pipeline-parallel (GPipe +
+1F1B) and MoE execution before a chip is touched — stage partitions
+from ``ctx_group`` or a ``pp`` mesh axis, stages priced by the MXL-R
+roofline, boundaries by the ICI model, the 1F1B walk driven by the
+SAME kind table the runtime compiles.  Activated by a >= 2-stage
+partition or MoE nodes (``mxlint --mesh dp=2,pp=4 --schedule``);
+``MXTPU_LINT_SCHEDULE=0`` kills the family.
+
 Suppress per node with the ``__lint_ignore__`` attr (comma-separated
 rule ids, or ``all``).
 """
@@ -141,12 +158,14 @@ from . import distributed as _distributed  # noqa: F401
 from . import divergence as _divergence    # noqa: F401
 from . import concurrency as _concurrency  # noqa: F401
 from . import retrace as _retrace          # noqa: F401
+from . import schedule as _schedule        # noqa: F401
 from .propagation import comm_report
 from .memory import peak_hbm_report, hbm_capacity_bytes
 from .tiling import register_kernel_spec, kernel_spec_issues
 from .roofline import (roofline_report, static_ceiling_summary,
                        static_mfu_ceiling)
 from .distributed import collective_trace
+from .schedule import schedule_report, stage_partition
 from .divergence import analyze_source_paths, collective_seam
 from .concurrency import analyze_concurrency_paths, thread_entry
 from .retrace import analyze_retrace_paths, traced_scope
@@ -158,7 +177,8 @@ __all__ = ["GraphIssue", "AnalysisContext", "Rule", "RULE_REGISTRY",
            "hbm_capacity_bytes", "register_kernel_spec",
            "kernel_spec_issues", "roofline_report", "static_mfu_ceiling",
            "static_ceiling_summary",
-           "collective_trace", "analyze_source_paths", "collective_seam",
+           "collective_trace", "schedule_report", "stage_partition",
+           "analyze_source_paths", "collective_seam",
            "analyze_concurrency_paths", "thread_entry",
            "analyze_retrace_paths", "traced_scope"]
 

@@ -6,8 +6,11 @@ Chip windows are scarce, so config selection happens off-chip: every
 model the search needs already exists in this package and prices a
 graph without lowering anything — MXL-R (roofline MFU ceiling,
 calibrated against the compiled AOT table in AOT_r05.json), MXL-M
-(peak-HBM fit), MXL-K (Mosaic tile legality) and MXL-D (distributed
-lint).  The tuner enumerates a config grammar, **prunes infeasible
+(peak-HBM fit), MXL-K (Mosaic tile legality), MXL-E (pipeline/MoE
+schedule lint — infeasible stage splits and expert counts are pruned,
+a feasible pipeline config's ceiling is scaled by its simulated 1F1B
+bubble) and MXL-D (distributed lint).  The tuner enumerates a config
+grammar, **prunes infeasible
 candidates before pricing them** (an illegal tile or an OOM config
 must not spend analysis time, and must never reach a chip), prices the
 survivors through one memoized analysis context per distinct graph
@@ -61,11 +64,16 @@ __all__ = ["AXES", "default_space", "parse_space", "space_configs",
 #: axis order IS the grammar order: config dicts, manifest rows and
 #: config ids all serialize axes in this order
 AXES = ("batch", "remat", "sharding", "dtype", "bucket_mb", "prefetch",
-        "serve_block", "serve_buckets")
+        "serve_block", "serve_buckets", "stages", "microbatches",
+        "experts", "capacity_factor")
 
 #: axes whose values are ints ("none" -> None for the optional ones)
-_INT_AXES = ("batch", "bucket_mb", "prefetch", "serve_block")
-_OPTIONAL_AXES = ("serve_block", "serve_buckets")
+_INT_AXES = ("batch", "bucket_mb", "prefetch", "serve_block", "stages",
+             "microbatches", "experts")
+#: axes whose values are floats
+_FLOAT_AXES = ("capacity_factor",)
+_OPTIONAL_AXES = ("serve_block", "serve_buckets", "stages", "experts",
+                  "capacity_factor")
 
 #: the serve paged-KV pool the MXL-K gate checks serve_block against —
 #: (pool_rows, head_dim): any realistic pool dominates the block, so
@@ -87,6 +95,13 @@ def default_space(model="resnet50"):
         "prefetch": (2,),
         "serve_block": (None,),
         "serve_buckets": (None,),
+        # pipeline / MoE axes (MXL-E): single-valued defaults keep the
+        # stock sweep's graph count unchanged; widen them with e.g.
+        # "stages=2,4;microbatches=4,8" or "experts=4,8"
+        "stages": (None,),
+        "microbatches": (8,),
+        "experts": (None,),
+        "capacity_factor": (None,),
     }
 
 
@@ -117,6 +132,8 @@ def parse_space(spec, base=None):
                 vals.append(None)
             elif axis in _INT_AXES:
                 vals.append(int(tok))
+            elif axis in _FLOAT_AXES:
+                vals.append(float(tok))
             else:
                 vals.append(tok)
         if not vals:
@@ -132,24 +149,10 @@ def space_configs(space):
     return [dict(zip(AXES, combo)) for combo in itertools.product(*axes)]
 
 
-_SHARDING_RE = _re.compile(
-    r"^(?:(fsdp|dp)(\d+))?(?:tp(\d+))?$")
-
-
-def parse_sharding(rule):
-    """``"dp1" | "dp8" | "fsdp8" | "tp4" | "dp2tp2"`` ->
-    ``{"dp": n, "tp": m, "fsdp": bool}``.  dp shards the batch, tp the
-    hidden axis, fsdp additionally shards param/grad/optimizer state
-    across the dp axis (the ShardedTrainer ``fsdp=True`` ZeRO-3 mode).
-    """
-    m = _SHARDING_RE.match(str(rule or "dp1").strip())
-    if not m or not (m.group(1) or m.group(3)):
-        raise ValueError("bad sharding rule %r (want dpN / fsdpN / "
-                         "tpN / dpNtpM)" % (rule,))
-    kind, dp, tp = m.group(1), m.group(2), m.group(3)
-    return {"dp": int(dp) if dp else 1,
-            "tp": int(tp) if tp else 1,
-            "fsdp": kind == "fsdp"}
+# the "dp2tp2pp4ep2"-style sharding grammar lives with the sharding
+# rules it configures; the tuner re-exports it (axes: dp/fsdp, tp, pp
+# pipeline stages, ep expert parallelism)
+from ..parallel.sharding import _SHARDING_RE, parse_sharding  # noqa: E402,F401
 
 
 def canonical_json(obj):
@@ -175,25 +178,43 @@ _RESNET_RE = _re.compile(r"^resnet(\d+)$")
 
 
 def _model_builder(model):
-    """(build_fn(remat_blocks) -> symbol, shapes_fn(batch) -> dict)."""
+    """(build_fn(remat_blocks, experts, capacity_factor) -> symbol,
+    shapes_fn(batch) -> dict).  ``experts`` / ``capacity_factor`` are
+    the MoE axes: on the transformer builders they swap every FFN for a
+    routed expert block (ops/moe.py); the conv models reject them."""
     m = _RESNET_RE.match(model)
     if m:
         layers = int(m.group(1))
 
-        def build(remat):
+        def build(remat, experts=None, capacity_factor=None):
+            if experts:
+                raise ValueError("model %r has no MoE variant (axis "
+                                 "experts=%s)" % (model, experts))
             from ..models import resnet
             return resnet.get_symbol(num_classes=1000, num_layers=layers,
                                      mirror_blocks=remat)
 
         return build, lambda b: {"data": (b, 3, 224, 224)}
-    if model == "transformer":
-        def build(remat):
-            from ..models import transformer
+    if model in ("transformer", "transformer_moe"):
+        def build(remat, experts=None, capacity_factor=None):
+            from ..models import transformer, transformer_moe
+            if model == "transformer_moe":
+                kw = {}
+                if experts:
+                    kw["num_experts"] = int(experts)
+                if capacity_factor:
+                    kw["moe_capacity_factor"] = float(capacity_factor)
+                return transformer_moe.get_symbol(mirror_blocks=remat,
+                                                  **kw)
+            if experts:
+                return transformer.get_symbol(
+                    mirror_blocks=remat, num_experts=int(experts),
+                    moe_capacity_factor=float(capacity_factor or 0.0))
             return transformer.get_symbol(mirror_blocks=remat)
 
         return build, lambda b: {"data": (b, 512)}
-    raise ValueError("unknown model %r (resnetNN or transformer)"
-                     % (model,))
+    raise ValueError("unknown model %r (resnetNN, transformer or "
+                     "transformer_moe)" % (model,))
 
 
 # ---------------------------------------------------------------------
@@ -213,11 +234,12 @@ class GraphMemo(object):
         self._ctxs = {}
         self.stats = {"symbols_built": 0, "analyses": 0, "memo_hits": 0}
 
-    def symbol(self, model, remat):
-        key = (model, remat)
+    def symbol(self, model, remat, experts=None, capacity_factor=None):
+        key = (model, remat, experts, capacity_factor)
         if key not in self._symbols:
             build, _shapes = _model_builder(model)
-            self._symbols[key] = build(remat == "blocks")
+            self._symbols[key] = build(remat == "blocks", experts,
+                                       capacity_factor)
             self.stats["symbols_built"] += 1
         return self._symbols[key]
 
@@ -225,7 +247,9 @@ class GraphMemo(object):
     def graph_key(model, config):
         """The axes that change the analyzed graph or its pricing."""
         return (model, config["batch"], config["remat"],
-                config["dtype"], config["sharding"])
+                config["dtype"], config["sharding"],
+                config.get("stages"), config.get("microbatches"),
+                config.get("experts"), config.get("capacity_factor"))
 
     def ctx(self, model, config):
         key = self.graph_key(model, config)
@@ -234,10 +258,15 @@ class GraphMemo(object):
             self.stats["memo_hits"] += 1
             return self._ctxs[key]
         self.stats["analyses"] += 1
-        sym = self.symbol(model, config["remat"])
+        sym = self.symbol(model, config["remat"],
+                          config.get("experts"),
+                          config.get("capacity_factor"))
         _build, shapes_fn = _model_builder(model)
         deg = parse_sharding(config["sharding"])
-        world = deg["dp"] * deg["tp"]
+        # an explicit "stages" axis pipelines without a pp mesh entry
+        # in the sharding rule; both spell the same pipeline degree
+        pp = int(config.get("stages") or deg["pp"])
+        world = deg["dp"] * deg["tp"] * pp * deg["ep"]
         mesh = None
         if world > 1:
             from ..parallel.mesh import LogicalMesh
@@ -246,6 +275,10 @@ class GraphMemo(object):
                 axes["dp"] = deg["dp"]
             if deg["tp"] > 1:
                 axes["tp"] = deg["tp"]
+            if pp > 1:
+                axes["pp"] = pp
+            if deg["ep"] > 1:
+                axes["ep"] = deg["ep"]
             mesh = LogicalMesh(**axes)
         # int8 is the quantized *serving* axis: price the graph in
         # inference mode (no grads, no param-update traffic) at the
@@ -258,6 +291,11 @@ class GraphMemo(object):
                               compute_dtype=config["dtype"],
                               device_kind=self.device_kind,
                               hbm_bytes=self.hbm_bytes)
+        # MXL-E reads the microbatch count off the context (overrides
+        # the MXTPU_LINT_MICROBATCHES default)
+        mb = config.get("microbatches")
+        if mb:
+            ctx.schedule_microbatches = int(mb)
         self._ctxs[key] = ctx
         return ctx
 
@@ -277,8 +315,13 @@ def predicted_peak_hbm(config, mem):
     (ZeRO-3)."""
     deg = parse_sharding(config["sharding"])
     credit = _env_float("MXTPU_AUTOTUNE_ACT_CREDIT", 0.2)
+    pp = int(config.get("stages") or deg["pp"])
     act_div = max(1, deg["dp"] * deg["tp"])
-    state_div = max(1, deg["tp"] * (deg["dp"] if deg["fsdp"] else 1))
+    # pp splits the layer stack (each stage holds ~1/pp of the params);
+    # ep shards the expert stacks, which this model treats as the bulk
+    # of an MoE config's state
+    state_div = max(1, deg["tp"] * pp * deg["ep"]
+                    * (deg["dp"] if deg["fsdp"] else 1))
     state = (mem["params_bytes"] + mem["grads_bytes"]
              + mem["aux_bytes"]) / float(state_div)
     act = mem["activations_bytes"] * credit / float(act_div)
@@ -299,13 +342,18 @@ def _serve_block_findings(config):
 def prune_config(model, config, memo, budget_bytes):
     """The feasibility gates, cheap-to-expensive, run BEFORE any
     pricing: returns ``None`` for a feasible config, else a
-    ``"mxl-k: ..." | "mxl-m: ..." | "mxl-d: ..."`` reason string.
+    ``"mxl-k: ..." | "mxl-m: ..." | "mxl-e: ..." | "mxl-d: ..."``
+    reason string.
     """
     # 1. MXL-K tile legality — needs no graph at all
     bad = _serve_block_findings(config)
     if bad:
         return "mxl-k: %s" % bad[0][2]
-    ctx = memo.ctx(model, config)
+    try:
+        ctx = memo.ctx(model, config)
+    except ValueError as exc:
+        # e.g. an "experts" axis on a model with no MoE variant
+        return "build: %s" % exc
     # 2. MXL-M HBM fit — memory report only, roofline never touched
     if budget_bytes:
         mem = peak_hbm_report(ctx)
@@ -314,9 +362,21 @@ def prune_config(model, config, memo, budget_bytes):
             return ("mxl-m: predicted peak %.1f GB > %.1f GB %s HBM"
                     % (pred / 1e9, budget_bytes / 1e9,
                        memo.device_kind))
-    # 3. MXL-D distributed lint — sharded configs only
     deg = parse_sharding(config["sharding"])
-    if deg["dp"] * deg["tp"] > 1:
+    # 3. MXL-E schedule lint — pipeline/MoE configs only: an imbalanced
+    # partition, a deadlocking back-edge, an over-budget 1F1B stash or
+    # an indivisible expert count never reaches pricing (or a chip)
+    pp = int(config.get("stages") or deg["pp"])
+    if pp > 1 or deg["ep"] > 1 or config.get("experts"):
+        if "autotune_mxl_e" not in ctx.cache:
+            issues = run_rules(ctx, select=("MXL-E*",))
+            ctx.cache["autotune_mxl_e"] = [
+                i for i in issues if i.severity == "error"]
+        errors = ctx.cache["autotune_mxl_e"]
+        if errors:
+            return "mxl-e: %s" % errors[0].message
+    # 4. MXL-D distributed lint — sharded configs only
+    if deg["dp"] * deg["tp"] * pp * deg["ep"] > 1:
         if "autotune_mxl_d" not in ctx.cache:
             issues = run_rules(ctx, select=("MXL-D*",))
             ctx.cache["autotune_mxl_d"] = [
@@ -360,14 +420,16 @@ def _recompute_flops(ctx):
 
 def price_config(model, config, memo, budget_bytes):
     """Static price for a feasible config: MFU ceiling (remat pays its
-    recompute replay in the time term but earns no useful-FLOP credit),
-    per-device step-time floor, throughput ceiling, predicted peak HBM
-    + headroom, and ICI bytes for sharded configs."""
+    recompute replay in the time term but earns no useful-FLOP credit;
+    a pipeline config pays its 1F1B bubble), per-device step-time
+    floor, throughput ceiling, predicted peak HBM + headroom, and ICI
+    bytes for sharded configs."""
     ctx = memo.ctx(model, config)
     rep = roofline_report(ctx)
     mem = peak_hbm_report(ctx)
     deg = parse_sharding(config["sharding"])
-    world = max(1, deg["dp"] * deg["tp"])
+    pp = int(config.get("stages") or deg["pp"])
+    world = max(1, deg["dp"] * deg["tp"] * pp * deg["ep"])
     pred_peak = predicted_peak_hbm(config, mem)
     out = {
         "mfu_ceiling": rep["mfu_ceiling"],
@@ -382,7 +444,19 @@ def price_config(model, config, memo, budget_bytes):
         "ici_bytes": 0,
         "step_ms_floor": None,
         "samples_per_sec_ceiling": None,
+        "bubble_fraction": None,
     }
+    # a pipelined config idles (1 - bubble) of each stage away: the
+    # MXL-E simulator's 1F1B bubble scales the ceiling down and the
+    # step floor up (same slot-synchronous model the lint validates)
+    bubble = 0.0
+    if pp > 1:
+        from .schedule import schedule_report
+        sched = schedule_report(ctx)
+        if sched and sched.get("schedules"):
+            bubble = float(
+                sched["schedules"]["1f1b"]["bubble_fraction"])
+            out["bubble_fraction"] = round(bubble, 4)
     peak_f = (rep["peak_tflops"] or 0) * 1e12
     peak_b = (rep["peak_hbm_gbps"] or 0) * 1e9
     if peak_f and peak_b:
@@ -391,6 +465,8 @@ def price_config(model, config, memo, budget_bytes):
         extra = _recompute_flops(ctx) / world \
             if config["remat"] == "blocks" else 0.0
         t = max((flops + extra) / peak_f, byts / peak_b)
+        if 0.0 < bubble < 1.0:
+            t /= (1.0 - bubble)
         out["step_ms_floor"] = round(t * 1e3, 3)
         out["samples_per_sec_ceiling"] = round(config["batch"] / t, 1)
         out["mfu_ceiling"] = round(flops / (t * peak_f), 4)
@@ -502,6 +578,16 @@ def bench_command(model, config, cid):
         env.append(("MXTPU_SERVE_BLOCK", config["serve_block"]))
     if config.get("serve_buckets"):
         env.append(("MXTPU_SERVE_BUCKETS", config["serve_buckets"]))
+    pp = int(config.get("stages") or deg["pp"])
+    if pp > 1:
+        env.append(("BENCH_PP_STAGES", pp))
+        env.append(("BENCH_MICROBATCHES",
+                    config.get("microbatches") or 8))
+    if config.get("experts"):
+        env.append(("BENCH_MOE_EXPERTS", config["experts"]))
+        if config.get("capacity_factor"):
+            env.append(("BENCH_MOE_CAPACITY",
+                        config["capacity_factor"]))
     env.append(("BENCH_AUTOTUNE_CONFIG_ID", cid))
     return " ".join("%s=%s" % (k, v) for k, v in env) + " python bench.py"
 

@@ -51,7 +51,7 @@ COLLECTIVE_ATTR = "__collective__"
 
 # kinds the propagation events carry -> the collective each lowers to
 _KIND_NAMES = {"reduce": "allreduce", "gather": "allgather",
-               "reshard": "alltoall"}
+               "reshard": "alltoall", "alltoall": "alltoall"}
 
 
 # ----------------------------------------------------------------------

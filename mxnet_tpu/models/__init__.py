@@ -23,6 +23,7 @@ from . import gru
 from . import rnn
 
 from . import transformer
+from . import transformer_moe
 from .mlp import get_symbol as get_mlp
 from .lenet import get_symbol as get_lenet
 from .alexnet import get_symbol as get_alexnet
@@ -32,7 +33,8 @@ from .inception_bn import get_symbol as get_inception_bn
 from .inception_v3 import get_symbol as get_inception_v3
 from .resnet import get_symbol as get_resnet
 
-__all__ = ["transformer", "mlp", "lenet", "alexnet", "vgg", "googlenet",
+__all__ = ["transformer", "transformer_moe", "mlp", "lenet", "alexnet",
+           "vgg", "googlenet",
            "inception_bn", "inception_v3", "resnet", "lstm", "gru", "rnn",
            "get_mlp", "get_lenet", "get_alexnet", "get_vgg",
            "get_googlenet", "get_inception_bn", "get_inception_v3",

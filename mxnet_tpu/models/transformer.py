@@ -12,25 +12,48 @@ from .. import symbol as sym
 
 
 def transformer_block(x, name, num_heads, dim, seq_len, ffn_mult=4,
-                      dropout=0.0, causal=True):
+                      dropout=0.0, causal=True, num_experts=0,
+                      moe_top_k=1, moe_capacity_factor=0.0):
+    """One decoder layer.  ``num_experts > 0`` swaps the dense FFN for a
+    routed MoE FFN (``ops/moe.py``: top-k gating, optional capacity
+    factor) — the Switch-Transformer layer shape; the aux load-balance
+    output is dropped at the symbol level (the trainer's loss already
+    carries the head loss; wire it in explicitly when training MoE for
+    real)."""
     ln1 = sym.LayerNorm(data=x, name="%s_ln1" % name)
     att = sym.MultiHeadAttention(data=ln1, num_heads=num_heads,
                                  causal=causal, dropout=dropout,
                                  name="%s_att" % name)
     x = x + att
     ln2 = sym.LayerNorm(data=x, name="%s_ln2" % name)
-    h = sym.FullyConnected(data=sym.Reshape(data=ln2, shape=(-1, dim)),
-                           num_hidden=ffn_mult * dim, name="%s_ffn1" % name)
-    h = sym.Activation(data=h, act_type="relu")
-    h = sym.FullyConnected(data=h, num_hidden=dim, name="%s_ffn2" % name)
+    if num_experts:
+        moe = sym.MoE(data=sym.Reshape(data=ln2, shape=(-1, dim)),
+                      num_experts=num_experts,
+                      hidden_size=ffn_mult * dim, top_k=moe_top_k,
+                      capacity_factor=moe_capacity_factor,
+                      name="%s_moe" % name)
+        h = moe[0]
+    else:
+        h = sym.FullyConnected(data=sym.Reshape(data=ln2, shape=(-1, dim)),
+                               num_hidden=ffn_mult * dim,
+                               name="%s_ffn1" % name)
+        h = sym.Activation(data=h, act_type="relu")
+        h = sym.FullyConnected(data=h, num_hidden=dim,
+                               name="%s_ffn2" % name)
     h = sym.Reshape(data=h, shape=(-1, seq_len, dim),
                     name="%s_ffn_out" % name)
     return x + h
 
 
 def get_symbol(vocab_size=32000, num_layers=4, num_heads=8, dim=256,
-               seq_len=512, ffn_mult=4, dropout=0.0, mirror_blocks=False):
+               seq_len=512, ffn_mult=4, dropout=0.0, mirror_blocks=False,
+               num_experts=0, moe_top_k=1, moe_capacity_factor=0.0):
     """LM symbol: data (B, S) token ids, softmax_label (B, S) next tokens.
+
+    ``num_experts > 0`` builds the MoE variant: every layer's FFN becomes
+    a routed ``layer%d_moe`` expert block whose ``*_expert_*`` weights
+    shard over an ``ep`` mesh axis (parallel.param_pspec matches the
+    names).
 
     ``mirror_blocks=True`` tags every op inside each decoder layer with
     ``force_mirroring`` + a per-layer ``mirror_stage`` (same mechanism
@@ -53,7 +76,10 @@ def get_symbol(vocab_size=32000, num_layers=4, num_heads=8, dim=256,
         with layer_scope("layer%d" % i):
             x = transformer_block(x, "layer%d" % i, num_heads, dim,
                                   seq_len, ffn_mult=ffn_mult,
-                                  dropout=dropout)
+                                  dropout=dropout,
+                                  num_experts=num_experts,
+                                  moe_top_k=moe_top_k,
+                                  moe_capacity_factor=moe_capacity_factor)
     x = sym.LayerNorm(data=x, name="final_ln")
     logits = sym.FullyConnected(
         data=sym.Reshape(data=x, shape=(-1, dim)),

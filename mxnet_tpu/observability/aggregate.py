@@ -520,6 +520,30 @@ def build_report(records, now=None):
             "sites": sorted({r.get("site") for r in retraces
                              if r.get("site")})[:8],
         }
+    # pipeline-schedule rollup (docs/graph_lint.md "MXL-E"): the
+    # GPipe/1F1B shape + measured bubble fraction the GPipeTrainer
+    # emits once on first build, and the expert load balance when an
+    # MoE run reports one.  String-tolerant — these round-trip through
+    # shell/env in the drills, so "0.33" reads like 0.33 and junk is
+    # dropped rather than crashed on.
+    scheds = [r for r in records if r.get("kind") == "schedule"]
+    if scheds:
+        def _flt(v):
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                return None
+        last = scheds[-1]
+        sched = {"schedule": str(last.get("schedule") or "?")}
+        for key in ("stages", "microbatches"):
+            n = _flt(last.get(key))
+            if n is not None:
+                sched[key] = int(n)
+        for key in ("bubble_fraction", "expert_balance"):
+            v = _flt(last.get(key))
+            if v is not None:
+                sched[key] = v
+        out["schedule"] = sched
     # SLO rollup (observability/sloengine.py): alert edges and scale
     # recommendations, when the live engine emitted any — what the
     # mxtop SLO pane renders post-hoc

@@ -1,32 +1,79 @@
 """Mixture-of-Experts FFN with expert parallelism.
 
 Beyond-reference (SURVEY's parallelism table lists expert parallelism as
-absent from the reference): a Switch-style top-1 routed FFN whose expert
+absent from the reference): a Switch-style routed FFN whose expert
 weights carry a leading ``num_experts`` axis — shard that axis over an
 ``ep`` mesh dimension (parallel.param_pspec does it by name) and GSPMD
 partitions the expert einsums across ranks, inserting the combine
 collective where the routed outputs merge.
 
-The dispatch is the dense einsum formulation (every expert computes every
-token, the routing mask selects): no dynamic shapes, no sorting — the
-XLA-friendly form for moderate expert counts.  Gate gradients flow
-through the top-1 probability scaling (Switch Transformer's trick);
-the op also returns the load-balance auxiliary loss as a second output
-(fraction·probability dot product, Switch eq. 4) so trainers can add it.
+The op's dispatch is the dense einsum formulation (every expert computes
+every token, the routing mask selects): no dynamic shapes, no sorting —
+the XLA-friendly form for moderate expert counts.  ``top_k`` experts per
+token (Switch's top-1 by default; GShard-style top-2+ scales each hit by
+its gate probability), and an optional ``capacity_factor``: each expert
+accepts at most ``ceil(cf * T * top_k / K)`` tokens, overflow tokens are
+dropped from that expert (their residual path carries them — Switch §2.2)
+— the token-drop risk MXL-E007 lints.  Gate gradients flow through the
+probability scaling; the op returns the load-balance auxiliary loss as a
+second output (fraction·probability dot product, Switch eq. 4).
+
+:func:`expert_parallel_moe` is the explicit shard_map form of the same
+block: tokens and experts both sharded over ``ep``, dispatch and combine
+each one ``lax.all_to_all`` — the collective pair MXL-E008 prices per
+rank and replays through the MXL-D trace diff.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..base import MXNetError
 from ..dparam import Field, ParamStruct
-from .registry import OperatorProperty, register_op, require_known
+from .registry import (OperatorProperty, register_cost_rule, register_op,
+                       register_sharding_rule, require_known)
+
+
+def moe_capacity(tokens, num_experts, top_k=1, capacity_factor=0.0):
+    """Per-expert token capacity: ``ceil(cf * T * top_k / K)``, or 0
+    meaning unbounded (``capacity_factor`` unset)."""
+    if not capacity_factor or capacity_factor <= 0:
+        return 0
+    import math
+    return int(math.ceil(int(tokens) * int(top_k) *
+                         float(capacity_factor) / int(num_experts)))
+
+
+def _routing(t, wg, num_experts, top_k, capacity_factor):
+    """Shared gating math: returns ``(probs, mask, combine)`` where
+    ``mask`` is the {0,1} token->expert assignment after any capacity
+    drop and ``combine = probs * mask`` the combine weights."""
+    K, topk = num_experts, min(int(top_k), num_experts)
+    logits = t @ wg.T                               # (T, K)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if topk == 1:
+        sel = jnp.argmax(probs, axis=-1)            # (T,)
+        mask = jax.nn.one_hot(sel, K, dtype=t.dtype)
+    else:
+        _, inds = lax.top_k(probs, topk)            # (T, topk)
+        mask = jnp.sum(jax.nn.one_hot(inds, K, dtype=t.dtype), axis=1)
+    cap = moe_capacity(t.shape[0], K, topk, capacity_factor)
+    if cap:
+        pos = jnp.cumsum(mask, axis=0) - mask       # queue position
+        mask = mask * (pos < cap).astype(t.dtype)
+    return probs, mask, probs * mask
 
 
 class _MoEParam(ParamStruct):
     num_experts = Field(int, required=True, lower=2)
     hidden_size = Field(int, required=True, lower=1)
+    top_k = Field(int, default=1, lower=1,
+                  doc="experts per token (Switch=1, GShard-style=2+)")
+    capacity_factor = Field(
+        float, default=0.0, lower=0.0,
+        doc="per-expert capacity = ceil(cf*T*top_k/K); 0 = unbounded "
+            "(overflow tokens are dropped from the expert)")
 
 
 @register_op("MoE", aliases=("SwitchFFN",))
@@ -50,28 +97,120 @@ class MoE(OperatorProperty):
             raise MXNetError("MoE: data must be (..., embed)")
         E = data[-1]
         K, H = self.param.num_experts, self.param.hidden_size
+        if self.param.top_k > K:
+            raise MXNetError("MoE: top_k (%d) > num_experts (%d)"
+                             % (self.param.top_k, K))
         return ([data, (K, E), (K, H, E), (K, H), (K, E, H), (K, E)],
                 [data, (1,)], [])
 
     def forward(self, inputs, aux, is_train, rng):
         x, wg, w1, b1, w2, b2 = inputs
         K = self.param.num_experts
+        topk = min(self.param.top_k, K)
         shape = x.shape
         t = x.reshape(-1, shape[-1])                    # (T, E)
-        logits = t @ wg.T                               # (T, K)
-        probs = jax.nn.softmax(logits, axis=-1)
-        top1 = jnp.argmax(probs, axis=-1)               # (T,)
-        mask = jax.nn.one_hot(top1, K, dtype=t.dtype)   # (T, K)
-        # switch gating: scale by the (differentiable) top-1 probability
-        gate = jnp.sum(mask * probs, axis=-1)           # (T,)
+        probs, mask, combine = _routing(
+            t, wg, K, topk, self.param.capacity_factor)
 
         h = jnp.einsum("te,khe->tkh", t, w1) + b1[None]
         h = jax.nn.relu(h)
         y = jnp.einsum("tkh,keh->tke", h, w2) + b2[None]
-        out = jnp.einsum("tke,tk->te", y, mask) * gate[:, None]
+        out = jnp.einsum("tke,tk->te", y, combine)
 
-        # load-balance aux (Switch eq. 4): K * <fraction, mean prob>
-        frac = jnp.mean(mask, axis=0)
+        # load-balance aux (Switch eq. 4): K * <fraction, mean prob>;
+        # fractions normalized by top_k so a balanced router scores 1
+        frac = jnp.mean(mask, axis=0) / topk
         mean_p = jnp.mean(probs, axis=0)
         aux_loss = (K * jnp.sum(frac * mean_p)).reshape(1)
         return [out.reshape(shape), aux_loss], None
+
+
+def expert_parallel_moe(x, wg, w1, b1, w2, b2, *, axis="ep", top_k=1,
+                        capacity_factor=1.25):
+    """Expert-parallel MoE block — CALL INSIDE shard_map over ``axis``.
+
+    ``x`` is this member's token shard ``(..., E)``; ``w1/b1/w2/b2`` are
+    the member's expert shard (leading dim ``K/ep``); ``wg`` is the full
+    replicated gate ``(K, E)``.  Routing is computed locally, tokens are
+    packed into per-expert capacity slots and exchanged with one
+    ``lax.all_to_all`` (dispatch), the local experts run, and a second
+    ``all_to_all`` returns the routed outputs (combine) — the exact
+    collective pair the MXL-E008 lint prices.  Per-member capacity is
+    ``ceil(cf * T_local * top_k / K)``; a ``capacity_factor`` is
+    REQUIRED here (the packed exchange needs a static slot count).
+
+    Matches the dense :class:`MoE` forward applied per member shard with
+    the same capacity factor.
+    """
+    if not capacity_factor or capacity_factor <= 0:
+        raise ValueError("expert_parallel_moe needs capacity_factor > 0")
+    from ..parallel.pipeline import _axis_size
+    ep = _axis_size(axis)
+    K = wg.shape[0]
+    k_local = w1.shape[0]
+    if k_local * ep != K:
+        raise ValueError("expert shard (%d) * ep (%d) != num_experts "
+                         "(%d)" % (k_local, ep, K))
+    shape = x.shape
+    t = x.reshape(-1, shape[-1])                        # (Tl, E)
+    probs, mask, combine = _routing(t, wg, K, top_k, capacity_factor)
+    cap = moe_capacity(t.shape[0], K, top_k, capacity_factor)
+    pos = jnp.cumsum(mask, axis=0) - mask
+    # dispatch tensor (Tl, K, C): one-hot capacity slot per assignment
+    dis = mask[:, :, None] * jax.nn.one_hot(pos, cap, dtype=t.dtype)
+    expert_in = jnp.einsum("tkc,te->kce", dis, t)       # (K, C, E)
+    # exchange: split experts across members, gather my experts' slots
+    # from every member along the capacity dim -> (K/ep, ep*C, E)
+    expert_in = lax.all_to_all(expert_in, axis, 0, 1, tiled=True)
+    h = jax.nn.relu(
+        jnp.einsum("kce,khe->kch", expert_in, w1) + b1[:, None, :])
+    y = jnp.einsum("kch,keh->kce", h, w2) + b2[:, None, :]
+    # return each member's slots to the token owner -> (K, C, E)
+    y = lax.all_to_all(y, axis, 1, 0, tiled=True)
+    out = jnp.einsum("tkc,kce->te", dis * combine[:, :, None], y)
+    frac = jnp.mean(mask, axis=0) / min(int(top_k), K)
+    aux_loss = K * jnp.sum(frac * jnp.mean(probs, axis=0))
+    return out.reshape(shape), aux_loss
+
+
+@register_sharding_rule("MoE")
+def _moe_transfer(op, in_specs, in_shapes, out_shapes, mesh_shape):
+    """Output follows the data spec; expert weights sharded over an
+    expert-parallel axis turn the routed dispatch/combine into the
+    all-to-all pair (priced per device like every reshard: each member
+    keeps 1/ep of its tokens locally)."""
+    data_spec = tuple(in_specs[0] or ())
+    w1_spec = tuple(in_specs[2] or ())
+    ep_axes = tuple(w1_spec[0]) if w1_spec else ()
+    notes = []
+    if ep_axes:
+        for leg in ("dispatch", "combine"):
+            notes.append({
+                "kind": "alltoall", "arg": 0, "axes": ep_axes,
+                "message": "MoE expert %s: routed tokens exchanged "
+                           "with the %s expert shards over an "
+                           "all-to-all" % (leg, "+".join(ep_axes))})
+    aux_rank = len(out_shapes[1]) if len(out_shapes) > 1 and \
+        out_shapes[1] is not None else 1
+    return {"out": [data_spec, ((),) * aux_rank], "notes": notes}
+
+
+@register_cost_rule("MoE")
+def _moe_cost(op, in_shapes, out_shapes):
+    """Price the ROUTED execution plan (each token visits ``top_k``
+    experts), not the dense einsum the CPU reference computes — the
+    TPU plan the analyzer validates is the expert-parallel one."""
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    T = 1
+    for d in data[:-1]:
+        T *= int(d)
+    E = int(data[-1])
+    K = int(op.param.num_experts)
+    H = int(op.param.hidden_size)
+    topk = min(int(op.param.top_k), K)
+    gate = 2.0 * T * K * E
+    ffn = 2.0 * T * topk * E * H * 2
+    return {"flops": gate + ffn, "mxu": True,
+            "mxu_dims": [(T * topk, E, H), (T * topk, H, E)]}

@@ -15,9 +15,11 @@ Axes (by convention): ``dp`` data, ``tp`` tensor, ``pp`` pipeline,
 """
 from .mesh import (make_mesh, auto_mesh, local_device_count, LogicalMesh,
                    remesh)
-from .sharding import ShardingRules, param_pspec, batch_pspec, named_pspecs
+from .sharding import (ShardingRules, param_pspec, batch_pspec,
+                       named_pspecs, parse_sharding)
 from .trainer import ShardedTrainer, ShardedPredictor
-from .pipeline import GPipeTrainer, pipeline_apply
+from .pipeline import (GPipeTrainer, pipeline_apply, build_1f1b_tables,
+                       schedule_occupancy)
 from .overlap import (DevicePrefetcher, AsyncLauncher, partition_buckets,
                       interleave_grad_buckets, prefetch_enabled,
                       prefetch_depth, bucket_bytes, compile_cache_stats,
@@ -26,8 +28,9 @@ from .overlap import (DevicePrefetcher, AsyncLauncher, partition_buckets,
 __all__ = ["make_mesh", "auto_mesh", "local_device_count", "LogicalMesh",
            "remesh",
            "ShardingRules", "param_pspec", "batch_pspec", "named_pspecs",
+           "parse_sharding",
            "ShardedTrainer", "ShardedPredictor", "GPipeTrainer",
-           "pipeline_apply",
+           "pipeline_apply", "build_1f1b_tables", "schedule_occupancy",
            "DevicePrefetcher", "AsyncLauncher", "partition_buckets",
            "interleave_grad_buckets", "prefetch_enabled", "prefetch_depth",
            "bucket_bytes", "compile_cache_stats", "compile_cache_clear",

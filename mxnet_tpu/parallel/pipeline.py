@@ -42,11 +42,115 @@ except ImportError:  # older jax: kwarg is check_rep, not check_vma
 
 from .mesh import make_mesh  # noqa: F401  (re-exported convenience)
 
-__all__ = ["pipeline_apply", "GPipeTrainer"]
+__all__ = ["pipeline_apply", "GPipeTrainer", "build_1f1b_tables",
+           "schedule_occupancy"]
 
 
 def _identity_perm(k):
     return [(i, (i + 1) % k) for i in range(k)]
+
+
+def _axis_size(axis):
+    """Static size of a named mesh axis from inside shard_map.
+    ``lax.axis_size`` only exists in newer jax; older versions expose
+    the bound axis env through ``jax.core.axis_frame`` (which returns
+    either the size itself or a frame carrying it)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    import jax.core as _core
+    frame = _core.axis_frame(axis)
+    return frame if isinstance(frame, int) else frame.size
+
+
+def _reverse_perm(k):
+    return [(i, (i - 1) % k) for i in range(k)]
+
+
+# ----------------------------------------------------------------------
+# 1F1B (one-forward-one-backward) schedule tables
+# ----------------------------------------------------------------------
+def build_1f1b_tables(k, m):
+    """Lock-step 1F1B schedule for ``k`` stages x ``m`` microbatches.
+
+    Returns ``(kind, mb)`` numpy int32 arrays of shape ``[S, k]`` where
+    slot table entry ``kind[t, s]`` is 0 idle / 1 forward / 2 backward
+    (mid stage) / 3 backward (last stage, initiates the microbatch's
+    gradient from its loss) and ``mb[t, s]`` the microbatch index.
+
+    Construction is the standard synchronous 1F1B greedy: each stage
+    prefers a backward whose gradient has arrived, else a forward whose
+    activation has arrived — capped at ``k - s`` in-flight microbatches
+    (the activation stash the analyzer prices).  A payload sent at slot
+    ``t`` is usable from slot ``t + 1`` (one ``ppermute`` per slot).
+    """
+    k, m = int(k), int(m)
+    if k < 1 or m < 1:
+        raise ValueError("1F1B needs k >= 1 stages and m >= 1 "
+                         "microbatches (got k=%d m=%d)" % (k, m))
+    f_slot = [[None] * m for _ in range(k)]
+    b_slot = [[None] * m for _ in range(k)]
+    f_done = [0] * k
+    b_done = [0] * k
+    kind_rows, mb_rows = [], []
+    t = 0
+    while min(b_done) < m:
+        krow, mrow = [0] * k, [0] * k
+        for s in range(k):
+            jb, jf = b_done[s], f_done[s]
+            can_b = jb < m and (
+                (s == k - 1 and f_slot[s][jb] is not None
+                 and f_slot[s][jb] < t) or
+                (s < k - 1 and b_slot[s + 1][jb] is not None
+                 and b_slot[s + 1][jb] < t))
+            can_f = jf < m and (f_done[s] - b_done[s]) < (k - s) and (
+                s == 0 or (f_slot[s - 1][jf] is not None
+                           and f_slot[s - 1][jf] < t))
+            if can_b:
+                krow[s] = 3 if s == k - 1 else 2
+                mrow[s] = jb
+                b_slot[s][jb] = t
+                b_done[s] += 1
+            elif can_f:
+                krow[s] = 1
+                mrow[s] = jf
+                f_slot[s][jf] = t
+                f_done[s] += 1
+        kind_rows.append(krow)
+        mb_rows.append(mrow)
+        t += 1
+        if t > 4 * (m + k) + 8:  # the greedy above always terminates;
+            raise RuntimeError(   # belt-and-braces against table bugs
+                "1F1B schedule did not converge for k=%d m=%d" % (k, m))
+    return (_np.asarray(kind_rows, dtype=_np.int32),
+            _np.asarray(mb_rows, dtype=_np.int32))
+
+
+def schedule_occupancy(k, m, schedule="1f1b", fwd_time=1.0, bwd_time=2.0):
+    """Measured bubble fraction of the lock-step schedule the trainer
+    actually executes: slot-occupancy of the compiled program's static
+    tables, time-weighted (backward ~ 2x forward by default), with each
+    slot's wall time set by its slowest member (the per-slot
+    ``ppermute`` is a barrier).  Independent of the analyzer's
+    event-driven simulator — the CPU-mesh drill compares the two."""
+    if schedule == "1f1b":
+        kind, _ = build_1f1b_tables(k, m)
+    elif schedule == "gpipe":
+        # GPipe: m+k-1 fill/drain fwd ticks then the mirrored bwd ticks
+        kind = _np.zeros((2 * (m + k - 1), k), dtype=_np.int32)
+        for t in range(m + k - 1):
+            for s in range(k):
+                if s <= t < s + m:
+                    kind[t, s] = 1
+                    kind[2 * (m + k - 1) - 1 - t, s] = 3
+    else:
+        raise ValueError("unknown schedule %r" % (schedule,))
+    w = _np.where(kind == 0, 0.0,
+                  _np.where(kind == 1, float(fwd_time), float(bwd_time)))
+    total = float(w.max(axis=1).sum())
+    busy = float(w.sum())
+    bubble = 1.0 - busy / (kind.shape[1] * total) if total else 0.0
+    return {"slots": int(kind.shape[0]), "busy_time": busy,
+            "total_time": total, "bubble_fraction": bubble}
 
 
 def pipeline_apply(block_fn, local_params, microbatches, *, axis="pp"):
@@ -64,7 +168,7 @@ def pipeline_apply(block_fn, local_params, microbatches, *, axis="pp"):
     the caller's psum/where; here we simply return what each member
     drained — the caller masks by axis_index == K-1).
     """
-    k = lax.axis_size(axis)
+    k = _axis_size(axis)
     idx = lax.axis_index(axis)
     m = microbatches.shape[0]
     ticks = m + k - 1
@@ -108,6 +212,131 @@ def pipeline_apply(block_fn, local_params, microbatches, *, axis="pp"):
     return outputs
 
 
+def _pipeline_1f1b(block_fn, layers_p, stream, batch_mbs, head_loss_fn,
+                   head_p, kind_tab, mb_tab, *, axis="pp"):
+    """Interleaved 1F1B forward+backward over the microbatch stream.
+    CALL INSIDE shard_map over ``axis``.
+
+    Walks the static slot tables from :func:`build_1f1b_tables`: each
+    slot a member runs one forward, one backward (recompute-based: the
+    stash holds stage INPUTS, ``K - stage_idx`` in flight, and backward
+    re-runs the local stack under ``jax.vjp``), or idles; activations
+    rotate forward and gradients rotate backward through one
+    ``ppermute`` pair per slot.  The last stage turns each drained
+    microbatch into its loss and seed gradient immediately (the 1F1B
+    point: drain backward work early, cap the stash).
+
+    Returns ``(loss_sum, g_layers, g_head, dstream)`` — per-member
+    partials: ``loss_sum``/``g_head`` live on the last member,
+    ``dstream`` (gradient w.r.t. the injected stream, ``[M, mb, ...]``)
+    on member 0, ``g_layers`` on every member for its own layers.  All
+    unscaled: the caller divides by M for the microbatch mean.
+    """
+    k = _axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = stream.shape[0]
+    depth = min(m, k + 1)  # stash ring: <= k in flight, +1 for the
+    kind_j = jnp.asarray(kind_tab)  # slot where an arrival overlaps a
+    mb_j = jnp.asarray(mb_tab)      # not-yet-drained predecessor
+
+    def local_stack(lp, h):
+        def body(carry, layer_params):
+            return block_fn(layer_params, carry), None
+        out, _ = lax.scan(body, h, lp)
+        return out
+
+    zero_mb = jnp.zeros_like(stream[0])
+    zeros_layers = jax.tree_util.tree_map(jnp.zeros_like, layers_p)
+    zeros_head = jax.tree_util.tree_map(jnp.zeros_like, head_p)
+
+    def slot(carry, t):
+        (stash, gstash, recv_h, recv_g, g_layers, g_head, loss_sum,
+         dstream) = carry
+        my_kind = kind_j[t, idx]
+        j = mb_j[t, idx]
+        # -- arrivals sent at slot t-1 go straight into the rings -----
+        tm1 = jnp.maximum(t - 1, 0)
+        pidx, nidx = (idx - 1) % k, (idx + 1) % k
+        pk, pj = kind_j[tm1, pidx], mb_j[tm1, pidx]
+        store_f = (t > 0) & (idx > 0) & (pk == 1)
+        cur = lax.dynamic_index_in_dim(stash, pj % depth, 0,
+                                       keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(store_f, recv_h, cur), pj % depth, 0)
+        nk, nj = kind_j[tm1, nidx], mb_j[tm1, nidx]
+        store_g = (t > 0) & (idx < k - 1) & (nk >= 2)
+        curg = lax.dynamic_index_in_dim(gstash, nj % depth, 0,
+                                        keepdims=False)
+        gstash = lax.dynamic_update_index_in_dim(
+            gstash, jnp.where(store_g, recv_g, curg), nj % depth, 0)
+        # -- stage 0 injects (and stashes, for its own backward) ------
+        inject = lax.dynamic_index_in_dim(stream, j, 0, keepdims=False)
+        cur0 = lax.dynamic_index_in_dim(stash, j % depth, 0,
+                                        keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where((idx == 0) & (my_kind == 1), inject, cur0),
+            j % depth, 0)
+        x_b = lax.dynamic_index_in_dim(stash, j % depth, 0,
+                                       keepdims=False)
+        x_f = jnp.where(idx == 0, inject, x_b)
+        g_in = lax.dynamic_index_in_dim(gstash, j % depth, 0,
+                                        keepdims=False)
+        batch_mb = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, j, 0, keepdims=False),
+            batch_mbs)
+
+        def _idle(op):
+            return (zero_mb, zero_mb, zeros_layers, zeros_head,
+                    jnp.zeros((), stream.dtype))
+
+        def _fwd(op):
+            xf, _, _, _ = op
+            return (local_stack(layers_p, xf), zero_mb, zeros_layers,
+                    zeros_head, jnp.zeros((), stream.dtype))
+
+        def _bwd_mid(op):
+            _, xb, gi, _ = op
+            _, pull = jax.vjp(
+                lambda lp, xx: local_stack(lp, xx), layers_p, xb)
+            g_l, g_x = pull(gi)
+            return (zero_mb, g_x, g_l, zeros_head,
+                    jnp.zeros((), stream.dtype))
+
+        def _bwd_last(op):
+            _, xb, _, bmb = op
+            def f(lp, hp, xx):
+                return head_loss_fn(hp, local_stack(lp, xx), bmb)
+            loss_j, pull = jax.vjp(f, layers_p, head_p, xb)
+            g_l, g_h, g_x = pull(jnp.ones_like(loss_j))
+            return (zero_mb, g_x, g_l, g_h,
+                    loss_j.astype(stream.dtype))
+
+        h_send, g_send, g_l_d, g_h_d, loss_d = lax.switch(
+            my_kind, [_idle, _fwd, _bwd_mid, _bwd_last],
+            (x_f, x_b, g_in, batch_mb))
+        g_layers = jax.tree_util.tree_map(jnp.add, g_layers, g_l_d)
+        g_head = jax.tree_util.tree_map(jnp.add, g_head, g_h_d)
+        loss_sum = loss_sum + loss_d
+        # member 0's backward output is dLoss/d stream[j]
+        curd = lax.dynamic_index_in_dim(dstream, j, 0, keepdims=False)
+        dstream = lax.dynamic_update_index_in_dim(
+            dstream, jnp.where((idx == 0) & (my_kind == 2), g_send,
+                               curd), j, 0)
+        recv_h = lax.ppermute(h_send, axis, _identity_perm(k))
+        recv_g = lax.ppermute(g_send, axis, _reverse_perm(k))
+        return (stash, gstash, recv_h, recv_g, g_layers, g_head,
+                loss_sum, dstream), None
+
+    init = (jnp.zeros((depth,) + zero_mb.shape, zero_mb.dtype),
+            jnp.zeros((depth,) + zero_mb.shape, zero_mb.dtype),
+            zero_mb, zero_mb, zeros_layers, zeros_head,
+            jnp.zeros((), stream.dtype),
+            jnp.zeros((m,) + zero_mb.shape, zero_mb.dtype))
+    (_, _, _, _, g_layers, g_head, loss_sum, dstream), _ = lax.scan(
+        slot, init, jnp.arange(kind_tab.shape[0]))
+    return loss_sum, g_layers, g_head, dstream
+
+
 class GPipeTrainer:
     """Microbatched pipeline trainer for repeated-block models.
 
@@ -129,9 +358,15 @@ class GPipeTrainer:
     """
 
     def __init__(self, embed_fn, block_fn, head_loss_fn, params, mesh,
-                 optimizer, num_microbatches=4):
+                 optimizer, num_microbatches=4, schedule="gpipe"):
         if "pp" not in mesh.axis_names:
             raise ValueError("GPipeTrainer needs a 'pp' mesh axis")
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError("schedule must be 'gpipe' or '1f1b', got %r"
+                             % (schedule,))
+        if schedule == "1f1b" and mesh.shape["pp"] < 2:
+            raise ValueError("1f1b schedule needs pp >= 2")
+        self.schedule = schedule
         self.mesh = mesh
         self.pp = mesh.shape["pp"]
         self.dp = mesh.shape.get("dp", 1)
@@ -182,6 +417,8 @@ class GPipeTrainer:
 
     # -- the fused pipelined step --------------------------------------
     def _build(self):
+        if self.schedule == "1f1b":
+            return self._build_1f1b()
         mesh, m, pp, dp = self.mesh, self.m, self.pp, self.dp
         embed_fn, block_fn = self._embed_fn, self._block_fn
         head_loss_fn = self._head_loss_fn
@@ -234,6 +471,101 @@ class GPipeTrainer:
         donate = (0, 1)
         return jax.jit(step, donate_argnums=donate)
 
+    def _build_1f1b(self):
+        """The 1F1B step: same signature and update loop as the GPipe
+        path, but fwd+bwd run interleaved per microbatch through
+        :func:`_pipeline_1f1b` (manual vjp schedule) instead of
+        ``jax.value_and_grad`` over the fwd-only pipeline.  The loss is
+        the mean of per-microbatch head losses, accumulated in
+        microbatch order — bit-identical to
+        :meth:`sequential_loss_microbatched`."""
+        mesh, m, pp, dp = self.mesh, self.m, self.pp, self.dp
+        embed_fn, block_fn = self._embed_fn, self._block_fn
+        head_loss_fn = self._head_loss_fn
+        has_dp = "dp" in mesh.axis_names and dp > 1
+        batch_axes = ("dp",) if has_dp else ()
+        kind_tab, mb_tab = build_1f1b_tables(pp, m)
+
+        def loss_and_grads(params, batch):
+            def inner(embed_p, layers_p, head_p, local_batch):
+                k = _axis_size("pp")
+                idx = lax.axis_index("pp")
+                h = embed_fn(embed_p, local_batch)
+                mb = h.shape[0] // m
+                stream = h.reshape((m, mb) + h.shape[1:])
+                batch_mbs = jax.tree_util.tree_map(
+                    lambda a: a.reshape((m, a.shape[0] // m)
+                                        + a.shape[1:]), local_batch)
+                loss_sum, g_layers, g_head, dstream = _pipeline_1f1b(
+                    block_fn, layers_p, stream, batch_mbs, head_loss_fn,
+                    head_p, kind_tab, mb_tab)
+                # broadcast the single-member partials (masked psums add
+                # exact zeros from the other members)
+                loss = lax.psum(jnp.where(idx == k - 1, loss_sum, 0.0),
+                                "pp") / m
+                g_head = jax.tree_util.tree_map(
+                    lambda g: lax.psum(
+                        jnp.where(idx == k - 1, g, 0.0), "pp") / m,
+                    g_head)
+                dstream = lax.psum(jnp.where(idx == 0, dstream, 0.0),
+                                   "pp")
+                g_layers = jax.tree_util.tree_map(
+                    lambda g: g / m, g_layers)
+                # embed backward at the full local batch
+                _, pull_e = jax.vjp(
+                    lambda ep: embed_fn(ep, local_batch), embed_p)
+                (g_embed,) = pull_e(dstream.reshape(h.shape) / m)
+                grads = {"embed": g_embed, "layers": g_layers,
+                         "head": g_head}
+                if has_dp:
+                    loss = lax.pmean(loss, "dp")
+                    grads = jax.tree_util.tree_map(
+                        lambda g: lax.pmean(g, "dp"), grads)
+                return loss, grads
+
+            in_specs = (jax.tree_util.tree_map(lambda _: P(),
+                                               params["embed"]),
+                        jax.tree_util.tree_map(lambda _: P("pp"),
+                                               params["layers"]),
+                        jax.tree_util.tree_map(lambda _: P(),
+                                               params["head"]),
+                        jax.tree_util.tree_map(
+                            lambda _: P(*batch_axes), batch))
+            out_specs = (P(), {"embed": jax.tree_util.tree_map(
+                                   lambda _: P(), params["embed"]),
+                               "layers": jax.tree_util.tree_map(
+                                   lambda _: P("pp"), params["layers"]),
+                               "head": jax.tree_util.tree_map(
+                                   lambda _: P(), params["head"])})
+            fn = shard_map(inner, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs)
+            return fn(params["embed"], params["layers"], params["head"],
+                      batch)
+
+        opt_update = self.optimizer.update_fn
+        preprocess = self.optimizer._preprocess_grad
+
+        def step(params, opt_state, batch, lr, wd, num_update):
+            loss, grads = loss_and_grads(params, batch)
+            new_params, new_state = {}, {}
+            for k in params:
+                flat_p, treedef = jax.tree_util.tree_flatten(params[k])
+                flat_g = jax.tree_util.tree_leaves(grads[k])
+                outs = [opt_update(p, preprocess(g), s, lr, wd,
+                                   num_update)
+                        for p, g, s in zip(flat_p, flat_g, opt_state[k])]
+                new_params[k] = jax.tree_util.tree_unflatten(
+                    treedef, [o[0] for o in outs])
+                new_state[k] = [o[1] for o in outs]
+            return new_params, new_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def schedule_occupancy(self):
+        """Measured schedule occupancy (bubble fraction etc.) of the
+        lock-step tables this trainer's compiled step executes."""
+        return schedule_occupancy(self.pp, self.m, self.schedule)
+
     def step(self, batch):
         """One pipelined train step on a host batch dict; returns loss."""
         rows = jax.tree_util.tree_leaves(batch)[0].shape[0]
@@ -243,6 +575,16 @@ class GPipeTrainer:
                 "* dp (%d)" % (rows, self.m, self.dp))
         if self._jit_step is None:
             self._jit_step = self._build()
+            try:  # one schedule record per run, for mxtop/parse_log
+                from ..observability import events as _events
+                if _events.enabled():
+                    occ = self.schedule_occupancy()
+                    _events.emit("schedule", schedule=self.schedule,
+                                 stages=self.pp, microbatches=self.m,
+                                 bubble_fraction=round(
+                                     occ["bubble_fraction"], 4))
+            except Exception:
+                pass
         self.num_update += 1
         opt = self.optimizer
         lr = (opt.lr_scheduler(self.num_update)
@@ -287,7 +629,7 @@ class GPipeTrainer:
                           embed_fn, head_loss_fn, embed_params,
                           head_params, input_shape, data_name="data",
                           initializer=None, num_microbatches=4,
-                          seed=0):
+                          seed=0, schedule="gpipe"):
         """Build the pipeline from ONE block defined in the Symbol
         language: the block symbol (e.g. FC->Activation residual cell,
         or a transformer block built from mx.sym ops) is traced into
@@ -367,7 +709,8 @@ class GPipeTrainer:
         params = {"embed": embed_params, "layers": stacked,
                   "head": head_params}
         return cls(embed_fn, block_fn, head_loss_fn, params, mesh,
-                   optimizer, num_microbatches=num_microbatches)
+                   optimizer, num_microbatches=num_microbatches,
+                   schedule=schedule)
 
     # reference (unpipelined) loss for testing/validation
     def sequential_loss(self, batch):
@@ -380,4 +723,32 @@ class GPipeTrainer:
                 return self._block_fn(layer_params, carry), None
             h, _ = lax.scan(body, h, params["layers"])
             return self._head_loss_fn(params["head"], h, batch)
+        return float(f(params_host))
+
+    def sequential_loss_microbatched(self, batch):
+        """Unpipelined reference for the 1F1B loss: full batch through
+        the layer stack on one device, then the mean of per-microbatch
+        head losses accumulated in microbatch order — the exact float
+        summation the 1F1B schedule performs, so the two agree
+        bit-for-bit."""
+        params_host = jax.tree_util.tree_map(_np.asarray, self.params)
+        m = self.m
+
+        def f(params):
+            h = self._embed_fn(params["embed"], batch)
+
+            def body(carry, layer_params):
+                return self._block_fn(layer_params, carry), None
+            h, _ = lax.scan(body, h, params["layers"])
+            hm = h.reshape((m, h.shape[0] // m) + h.shape[1:])
+            batch_mbs = jax.tree_util.tree_map(
+                lambda a: _np.reshape(
+                    _np.asarray(a),
+                    (m, a.shape[0] // m) + tuple(a.shape[1:])), batch)
+            loss_sum = jnp.zeros((), hm.dtype)
+            for j in range(m):
+                bmb = jax.tree_util.tree_map(lambda a: a[j], batch_mbs)
+                loss_sum = loss_sum + self._head_loss_fn(
+                    params["head"], hm[j], bmb).astype(hm.dtype)
+            return loss_sum / m
         return float(f(params_host))

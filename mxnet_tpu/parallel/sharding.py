@@ -22,7 +22,37 @@ import re
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["ShardingRules", "param_pspec", "batch_pspec", "named_pspecs",
+           "parse_sharding",
            "put_local_sharded", "put_replicated_host"]
+
+
+#: the compact sharding-rule grammar shared by the autotuner and the
+#: CLIs: ``dpN`` / ``fsdpN`` / ``tpN`` / ``ppN`` / ``epN`` concatenated
+#: in that order ("dp2tp2", "dp2pp4", "fsdp8ep4", ...)
+_SHARDING_RE = re.compile(
+    r"^(?:(fsdp|dp)(\d+))?(?:tp(\d+))?(?:pp(\d+))?(?:ep(\d+))?$")
+
+
+def parse_sharding(rule):
+    """``"dp1" | "fsdp8" | "dp2tp2" | "dp2pp4" | "ep4" | "dp2pp2ep2"``
+    -> ``{"dp": n, "tp": m, "pp": k, "ep": e, "fsdp": bool}``.
+
+    dp shards the batch, tp the hidden axis, pp splits the layer stack
+    into pipeline stages (GPipe/1F1B — parallel.pipeline), ep shards
+    the MoE expert stacks (ops/moe.py), and fsdp additionally shards
+    param/grad/optimizer state across the dp axis (ZeRO-3).  Axis
+    degrees multiply into the mesh world size."""
+    m = _SHARDING_RE.match(str(rule or "dp1").strip())
+    if not m or not any(m.group(i) for i in (1, 3, 4, 5)):
+        raise ValueError("bad sharding rule %r (want dpN / fsdpN / tpN "
+                         "/ ppN / epN concatenated, e.g. dp2pp4)"
+                         % (rule,))
+    kind, dp, tp, pp, ep = (m.group(i) for i in range(1, 6))
+    return {"dp": int(dp) if dp else 1,
+            "tp": int(tp) if tp else 1,
+            "pp": int(pp) if pp else 1,
+            "ep": int(ep) if ep else 1,
+            "fsdp": kind == "fsdp"}
 
 
 def put_local_sharded(value, sharding):

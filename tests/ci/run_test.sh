@@ -234,6 +234,45 @@ print("kernel-tier MXL-K sweep OK "
         echo "FIXTURE $file missing $rule:"; echo "$out"; exit 1; }
       echo "fixture $file flagged with $rule (expected-fail OK)"
     done
+    # schedule lint (docs/graph_lint.md MXL-E): the pipeline-parallel
+    # transformer sweep (dp=2,pp=4 flops-balanced auto-split) and the
+    # expert-parallel MoE sweep (top-1 routing, ep=4, the priced
+    # dispatch/combine all-to-all pair replayed through the MXL-D
+    # collective trace at world 4) must both price clean
+    JAX_PLATFORMS=cpu python tools/mxlint.py --model transformer \
+      --mesh dp=2,pp=4 --schedule --fail-on=error --format=github
+    JAX_PLATFORMS=cpu python tools/mxlint.py --model transformer_moe \
+      --mesh dp=1,ep=4 --schedule --distributed --world-size 4 \
+      --fail-on=error --format=github
+    # the MXL-E analyzer, the MoE op and the 1F1B runtime are
+    # themselves lint subjects: pin the divergence/concurrency/retrace
+    # self-lints on them so the pricing machinery stays clean under
+    # the families that police it
+    JAX_PLATFORMS=cpu python tools/mxlint.py --distributed \
+      --concurrency --retrace mxnet_tpu/analysis/schedule.py \
+      mxnet_tpu/ops/moe.py mxnet_tpu/parallel/pipeline.py \
+      --fail-on=error --format=github
+    # the pre-fix schedule regression fixtures are expected-FAIL
+    # symbol graphs: MXL-E must keep flagging each with its
+    # documented rule id (an imbalanced ctx_group split, a
+    # cross-stage back-edge, an expert count the ep mesh cannot
+    # divide)
+    sx=tests/fixtures/schedule
+    for f in "$sx/imbalanced_stages.json|MXL-E001|data=(256,4096)|" \
+             "$sx/cross_stage_backedge.json|MXL-E003|data=(256,4096)|" \
+             "$sx/indivisible_experts.json|MXL-E006|data=(512,64)|ep=4"
+    do
+      IFS='|' read -r file rule shapes mesh <<< "$f"
+      cmd=(tools/mxlint.py "$file" --schedule --shapes "$shapes"
+           --fail-on=error --format=github)
+      [ -n "$mesh" ] && cmd+=(--mesh "$mesh")
+      if out=$(JAX_PLATFORMS=cpu python "${cmd[@]}"); then
+        echo "FIXTURE NOT FLAGGED: $file"; exit 1
+      fi
+      echo "$out" | grep -q "$rule" || {
+        echo "FIXTURE $file missing $rule:"; echo "$out"; exit 1; }
+      echo "fixture $file flagged with $rule (expected-fail OK)"
+    done
     ;;
   python)
     make -s all || echo "native build unavailable; python fallback"
@@ -453,6 +492,34 @@ print("autotune dp2tp2 transformer OK: %d configs, ici %.1f MB at top"
       % (len(man["configs"]),
          man["configs"][0]["predicted"]["ici_bytes"] / 1e6))
 ' "$ATDIR/tfm.a.json"
+    # pipeline/MoE axes (docs/graph_lint.md MXL-E): the dp2pp2 sweep
+    # must price with a simulated 1F1B bubble, the indivisible expert
+    # count must be mxl-e-pruned before pricing, and the manifest must
+    # stay byte-identical over the new axes
+    JAX_PLATFORMS=cpu python tools/autotune.py --model transformer_moe \
+      --space "sharding=dp2pp2,ep4;batch=8;microbatches=4,8;experts=8,6;capacity_factor=1.25" \
+      -o "$ATDIR/moe.a.json"
+    JAX_PLATFORMS=cpu python tools/autotune.py --model transformer_moe \
+      --space "sharding=dp2pp2,ep4;batch=8;microbatches=4,8;experts=8,6;capacity_factor=1.25" \
+      -o "$ATDIR/moe.b.json"
+    cmp "$ATDIR/moe.a.json" "$ATDIR/moe.b.json"
+    python -c '
+import json, sys
+man = json.load(open(sys.argv[1]))
+piped = [e for e in man["configs"] if e["config"]["sharding"] == "dp2pp2"]
+assert piped, [e["config"] for e in man["configs"]]
+for e in piped:
+    b = e["predicted"]["bubble_fraction"]
+    assert b is not None and 0.0 < b < 1.0, e["predicted"]
+    assert "BENCH_PP_STAGES=2" in e["bench_cmd"], e["bench_cmd"]
+bad = [p for p in man["pruned"] if p["config"].get("experts") == 6
+       and p["config"]["sharding"] == "ep4"]
+assert bad and all(p["reason"].startswith("mxl-e:") for p in bad), \
+    man["pruned"]
+print("autotune pp/MoE axes OK: %d pipelined configs priced with "
+      "bubbles, %d expert-indivisible config(s) mxl-e-pruned"
+      % (len(piped), len(bad)))
+' "$ATDIR/moe.a.json"
     # replay gate over the pinned fixture: the recorded chip-window
     # payloads must pass the slo sentry clean against the committed
     # BENCH_r05 baseline, fit a correction, and emit a corrected order

@@ -166,14 +166,24 @@ def test_transformer_dp2tp2_search_prices_with_ici_bytes():
 # grammar
 # ----------------------------------------------------------------------
 def test_parse_sharding_grammar():
-    assert at.parse_sharding("dp1") == {"dp": 1, "tp": 1, "fsdp": False}
-    assert at.parse_sharding("dp2tp2") == {"dp": 2, "tp": 2,
-                                           "fsdp": False}
-    assert at.parse_sharding("fsdp8") == {"dp": 8, "tp": 1,
-                                          "fsdp": True}
-    assert at.parse_sharding("tp4") == {"dp": 1, "tp": 4, "fsdp": False}
+    base = {"dp": 1, "tp": 1, "pp": 1, "ep": 1, "fsdp": False}
+    assert at.parse_sharding("dp1") == base
+    assert at.parse_sharding("dp2tp2") == dict(base, dp=2, tp=2)
+    assert at.parse_sharding("fsdp8") == dict(base, dp=8, fsdp=True)
+    assert at.parse_sharding("tp4") == dict(base, tp=4)
+    # pipeline + expert axes ride the same grammar (MXL-E configs)
+    assert at.parse_sharding("dp2pp4") == dict(base, dp=2, pp=4)
+    assert at.parse_sharding("ep4") == dict(base, ep=4)
+    assert at.parse_sharding("dp2pp2ep2") == dict(base, dp=2, pp=2,
+                                                  ep=2)
+    # the canonical parser lives with the sharding rules; the tuner
+    # re-exports the same function
+    from mxnet_tpu.parallel import parse_sharding as canonical
+    assert canonical is at.parse_sharding
     with pytest.raises(ValueError):
         at.parse_sharding("zp3")
+    with pytest.raises(ValueError):
+        at.parse_sharding("pp4dp2")  # grammar order is fixed
 
 
 def test_parse_space_rejects_unknown_axis():
@@ -208,13 +218,91 @@ def test_manifest_is_deterministic():
 
 def test_config_id_is_content_hash():
     cfg = dict(zip(at.AXES, (256, "none", "dp1", "bfloat16", 25, 2,
-                             None, None)))
+                             None, None, None, 8, None, None)))
+    assert len(cfg) == len(at.AXES)
     cfg["model"] = "resnet50"
     a = at.config_id(cfg)
     assert a == at.config_id(dict(cfg))
     cfg2 = dict(cfg, batch=512)
     assert a != at.config_id(cfg2)
+    # the new pipeline/MoE axes are part of the hashed identity
+    assert a != at.config_id(dict(cfg, stages=4))
+    assert a != at.config_id(dict(cfg, experts=8))
     assert a.startswith("at-")
+
+
+def test_manifest_deterministic_over_pipeline_and_moe_axes():
+    # the new pp/MoE axes must not break same-inputs -> byte-identical
+    # manifests: two independent sweeps (fresh memo each) over stages,
+    # microbatches, experts and capacity_factor
+    outs = []
+    for _ in range(2):
+        space = at.parse_space(
+            "batch=8;remat=none;sharding=dp2pp2,ep4;microbatches=4,8;"
+            "experts=none,8;capacity_factor=none,1.25")
+        res = at.search("transformer_moe", device_kind="v5e",
+                        space=space)
+        man = at.build_manifest(res, top_k=16,
+                                provenance={"tool": "test"})
+        outs.append(at.canonical_json(man))
+    assert outs[0] == outs[1]
+    man = json.loads(outs[0])
+    # a pipelined entry carries its simulated bubble and the pipeline
+    # bench envs; an MoE entry carries the expert envs
+    piped = [c for c in man["configs"]
+             if c["config"]["sharding"] == "dp2pp2"]
+    assert piped, [c["config"] for c in man["configs"]]
+    for c in piped:
+        assert c["predicted"]["bubble_fraction"] is not None
+        assert "BENCH_PP_STAGES=2" in c["bench_cmd"]
+        assert ("BENCH_MICROBATCHES=%d"
+                % c["config"]["microbatches"]) in c["bench_cmd"]
+    moe = [c for c in man["configs"] if c["config"]["experts"]]
+    for c in moe:
+        assert "BENCH_MOE_EXPERTS=8" in c["bench_cmd"]
+
+
+def test_mxl_e_infeasible_pruned_before_pricing():
+    memo = at.GraphMemo(device_kind="v5e")
+    # 6 experts over an ep=4 mesh axis: MXL-E006 (indivisible experts)
+    # must reject the config before the roofline prices it
+    space = at.parse_space("batch=8;remat=none;sharding=ep4;"
+                           "experts=6;capacity_factor=1.25")
+    res = at.search("transformer_moe", device_kind="v5e", space=space,
+                    memo=memo)
+    assert res["counts"]["priced"] == 0
+    assert res["counts"]["pruned"] == 1
+    assert res["pruned"][0]["reason"].startswith("mxl-e:")
+    assert "MXL-E006" not in res["pruned"][0]["reason"]  # message only
+    # pruned BEFORE pricing: the memoized context ran the schedule
+    # rules but the roofline report was never computed
+    (_key, ctx), = memo._ctxs.items()
+    assert "autotune_mxl_e" in ctx.cache
+    assert "roofline_report" not in ctx.cache
+    # the schedule gate memoizes: re-pruning the same config re-uses
+    # the cached rule run (analyses stays 1)
+    assert at.prune_config("transformer_moe", res["pruned"][0]["config"],
+                           memo, res["hbm_budget_bytes"]) \
+        .startswith("mxl-e:")
+    assert memo.stats["analyses"] == 1
+
+
+def test_pipeline_config_priced_with_bubble_scaled_ceiling():
+    # a feasible pp=2 transformer prices with a 1F1B bubble fraction
+    # and a ceiling strictly below the unpipelined one
+    memo = at.GraphMemo(device_kind="v5e")
+    space = at.parse_space("batch=8;remat=none;sharding=dp2,dp2pp2")
+    res = at.search("transformer", device_kind="v5e", space=space,
+                    memo=memo)
+    by_shard = {e["config"]["sharding"]: e["predicted"]
+                for e in res["entries"]}
+    assert set(by_shard) == {"dp2", "dp2pp2"}, \
+        [p["reason"] for p in res["pruned"]]
+    assert by_shard["dp2"]["bubble_fraction"] is None
+    bubble = by_shard["dp2pp2"]["bubble_fraction"]
+    assert 0.0 < bubble < 1.0
+    assert by_shard["dp2pp2"]["mfu_ceiling"] < \
+        by_shard["dp2"]["mfu_ceiling"]
 
 
 def test_fit_correction_and_rerank():

@@ -220,3 +220,67 @@ def test_pipeline_checkpoint_resume(tmp_path):
     assert fresh.num_update == 3
     got_next = fresh.step(batch)
     assert abs(got_next - ref_next) < 1e-6, (got_next, ref_next)
+
+
+# ----------------------------------------------------------------------
+# 1F1B: loss parity + the predicted-vs-measured bubble drill
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("micro", [4, 8])
+def test_1f1b_matches_microbatched_sequential(micro):
+    """1F1B on a 4-stage CPU mesh: the loss is BIT-identical to the
+    unpipelined microbatched reference (same float summation order),
+    and training descends."""
+    rs = np.random.RandomState(1)
+    mesh = make_mesh(jax.devices()[:4], pp=4)
+    opt = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9)
+    tr = GPipeTrainer(_embed, _block, _head_loss, _params(rs, 4),
+                      mesh, opt, num_microbatches=micro,
+                      schedule="1f1b")
+    batch = _batch(rs, micro * 4)
+    ref = tr.sequential_loss_microbatched(batch)
+    got = tr.step(batch)
+    assert got == ref, (got, ref)
+    for _ in range(8):
+        last = tr.step(batch)
+    assert last < got
+    ref_now = tr.sequential_loss_microbatched(batch)
+    assert tr.step(batch) == ref_now
+
+
+@pytest.mark.parametrize("micro", [4, 8])
+def test_1f1b_predicted_bubble_tracks_measured(monkeypatch, micro):
+    """The acceptance drill: the analyzer's slot-synchronous 1F1B
+    simulation (MXL-E, over a 4-stage ctx_group graph) predicts the
+    bubble the runtime's compiled tables measure, within 15% relative.
+    Predicted comes from roofline-priced stage times (fwd = t/3,
+    bwd = 2t/3 in training), measured from schedule_occupancy's
+    fwd=1/bwd=2 slot weights over the SAME build_1f1b_tables — so the
+    drill pins the whole pricing chain, not just the table shape."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.analysis import analyze
+    from mxnet_tpu.analysis.schedule import schedule_report
+
+    monkeypatch.setenv("MXTPU_LINT_MICROBATCHES", str(micro))
+    data = mx.sym.Variable("data")
+    h = data
+    for s in range(4):
+        with mx.AttrScope(ctx_group="pp%d" % s):
+            h = mx.sym.FullyConnected(data=h, num_hidden=4096,
+                                      name="fc%d" % s)
+    ctxs = []
+    analyze(h, shapes={"data": (256, 4096)}, _ctx_out=ctxs)
+    predicted = schedule_report(ctxs[0])["schedules"]["1f1b"][
+        "bubble_fraction"]
+
+    rs = np.random.RandomState(2)
+    mesh = make_mesh(jax.devices()[:4], pp=4)
+    opt = opt_mod.create("sgd", learning_rate=0.1)
+    tr = GPipeTrainer(_embed, _block, _head_loss, _params(rs, 4),
+                      mesh, opt, num_microbatches=micro,
+                      schedule="1f1b")
+    tr.step(_batch(rs, micro * 4))    # compiles + emits the tables
+    measured = tr.schedule_occupancy()["bubble_fraction"]
+
+    assert measured > 0.0
+    assert abs(predicted - measured) / measured < 0.15, \
+        (predicted, measured)

@@ -1,0 +1,281 @@
+"""mxnet_tpu/analysis/schedule.py: the MXL-E static schedule lint.
+
+Partition resolution (ctx_group first-appearance vs pp flops-balanced),
+the slot-synchronous simulator against closed forms, and every rule
+E001..E008 firing on a known-bad graph while staying silent on clean /
+toy-sized ones.  The 1F1B tables come from parallel.pipeline — the same
+tables the runtime compiles — so these tests also pin that contract.
+"""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.analysis import analyze
+from mxnet_tpu.analysis.schedule import (gpipe_kind_rows, schedule_report,
+                                         simulate_schedule, stage_partition)
+from mxnet_tpu.parallel import LogicalMesh
+
+
+def _ids(issues):
+    return {i.rule_id for i in issues}
+
+
+def _only(issues, rule_id):
+    return [i for i in issues if i.rule_id == rule_id]
+
+
+# ----------------------------------------------------------------------
+# graph builders
+# ----------------------------------------------------------------------
+def _balanced_pipeline(hidden=4096, per_stage=2):
+    """Two ctx_group stages, ``per_stage`` equal FCs each."""
+    data = mx.sym.Variable("data")
+    h = data
+    i = 0
+    for g in ("pp0", "pp1"):
+        with mx.AttrScope(ctx_group=g):
+            for _ in range(per_stage):
+                h = mx.sym.FullyConnected(data=h, num_hidden=hidden,
+                                          name="fc%d" % i)
+                i += 1
+    return h
+
+
+def _imbalanced_pipeline():
+    """pp0 holds one FC, pp1 holds four: 4x stage imbalance."""
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="pp0"):
+        h = mx.sym.FullyConnected(data=data, num_hidden=4096, name="fc0")
+    with mx.AttrScope(ctx_group="pp1"):
+        for i in range(1, 5):
+            h = mx.sym.FullyConnected(data=h, num_hidden=4096,
+                                      name="fc%d" % i)
+    return h
+
+
+def _backedge_pipeline():
+    """pp0 -> pp1 -> pp0: the last FC returns to the earlier group."""
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="pp0"):
+        a = mx.sym.FullyConnected(data=data, num_hidden=256, name="fc_a")
+    with mx.AttrScope(ctx_group="pp1"):
+        b = mx.sym.FullyConnected(data=a, num_hidden=256, name="fc_b")
+    with mx.AttrScope(ctx_group="pp0"):
+        c = mx.sym.FullyConnected(data=b, num_hidden=256, name="fc_c")
+    return c
+
+
+def _moe_net(num_experts, capacity_factor, hidden_size=128):
+    data = mx.sym.Variable("data")
+    return mx.sym.MoE(data=data, num_experts=num_experts,
+                      hidden_size=hidden_size, top_k=1,
+                      capacity_factor=capacity_factor, name="moe")
+
+
+_BIG = {"data": (256, 4096)}
+
+
+# ----------------------------------------------------------------------
+# stage partition
+# ----------------------------------------------------------------------
+def test_partition_ctx_group_first_appearance_order():
+    ctxs = []
+    analyze(_imbalanced_pipeline(), shapes=_BIG, _ctx_out=ctxs)
+    part = stage_partition(ctxs[0])
+    assert part["mode"] == "ctx_group"
+    assert part["k"] == 2
+    assert part["groups"] == ["pp0", "pp1"]
+    assert part["stage_of"]["fc0"] == 0
+    assert all(part["stage_of"]["fc%d" % i] == 1 for i in range(1, 5))
+
+
+def test_partition_pp_axis_flops_balanced():
+    """No ctx_group attrs + a pp mesh axis: contiguous balanced cut."""
+    net = mx.models.get_mlp()
+    ctxs = []
+    analyze(net, shapes={"data": (32, 784)},
+            mesh=LogicalMesh(dp=1, pp=2), _ctx_out=ctxs)
+    part = stage_partition(ctxs[0])
+    assert part["mode"] == "pp"
+    assert part["k"] == 2
+    assert all(len(s) >= 1 for s in part["stages"])
+    # contiguous: stage index never decreases along the topo order
+    seen = [part["stage_of"][n] for s in part["stages"] for n in s]
+    assert seen == sorted(seen)
+
+
+def test_partition_none_without_groups_or_pp():
+    ctxs = []
+    analyze(mx.models.get_mlp(), shapes={"data": (32, 784)},
+            _ctx_out=ctxs)
+    assert stage_partition(ctxs[0]) is None
+
+
+# ----------------------------------------------------------------------
+# the slot-synchronous simulator: closed forms
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("k,m,expect", [
+    (2, 2, 0.4), (4, 4, 0.5), (4, 8, 0.4), (2, 6, 0.3077)])
+def test_1f1b_bubble_closed_forms(k, m, expect):
+    from mxnet_tpu.analysis.schedule import _1f1b_kind_rows
+    sim = simulate_schedule(_1f1b_kind_rows(k, m), [1.0] * k, [2.0] * k)
+    assert sim["bubble_fraction"] == pytest.approx(expect, abs=1e-4)
+
+
+@pytest.mark.parametrize("k,m", [(2, 4), (4, 4), (4, 8)])
+def test_gpipe_bubble_closed_form(k, m):
+    sim = simulate_schedule(gpipe_kind_rows(k, m), [1.0] * k, [2.0] * k)
+    assert sim["bubble_fraction"] == \
+        pytest.approx((k - 1) / (m + k - 1.0), abs=1e-9)
+
+
+def test_more_microbatches_shrink_the_bubble():
+    from mxnet_tpu.analysis.schedule import _1f1b_kind_rows
+    bubbles = [simulate_schedule(_1f1b_kind_rows(4, m), [1.0] * 4,
+                                 [2.0] * 4)["bubble_fraction"]
+               for m in (4, 8, 16)]
+    assert bubbles == sorted(bubbles, reverse=True)
+
+
+def test_transfer_dominated_slot_costs_the_transfer():
+    sim = simulate_schedule(gpipe_kind_rows(2, 2), [1.0] * 2, [2.0] * 2,
+                            xfer=10.0)
+    assert sim["total_time"] == pytest.approx(10.0 * sim["slots"])
+
+
+# ----------------------------------------------------------------------
+# the report
+# ----------------------------------------------------------------------
+def test_schedule_report_prices_both_schedules():
+    ctxs = []
+    analyze(_balanced_pipeline(), shapes=_BIG, _ctx_out=ctxs)
+    rep = schedule_report(ctxs[0])
+    assert rep["partition"]["k"] == 2
+    assert set(rep["schedules"]) == {"gpipe", "1f1b"}
+    for sim in rep["schedules"].values():
+        assert 0.0 <= sim["bubble_fraction"] < 1.0
+    assert len(rep["stage_hbm"]) == 2
+    # 1F1B stash: stage s holds at most K - s microbatches, never more
+    # than GPipe's full M
+    for h in rep["stage_hbm"]:
+        assert h["stash_1f1b"] <= h["stash_gpipe"]
+        assert h["peak_1f1b"] <= h["peak_gpipe"]
+    assert rep["back_edges"] == []
+    assert rep["boundaries"] and rep["boundaries"][0]["bytes"] > 0
+
+
+def test_schedule_report_none_without_pipeline_or_moe():
+    ctxs = []
+    analyze(mx.models.get_mlp(), shapes={"data": (32, 784)},
+            _ctx_out=ctxs)
+    assert schedule_report(ctxs[0]) is None
+
+
+# ----------------------------------------------------------------------
+# rules: pipeline
+# ----------------------------------------------------------------------
+def test_e001_stage_imbalance_fires_and_names_the_stage():
+    issues = _only(analyze(_imbalanced_pipeline(), shapes=_BIG),
+                   "MXL-E001")
+    assert issues, "expected a stage-imbalance finding"
+    assert "stage 1" in issues[0].message
+    assert "MXTPU_LINT_STAGE_IMBALANCE" in issues[0].message
+
+
+def test_e001_silent_on_balanced_stages():
+    assert not _only(analyze(_balanced_pipeline(), shapes=_BIG),
+                     "MXL-E001")
+
+
+def test_e001_silent_below_flops_floor():
+    """The same 4x imbalance on a toy graph stays quiet."""
+    net = _imbalanced_pipeline()
+    issues = analyze(net, shapes={"data": (8, 16)})
+    assert not _ids(issues) & {"MXL-E001", "MXL-E002", "MXL-E005"}
+
+
+def test_e002_bubble_overrun_names_the_fix(monkeypatch):
+    monkeypatch.setenv("MXTPU_LINT_MICROBATCHES", "1")
+    issues = _only(analyze(_balanced_pipeline(), shapes=_BIG),
+                   "MXL-E002")
+    assert issues, "expected a bubble finding at 1 microbatch"
+    assert "microbatches would reach the bound" in issues[0].message \
+        or "rebalance stages first" in issues[0].message
+
+
+def test_e002_silent_at_ample_microbatches(monkeypatch):
+    monkeypatch.setenv("MXTPU_LINT_MICROBATCHES", "64")
+    assert not _only(analyze(_balanced_pipeline(), shapes=_BIG),
+                     "MXL-E002")
+
+
+def test_e003_cross_stage_backedge():
+    issues = _only(analyze(_backedge_pipeline(),
+                           shapes={"data": (8, 256)}), "MXL-E003")
+    assert issues, "expected a back-edge finding"
+    assert "fc_c" in issues[0].message
+    assert "deadlock" in issues[0].message
+
+
+def test_e004_activation_stash_overflow():
+    issues = _only(analyze(_balanced_pipeline(), shapes=_BIG,
+                           hbm_bytes=1 << 20), "MXL-E004")
+    assert issues, "expected a stash-HBM finding at a 1MiB budget"
+    assert "stashed microbatch activations" in issues[0].message
+
+
+def test_e005_ici_bound_seam(monkeypatch):
+    monkeypatch.setenv("MXTPU_LINT_ICI_GBPS", "0.0001")
+    issues = _only(analyze(_balanced_pipeline(), shapes=_BIG),
+                   "MXL-E005")
+    assert issues, "expected an ICI-bound boundary finding"
+    assert "cannot hide under compute" in issues[0].message
+
+
+def test_kill_switch_disables_the_family(monkeypatch):
+    monkeypatch.setenv("MXTPU_LINT_SCHEDULE", "0")
+    issues = analyze(_imbalanced_pipeline(), shapes=_BIG)
+    assert not {i for i in _ids(issues) if i.startswith("MXL-E")}
+
+
+# ----------------------------------------------------------------------
+# rules: MoE
+# ----------------------------------------------------------------------
+def test_e006_indivisible_experts():
+    issues = _only(analyze(_moe_net(6, 1.25), shapes={"data": (512, 64)},
+                           mesh=LogicalMesh(ep=4)), "MXL-E006")
+    assert issues, "expected an expert-divisibility finding"
+    assert "6 experts" in issues[0].message
+
+
+def test_e006_silent_when_divisible():
+    assert not _only(analyze(_moe_net(8, 1.25),
+                             shapes={"data": (512, 64)},
+                             mesh=LogicalMesh(ep=4)), "MXL-E006")
+
+
+def test_e007_capacity_factor_under_one():
+    issues = _only(analyze(_moe_net(8, 0.5), shapes={"data": (512, 64)}),
+                   "MXL-E007")
+    assert issues, "expected a token-drop finding at cf=0.5"
+    assert "dropped" in issues[0].message
+
+
+def test_e007_silent_at_unbounded_capacity():
+    """cf=0 means unbounded expert buffers: nothing can drop."""
+    assert not _only(analyze(_moe_net(8, 0.0),
+                             shapes={"data": (512, 64)}), "MXL-E007")
+
+
+def test_e008_prices_the_alltoall_and_replays_mxl_d():
+    issues = _only(analyze(_moe_net(8, 1.25), shapes={"data": (512, 64)},
+                           mesh=LogicalMesh(ep=4), world_size=4),
+                   "MXL-E008")
+    assert issues, "expected the all-to-all pricing info"
+    assert issues[0].severity == "info"
+    assert "all-to-all" in issues[0].message
+    assert "MXL-D collective trace" in issues[0].message
+
+
+def test_e008_silent_without_ep_axis():
+    assert not _only(analyze(_moe_net(8, 1.25),
+                             shapes={"data": (512, 64)}), "MXL-E008")

@@ -236,11 +236,14 @@ def main(argv=None):
         prog="autotune", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--model", default="resnet50",
-                    help="resnetNN or transformer (default resnet50)")
+                    help="resnetNN, transformer or transformer_moe "
+                         "(default resnet50)")
     ap.add_argument("--device-kind", default="v5e")
     ap.add_argument("--space", default=None,
                     help='grammar string, e.g. "batch=64,512;'
-                         'remat=none,blocks;sharding=dp1,dp2tp2"')
+                         'remat=none,blocks;sharding=dp1,dp2tp2,dp2pp4;'
+                         'stages=2,4;microbatches=4,8;experts=4,8;'
+                         'capacity_factor=1.25"')
     ap.add_argument("--hbm-gb", type=float, default=None,
                     help="override the device HBM budget")
     ap.add_argument("--top-k", type=int, default=8)

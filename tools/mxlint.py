@@ -98,6 +98,10 @@ MODEL_SWEEP = [
      {"vocab_size": 512, "num_layers": 2, "num_heads": 4, "dim": 64,
       "seq_len": 64},
      {"data": (2, 64), "softmax_label": (2, 64)}),
+    ("transformer_moe",
+     {"vocab_size": 512, "num_layers": 2, "num_heads": 4, "dim": 64,
+      "seq_len": 64, "num_experts": 4},
+     {"data": (2, 64), "softmax_label": (2, 64)}),
 ]
 
 
@@ -354,6 +358,55 @@ def roofline_report_lines(ctx):
     return lines
 
 
+def schedule_report_lines(ctx):
+    """The static pipeline/MoE schedule section (text mode)."""
+    from mxnet_tpu.analysis import schedule_report
+    from mxnet_tpu.analysis.propagation import fmt_bytes
+    rep = schedule_report(ctx)
+    if rep is None:
+        return ["-- schedule: no pipeline partition or MoE nodes"]
+    lines = []
+    if rep["partition"] is not None:
+        lines.append("-- schedule (%s, %d stages x %d microbatches):"
+                     % (rep["partition"]["mode"], rep["partition"]["k"],
+                        rep["microbatches"]))
+        for s in rep["stages"]:
+            lines.append("   stage %d (%-8s) %3d ops  %8.2f GF  "
+                         "fwd %.3f ms  bwd %.3f ms"
+                         % (s["index"], s["group"], s["ops"],
+                            s["flops"] / 1e9, s["t_fwd_s"] * 1e3,
+                            s["t_bwd_s"] * 1e3))
+        for e in rep["boundaries"]:
+            lines.append("   boundary %d->%d  %9s  %.3f ms"
+                         % (e["src"], e["dst"], fmt_bytes(e["bytes"]),
+                            e["time_s"] * 1e3))
+        for name, sim in sorted(rep["schedules"].items()):
+            lines.append("   %-6s bubble %.3f  (%d slots, %.3f ms/step)"
+                         % (name, sim["bubble_fraction"], sim["slots"],
+                            sim["total_time"] * 1e3))
+        for h in rep["stage_hbm"]:
+            lines.append("   stage %d HBM: params+grads %s + stash "
+                         "%dx%s = %s (1f1b)"
+                         % (h["index"], fmt_bytes(h["param_bytes"]),
+                            h["stash_1f1b"],
+                            fmt_bytes(h["act_per_microbatch"]),
+                            fmt_bytes(h["peak_1f1b"])))
+    for s in rep["moe"]:
+        lines.append("-- moe %s: %d experts top-%d cf=%.2f  "
+                     "capacity %s/expert  balance %s"
+                     % (s["node"], s["num_experts"], s["top_k"],
+                        s["capacity_factor"],
+                        s["capacity"] if s["capacity"] else "inf",
+                        ("%.2f" % s["expert_balance"])
+                        if s["expert_balance"] is not None else "-"))
+    return lines
+
+
+def schedule_report_dict(ctx):
+    from mxnet_tpu.analysis import schedule_report
+    return schedule_report(ctx)
+
+
 def _baseline_key(label, rule_id, where, message):
     """``where`` is the stable location: the file:qualname anchor when
     the finding has one, else the node name — never a line number, so
@@ -461,6 +514,14 @@ def main(argv=None):
     ap.add_argument("--roofline", action="store_true",
                     help="print the static roofline / MFU-ceiling report "
                          "per graph (text mode; implied by --mesh)")
+    ap.add_argument("--schedule", action="store_true",
+                    help="print the static pipeline/MoE schedule report "
+                         "(MXL-E): per-stage roofline pricing, GPipe + "
+                         "1F1B bubble fractions, activation-stash HBM, "
+                         "expert routing stats")
+    ap.add_argument("--microbatches", type=int, default=None, metavar="M",
+                    help="microbatch count the schedule simulator walks "
+                         "(default MXTPU_LINT_MICROBATCHES, else 8)")
     ap.add_argument("--distributed", action="store_true",
                     help="enable the MXL-D distributed family: per-rank "
                          "collective-trace diff on graphs (D001..003) "
@@ -572,6 +633,10 @@ def main(argv=None):
         spmd["world_size"] = world_size
     if args.update_baseline and not args.baseline:
         ap.error("--update-baseline needs --baseline FILE")
+    if args.microbatches is not None:
+        if args.microbatches < 1:
+            ap.error("--microbatches must be >= 1")
+        os.environ["MXTPU_LINT_MICROBATCHES"] = str(args.microbatches)
 
     # each --select/--skip may itself be comma-separated
     select = {p.strip() for s in args.select for p in s.split(",")
@@ -651,6 +716,9 @@ def main(argv=None):
                     and ctx.target == "tpu":
                 from mxnet_tpu.analysis import roofline_report
                 entry["roofline"] = roofline_report(ctx)
+            if args.schedule and ctx is not None and \
+                    ctx.symbol is not None and ctx.target == "tpu":
+                entry["schedule"] = schedule_report_dict(ctx)
             doc.append(entry)
         print(json.dumps(doc, indent=2))
     for label, issues, ctx in targets:
@@ -667,6 +735,10 @@ def main(argv=None):
             if roofline and ctx is not None and ctx.symbol is not None \
                     and ctx.target == "tpu":
                 for line in roofline_report_lines(ctx):
+                    print(line)
+            if args.schedule and ctx is not None and \
+                    ctx.symbol is not None and ctx.target == "tpu":
+                for line in schedule_report_lines(ctx):
                     print(line)
         elif args.fmt == "github":
             for i in issues:

@@ -116,6 +116,7 @@ def render(report, stream=sys.stdout):
                 or rec.get("path") or ""))
     render_slo(report, stream=stream)
     render_retrace(report, stream=stream)
+    render_schedule(report, stream=stream)
 
 
 def render_serve(report, stream=sys.stdout):
@@ -193,6 +194,27 @@ def render_retrace(report, stream=sys.stdout):
         or "?"))
     for site in rt.get("sites") or []:
         w("      at %s\n" % site)
+
+
+def render_schedule(report, stream=sys.stdout):
+    """Pipeline-schedule pane: the GPipe/1F1B shape the trainer runs
+    (one ``schedule`` record per run), its measured bubble fraction,
+    and the expert load balance when an MoE run reports one — the
+    runtime counterparts of the ``mxlint --schedule`` predictions
+    (docs/graph_lint.md "MXL-E").  Absent keys are skipped, not
+    guessed at."""
+    sc = report.get("schedule") or {}
+    if not sc:
+        return
+    w = stream.write
+    parts = ["SCHEDULE — %s  stages %s  microbatches %s" % (
+        sc.get("schedule", "?"), sc.get("stages", "?"),
+        sc.get("microbatches", "?"))]
+    if sc.get("bubble_fraction") is not None:
+        parts.append("bubble %.1f%%" % (100.0 * sc["bubble_fraction"]))
+    if sc.get("expert_balance") is not None:
+        parts.append("expert balance %.2f" % sc["expert_balance"])
+    w("   ".join(parts) + "\n")
 
 
 def render_fleet(report, stream=sys.stdout):

@@ -206,6 +206,32 @@ def parse_telemetry(path):
                             for ingredient in (r.get("divergent") or [])})
         if divergent:
             overlap_cols["retrace-divergent"] = ",".join(divergent)
+    # pipeline-schedule columns (docs/graph_lint.md "MXL-E"): the
+    # schedule shape the GPipeTrainer emits on first build (one
+    # "schedule" record per run: kind/stages/microbatches + the
+    # measured bubble fraction of its lock-step tables), and the
+    # expert load balance when an MoE run reports one.  Values are
+    # string-tolerant — drills round-trip these through shell/env, so
+    # "0.33" parses like 0.33 and junk is dropped, not crashed on.
+    def _tolerant_float(v):
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return None
+
+    scheds = [r for r in records if r.get("kind") == "schedule"]
+    if scheds:
+        last = scheds[-1]
+        if last.get("schedule"):
+            overlap_cols["pp-schedule"] = "%s k%s m%s" % (
+                last["schedule"], last.get("stages", "?"),
+                last.get("microbatches", "?"))
+        bf = _tolerant_float(last.get("bubble_fraction"))
+        if bf is not None:
+            overlap_cols["bubble-fraction"] = bf
+        eb = _tolerant_float(last.get("expert_balance"))
+        if eb is not None:
+            overlap_cols["expert-balance"] = eb
     # SLO-engine columns (docs/observability.md "Live metrics & SLO
     # engine"): alert count as "N (tier/metric,...)" — a string column
     # like serve-kernel — plus the worst observed burn rate as
@@ -242,6 +268,9 @@ def parse_telemetry(path):
                     or "retraces" in overlap_cols
                     or "slo-alerts" in overlap_cols
                     or "arrival" in overlap_cols
+                    or "pp-schedule" in overlap_cols
+                    or "bubble-fraction" in overlap_cols
+                    or "expert-balance" in overlap_cols
                     or "autotune-config-id" in overlap_cols):
         # serving-/bench-only event stream: one summary row
         acc[0] = {"steps": 0, "dur_ms": [], "sps": []}
